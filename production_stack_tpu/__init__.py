@@ -1,0 +1,20 @@
+"""TPU-native production inference stack.
+
+A Kubernetes-native control plane and TPU serving data plane with the
+capabilities of vLLM Production Stack (reference: /root/reference):
+
+- OpenAI-compatible L7 request router with pluggable routing logic
+  (round-robin, session affinity via consistent hashing, KV-aware).
+- Kubernetes service discovery, dynamic hot-reconfiguration, and a
+  native operator.
+- A JAX/XLA/Pallas serving engine (the reference delegates compute to
+  external vLLM CUDA images; on TPU the stack is standalone).
+- KV-cache offload: TPU HBM -> host DRAM -> remote shared store.
+- Prometheus/Grafana observability keyed on TPU engine metrics.
+
+Reference layer map: see SURVEY.md section 1.
+"""
+
+from production_stack_tpu.version import __version__
+
+__all__ = ["__version__"]

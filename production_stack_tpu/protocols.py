@@ -1,0 +1,93 @@
+"""Pydantic models for the OpenAI-compatible surface.
+
+Reference counterpart: src/vllm_router/protocols.py:7-51.  Extra fields are
+tolerated (the router proxies bodies it does not fully model).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from pydantic import BaseModel, ConfigDict, Field
+
+
+class OpenAIBaseModel(BaseModel):
+    model_config = ConfigDict(extra="allow")
+
+
+class ErrorResponse(OpenAIBaseModel):
+    object: str = "error"
+    message: str
+    type: str
+    param: Optional[str] = None
+    code: int = 400
+
+
+class ModelCard(OpenAIBaseModel):
+    id: str
+    object: str = "model"
+    created: int = Field(default_factory=lambda: int(time.time()))
+    owned_by: str = "production-stack-tpu"
+    root: Optional[str] = None
+    parent: Optional[str] = None
+
+
+class ModelList(OpenAIBaseModel):
+    object: str = "list"
+    data: List[ModelCard] = Field(default_factory=list)
+
+
+class ChatMessage(OpenAIBaseModel):
+    role: str
+    content: Any = None
+
+
+class ChatCompletionRequest(OpenAIBaseModel):
+    model: str
+    messages: List[ChatMessage]
+    stream: bool = False
+    max_tokens: Optional[int] = None
+    max_completion_tokens: Optional[int] = None
+    temperature: Optional[float] = None
+    top_p: Optional[float] = None
+    top_k: Optional[int] = None
+    n: int = 1
+    stop: Optional[Any] = None
+    seed: Optional[int] = None
+    logprobs: Optional[bool] = None
+    top_logprobs: Optional[int] = None
+    presence_penalty: Optional[float] = None
+    frequency_penalty: Optional[float] = None
+    user: Optional[str] = None
+
+
+class CompletionRequest(OpenAIBaseModel):
+    model: str
+    prompt: Any
+    stream: bool = False
+    max_tokens: Optional[int] = None
+    temperature: Optional[float] = None
+    top_p: Optional[float] = None
+    top_k: Optional[int] = None
+    n: int = 1
+    stop: Optional[Any] = None
+    seed: Optional[int] = None
+    echo: bool = False
+    user: Optional[str] = None
+
+
+class UsageInfo(OpenAIBaseModel):
+    prompt_tokens: int = 0
+    completion_tokens: int = 0
+    total_tokens: int = 0
+
+
+class EmbeddingRequest(OpenAIBaseModel):
+    model: str
+    input: Any
+    encoding_format: str = "float"
+
+
+def error_json(message: str, type_: str = "invalid_request_error", code: int = 400) -> Dict[str, Any]:
+    return {"error": {"message": message, "type": type_, "code": code}}

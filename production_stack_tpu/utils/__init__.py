@@ -1,0 +1,28 @@
+"""Shared utilities (reference counterpart: src/vllm_router/utils.py:10-95).
+
+Design deviation from the reference: the reference uses metaclass singletons
+(``SingletonMeta``, utils.py:10-39) which made hot-reconfiguration racy
+(SURVEY.md section 7, "Hot-reconfig correctness").  We use one explicit,
+lock-guarded :class:`ServiceRegistry` instead.
+"""
+
+from production_stack_tpu.utils.registry import ServiceRegistry, registry
+from production_stack_tpu.utils.net import (
+    parse_static_aliases,
+    parse_static_model_types,
+    parse_static_models,
+    parse_static_urls,
+    set_ulimit,
+    validate_url,
+)
+
+__all__ = [
+    "ServiceRegistry",
+    "registry",
+    "validate_url",
+    "parse_static_urls",
+    "parse_static_models",
+    "parse_static_aliases",
+    "parse_static_model_types",
+    "set_ulimit",
+]

@@ -1,0 +1,82 @@
+"""Consistent hash ring for session-affinity routing.
+
+The reference depends on the third-party ``uhashring`` package
+(src/vllm_router/routers/routing_logic.py:10,94-136).  That package is not a
+given on TPU images, and the required surface is tiny, so we implement the
+ring directly: each node is mapped to ``vnodes`` points on a 2^64 ring via
+blake2b; a key routes to the first node clockwise from its hash.  Removing a
+node only remaps keys that landed on that node's points (minimal disruption —
+the invariant the reference tests in src/tests/test_session_router.py:92-135).
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+from typing import Dict, Iterable, List, Optional
+
+
+def _hash(key: str) -> int:
+    digest = hashlib.blake2b(key.encode("utf-8"), digest_size=8).digest()
+    return int.from_bytes(digest, "big")
+
+
+class HashRing:
+    """Consistent hash ring with virtual nodes."""
+
+    def __init__(self, nodes: Optional[Iterable[str]] = None, vnodes: int = 160):
+        self._vnodes = vnodes
+        self._ring: List[int] = []  # sorted hash points
+        self._points: Dict[int, str] = {}  # hash point -> node
+        self._nodes: set = set()
+        for node in nodes or ():
+            self.add_node(node)
+
+    @property
+    def nodes(self) -> set:
+        return set(self._nodes)
+
+    def add_node(self, node: str) -> None:
+        if node in self._nodes:
+            return
+        self._nodes.add(node)
+        for i in range(self._vnodes):
+            point = _hash(f"{node}#{i}")
+            if point in self._points:  # vanishingly rare 64-bit collision
+                continue
+            self._points[point] = node
+            bisect.insort(self._ring, point)
+
+    def remove_node(self, node: str) -> None:
+        if node not in self._nodes:
+            return
+        self._nodes.discard(node)
+        stale = [p for p, n in self._points.items() if n == node]
+        for point in stale:
+            del self._points[point]
+        stale_set = set(stale)
+        self._ring = [p for p in self._ring if p not in stale_set]
+
+    def sync(self, nodes: Iterable[str]) -> None:
+        """Make the ring membership equal *nodes* with minimal churn
+        (reference ring-sync on endpoint churn: routing_logic.py:117-136)."""
+        target = set(nodes)
+        for node in self._nodes - target:
+            self.remove_node(node)
+        for node in target - self._nodes:
+            self.add_node(node)
+
+    def get_node(self, key: str) -> Optional[str]:
+        if not self._ring:
+            return None
+        point = _hash(key)
+        idx = bisect.bisect_right(self._ring, point)
+        if idx == len(self._ring):
+            idx = 0
+        return self._points[self._ring[idx]]
+
+    def __len__(self) -> int:
+        return len(self._nodes)
+
+    def __contains__(self, node: str) -> bool:
+        return node in self._nodes

@@ -1,0 +1,76 @@
+"""URL/CLI parsing helpers and process limits.
+
+Reference counterpart: src/vllm_router/utils.py:42-95 (validate_url,
+parse_static_urls/models, set_ulimit).
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+from typing import Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+_URL_RE = re.compile(
+    r"^(https?)://"  # scheme
+    r"(?:[A-Za-z0-9._~%-]+|\[[0-9A-Fa-f:]+\])"  # host or [ipv6]
+    r"(?::\d{1,5})?"  # optional port
+    r"(?:/.*)?$"  # optional path
+)
+
+
+def validate_url(url: str) -> bool:
+    """True iff *url* looks like an http(s) URL with a host."""
+    return bool(_URL_RE.match(url or ""))
+
+
+def _split_csv(value: Optional[str]) -> List[str]:
+    if not value:
+        return []
+    return [item.strip() for item in value.split(",") if item.strip()]
+
+
+def parse_static_urls(static_backends: str) -> List[str]:
+    urls = _split_csv(static_backends)
+    for url in urls:
+        if not validate_url(url):
+            raise ValueError(f"Invalid backend URL: {url!r}")
+    return urls
+
+
+def parse_static_models(static_models: str) -> List[str]:
+    return _split_csv(static_models)
+
+
+def parse_static_aliases(static_aliases: str) -> Dict[str, str]:
+    """Parse ``alias:model,alias2:model2`` into a dict."""
+    aliases: Dict[str, str] = {}
+    for pair in _split_csv(static_aliases):
+        alias, sep, model = pair.partition(":")
+        if not sep or not alias or not model:
+            raise ValueError(f"Invalid model alias entry: {pair!r}")
+        aliases[alias] = model
+    return aliases
+
+
+def parse_static_model_types(static_model_types: str) -> List[str]:
+    return _split_csv(static_model_types)
+
+
+def set_ulimit(target_soft_limit: int = 65535) -> None:
+    """Raise RLIMIT_NOFILE so the streaming proxy can hold many sockets
+    (reference: src/vllm_router/utils.py:64-79)."""
+    try:
+        import resource
+    except ImportError:  # non-POSIX
+        return
+    soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+    if soft >= target_soft_limit:
+        return
+    try:
+        resource.setrlimit(resource.RLIMIT_NOFILE, (min(target_soft_limit, hard), hard))
+    except ValueError as e:
+        logger.warning(
+            "Could not raise RLIMIT_NOFILE from %d to %d: %s", soft, target_soft_limit, e
+        )

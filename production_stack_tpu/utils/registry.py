@@ -1,0 +1,77 @@
+"""Explicit service registry.
+
+The reference wires its router from module-level metaclass singletons
+(src/vllm_router/utils.py:10-39) and tears them down during dynamic
+reconfiguration by deleting entries from ``SingletonMeta._instances``
+(src/vllm_router/routers/routing_logic.py:189-196), which is racy: a request
+thread can observe a half-rebuilt registry.  Here every service lives in one
+registry guarded by an RLock, and ``replace()`` swaps atomically.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Optional
+
+
+class ServiceRegistry:
+    """Thread-safe named-service registry with atomic replacement."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._services: Dict[str, Any] = {}
+
+    def set(self, name: str, service: Any) -> Any:
+        with self._lock:
+            self._services[name] = service
+        return service
+
+    def get(self, name: str, default: Any = None) -> Any:
+        with self._lock:
+            return self._services.get(name, default)
+
+    def require(self, name: str) -> Any:
+        with self._lock:
+            if name not in self._services:
+                raise KeyError(
+                    f"Service {name!r} has not been initialized "
+                    f"(available: {sorted(self._services)})"
+                )
+            return self._services[name]
+
+    def contains(self, name: str) -> bool:
+        with self._lock:
+            return name in self._services
+
+    def replace(
+        self,
+        name: str,
+        factory: Callable[[], Any],
+        close_old: Optional[Callable[[Any], None]] = None,
+    ) -> Any:
+        """Atomically build a new service and swap it in.
+
+        The old service (if any) is closed *after* the swap so readers never
+        observe a missing service mid-reconfigure.
+        """
+        new = factory()
+        with self._lock:
+            old = self._services.get(name)
+            self._services[name] = new
+        if old is not None and close_old is not None:
+            close_old(old)
+        return new
+
+    def pop(self, name: str) -> Any:
+        with self._lock:
+            return self._services.pop(name, None)
+
+    def reset(self) -> None:
+        """Drop all services (test isolation; reference counterpart is
+        deleting ``SingletonMeta._instances`` entries, src/tests/test_singleton.py:14-60)."""
+        with self._lock:
+            self._services.clear()
+
+
+#: Process-global registry used by the router app.  Tests construct their own.
+registry = ServiceRegistry()

@@ -1,0 +1,3 @@
+"""Single source of the package version (reference: src/vllm_router/version.py)."""
+
+__version__ = "0.1.0"

@@ -1,0 +1,51 @@
+"""Test harness configuration.
+
+Two jobs:
+
+1. Force JAX onto a *virtual 8-device CPU mesh* so every sharding/collective
+   path is exercised without TPU hardware (the driver separately dry-runs the
+   multi-chip path; see __graft_entry__.py).  Must happen before jax import.
+2. Provide asyncio test support without pytest-asyncio: ``async def`` test
+   functions are run via asyncio.run().
+
+Reference test strategy being mirrored: SURVEY.md section 4 (duck-typed fakes,
+fake engine servers on localhost, no accelerator required).
+"""
+
+import asyncio
+import inspect
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+def pytest_pyfunc_call(pyfuncitem):
+    """Run coroutine tests with asyncio.run (stand-in for pytest-asyncio)."""
+    fn = pyfuncitem.obj
+    if inspect.iscoroutinefunction(fn):
+        kwargs = {
+            name: pyfuncitem.funcargs[name]
+            for name in pyfuncitem._fixtureinfo.argnames
+        }
+        asyncio.run(fn(**kwargs))
+        return True
+    return None
+
+
+@pytest.fixture()
+def registry():
+    """Fresh service registry per test (reference resets SingletonMeta._instances,
+    src/tests/test_singleton.py:14-60)."""
+    from production_stack_tpu.utils.registry import ServiceRegistry
+
+    return ServiceRegistry()

@@ -1,0 +1,64 @@
+"""Consistent-hash ring invariants.
+
+Mirrors the affinity/minimal-remap invariants the reference tests for its
+uhashring-based session router (src/tests/test_session_router.py:92-135).
+"""
+
+from collections import Counter
+
+from production_stack_tpu.utils.hashring import HashRing
+
+
+def test_empty_ring_returns_none():
+    assert HashRing().get_node("key") is None
+
+
+def test_single_node_takes_all():
+    ring = HashRing(["a"])
+    assert all(ring.get_node(f"k{i}") == "a" for i in range(100))
+
+
+def test_deterministic():
+    ring = HashRing(["a", "b", "c"])
+    assert [ring.get_node(f"k{i}") for i in range(50)] == [
+        ring.get_node(f"k{i}") for i in range(50)
+    ]
+
+
+def test_distribution_roughly_even():
+    ring = HashRing([f"node{i}" for i in range(4)])
+    counts = Counter(ring.get_node(f"key-{i}") for i in range(4000))
+    assert len(counts) == 4
+    for n in counts.values():
+        assert 500 < n < 2000  # coarse balance with 160 vnodes
+
+
+def test_remove_node_minimal_remap():
+    nodes = ["a", "b", "c", "d"]
+    ring = HashRing(nodes)
+    keys = [f"key-{i}" for i in range(2000)]
+    before = {k: ring.get_node(k) for k in keys}
+    ring.remove_node("b")
+    after = {k: ring.get_node(k) for k in keys}
+    for k in keys:
+        if before[k] != "b":
+            assert after[k] == before[k]  # only b's keys move
+        else:
+            assert after[k] != "b"
+
+
+def test_add_node_minimal_remap():
+    ring = HashRing(["a", "b", "c"])
+    keys = [f"key-{i}" for i in range(2000)]
+    before = {k: ring.get_node(k) for k in keys}
+    ring.add_node("d")
+    after = {k: ring.get_node(k) for k in keys}
+    for k in keys:
+        assert after[k] == before[k] or after[k] == "d"
+
+
+def test_sync_membership():
+    ring = HashRing(["a", "b"])
+    ring.sync(["b", "c", "d"])
+    assert ring.nodes == {"b", "c", "d"}
+    assert ring.get_node("x") in {"b", "c", "d"}

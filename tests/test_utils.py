@@ -1,0 +1,71 @@
+"""Utils: URL validation, static parsing, registry semantics.
+
+Reference counterparts: src/vllm_router/utils.py:42-95,
+src/tests/test_singleton.py:14-60.
+"""
+
+import pytest
+
+from production_stack_tpu.utils.net import (
+    parse_static_aliases,
+    parse_static_models,
+    parse_static_urls,
+    validate_url,
+)
+from production_stack_tpu.utils.registry import ServiceRegistry
+
+
+@pytest.mark.parametrize(
+    "url,ok",
+    [
+        ("http://localhost:8000", True),
+        ("https://engine-0.ns.svc.cluster.local:8000", True),
+        ("http://10.0.0.1:8000/v1", True),
+        ("ftp://host", False),
+        ("localhost:8000", False),
+        ("", False),
+        ("http://", False),
+    ],
+)
+def test_validate_url(url, ok):
+    assert validate_url(url) is ok
+
+
+def test_parse_static_urls():
+    assert parse_static_urls("http://a:1, http://b:2") == ["http://a:1", "http://b:2"]
+    with pytest.raises(ValueError):
+        parse_static_urls("http://a:1,not-a-url")
+
+
+def test_parse_static_models():
+    assert parse_static_models("m1, m2,m3") == ["m1", "m2", "m3"]
+    assert parse_static_models("") == []
+
+
+def test_parse_static_aliases():
+    assert parse_static_aliases("gpt-4:llama-3-8b") == {"gpt-4": "llama-3-8b"}
+    with pytest.raises(ValueError):
+        parse_static_aliases("no-colon")
+
+
+def test_registry_require_raises():
+    reg = ServiceRegistry()
+    with pytest.raises(KeyError):
+        reg.require("router")
+
+
+def test_registry_replace_atomic_and_closes_old():
+    reg = ServiceRegistry()
+    closed = []
+    reg.set("svc", "old")
+    out = reg.replace("svc", lambda: "new", close_old=closed.append)
+    assert out == "new"
+    assert reg.get("svc") == "new"
+    assert closed == ["old"]
+
+
+def test_registry_reset():
+    reg = ServiceRegistry()
+    reg.set("a", 1)
+    reg.reset()
+    assert not reg.contains("a")
