@@ -1,0 +1,1 @@
+"""OpenAI-compatible L7 router (reference counterpart: src/vllm_router/)."""

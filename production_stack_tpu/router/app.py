@@ -1,0 +1,223 @@
+"""Router app assembly and entry point.
+
+Reference counterpart: src/vllm_router/app.py:73-230 (lifespan,
+initialize_all, main).  aiohttp instead of FastAPI/uvicorn; all singletons
+live in a ServiceRegistry attached to the app.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional
+
+import aiohttp
+from aiohttp import web
+
+from production_stack_tpu.router import parser as router_parser
+from production_stack_tpu.router.routing import initialize_routing_logic
+from production_stack_tpu.router.service_discovery import (
+    DISCOVERY_SERVICE,
+    StaticServiceDiscovery,
+)
+from production_stack_tpu.router.services.request_service.request import (
+    CLIENT_SESSION,
+    ENGINE_STATS_SCRAPER,
+    REQUEST_REWRITER,
+    REQUEST_STATS_MONITOR,
+)
+from production_stack_tpu.router.services.request_service.rewriter import (
+    get_request_rewriter,
+)
+from production_stack_tpu.router.stats.engine_stats import EngineStatsScraper
+from production_stack_tpu.router.stats.log_stats import log_stats_task
+from production_stack_tpu.router.stats.request_stats import RequestStatsMonitor
+from production_stack_tpu.utils.log import init_logger
+from production_stack_tpu.utils.net import (
+    parse_static_aliases,
+    parse_static_models,
+    parse_static_urls,
+    set_ulimit,
+)
+from production_stack_tpu.utils.registry import ServiceRegistry
+
+logger = logging.getLogger(__name__)
+
+
+def _build_service_discovery(args):
+    if args.service_discovery == "static":
+        urls = parse_static_urls(args.static_backends)
+        if args.static_models:
+            # ';' separates multiple models on one backend.
+            models = [entry.split(";") for entry in parse_static_models(args.static_models)]
+        else:
+            models = [[] for _ in urls]
+        labels = parse_static_models(args.static_model_labels) if args.static_model_labels else None
+        types = (
+            [entry.split(";") for entry in parse_static_models(args.static_model_types)]
+            if args.static_model_types
+            else None
+        )
+        return StaticServiceDiscovery(
+            urls,
+            models,
+            model_labels=labels,
+            model_types=types,
+            probe_models=args.static_probe_models,
+        )
+    # Lazy import: K8s discovery pulls in token/CA file handling not needed
+    # for static mode (reference gates this on args too, app.py:108-122).
+    try:
+        from production_stack_tpu.router.k8s_discovery import K8sServiceDiscovery
+    except ImportError as e:
+        _unavailable("--service-discovery k8s", e)
+
+    return K8sServiceDiscovery(
+        namespace=args.k8s_namespace,
+        port=args.k8s_port,
+        label_selector=args.k8s_label_selector,
+    )
+
+
+def initialize_all(app: web.Application, args) -> ServiceRegistry:
+    """Wire every service into the app registry
+    (reference initialize_all, app.py:97-207)."""
+    registry: ServiceRegistry = app["registry"]
+
+    discovery = _build_service_discovery(args)
+    registry.set(DISCOVERY_SERVICE, discovery)
+
+    monitor = RequestStatsMonitor(sliding_window_size=args.request_stats_window)
+    registry.set(REQUEST_STATS_MONITOR, monitor)
+
+    scraper = EngineStatsScraper(discovery, scrape_interval=args.engine_stats_interval)
+    registry.set(ENGINE_STATS_SCRAPER, scraper)
+
+    routing_kwargs = {}
+    if args.routing_logic == "session":
+        routing_kwargs["session_key"] = args.session_key
+    initialize_routing_logic(registry, args.routing_logic, **routing_kwargs)
+
+    aliases = parse_static_aliases(args.model_aliases) if args.model_aliases else None
+    registry.set(REQUEST_REWRITER, get_request_rewriter(args.request_rewriter, aliases))
+
+    # Optional subsystems -------------------------------------------------
+    if args.enable_batch_api:
+        try:
+            from production_stack_tpu.router.services.batch_service import (
+                initialize_batch_service,
+            )
+        except ImportError as e:
+            _unavailable("--enable-batch-api", e)
+        initialize_batch_service(app, registry, args)
+
+    if args.feature_gates:
+        try:
+            from production_stack_tpu.router.experimental import initialize_experimental
+        except ImportError as e:
+            _unavailable("--feature-gates", e)
+        initialize_experimental(app, registry, args)
+
+    if args.dynamic_config_json:
+        try:
+            from production_stack_tpu.router.dynamic_config import DynamicConfigWatcher
+        except ImportError as e:
+            _unavailable("--dynamic-config-json", e)
+        registry.set(
+            "dynamic_config_watcher",
+            DynamicConfigWatcher(args.dynamic_config_json, registry, args),
+        )
+
+    return registry
+
+
+def _unavailable(feature: str, exc: ImportError):
+    raise SystemExit(
+        f"{feature} is not available in this build: {exc}. "
+        "See SURVEY.md section 7 for the build plan."
+    )
+
+
+def build_app(args, registry: Optional[ServiceRegistry] = None) -> web.Application:
+    app = web.Application()
+    app["registry"] = registry if registry is not None else ServiceRegistry()
+    app["args"] = args
+    initialize_all(app, args)
+
+    from production_stack_tpu.router.routers import main_router, metrics_router
+
+    app.add_routes(main_router.routes)
+    app.add_routes(metrics_router.routes)
+    if args.enable_batch_api:
+        from production_stack_tpu.router.routers import batches_router, files_router
+
+        app.add_routes(files_router.routes)
+        app.add_routes(batches_router.routes)
+
+    app.cleanup_ctx.append(_lifespan(args))
+    return app
+
+
+def _lifespan(args):
+    """Startup/shutdown of background services
+    (reference FastAPI lifespan, app.py:73-94)."""
+
+    async def ctx(app: web.Application):
+        registry: ServiceRegistry = app["registry"]
+        session = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=None, sock_connect=30),
+            connector=aiohttp.TCPConnector(limit=0),
+        )
+        registry.set(CLIENT_SESSION, session)
+
+        discovery = registry.require(DISCOVERY_SERVICE)
+        await discovery.start()
+
+        scraper = registry.require(ENGINE_STATS_SCRAPER)
+        await scraper.start()
+        # Populate engine stats before serving the first request.
+        try:
+            await scraper.scrape_once()
+        except Exception:
+            logger.warning("initial engine-stats scrape failed", exc_info=True)
+
+        watcher = registry.get("dynamic_config_watcher")
+        if watcher is not None:
+            await watcher.start()
+
+        batch_processor = registry.get("batch_processor")
+        if batch_processor is not None:
+            await batch_processor.start()
+
+        log_task = None
+        if args.log_stats:
+            log_task = asyncio.create_task(
+                log_stats_task(registry, args.log_stats_interval)
+            )
+
+        yield
+
+        if log_task is not None:
+            log_task.cancel()
+        if batch_processor is not None:
+            await batch_processor.close()
+        if watcher is not None:
+            await watcher.close()
+        await scraper.close()
+        await discovery.close()
+        await session.close()
+
+    return ctx
+
+
+def main(argv=None) -> None:
+    args = router_parser.parse_args(argv)
+    init_logger("production_stack_tpu", args.log_level)
+    set_ulimit()
+    app = build_app(args)
+    logger.info("Starting tpu-router on %s:%d", args.host, args.port)
+    web.run_app(app, host=args.host, port=args.port, access_log=None)
+
+
+if __name__ == "__main__":
+    main()

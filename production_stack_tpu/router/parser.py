@@ -1,0 +1,152 @@
+"""Router CLI (reference counterpart: src/vllm_router/parsers/parser.py:30-209)."""
+
+from __future__ import annotations
+
+import argparse
+
+from production_stack_tpu.router.routing import available_routing_logics
+from production_stack_tpu.utils.net import (
+    parse_static_aliases,
+    parse_static_models,
+    parse_static_urls,
+    validate_url,
+)
+from production_stack_tpu.version import __version__
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    parser = argparse.ArgumentParser(
+        prog="tpu-router",
+        description="OpenAI-compatible L7 router for TPU serving engines",
+    )
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=8001)
+
+    # Service discovery (reference parser.py:62-96).
+    parser.add_argument(
+        "--service-discovery", choices=["static", "k8s"], default="static"
+    )
+    parser.add_argument(
+        "--static-backends",
+        default=None,
+        help="Comma-separated engine base URLs (static discovery)",
+    )
+    parser.add_argument(
+        "--static-models",
+        default=None,
+        help="Comma-separated model names, one entry per backend; "
+        "use ';' inside an entry for multi-model engines",
+    )
+    parser.add_argument(
+        "--static-model-labels", default=None, help="Comma-separated model labels"
+    )
+    parser.add_argument(
+        "--static-model-types",
+        default=None,
+        help="Comma-separated model types (chat|completion|embeddings|rerank|score)",
+    )
+    parser.add_argument(
+        "--static-probe-models",
+        action="store_true",
+        help="Probe <backend>/v1/models at startup for backends without a "
+        "configured model list",
+    )
+    parser.add_argument("--k8s-namespace", default="default")
+    parser.add_argument("--k8s-port", type=int, default=8000)
+    parser.add_argument(
+        "--k8s-label-selector", default="", help="Label selector for engine pods"
+    )
+
+    # Routing (reference parser.py:98-116).
+    parser.add_argument(
+        "--routing-logic", choices=available_routing_logics(), default="roundrobin"
+    )
+    parser.add_argument(
+        "--session-key", default=None, help="Session-affinity header name"
+    )
+    parser.add_argument(
+        "--model-aliases",
+        default=None,
+        help="Comma-separated alias:model pairs rewritten before routing",
+    )
+
+    # Stats (reference parser.py:118-139).
+    parser.add_argument("--engine-stats-interval", type=float, default=10.0)
+    parser.add_argument("--request-stats-window", type=float, default=60.0)
+    parser.add_argument(
+        "--log-stats", action="store_true", help="Periodically log the stats planes"
+    )
+    parser.add_argument("--log-stats-interval", type=float, default=10.0)
+
+    # Dynamic config (reference parser.py:141-150).
+    parser.add_argument(
+        "--dynamic-config-json",
+        default=None,
+        help="Path to a hot-reloaded router config JSON (written by the operator)",
+    )
+
+    # Files / batch API (reference parser.py:152-176).
+    parser.add_argument("--enable-batch-api", action="store_true")
+    parser.add_argument("--file-storage-class", default="local_file")
+    parser.add_argument("--file-storage-path", default="/tmp/tpu_router_storage")
+    parser.add_argument("--batch-processor", default="local")
+
+    # Experimental feature gates (reference feature_gates.py:80-142).
+    parser.add_argument(
+        "--feature-gates",
+        default="",
+        help="K8s-style gates, e.g. SemanticCache=true,PIIDetection=true",
+    )
+    parser.add_argument("--semantic-cache-model", default="hash")
+    parser.add_argument("--semantic-cache-dir", default=None)
+    parser.add_argument("--semantic-cache-threshold", type=float, default=0.95)
+    parser.add_argument("--pii-analyzer", default="regex")
+
+    parser.add_argument("--request-rewriter", default="noop")
+    parser.add_argument("--log-level", default="info")
+    parser.add_argument(
+        "--version", action="version", version=f"%(prog)s {__version__}"
+    )
+
+    args = parser.parse_args(argv)
+    validate_args(args)
+    return args
+
+
+def validate_args(args: argparse.Namespace) -> None:
+    """Cross-flag validation (reference parser.py:30-51)."""
+    if args.service_discovery == "static":
+        if not args.static_backends:
+            raise ValueError("static service discovery requires --static-backends")
+        urls = parse_static_urls(args.static_backends)
+        for url in urls:
+            if not validate_url(url):
+                raise ValueError(f"Invalid static backend URL: {url}")
+        if args.static_models:
+            models = parse_static_models(args.static_models)
+            if len(models) != len(urls):
+                raise ValueError(
+                    f"--static-models has {len(models)} entries but "
+                    f"--static-backends has {len(urls)}"
+                )
+        elif not args.static_probe_models:
+            raise ValueError(
+                "static discovery needs --static-models or --static-probe-models"
+            )
+        for flag, value in [
+            ("--static-model-labels", args.static_model_labels),
+            ("--static-model-types", args.static_model_types),
+        ]:
+            if value:
+                entries = parse_static_models(value)
+                if len(entries) != len(urls):
+                    raise ValueError(
+                        f"{flag} has {len(entries)} entries but "
+                        f"--static-backends has {len(urls)}"
+                    )
+    if args.routing_logic == "session" and not args.session_key:
+        raise ValueError("--routing-logic session requires --session-key")
+    if args.model_aliases:
+        parse_static_aliases(args.model_aliases)
+    if args.batch_processor not in ("local",):
+        raise ValueError(f"Unknown batch processor {args.batch_processor!r}")

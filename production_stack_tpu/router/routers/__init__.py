@@ -1,0 +1,1 @@
+"""aiohttp route tables (reference counterpart: src/vllm_router/routers/)."""

@@ -1,0 +1,99 @@
+"""OpenAI-compatible endpoints + /v1/models + /health + /version.
+
+Reference counterpart: src/vllm_router/routers/main_router.py:42-160.
+"""
+
+from __future__ import annotations
+
+import time
+
+from aiohttp import web
+
+from production_stack_tpu.router.service_discovery import DISCOVERY_SERVICE
+from production_stack_tpu.router.services.request_service import route_general_request
+from production_stack_tpu.router.services.request_service.request import (
+    ENGINE_STATS_SCRAPER,
+)
+from production_stack_tpu.version import __version__
+
+routes = web.RouteTableDef()
+
+# Proxied OpenAI endpoints (reference main_router.py:42-91).  Each handler
+# binds the upstream path explicitly so aliases (/rerank, /score) work.
+_PROXY_PATHS = [
+    "/v1/chat/completions",
+    "/v1/completions",
+    "/v1/embeddings",
+    "/v1/rerank",
+    "/rerank",
+    "/v1/score",
+    "/score",
+]
+
+
+def _make_proxy_handler(path: str):
+    async def handler(request: web.Request) -> web.StreamResponse:
+        hooks = request.app.get("proxy_hooks")
+        if hooks is not None:
+            short_circuit = await hooks.pre_route(request, path)
+            if short_circuit is not None:
+                return short_circuit
+            return await route_general_request(
+                request, path, background=hooks.post_response_hook(request, path)
+            )
+        return await route_general_request(request, path)
+
+    return handler
+
+
+for _path in _PROXY_PATHS:
+    routes.post(_path)(_make_proxy_handler(_path))
+
+
+@routes.get("/v1/models")
+async def show_models(request: web.Request) -> web.Response:
+    """Aggregate model cards across discovered endpoints
+    (reference main_router.py:93-122)."""
+    registry = request.app["registry"]
+    discovery = registry.require(DISCOVERY_SERVICE)
+    seen = {}
+    for ep in discovery.get_endpoint_info():
+        for name in ep.model_names:
+            if name not in seen:
+                seen[name] = {
+                    "id": name,
+                    "object": "model",
+                    "created": int(ep.added_timestamp),
+                    "owned_by": "production-stack-tpu",
+                }
+    return web.json_response({"object": "list", "data": list(seen.values())})
+
+
+@routes.get("/version")
+async def show_version(request: web.Request) -> web.Response:
+    return web.json_response({"version": __version__})
+
+
+@routes.get("/health")
+async def health(request: web.Request) -> web.Response:
+    """Composite liveness: discovery + stats scraper
+    (reference main_router.py:125-160)."""
+    registry = request.app["registry"]
+    problems = []
+    discovery = registry.get(DISCOVERY_SERVICE)
+    if discovery is None:
+        problems.append("service discovery not initialized")
+    elif not discovery.get_health():
+        problems.append("service discovery watcher is down")
+    scraper = registry.get(ENGINE_STATS_SCRAPER)
+    if scraper is not None and not scraper.get_health():
+        problems.append("engine stats scraper is down")
+    dynamic_config = registry.get("dynamic_config_watcher")
+    if dynamic_config is not None and not dynamic_config.get_health():
+        problems.append("dynamic config watcher is down")
+    if problems:
+        return web.json_response({"status": "unhealthy", "problems": problems}, status=503)
+    body = {"status": "healthy", "time": time.time()}
+    if dynamic_config is not None:
+        body["dynamic_config"] = dynamic_config.current_config_digest()
+    return web.json_response(body)

@@ -1,0 +1,63 @@
+"""Router /metrics endpoint.
+
+Reference counterpart: src/vllm_router/routers/metrics_router.py:24-64 —
+refreshes labeled gauges from the request-stats monitor and discovery on
+every scrape, then renders the default registry.
+"""
+
+from __future__ import annotations
+
+import time
+
+from aiohttp import web
+from prometheus_client import CONTENT_TYPE_LATEST, generate_latest
+
+from production_stack_tpu.router.service_discovery import DISCOVERY_SERVICE
+from production_stack_tpu.router.services import metrics_service as ms
+from production_stack_tpu.router.services.request_service.request import (
+    ENGINE_STATS_SCRAPER,
+    REQUEST_STATS_MONITOR,
+)
+
+routes = web.RouteTableDef()
+
+
+@routes.get("/metrics")
+async def metrics(request: web.Request) -> web.Response:
+    registry = request.app["registry"]
+
+    monitor = registry.get(REQUEST_STATS_MONITOR)
+    if monitor is not None:
+        for server, stats in monitor.get_request_stats(time.time()).items():
+            ms.current_qps.labels(server=server).set(stats.qps)
+            ms.avg_ttft.labels(server=server).set(stats.ttft)
+            ms.avg_latency.labels(server=server).set(stats.latency)
+            ms.avg_itl.labels(server=server).set(stats.itl)
+            ms.avg_decoding_length.labels(server=server).set(stats.decoding_length)
+            ms.queueing_delay.labels(server=server).set(stats.queueing_delay)
+            ms.num_prefill_requests.labels(server=server).set(stats.in_prefill_requests)
+            ms.num_decoding_requests.labels(server=server).set(stats.in_decoding_requests)
+            ms.num_requests_finished.labels(server=server).set(stats.finished_requests)
+            ms.num_requests_uncompleted.labels(server=server).set(
+                stats.uncompleted_requests
+            )
+
+    scraper = registry.get(ENGINE_STATS_SCRAPER)
+    if scraper is not None:
+        for server, es in scraper.get_engine_stats().items():
+            ms.engine_kv_usage_perc.labels(server=server).set(es.kv_usage_perc)
+            ms.engine_prefix_cache_hit_rate.labels(server=server).set(
+                es.prefix_cache_hit_rate
+            )
+            ms.engine_queue_depth.labels(server=server).set(es.num_queuing_requests)
+
+    discovery = registry.get(DISCOVERY_SERVICE)
+    if discovery is not None:
+        per_model: dict = {}
+        for ep in discovery.get_endpoint_info():
+            for model in ep.model_names or ["<unknown>"]:
+                per_model[model] = per_model.get(model, 0) + 1
+        for model, count in per_model.items():
+            ms.healthy_pods_total.labels(model=model).set(count)
+
+    return web.Response(body=generate_latest(), headers={"Content-Type": CONTENT_TYPE_LATEST})

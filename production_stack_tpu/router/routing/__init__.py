@@ -1,0 +1,61 @@
+"""Pluggable routing logic (reference: src/vllm_router/routers/routing_logic.py).
+
+Algorithms:
+
+* ``roundrobin`` — per-model round robin (fixes the reference's shared
+  counter, routing_logic.py:73-76, which skews fairness across models).
+* ``session`` — session affinity via consistent hashing with lowest-QPS
+  fallback (reference SessionRouter, routing_logic.py:79-172).
+* ``least_loaded`` — lowest engine queue depth (the second algorithm the
+  reference's StaticRoute CRD advertises, staticroute_types.go:42).
+* ``kv_aware`` — prefix-affinity + load-aware scoring; maximizes TPU HBM
+  KV-cache reuse (capability the reference only gets implicitly through
+  session stickiness).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from production_stack_tpu.router.routing.base import Request, RoutingInterface
+from production_stack_tpu.router.routing.round_robin import RoundRobinRouter
+from production_stack_tpu.router.routing.session import SessionRouter
+from production_stack_tpu.router.routing.least_loaded import LeastLoadedRouter
+from production_stack_tpu.router.routing.kv_aware import KVAwareRouter
+
+ROUTING_SERVICE = "routing_logic"
+
+_ALGORITHMS = {
+    "roundrobin": RoundRobinRouter,
+    "session": SessionRouter,
+    "least_loaded": LeastLoadedRouter,
+    "kv_aware": KVAwareRouter,
+}
+
+
+def available_routing_logics():
+    return sorted(_ALGORITHMS)
+
+
+def build_routing_logic(name: str, **kwargs: Any) -> RoutingInterface:
+    try:
+        cls = _ALGORITHMS[name]
+    except KeyError:
+        raise ValueError(
+            f"Unknown routing logic {name!r}; available: {available_routing_logics()}"
+        ) from None
+    return cls(**kwargs)
+
+
+def initialize_routing_logic(registry, name: str, **kwargs: Any) -> RoutingInterface:
+    """Build and register (reference initialize_routing_logic, routing_logic.py:176-187)."""
+    return registry.set(ROUTING_SERVICE, build_routing_logic(name, **kwargs))
+
+
+def reconfigure_routing_logic(registry, name: str, **kwargs: Any) -> RoutingInterface:
+    """Atomic swap (reference purges SingletonMeta._instances, routing_logic.py:189-196)."""
+    return registry.replace(ROUTING_SERVICE, lambda: build_routing_logic(name, **kwargs))
+
+
+def get_routing_logic(registry) -> RoutingInterface:
+    return registry.require(ROUTING_SERVICE)

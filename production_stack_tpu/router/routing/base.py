@@ -1,0 +1,55 @@
+"""Routing interface (reference RoutingInterface, routing_logic.py:22-42)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Mapping, Optional, Protocol, runtime_checkable
+
+from production_stack_tpu.router.service_discovery import EndpointInfo
+from production_stack_tpu.router.stats.engine_stats import EngineStats
+from production_stack_tpu.router.stats.request_stats import RequestStats
+
+
+@runtime_checkable
+class Request(Protocol):
+    """The slice of an HTTP request routing needs (duck-typed so tests can
+    use plain fakes, mirroring src/tests/test_session_router.py:6-19)."""
+
+    @property
+    def headers(self) -> Mapping[str, str]: ...  # noqa: E704
+
+
+class RoutingInterface:
+    def route_request(
+        self,
+        endpoints: List[EndpointInfo],
+        engine_stats: Dict[str, EngineStats],
+        request_stats: Dict[str, RequestStats],
+        request: Request,
+        request_json: Optional[Dict[str, Any]] = None,
+    ) -> str:
+        """Pick a backend URL for this request.
+
+        ``endpoints`` is already filtered to those serving the requested
+        model (reference request.py:169).  Raises ValueError when empty.
+        """
+        raise NotImplementedError
+
+
+def require_endpoints(endpoints: List[EndpointInfo]) -> List[EndpointInfo]:
+    if not endpoints:
+        raise ValueError("No serving-engine endpoints available for this model")
+    return endpoints
+
+
+def lowest_qps_url(
+    endpoints: List[EndpointInfo], request_stats: Dict[str, RequestStats]
+) -> str:
+    """Endpoint with lowest observed QPS; unseen endpoints count as idle
+    (reference SessionRouter._qps_routing, routing_logic.py:94-115)."""
+    best_url, best_qps = None, float("inf")
+    for ep in require_endpoints(endpoints):
+        qps = request_stats[ep.url].qps if ep.url in request_stats else 0.0
+        if qps < best_qps:
+            best_url, best_qps = ep.url, qps
+    assert best_url is not None
+    return best_url

@@ -1,0 +1,117 @@
+"""KV-cache-aware (prefix-affinity) routing.
+
+Not present in the reference: its only KV-locality mechanism is session
+stickiness (routing_logic.py:79-172) + LMCache offload.  On TPU, prefix reuse
+is the dominant TTFT lever (the multi-round-QA workload re-sends a 1,000-token
+system prompt and up to 20,000 tokens of history every round, see
+benchmarks/multi-round-qa/run.sh:43-48) — so the router itself tracks which
+engine has most recently served each prompt prefix and routes to maximize
+paged-KV prefix-cache hits, balanced against queue depth.
+
+Mechanism: the request's prompt text is split into fixed-size chunks; each
+cumulative chunk-prefix hash is remembered in a bounded LRU mapping to the
+engine that served it.  Scoring an endpoint combines (matched prefix length)
+against (engine load), so a hot engine does not melt down just because it
+owns a popular prefix.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional
+
+from production_stack_tpu.router.routing.base import RoutingInterface, require_endpoints
+from production_stack_tpu.router.service_discovery import EndpointInfo
+
+
+def extract_prompt_text(request_json: Optional[Dict[str, Any]]) -> str:
+    """Canonical prompt text from a chat-completion or completion body."""
+    if not request_json:
+        return ""
+    if "messages" in request_json:
+        parts = []
+        for msg in request_json.get("messages") or []:
+            content = msg.get("content") if isinstance(msg, dict) else None
+            if isinstance(content, str):
+                parts.append(f"{msg.get('role', '')}:{content}")
+            elif isinstance(content, list):  # multimodal content parts
+                parts.append(json.dumps(content, sort_keys=True, default=str))
+        return "\n".join(parts)
+    prompt = request_json.get("prompt")
+    if isinstance(prompt, str):
+        return prompt
+    if isinstance(prompt, list):
+        return "\n".join(str(p) for p in prompt)
+    return ""
+
+
+class KVAwareRouter(RoutingInterface):
+    def __init__(
+        self,
+        chunk_chars: int = 1024,
+        max_tracked_prefixes: int = 65536,
+        load_tradeoff: float = 2.0,
+    ):
+        self.chunk_chars = int(chunk_chars)
+        self.max_tracked_prefixes = int(max_tracked_prefixes)
+        # How many chunks of prefix-match one unit of queue depth is worth.
+        self.load_tradeoff = float(load_tradeoff)
+        self._lock = threading.Lock()
+        self._prefix_owner: "OrderedDict[str, str]" = OrderedDict()
+
+    def _prefix_hashes(self, text: str) -> List[str]:
+        hashes = []
+        h = hashlib.blake2b(digest_size=8)
+        for start in range(0, len(text), self.chunk_chars):
+            h.update(text[start : start + self.chunk_chars].encode("utf-8"))
+            hashes.append(h.hexdigest())
+        return hashes
+
+    def _matched_chunks(self, hashes: List[str], url: str) -> int:
+        matched = 0
+        for digest in hashes:
+            if self._prefix_owner.get(digest) == url:
+                matched += 1
+            else:
+                break
+        return matched
+
+    def route_request(
+        self,
+        endpoints: List[EndpointInfo],
+        engine_stats,
+        request_stats,
+        request,
+        request_json: Optional[Dict[str, Any]] = None,
+    ) -> str:
+        endpoints = require_endpoints(endpoints)
+        engine_stats = engine_stats or {}
+        request_stats = request_stats or {}
+        hashes = self._prefix_hashes(extract_prompt_text(request_json))
+
+        def load(url: str) -> float:
+            if url in engine_stats:
+                es = engine_stats[url]
+                return float(es.num_running_requests + es.num_queuing_requests)
+            if url in request_stats:
+                rs = request_stats[url]
+                return float(rs.in_prefill_requests + rs.in_decoding_requests)
+            return 0.0
+
+        with self._lock:
+            best_url, best_score = None, float("inf")
+            for ep in sorted(endpoints, key=lambda e: e.url):
+                affinity = self._matched_chunks(hashes, ep.url) if hashes else 0
+                score = load(ep.url) - self.load_tradeoff * affinity
+                if score < best_score:
+                    best_url, best_score = ep.url, score
+            assert best_url is not None
+            for digest in hashes:
+                self._prefix_owner[digest] = best_url
+                self._prefix_owner.move_to_end(digest)
+            while len(self._prefix_owner) > self.max_tracked_prefixes:
+                self._prefix_owner.popitem(last=False)
+        return best_url

@@ -1,0 +1,40 @@
+"""Least-loaded routing.
+
+The reference's StaticRoute CRD advertises ``roundrobin|least_loaded``
+(src/router-controller/api/v1alpha1/staticroute_types.go:42) but the Python
+router never implements the latter; we do.  Load = engine running+waiting
+queue depth from scraped stats, falling back to router-side in-flight counts
+for engines that have not been scraped yet.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from production_stack_tpu.router.routing.base import RoutingInterface, require_endpoints
+from production_stack_tpu.router.service_discovery import EndpointInfo
+
+
+class LeastLoadedRouter(RoutingInterface):
+    def route_request(
+        self,
+        endpoints: List[EndpointInfo],
+        engine_stats,
+        request_stats,
+        request,
+        request_json: Optional[Dict[str, Any]] = None,
+    ) -> str:
+        endpoints = require_endpoints(endpoints)
+        engine_stats = engine_stats or {}
+        request_stats = request_stats or {}
+
+        def load(ep: EndpointInfo) -> float:
+            if ep.url in engine_stats:
+                es = engine_stats[ep.url]
+                return float(es.num_running_requests + es.num_queuing_requests)
+            if ep.url in request_stats:
+                rs = request_stats[ep.url]
+                return float(rs.in_prefill_requests + rs.in_decoding_requests)
+            return 0.0
+
+        return min(endpoints, key=lambda ep: (load(ep), ep.url)).url

@@ -1,0 +1,43 @@
+"""Round-robin routing (reference RoundRobinRouter, routing_logic.py:45-76).
+
+Fix over the reference: one counter *per model* instead of a single shared
+counter, so interleaved traffic to different models cannot skew per-model
+fairness (SURVEY.md section 7, "Reference bugs to avoid repeating").
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+from production_stack_tpu.router.routing.base import RoutingInterface, require_endpoints
+from production_stack_tpu.router.service_discovery import EndpointInfo
+
+
+class RoundRobinRouter(RoutingInterface):
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: Dict[str, int] = {}
+
+    def route_request(
+        self,
+        endpoints: List[EndpointInfo],
+        engine_stats,
+        request_stats,
+        request,
+        request_json: Optional[Dict[str, Any]] = None,
+    ) -> str:
+        endpoints = require_endpoints(endpoints)
+        # Sort by URL so the rotation order is stable across calls even if
+        # discovery returns endpoints in a different order (reference sorts
+        # the same way, routing_logic.py:73-74).
+        ordered = sorted(endpoints, key=lambda ep: ep.url)
+        # Key the counter on the *requested* model so interleaved traffic to
+        # different models each sees its own fair rotation.
+        model_key = (request_json or {}).get("model") or ",".join(
+            sorted(ordered[0].model_names)
+        ) or "<default>"
+        with self._lock:
+            count = self._counters.get(model_key, 0)
+            self._counters[model_key] = count + 1
+        return ordered[count % len(ordered)].url

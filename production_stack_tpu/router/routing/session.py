@@ -1,0 +1,50 @@
+"""Session-affinity routing via consistent hashing.
+
+Reference counterpart: SessionRouter, routing_logic.py:79-172 — session key
+taken from a configurable header; requests without the header fall back to
+lowest-QPS; the hash ring is synced to endpoint churn so only sessions on
+removed engines are remapped.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+from production_stack_tpu.router.routing.base import (
+    RoutingInterface,
+    lowest_qps_url,
+    require_endpoints,
+)
+from production_stack_tpu.router.service_discovery import EndpointInfo
+from production_stack_tpu.utils.hashring import HashRing
+
+
+class SessionRouter(RoutingInterface):
+    def __init__(self, session_key: str = "x-user-id"):
+        if not session_key:
+            raise ValueError("session routing requires a session_key header name")
+        self.session_key = session_key
+        self._lock = threading.Lock()
+        self._ring = HashRing()
+
+    def _sync_ring(self, endpoints: List[EndpointInfo]) -> None:
+        self._ring.sync(ep.url for ep in endpoints)
+
+    def route_request(
+        self,
+        endpoints: List[EndpointInfo],
+        engine_stats,
+        request_stats,
+        request,
+        request_json: Optional[Dict[str, Any]] = None,
+    ) -> str:
+        endpoints = require_endpoints(endpoints)
+        session_id = request.headers.get(self.session_key)
+        if not session_id:
+            return lowest_qps_url(endpoints, request_stats or {})
+        with self._lock:
+            self._sync_ring(endpoints)
+            url = self._ring.get_node(session_id)
+        assert url is not None
+        return url

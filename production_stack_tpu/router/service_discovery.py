@@ -1,0 +1,129 @@
+"""Service discovery: which serving engines exist and what models they host.
+
+Reference counterpart: src/vllm_router/service_discovery.py:24-337
+(EndpointInfo, StaticServiceDiscovery, K8sServiceDiscovery,
+reconfigure_service_discovery).
+
+Two implementations:
+
+* :class:`StaticServiceDiscovery` — fixed URL/model lists from the CLI.
+* :class:`K8sServiceDiscovery` (k8s_discovery.py) — watches pods via the
+  Kubernetes API (raw HTTPS; the heavyweight ``kubernetes`` client package is
+  not required on TPU images).
+
+Both are registered/replaced through the shared ServiceRegistry rather than
+the reference's module-global singleton + lock dance
+(service_discovery.py:270-337).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+import time
+from typing import Dict, List, Optional
+
+import aiohttp
+
+logger = logging.getLogger(__name__)
+
+DISCOVERY_SERVICE = "service_discovery"
+
+
+@dataclasses.dataclass
+class EndpointInfo:
+    """One serving-engine endpoint (reference service_discovery.py:24-33)."""
+
+    url: str
+    model_names: List[str]
+    added_timestamp: float = dataclasses.field(default_factory=time.time)
+    model_label: Optional[str] = None  # engine's modelSpec label (helm)
+    pod_name: Optional[str] = None
+    # "chat" | "completion" | "embeddings" | "rerank" | "score"
+    model_types: Optional[List[str]] = None
+    sleep: bool = False  # engine put to sleep by autoscaler; excluded from routing
+
+
+class ServiceDiscovery:
+    """Interface (reference service_discovery.py:36-61)."""
+
+    def get_endpoint_info(self) -> List[EndpointInfo]:
+        raise NotImplementedError
+
+    def get_unhealthy_endpoint_hashes(self) -> List[str]:
+        return []
+
+    def get_health(self) -> bool:
+        """Is the discovery mechanism itself alive?"""
+        return True
+
+    async def start(self) -> None:  # pragma: no cover - trivial
+        return
+
+    async def close(self) -> None:  # pragma: no cover - trivial
+        return
+
+
+class StaticServiceDiscovery(ServiceDiscovery):
+    """Fixed endpoint list (reference service_discovery.py:64-82).
+
+    If ``probe_models`` is set and a URL has no configured model list, the
+    models are discovered by GETting ``<url>/v1/models`` once at startup
+    (mirrors the reference's K8s model probe, service_discovery.py:131-155).
+    """
+
+    def __init__(
+        self,
+        urls: List[str],
+        models: Optional[List[List[str]]] = None,
+        model_labels: Optional[List[str]] = None,
+        model_types: Optional[List[List[str]]] = None,
+        probe_models: bool = False,
+        probe_timeout: float = 5.0,
+    ):
+        models = models if models is not None else [[] for _ in urls]
+        if len(urls) != len(models):
+            raise ValueError(
+                f"static URLs ({len(urls)}) and model lists ({len(models)}) differ in length"
+            )
+        now = time.time()
+        self._endpoints = [
+            EndpointInfo(
+                url=url,
+                model_names=list(model_list),
+                added_timestamp=now,
+                model_label=(model_labels[i] if model_labels else None),
+                model_types=(model_types[i] if model_types else None),
+            )
+            for i, (url, model_list) in enumerate(zip(urls, models))
+        ]
+        self._probe_models = probe_models
+        self._probe_timeout = probe_timeout
+
+    async def start(self) -> None:
+        if not self._probe_models:
+            return
+        timeout = aiohttp.ClientTimeout(total=self._probe_timeout)
+        async with aiohttp.ClientSession(timeout=timeout) as session:
+            await asyncio.gather(
+                *(self._probe_one(session, ep) for ep in self._endpoints if not ep.model_names),
+                return_exceptions=True,
+            )
+
+    async def _probe_one(self, session: aiohttp.ClientSession, ep: EndpointInfo) -> None:
+        try:
+            async with session.get(f"{ep.url}/v1/models") as resp:
+                resp.raise_for_status()
+                body = await resp.json()
+            ep.model_names = [m["id"] for m in body.get("data", [])]
+            logger.info("Probed %s -> models %s", ep.url, ep.model_names)
+        except Exception as e:
+            logger.warning("Model probe failed for %s: %s", ep.url, e)
+
+    def get_endpoint_info(self) -> List[EndpointInfo]:
+        return list(self._endpoints)
+
+
+def get_service_discovery(registry) -> ServiceDiscovery:
+    return registry.require(DISCOVERY_SERVICE)

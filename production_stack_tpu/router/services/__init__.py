@@ -1,0 +1,1 @@
+"""Router services (reference counterpart: src/vllm_router/services/)."""

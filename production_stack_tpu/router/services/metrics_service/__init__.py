@@ -1,0 +1,49 @@
+"""Router-level Prometheus gauges, labeled by backend server.
+
+Reference counterpart: src/vllm_router/services/metrics_service/__init__.py:1-31.
+Extended with the gauges the reference's dashboard charts but never exports
+(router queueing delay) and TPU engine mirrors (HBM KV usage, prefix hit
+rate) so one scrape of the router suffices for the whole stack.
+"""
+
+from prometheus_client import Gauge
+
+current_qps = Gauge("tpu_router:current_qps", "Sliding-window QPS", ["server"])
+avg_ttft = Gauge("tpu_router:avg_ttft", "Average time-to-first-token (s)", ["server"])
+avg_latency = Gauge(
+    "tpu_router:avg_latency", "Average end-to-end request latency (s)", ["server"]
+)
+avg_itl = Gauge("tpu_router:avg_itl", "Average inter-token latency (s)", ["server"])
+avg_decoding_length = Gauge(
+    "tpu_router:avg_decoding_length", "Average streamed chunks per request", ["server"]
+)
+queueing_delay = Gauge(
+    "tpu_router:queueing_delay_seconds",
+    "Router-side queueing delay: receive -> backend connect (s)",
+    ["server"],
+)
+num_prefill_requests = Gauge(
+    "tpu_router:num_prefill_requests", "Requests awaiting first token", ["server"]
+)
+num_decoding_requests = Gauge(
+    "tpu_router:num_decoding_requests", "Requests streaming tokens", ["server"]
+)
+num_requests_finished = Gauge(
+    "tpu_router:num_requests_finished", "Completed requests", ["server"]
+)
+num_requests_uncompleted = Gauge(
+    "tpu_router:num_requests_uncompleted", "In-flight requests", ["server"]
+)
+healthy_pods_total = Gauge(
+    "tpu_router:healthy_pods_total", "Healthy serving-engine endpoints", ["model"]
+)
+# Engine-side mirrors (scraped via EngineStatsScraper).
+engine_kv_usage_perc = Gauge(
+    "tpu_router:engine_hbm_kv_usage_perc", "Engine TPU HBM KV pool usage (0-1)", ["server"]
+)
+engine_prefix_cache_hit_rate = Gauge(
+    "tpu_router:engine_prefix_cache_hit_rate", "Engine prefix-cache hit rate (0-1)", ["server"]
+)
+engine_queue_depth = Gauge(
+    "tpu_router:engine_num_requests_waiting", "Engine waiting-queue depth", ["server"]
+)

@@ -1,0 +1,17 @@
+"""Request proxy service (reference: src/vllm_router/services/request_service/)."""
+
+from production_stack_tpu.router.services.request_service.request import (
+    route_general_request,
+)
+from production_stack_tpu.router.services.request_service.rewriter import (
+    NoopRequestRewriter,
+    RequestRewriter,
+    get_request_rewriter,
+)
+
+__all__ = [
+    "route_general_request",
+    "RequestRewriter",
+    "NoopRequestRewriter",
+    "get_request_rewriter",
+]

@@ -1,0 +1,242 @@
+"""The router data path: parse -> rewrite -> route -> stream-proxy.
+
+Reference counterpart: src/vllm_router/services/request_service/request.py
+(route_general_request :120-196, process_request :44-117).  This is the
+hottest path in the control plane; the proxy adds exactly one backend stream
+and no buffering of the streamed body (SURVEY.md section 7, "Streaming proxy
+fidelity").
+
+Differences from the reference:
+
+* pure-asyncio aiohttp instead of FastAPI+httpx (FastAPI is not a given on
+  TPU images; one event loop, no thread hand-offs on the data path).
+* stats hooks additionally record router-side queueing delay and per-chunk
+  inter-token latency (reference monitors for these were never fed).
+* failed/aborted requests are reported to the stats monitor instead of
+  leaking in-flight counts.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+import uuid
+from typing import Any, Dict, Optional
+
+import aiohttp
+from aiohttp import web
+
+from production_stack_tpu.router.routing import ROUTING_SERVICE
+from production_stack_tpu.router.service_discovery import DISCOVERY_SERVICE
+
+logger = logging.getLogger(__name__)
+
+CLIENT_SESSION = "client_session"
+REQUEST_STATS_MONITOR = "request_stats_monitor"
+ENGINE_STATS_SCRAPER = "engine_stats_scraper"
+REQUEST_REWRITER = "request_rewriter"
+
+# Headers that must not be forwarded either direction: hop-by-hop headers,
+# plus encoding headers — aiohttp's client auto-decompresses the backend body
+# and negotiates its own Accept-Encoding, so forwarding either would claim an
+# encoding the relayed bytes no longer have.
+_HOP_BY_HOP = {
+    "host",
+    "connection",
+    "keep-alive",
+    "proxy-authenticate",
+    "proxy-authorization",
+    "te",
+    "trailers",
+    "transfer-encoding",
+    "upgrade",
+    "content-length",
+    "content-encoding",
+    "accept-encoding",
+}
+
+
+def _forward_headers(headers) -> Dict[str, str]:
+    return {k: v for k, v in headers.items() if k.lower() not in _HOP_BY_HOP}
+
+
+def _error_response(status: int, message: str, type_: str = "invalid_request_error") -> web.Response:
+    return web.json_response(
+        {"error": {"message": message, "type": type_, "code": status}}, status=status
+    )
+
+
+async def route_general_request(
+    request: web.Request, endpoint_path: str, background: Optional[Any] = None
+) -> web.StreamResponse:
+    """Proxy one OpenAI-style POST to the chosen serving engine.
+
+    ``background`` is an optional async callable ``(body_json, response_text)``
+    invoked after a successful non-streaming-aware completion (used by the
+    semantic cache, reference request.py:113-117).
+    """
+    registry = request.app["registry"]
+    in_router_time = time.time()
+    request_id = request.headers.get("x-request-id") or str(uuid.uuid4())
+
+    body_bytes = await request.read()
+    try:
+        body_json: Optional[Dict[str, Any]] = json.loads(body_bytes) if body_bytes else None
+    except json.JSONDecodeError:
+        return _error_response(400, "Request body is not valid JSON")
+
+    requested_model = (body_json or {}).get("model")
+    if body_json is not None and requested_model is None and endpoint_path.startswith("/v1/"):
+        return _error_response(400, "Request body must include a 'model' field")
+
+    # Rewrite hook (reference request.py:149-160).
+    rewriter = registry.get(REQUEST_REWRITER)
+    if rewriter is not None and body_json is not None:
+        rewritten = rewriter.rewrite_request(body_json, requested_model, endpoint_path)
+        if rewritten is not body_json:
+            body_json = rewritten
+            body_bytes = json.dumps(body_json).encode("utf-8")
+        requested_model = (body_json or {}).get("model", requested_model)
+
+    discovery = registry.require(DISCOVERY_SERVICE)
+    endpoints = [ep for ep in discovery.get_endpoint_info() if not ep.sleep]
+    scraper = registry.get(ENGINE_STATS_SCRAPER)
+    # Avoid engines whose last /metrics scrape failed — as long as at least
+    # one reachable engine remains (otherwise optimistically try them all;
+    # the scrape may lag an engine's recovery).
+    if scraper is not None:
+        unreachable = scraper.get_unreachable_urls()
+        if unreachable:
+            reachable = [ep for ep in endpoints if ep.url not in unreachable]
+            if reachable:
+                endpoints = reachable
+    if requested_model is not None:
+        endpoints = [
+            ep
+            for ep in endpoints
+            if not ep.model_names or requested_model in ep.model_names
+        ]
+    if not endpoints:
+        return _error_response(
+            400, f"Model '{requested_model}' not served by any healthy engine", "model_not_found"
+        )
+
+    engine_stats = scraper.get_engine_stats() if scraper else {}
+    monitor = registry.get(REQUEST_STATS_MONITOR)
+    request_stats = monitor.get_request_stats(time.time()) if monitor else {}
+
+    router = registry.require(ROUTING_SERVICE)
+    try:
+        server_url = router.route_request(
+            endpoints, engine_stats, request_stats, request, body_json
+        )
+    except ValueError as e:
+        return _error_response(503, str(e), "service_unavailable")
+
+    logger.debug(
+        "Routing request %s (model=%s) to %s at %.6f, took %.3f ms",
+        request_id,
+        requested_model,
+        server_url,
+        in_router_time,
+        (time.time() - in_router_time) * 1e3,
+    )
+
+    return await process_request(
+        request,
+        body_bytes=body_bytes,
+        body_json=body_json,
+        server_url=server_url,
+        endpoint_path=endpoint_path,
+        request_id=request_id,
+        in_router_time=in_router_time,
+        background=background,
+    )
+
+
+async def process_request(
+    request: web.Request,
+    *,
+    body_bytes: bytes,
+    body_json: Optional[Dict[str, Any]],
+    server_url: str,
+    endpoint_path: str,
+    request_id: str,
+    in_router_time: float,
+    background: Optional[Any] = None,
+) -> web.StreamResponse:
+    """Open one backend stream and relay chunks, feeding the stats lifecycle
+    (reference process_request, request.py:44-117)."""
+    registry = request.app["registry"]
+    monitor = registry.get(REQUEST_STATS_MONITOR)
+    session: aiohttp.ClientSession = registry.require(CLIENT_SESSION)
+
+    headers = _forward_headers(request.headers)
+    headers["x-request-id"] = request_id
+
+    if monitor:
+        monitor.on_new_request(server_url, request_id, in_router_time)
+
+    collected: list = []
+    want_store = background is not None
+    first_chunk_seen = False
+    response: Optional[web.StreamResponse] = None
+    try:
+        async with session.request(
+            request.method,
+            f"{server_url}{endpoint_path}",
+            data=body_bytes if body_bytes else None,
+            headers=headers,
+        ) as backend:
+            if monitor:
+                monitor.on_backend_connected(server_url, request_id, time.time())
+            response = web.StreamResponse(
+                status=backend.status, headers=_forward_headers(backend.headers)
+            )
+            await response.prepare(request)
+            async for chunk in backend.content.iter_any():
+                if not chunk:
+                    continue
+                now = time.time()
+                if monitor:
+                    if not first_chunk_seen:
+                        # Seeds the token clock + counts this chunk; no ITL
+                        # sample (the first chunk defines no interval).
+                        monitor.on_request_response(server_url, request_id, now)
+                        first_chunk_seen = True
+                    else:
+                        monitor.on_token_chunk(server_url, request_id, now)
+                if want_store:
+                    collected.append(chunk)
+                await response.write(chunk)
+            await response.write_eof()
+        if monitor:
+            monitor.on_request_complete(server_url, request_id, time.time())
+    except asyncio.CancelledError:
+        # Client disconnected (or server shutdown): release in-flight stats,
+        # then propagate — cancellation must never be swallowed.
+        if monitor:
+            monitor.on_request_failed(server_url, request_id, time.time())
+        raise
+    except (aiohttp.ClientError, ConnectionResetError) as e:
+        if monitor:
+            monitor.on_request_failed(server_url, request_id, time.time())
+        if response is None:
+            logger.warning("Backend %s failed before response: %s", server_url, e)
+            return _error_response(
+                502, f"Serving engine {server_url} is unreachable", "bad_gateway"
+            )
+        # Mid-stream failure: the client already has a partial body; all we
+        # can do is terminate the stream (matches reference behavior,
+        # SURVEY.md section 5 "no request retry/failover mid-stream").
+        logger.warning("Backend %s failed mid-stream: %s", server_url, e)
+        raise
+
+    if want_store and collected and body_json is not None:
+        try:
+            await background(body_json, b"".join(collected))
+        except Exception:
+            logger.exception("post-response background hook failed")
+    return response

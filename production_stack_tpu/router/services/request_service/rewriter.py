@@ -1,0 +1,57 @@
+"""Request rewriting hook (reference: services/request_service/rewriter.py:17-107).
+
+Rewriters mutate the request body before routing/proxying (prompt
+engineering, model-name canonicalization, default-parameter injection).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+
+class RequestRewriter:
+    def rewrite_request(
+        self, body: Dict[str, Any], model: str, endpoint_path: str
+    ) -> Dict[str, Any]:
+        raise NotImplementedError
+
+
+class NoopRequestRewriter(RequestRewriter):
+    def rewrite_request(
+        self, body: Dict[str, Any], model: str, endpoint_path: str
+    ) -> Dict[str, Any]:
+        return body
+
+
+class ModelAliasRewriter(RequestRewriter):
+    """Maps public model aliases to backend model names (e.g. expose
+    ``gpt-4`` while the engines serve ``llama-3-8b``).  The reference parses
+    static aliases but has no rewriter wired to apply them."""
+
+    def __init__(self, aliases: Dict[str, str]):
+        self.aliases = dict(aliases)
+
+    def rewrite_request(
+        self, body: Dict[str, Any], model: str, endpoint_path: str
+    ) -> Dict[str, Any]:
+        if model in self.aliases:
+            body = dict(body)
+            body["model"] = self.aliases[model]
+        return body
+
+
+_REWRITERS = {
+    "noop": NoopRequestRewriter,
+}
+
+
+def get_request_rewriter(
+    name: str = "noop", aliases: Optional[Dict[str, str]] = None
+) -> RequestRewriter:
+    """Factory (reference rewriter.py:97-107); aliases take priority."""
+    if aliases:
+        return ModelAliasRewriter(aliases)
+    try:
+        return _REWRITERS[name]()
+    except KeyError:
+        raise ValueError(f"Unknown request rewriter {name!r}") from None

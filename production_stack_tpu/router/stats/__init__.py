@@ -1,0 +1,4 @@
+"""Stats plane: engine scraping, request lifecycle windows, periodic logging.
+
+Reference counterpart: src/vllm_router/stats/.
+"""

@@ -1,0 +1,161 @@
+"""Engine /metrics scraper.
+
+Reference counterpart: src/vllm_router/stats/engine_stats.py:27-196
+(EngineStats.from_vllm_scrape, EngineStatsScraper background thread).
+
+Design deviation: the reference runs a thread with blocking ``requests`` GETs
+(engine_stats.py:92-110); our router is a single-event-loop aiohttp app, so
+the scraper is an asyncio task that fans out concurrent GETs to all engines —
+one slow engine no longer delays the others' scrape freshness.  Metric names
+are resolved through the shared vocabulary module (vocabulary.py) so the
+router can front both our JAX engine (``tpu:*``) and stock vLLM (``vllm:*``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+import time
+from typing import Dict, Optional
+
+import aiohttp
+from prometheus_client.parser import text_string_to_metric_families
+
+from production_stack_tpu.router.stats.vocabulary import ENGINE_METRIC_CANDIDATES
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class EngineStats:
+    """One engine's scraped gauges (canonical vocabulary)."""
+
+    num_running_requests: int = 0
+    num_queuing_requests: int = 0
+    kv_usage_perc: float = 0.0
+    prefix_cache_hit_rate: float = 0.0
+    kv_offload_usage_perc: float = 0.0
+    accelerator_utilization: float = 0.0
+    scraped_at: float = 0.0
+
+    @classmethod
+    def from_prometheus_text(cls, text: str, scraped_at: Optional[float] = None) -> "EngineStats":
+        values: Dict[str, float] = {}
+        for family in text_string_to_metric_families(text):
+            for sample in family.samples:
+                # Last sample wins; engine gauges are unlabeled or
+                # single-labeled per engine, either is fine for a scalar read.
+                values[sample.name] = sample.value
+        fields: Dict[str, float] = {}
+        for field, candidates in ENGINE_METRIC_CANDIDATES.items():
+            for name in candidates:
+                # prometheus_client normalizes ':' in exposition names; check both.
+                for probe in (name, name.replace(":", "_")):
+                    if probe in values:
+                        fields[field] = values[probe]
+                        break
+                else:
+                    continue
+                break
+        stats = cls(scraped_at=scraped_at if scraped_at is not None else time.time())
+        for field, value in fields.items():
+            if field.startswith("num_"):
+                setattr(stats, field, int(value))
+            else:
+                setattr(stats, field, float(value))
+        return stats
+
+
+class EngineStatsScraper:
+    """Periodically scrapes every discovered engine's /metrics endpoint."""
+
+    def __init__(
+        self,
+        service_discovery,
+        scrape_interval: float = 10.0,
+        request_timeout: float = 5.0,
+    ):
+        self.service_discovery = service_discovery
+        self.scrape_interval = float(scrape_interval)
+        self.request_timeout = float(request_timeout)
+        self._stats: Dict[str, EngineStats] = {}
+        self._unreachable: set = set()
+        self._task: Optional[asyncio.Task] = None
+        self._session: Optional[aiohttp.ClientSession] = None
+        self._last_loop_at: float = 0.0
+
+    async def start(self) -> None:
+        if self._task is not None:
+            return
+        self._session = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=self.request_timeout)
+        )
+        self._last_loop_at = time.time()
+        self._task = asyncio.create_task(self._run(), name="engine-stats-scraper")
+
+    async def close(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._task = None
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
+
+    async def _run(self) -> None:
+        while True:
+            try:
+                await self.scrape_once()
+            except Exception:
+                logger.exception("engine stats scrape loop error")
+            self._last_loop_at = time.time()
+            await asyncio.sleep(self.scrape_interval)
+
+    async def scrape_once(self) -> None:
+        endpoints = self.service_discovery.get_endpoint_info()
+        urls = [ep.url for ep in endpoints]
+        results = await asyncio.gather(
+            *(self._scrape_one(url) for url in urls), return_exceptions=True
+        )
+        fresh: Dict[str, EngineStats] = {}
+        unreachable = set()
+        for url, result in zip(urls, results):
+            if isinstance(result, EngineStats):
+                fresh[url] = result
+            else:
+                # Unreachable engines are dropped from stats so routing does
+                # not consider them fresh (reference engine_stats.py:107-109),
+                # and flagged so the request path can avoid them entirely
+                # (improvement over the reference, which keeps round-robining
+                # onto dead static backends).
+                logger.warning("Failed to scrape %s/metrics: %s", url, result)
+                unreachable.add(url)
+        self._stats = fresh
+        self._unreachable = unreachable
+
+    async def _scrape_one(self, url: str) -> EngineStats:
+        assert self._session is not None, "scraper not started"
+        async with self._session.get(f"{url}/metrics") as resp:
+            resp.raise_for_status()
+            text = await resp.text()
+        return EngineStats.from_prometheus_text(text)
+
+    # -- read side (sync, called from request path) ------------------------
+
+    def get_engine_stats(self) -> Dict[str, EngineStats]:
+        return dict(self._stats)
+
+    def get_unreachable_urls(self) -> set:
+        """Engines whose last /metrics scrape failed (likely down)."""
+        return set(self._unreachable)
+
+    def get_health(self) -> bool:
+        """Scrape loop is alive if it ticked within 3 intervals
+        (reference composes this into /health, main_router.py:125-160)."""
+        if self._task is None or self._task.done():
+            return False
+        return (time.time() - self._last_loop_at) < 3 * self.scrape_interval + 10

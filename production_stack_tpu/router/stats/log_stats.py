@@ -1,0 +1,60 @@
+"""Periodic human-readable stats dump.
+
+Reference counterpart: src/vllm_router/stats/log_stats.py:21-82.  The
+reference launches this with the wrong arity (app.py:222-225 passes one arg
+to a two-arg function) so it crashes silently inside a daemon thread —
+SURVEY.md section 7 bug list.  Here it is an asyncio task owned by the app's
+cleanup context, so a crash is visible and cancellation is clean.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+
+logger = logging.getLogger("production_stack_tpu.stats")
+
+
+def format_stats_block(registry) -> str:
+    from production_stack_tpu.router.service_discovery import DISCOVERY_SERVICE
+    from production_stack_tpu.router.services.request_service.request import (
+        ENGINE_STATS_SCRAPER,
+        REQUEST_STATS_MONITOR,
+    )
+
+    lines = ["", "==================== Router Stats ===================="]
+    discovery = registry.get(DISCOVERY_SERVICE)
+    endpoints = discovery.get_endpoint_info() if discovery else []
+    lines.append(f"Endpoints ({len(endpoints)}):")
+    for ep in endpoints:
+        lines.append(f"  {ep.url}  models={ep.model_names}")
+
+    scraper = registry.get(ENGINE_STATS_SCRAPER)
+    if scraper:
+        for url, es in sorted(scraper.get_engine_stats().items()):
+            lines.append(
+                f"  [engine ] {url}: running={es.num_running_requests} "
+                f"waiting={es.num_queuing_requests} kv={es.kv_usage_perc:.1%} "
+                f"prefix_hit={es.prefix_cache_hit_rate:.1%}"
+            )
+    monitor = registry.get(REQUEST_STATS_MONITOR)
+    if monitor:
+        for url, rs in sorted(monitor.get_request_stats(time.time()).items()):
+            lines.append(
+                f"  [request] {url}: qps={rs.qps:.2f} ttft={rs.ttft * 1e3:.1f}ms "
+                f"latency={rs.latency:.2f}s itl={rs.itl * 1e3:.1f}ms "
+                f"prefill={rs.in_prefill_requests} decode={rs.in_decoding_requests} "
+                f"finished={rs.finished_requests}"
+            )
+    lines.append("======================================================")
+    return "\n".join(lines)
+
+
+async def log_stats_task(registry, interval: float = 10.0) -> None:
+    while True:
+        await asyncio.sleep(interval)
+        try:
+            logger.info(format_stats_block(registry))
+        except Exception:
+            logger.exception("stats logging failed")

@@ -1,0 +1,236 @@
+"""Per-engine request lifecycle statistics with sliding windows.
+
+Reference counterpart: src/vllm_router/stats/request_stats.py:20-282
+(RequestStats, MovingAverageMonitor, RequestStatsMonitor).
+
+Bugs in the reference deliberately fixed here (SURVEY.md section 7):
+
+* the latency / decoding-length monitors were write-orphaned — allocated at
+  request_stats.py:122-123 but never ``update()``-ed, so the router's
+  ``/metrics`` exported frozen zeros.  Here ``on_request_complete`` feeds
+  end-to-end latency, and inter-token latency is derived from the streaming
+  chunk callbacks.
+* the router-side queueing delay the reference dashboard charts but never
+  measures (``vllm:router_queueing_delay_seconds``, SURVEY.md section 5)
+  is measured here: time between router receive and backend connect.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import deque
+from typing import Deque, Dict, Optional, Tuple
+
+
+@dataclasses.dataclass
+class RequestStats:
+    """Snapshot of one engine's request-level stats."""
+
+    qps: float = 0.0
+    ttft: float = 0.0  # seconds, sliding-window average
+    in_prefill_requests: int = 0
+    in_decoding_requests: int = 0
+    finished_requests: int = 0
+    uncompleted_requests: int = 0
+    latency: float = 0.0  # end-to-end seconds, sliding-window average
+    itl: float = 0.0  # inter-token latency seconds, sliding-window average
+    queueing_delay: float = 0.0  # router-side, seconds
+    decoding_length: float = 0.0  # avg streamed chunks per finished request
+
+
+class SlidingWindow:
+    """Timestamped samples over the last ``window`` seconds."""
+
+    def __init__(self, window: float):
+        self.window = window
+        self._samples: Deque[Tuple[float, float]] = deque()
+
+    def update(self, timestamp: float, value: float) -> None:
+        self._samples.append((timestamp, value))
+        self._expire(timestamp)
+
+    def _expire(self, now: float) -> None:
+        cutoff = now - self.window
+        while self._samples and self._samples[0][0] < cutoff:
+            self._samples.popleft()
+
+    def count(self, now: Optional[float] = None) -> int:
+        if now is not None:
+            self._expire(now)
+        return len(self._samples)
+
+    def average(self, now: Optional[float] = None) -> float:
+        if now is not None:
+            self._expire(now)
+        if not self._samples:
+            return 0.0
+        return sum(v for _, v in self._samples) / len(self._samples)
+
+    def rate(self, now: Optional[float] = None) -> float:
+        """Samples per second over the window."""
+        if now is None:
+            now = time.time()
+        self._expire(now)
+        return len(self._samples) / self.window
+
+
+class _EngineWindows:
+    __slots__ = (
+        "arrivals",
+        "ttft",
+        "latency",
+        "itl",
+        "queueing",
+        "decoding_length",
+        "finished",
+        "in_prefill",
+        "in_decoding",
+    )
+
+    def __init__(self, window: float):
+        self.arrivals = SlidingWindow(window)
+        self.ttft = SlidingWindow(window)
+        self.latency = SlidingWindow(window)
+        self.itl = SlidingWindow(window)
+        self.queueing = SlidingWindow(window)
+        self.decoding_length = SlidingWindow(window)
+        self.finished = 0
+        self.in_prefill = 0
+        self.in_decoding = 0
+
+
+class RequestStatsMonitor:
+    """Tracks request lifecycle per engine URL.
+
+    Lifecycle callbacks, called from the proxy data path
+    (reference: services/request_service/request.py:68,95-107):
+
+      on_new_request -> [on_backend_connected] -> on_request_response
+      -> on_token_chunk* -> on_request_complete | on_request_failed
+    """
+
+    def __init__(self, sliding_window_size: float = 60.0):
+        self.sliding_window_size = float(sliding_window_size)
+        self._lock = threading.Lock()
+        self._engines: Dict[str, _EngineWindows] = {}
+        # (engine_url, request_id) -> timestamps
+        self._arrived_at: Dict[Tuple[str, str], float] = {}
+        self._first_token_at: Dict[Tuple[str, str], float] = {}
+        self._last_token_at: Dict[Tuple[str, str], float] = {}
+        self._chunk_count: Dict[Tuple[str, str], int] = {}
+
+    def _windows(self, engine_url: str) -> _EngineWindows:
+        if engine_url not in self._engines:
+            self._engines[engine_url] = _EngineWindows(self.sliding_window_size)
+        return self._engines[engine_url]
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def on_new_request(self, engine_url: str, request_id: str, timestamp: float) -> None:
+        with self._lock:
+            w = self._windows(engine_url)
+            w.arrivals.update(timestamp, 1.0)
+            w.in_prefill += 1
+            self._arrived_at[(engine_url, request_id)] = timestamp
+
+    def on_backend_connected(
+        self, engine_url: str, request_id: str, timestamp: float
+    ) -> None:
+        """Backend stream opened: records router-side queueing delay."""
+        key = (engine_url, request_id)
+        with self._lock:
+            arrived = self._arrived_at.get(key)
+            if arrived is not None:
+                self._windows(engine_url).queueing.update(timestamp, timestamp - arrived)
+
+    def on_request_response(
+        self, engine_url: str, request_id: str, timestamp: float
+    ) -> None:
+        """First token chunk arrived: TTFT; request moves prefill -> decode."""
+        key = (engine_url, request_id)
+        with self._lock:
+            if key in self._first_token_at:
+                return
+            self._first_token_at[key] = timestamp
+            # Seed the inter-token clock and count the first chunk here; the
+            # first chunk defines no ITL interval, so it must not produce an
+            # ITL sample (n chunks -> n-1 intervals).
+            self._last_token_at[key] = timestamp
+            self._chunk_count[key] = 1
+            w = self._windows(engine_url)
+            arrived = self._arrived_at.get(key)
+            if arrived is not None:
+                w.ttft.update(timestamp, timestamp - arrived)
+            w.in_prefill = max(0, w.in_prefill - 1)
+            w.in_decoding += 1
+
+    def on_token_chunk(self, engine_url: str, request_id: str, timestamp: float) -> None:
+        """Per streamed chunk: feeds inter-token latency."""
+        key = (engine_url, request_id)
+        with self._lock:
+            last = self._last_token_at.get(key)
+            if last is not None:
+                self._windows(engine_url).itl.update(timestamp, timestamp - last)
+            self._last_token_at[key] = timestamp
+            self._chunk_count[key] = self._chunk_count.get(key, 0) + 1
+
+    def on_request_complete(
+        self, engine_url: str, request_id: str, timestamp: float
+    ) -> None:
+        key = (engine_url, request_id)
+        with self._lock:
+            w = self._windows(engine_url)
+            arrived = self._arrived_at.pop(key, None)
+            if arrived is not None:
+                w.latency.update(timestamp, timestamp - arrived)
+            if key in self._first_token_at:
+                w.in_decoding = max(0, w.in_decoding - 1)
+            else:
+                # Completed without any token chunk (e.g. non-streaming).
+                w.in_prefill = max(0, w.in_prefill - 1)
+            w.finished += 1
+            chunks = self._chunk_count.pop(key, 0)
+            if chunks:
+                w.decoding_length.update(timestamp, float(chunks))
+            self._first_token_at.pop(key, None)
+            self._last_token_at.pop(key, None)
+
+    def on_request_failed(self, engine_url: str, request_id: str, timestamp: float) -> None:
+        """Failed or client-aborted request: drop in-flight state, no latency sample."""
+        key = (engine_url, request_id)
+        with self._lock:
+            w = self._windows(engine_url)
+            if self._arrived_at.pop(key, None) is not None:
+                if key in self._first_token_at:
+                    w.in_decoding = max(0, w.in_decoding - 1)
+                else:
+                    w.in_prefill = max(0, w.in_prefill - 1)
+            self._first_token_at.pop(key, None)
+            self._last_token_at.pop(key, None)
+            self._chunk_count.pop(key, None)
+
+    # -- read side ---------------------------------------------------------
+
+    def get_request_stats(self, current_time: Optional[float] = None) -> Dict[str, RequestStats]:
+        now = time.time() if current_time is None else current_time
+        out: Dict[str, RequestStats] = {}
+        with self._lock:
+            uncompleted: Dict[str, int] = {}
+            for (url, _), _ts in self._arrived_at.items():
+                uncompleted[url] = uncompleted.get(url, 0) + 1
+            for url, w in self._engines.items():
+                out[url] = RequestStats(
+                    qps=w.arrivals.rate(now),
+                    ttft=w.ttft.average(now),
+                    in_prefill_requests=w.in_prefill,
+                    in_decoding_requests=w.in_decoding,
+                    finished_requests=w.finished,
+                    uncompleted_requests=uncompleted.get(url, 0),
+                    latency=w.latency.average(now),
+                    itl=w.itl.average(now),
+                    queueing_delay=w.queueing.average(now),
+                    decoding_length=w.decoding_length.average(now),
+                )
+        return out

@@ -1,0 +1,61 @@
+"""Engine metric vocabulary — the single place TPU metric names live.
+
+SURVEY.md section 7 "Hard parts" calls this out: the scraper, the Grafana
+dashboard, the prometheus-adapter rule and the HPA all key off engine metric
+names, and vLLM-TPU names differ from CUDA vLLM's (reference scraper
+hard-codes ``vllm:gpu_cache_usage_perc`` etc. at
+src/vllm_router/stats/engine_stats.py:52-55).
+
+Canonical fields map to an ordered list of candidate Prometheus metric names;
+the first present wins.  Our JAX engine emits the ``tpu:`` names; stock
+vLLM(-TPU) emits the ``vllm:`` names — the scraper understands both, so the
+router can front either engine.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+# Canonical engine-stat field -> candidate gauge names, most preferred first.
+ENGINE_METRIC_CANDIDATES: Dict[str, List[str]] = {
+    "num_running_requests": [
+        "tpu:num_requests_running",
+        "vllm:num_requests_running",
+    ],
+    "num_queuing_requests": [
+        "tpu:num_requests_waiting",
+        "vllm:num_requests_waiting",
+    ],
+    # Fraction (0-1) of the paged-KV block pool in TPU HBM that is in use.
+    "kv_usage_perc": [
+        "tpu:hbm_kv_usage_perc",
+        "vllm:gpu_cache_usage_perc",
+        "vllm:cpu_cache_usage_perc",
+    ],
+    # Rolling prefix-cache hit rate (0-1).
+    "prefix_cache_hit_rate": [
+        "tpu:prefix_cache_hit_rate",
+        "vllm:gpu_prefix_cache_hit_rate",
+    ],
+    # Fraction of KV blocks currently offloaded to host DRAM.
+    "kv_offload_usage_perc": [
+        "tpu:host_kv_usage_perc",
+    ],
+    # TPU duty cycle (0-1), the TPU analogue of GPU utilization.
+    "accelerator_utilization": [
+        "tpu:duty_cycle",
+    ],
+}
+
+# Names our own engine exports (used by the engine server and the fake
+# engine; keep in sync with ENGINE_METRIC_CANDIDATES above).
+TPU_NUM_REQUESTS_RUNNING = "tpu:num_requests_running"
+TPU_NUM_REQUESTS_WAITING = "tpu:num_requests_waiting"
+TPU_HBM_KV_USAGE_PERC = "tpu:hbm_kv_usage_perc"
+TPU_PREFIX_CACHE_HIT_RATE = "tpu:prefix_cache_hit_rate"
+TPU_HOST_KV_USAGE_PERC = "tpu:host_kv_usage_perc"
+TPU_DUTY_CYCLE = "tpu:duty_cycle"
+
+# The custom metric the prometheus-adapter exposes for HPA (reference:
+# observability/prom-adapter.yaml:8-20 exposes vllm:num_requests_waiting).
+HPA_QUEUE_METRIC = TPU_NUM_REQUESTS_WAITING

@@ -1,0 +1,1 @@
+"""Test doubles: fake TPU serving engine (reference: src/tests/perftest/)."""

@@ -1,0 +1,251 @@
+"""Fake TPU serving engine: SSE token streaming + TPU-vocabulary /metrics.
+
+Reference counterpart: src/tests/perftest/fake-openai-server.py:50-171 — the
+stand-in backend that makes the whole stack testable without accelerators
+(SURVEY.md section 4 takeaway).  Ours emits the ``tpu:`` metric vocabulary
+our scraper/dashboard/HPA key off, simulates a configurable TTFT and
+tokens/s, and tracks running-request gauges so load-aware routing is
+exercisable in CI.
+
+Usable three ways: as an importable aiohttp app factory (unit tests), as a
+CLI (perf tests / CI workflows), and inside the helm chart's clusterless CI
+values as a stand-in engine image command.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import random
+import time
+import uuid
+
+from aiohttp import web
+
+from production_stack_tpu.router.stats import vocabulary as vocab
+
+
+class FakeEngineState:
+    def __init__(
+        self,
+        model: str = "fake/llama-3-8b",
+        tokens_per_sec: float = 500.0,
+        ttft: float = 0.02,
+        max_tokens_default: int = 100,
+        seed: int = 0,
+    ):
+        self.model = model
+        self.tokens_per_sec = tokens_per_sec
+        self.ttft = ttft
+        self.max_tokens_default = max_tokens_default
+        self.num_running = 0
+        self.num_waiting = 0
+        self.total_requests = 0
+        self.prefix_hits = 0
+        self.prefix_queries = 0
+        self._rng = random.Random(seed)
+        self._seen_prefixes: set = set()
+
+    def note_prompt(self, prompt_text: str) -> None:
+        """Rough prefix-cache simulation so hit-rate metrics move in CI."""
+        key = hash(prompt_text[:2048])
+        self.prefix_queries += 1
+        if key in self._seen_prefixes:
+            self.prefix_hits += 1
+        else:
+            self._seen_prefixes.add(key)
+
+    @property
+    def prefix_hit_rate(self) -> float:
+        if not self.prefix_queries:
+            return 0.0
+        return self.prefix_hits / self.prefix_queries
+
+    @property
+    def kv_usage(self) -> float:
+        return min(1.0, self.num_running * 0.05)
+
+
+def _sse(data: dict) -> bytes:
+    return f"data: {json.dumps(data)}\n\n".encode()
+
+
+def _word(rng: random.Random) -> str:
+    return rng.choice(
+        ["alpha", "bravo", "charlie", "delta", "echo", "foxtrot", "tensor", "tpu"]
+    )
+
+
+def build_fake_engine_app(state: FakeEngineState | None = None) -> web.Application:
+    state = state or FakeEngineState()
+    app = web.Application()
+    app["state"] = state
+
+    async def models(_request: web.Request) -> web.Response:
+        return web.json_response(
+            {
+                "object": "list",
+                "data": [
+                    {
+                        "id": state.model,
+                        "object": "model",
+                        "created": int(time.time()),
+                        "owned_by": "fake-tpu-engine",
+                    }
+                ],
+            }
+        )
+
+    async def health(_request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok"})
+
+    async def metrics(_request: web.Request) -> web.Response:
+        lines = []
+        for name, value in [
+            (vocab.TPU_NUM_REQUESTS_RUNNING, state.num_running),
+            (vocab.TPU_NUM_REQUESTS_WAITING, state.num_waiting),
+            (vocab.TPU_HBM_KV_USAGE_PERC, state.kv_usage),
+            (vocab.TPU_PREFIX_CACHE_HIT_RATE, state.prefix_hit_rate),
+            (vocab.TPU_HOST_KV_USAGE_PERC, 0.0),
+            (vocab.TPU_DUTY_CYCLE, min(1.0, state.num_running * 0.1)),
+        ]:
+            lines.append(f"# TYPE {name} gauge")
+            lines.append(f"{name} {float(value)}")
+        return web.Response(text="\n".join(lines) + "\n")
+
+    async def chat_completions(request: web.Request) -> web.StreamResponse:
+        return await _completion_common(request, chat=True)
+
+    async def completions(request: web.Request) -> web.StreamResponse:
+        return await _completion_common(request, chat=False)
+
+    async def _completion_common(request: web.Request, chat: bool) -> web.StreamResponse:
+        body = await request.json()
+        stream = bool(body.get("stream", False))
+        max_tokens = int(
+            body.get("max_tokens")
+            or body.get("max_completion_tokens")
+            or state.max_tokens_default
+        )
+        if chat:
+            prompt_text = json.dumps(body.get("messages", ""))
+        else:
+            prompt_text = str(body.get("prompt", ""))
+        state.note_prompt(prompt_text)
+        request_id = f"cmpl-{uuid.uuid4().hex[:16]}"
+        created = int(time.time())
+        state.total_requests += 1
+        state.num_running += 1
+        try:
+            await asyncio.sleep(state.ttft)
+            interval = 1.0 / state.tokens_per_sec
+            object_name = "chat.completion.chunk" if chat else "text_completion"
+            if stream:
+                response = web.StreamResponse(
+                    headers={
+                        "Content-Type": "text/event-stream",
+                        "Cache-Control": "no-cache",
+                    }
+                )
+                await response.prepare(request)
+                for i in range(max_tokens):
+                    token = _word(state._rng) + " "
+                    if chat:
+                        delta = {"content": token}
+                        if i == 0:
+                            delta["role"] = "assistant"
+                        choice = {"index": 0, "delta": delta, "finish_reason": None}
+                    else:
+                        choice = {"index": 0, "text": token, "finish_reason": None}
+                    await response.write(
+                        _sse(
+                            {
+                                "id": request_id,
+                                "object": object_name,
+                                "created": created,
+                                "model": body.get("model", state.model),
+                                "choices": [choice],
+                            }
+                        )
+                    )
+                    await asyncio.sleep(interval)
+                final_choice = (
+                    {"index": 0, "delta": {}, "finish_reason": "length"}
+                    if chat
+                    else {"index": 0, "text": "", "finish_reason": "length"}
+                )
+                await response.write(
+                    _sse(
+                        {
+                            "id": request_id,
+                            "object": object_name,
+                            "created": created,
+                            "model": body.get("model", state.model),
+                            "choices": [final_choice],
+                            "usage": {
+                                "prompt_tokens": len(prompt_text) // 4,
+                                "completion_tokens": max_tokens,
+                                "total_tokens": len(prompt_text) // 4 + max_tokens,
+                            },
+                        }
+                    )
+                )
+                await response.write(b"data: [DONE]\n\n")
+                await response.write_eof()
+                return response
+            await asyncio.sleep(max_tokens * interval)
+            text = " ".join(_word(state._rng) for _ in range(max_tokens))
+            if chat:
+                choice = {
+                    "index": 0,
+                    "message": {"role": "assistant", "content": text},
+                    "finish_reason": "length",
+                }
+                object_name = "chat.completion"
+            else:
+                choice = {"index": 0, "text": text, "finish_reason": "length"}
+                object_name = "text_completion"
+            return web.json_response(
+                {
+                    "id": request_id,
+                    "object": object_name,
+                    "created": created,
+                    "model": body.get("model", state.model),
+                    "choices": [choice],
+                    "usage": {
+                        "prompt_tokens": len(prompt_text) // 4,
+                        "completion_tokens": max_tokens,
+                        "total_tokens": len(prompt_text) // 4 + max_tokens,
+                    },
+                }
+            )
+        finally:
+            state.num_running -= 1
+
+    app.router.add_get("/v1/models", models)
+    app.router.add_get("/health", health)
+    app.router.add_get("/metrics", metrics)
+    app.router.add_post("/v1/chat/completions", chat_completions)
+    app.router.add_post("/v1/completions", completions)
+    return app
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(description="Fake TPU serving engine")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=9000)
+    parser.add_argument("--model", default="fake/llama-3-8b")
+    parser.add_argument("--tokens-per-sec", type=float, default=500.0)
+    parser.add_argument("--ttft", type=float, default=0.02)
+    args = parser.parse_args(argv)
+    state = FakeEngineState(
+        model=args.model, tokens_per_sec=args.tokens_per_sec, ttft=args.ttft
+    )
+    web.run_app(
+        build_fake_engine_app(state), host=args.host, port=args.port, access_log=None
+    )
+
+
+if __name__ == "__main__":
+    main()

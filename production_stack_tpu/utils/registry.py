@@ -53,6 +53,14 @@ class ServiceRegistry:
 
         The old service (if any) is closed *after* the swap so readers never
         observe a missing service mid-reconfigure.
+
+        Note on lifetimes: readers hold raw references, so a reader that
+        fetched the old service just before the swap may still be using it
+        when ``close_old`` runs.  ``close_old`` must therefore be graceful
+        for in-flight users — e.g. discovery/scraper closes cancel background
+        tasks but leave read methods safe, and long-lived IO objects (client
+        sessions) should be drained or closed with a grace period rather
+        than hard-closed here.
         """
         new = factory()
         with self._lock:

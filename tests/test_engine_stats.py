@@ -1,0 +1,59 @@
+"""EngineStats Prometheus parsing across both metric vocabularies.
+
+Reference counterpart: EngineStats.from_vllm_scrape
+(src/vllm_router/stats/engine_stats.py:27-62), which only understands CUDA
+vLLM names; ours resolves through the shared vocabulary (vocabulary.py).
+"""
+
+from production_stack_tpu.router.stats.engine_stats import EngineStats
+
+TPU_METRICS = """\
+# HELP tpu:num_requests_running Number of running requests
+# TYPE tpu:num_requests_running gauge
+tpu:num_requests_running 3.0
+# TYPE tpu:num_requests_waiting gauge
+tpu:num_requests_waiting 7.0
+# TYPE tpu:hbm_kv_usage_perc gauge
+tpu:hbm_kv_usage_perc 0.42
+# TYPE tpu:prefix_cache_hit_rate gauge
+tpu:prefix_cache_hit_rate 0.87
+# TYPE tpu:host_kv_usage_perc gauge
+tpu:host_kv_usage_perc 0.11
+# TYPE tpu:duty_cycle gauge
+tpu:duty_cycle 0.93
+"""
+
+VLLM_METRICS = """\
+# TYPE vllm:num_requests_running gauge
+vllm:num_requests_running{model_name="m"} 2.0
+# TYPE vllm:num_requests_waiting gauge
+vllm:num_requests_waiting{model_name="m"} 5.0
+# TYPE vllm:gpu_cache_usage_perc gauge
+vllm:gpu_cache_usage_perc{model_name="m"} 0.31
+# TYPE vllm:gpu_prefix_cache_hit_rate gauge
+vllm:gpu_prefix_cache_hit_rate{model_name="m"} 0.66
+"""
+
+
+def test_parse_tpu_vocabulary():
+    s = EngineStats.from_prometheus_text(TPU_METRICS)
+    assert s.num_running_requests == 3
+    assert s.num_queuing_requests == 7
+    assert abs(s.kv_usage_perc - 0.42) < 1e-9
+    assert abs(s.prefix_cache_hit_rate - 0.87) < 1e-9
+    assert abs(s.kv_offload_usage_perc - 0.11) < 1e-9
+    assert abs(s.accelerator_utilization - 0.93) < 1e-9
+
+
+def test_parse_vllm_vocabulary_compat():
+    s = EngineStats.from_prometheus_text(VLLM_METRICS)
+    assert s.num_running_requests == 2
+    assert s.num_queuing_requests == 5
+    assert abs(s.kv_usage_perc - 0.31) < 1e-9
+    assert abs(s.prefix_cache_hit_rate - 0.66) < 1e-9
+
+
+def test_parse_empty_text_defaults():
+    s = EngineStats.from_prometheus_text("")
+    assert s.num_running_requests == 0
+    assert s.kv_usage_perc == 0.0

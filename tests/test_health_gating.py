@@ -1,0 +1,79 @@
+"""Unreachable-engine gating: the router must stop routing to a backend whose
+/metrics scrape fails, as long as a reachable one remains.
+
+This is an improvement over the reference, which keeps round-robining onto
+dead static backends (observed during end-to-end verification; the reference
+only gets health gating from K8s readiness, service_discovery.py:121-129).
+"""
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from production_stack_tpu.router.app import build_app
+from production_stack_tpu.router.parser import parse_args
+from production_stack_tpu.router.services.request_service.request import (
+    ENGINE_STATS_SCRAPER,
+)
+from production_stack_tpu.testing.fake_engine import FakeEngineState, build_fake_engine_app
+
+
+async def test_dead_engine_excluded_after_scrape():
+    state = FakeEngineState()
+    engine = TestServer(build_fake_engine_app(state))
+    await engine.start_server()
+    alive = str(engine.make_url("")).rstrip("/")
+    dead = "http://127.0.0.1:9"  # nothing listens here
+
+    args = parse_args(
+        [
+            "--static-backends",
+            f"{alive},{dead}",
+            "--static-models",
+            "m,m",
+            "--engine-stats-interval",
+            "3600",  # only the startup scrape runs
+        ]
+    )
+    app = build_app(args)
+    server = TestServer(app)
+    await server.start_server()
+    client = TestClient(server)
+    try:
+        scraper = app["registry"].require(ENGINE_STATS_SCRAPER)
+        assert dead in scraper.get_unreachable_urls()
+        # 6 round-robin requests: all must land on the live engine.
+        for _ in range(6):
+            resp = await client.post(
+                "/v1/completions", json={"model": "m", "prompt": "x", "max_tokens": 1}
+            )
+            assert resp.status == 200
+        assert state.total_requests == 6
+    finally:
+        await client.close()
+        await engine.close()
+
+
+async def test_all_unreachable_still_tries():
+    """If every engine looks dead, optimistically route anyway (scrape may lag)."""
+    state = FakeEngineState()
+    engine = TestServer(build_fake_engine_app(state))
+    await engine.start_server()
+    alive = str(engine.make_url("")).rstrip("/")
+
+    args = parse_args(
+        ["--static-backends", alive, "--static-models", "m",
+         "--engine-stats-interval", "3600"]
+    )
+    app = build_app(args)
+    server = TestServer(app)
+    await server.start_server()
+    client = TestClient(server)
+    try:
+        scraper = app["registry"].require(ENGINE_STATS_SCRAPER)
+        scraper._unreachable = {alive}  # simulate stale scrape
+        resp = await client.post(
+            "/v1/completions", json={"model": "m", "prompt": "x", "max_tokens": 1}
+        )
+        assert resp.status == 200
+    finally:
+        await client.close()
+        await engine.close()

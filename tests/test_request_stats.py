@@ -1,0 +1,94 @@
+"""RequestStatsMonitor lifecycle and sliding-window semantics.
+
+Reference counterpart behaviors: src/vllm_router/stats/request_stats.py
+(QPS/TTFT windows, prefill/decode transitions) — plus the latency/ITL/
+queueing-delay measurements the reference allocated but never fed
+(SURVEY.md section 7 bug list).
+"""
+
+from production_stack_tpu.router.stats.request_stats import (
+    RequestStatsMonitor,
+    SlidingWindow,
+)
+
+URL = "http://engine:8000"
+
+
+def test_sliding_window_expiry():
+    w = SlidingWindow(window=10.0)
+    w.update(0.0, 1.0)
+    w.update(5.0, 3.0)
+    assert w.average(6.0) == 2.0
+    assert w.average(11.0) == 3.0  # first sample expired
+    assert w.count(16.0) == 0
+
+
+def test_qps_over_window():
+    m = RequestStatsMonitor(sliding_window_size=10.0)
+    for i in range(20):
+        m.on_new_request(URL, f"r{i}", timestamp=float(i) * 0.5)  # 2 rps for 10s
+    stats = m.get_request_stats(current_time=10.0)[URL]
+    assert 1.5 <= stats.qps <= 2.0
+
+
+def test_ttft_and_phase_transitions():
+    m = RequestStatsMonitor(sliding_window_size=60.0)
+    m.on_new_request(URL, "r1", timestamp=100.0)
+    s = m.get_request_stats(current_time=100.5)[URL]
+    assert s.in_prefill_requests == 1 and s.in_decoding_requests == 0
+
+    m.on_request_response(URL, "r1", timestamp=100.8)
+    s = m.get_request_stats(current_time=101.0)[URL]
+    assert s.in_prefill_requests == 0 and s.in_decoding_requests == 1
+    assert abs(s.ttft - 0.8) < 1e-9
+
+    m.on_request_complete(URL, "r1", timestamp=102.0)
+    s = m.get_request_stats(current_time=102.5)[URL]
+    assert s.in_decoding_requests == 0
+    assert s.finished_requests == 1
+    assert abs(s.latency - 2.0) < 1e-9  # fed, unlike the reference
+    assert s.uncompleted_requests == 0
+
+
+def test_itl_from_token_chunks():
+    m = RequestStatsMonitor()
+    m.on_new_request(URL, "r1", timestamp=0.0)
+    # First chunk: seeds the token clock, no ITL sample (n chunks -> n-1
+    # intervals; the reference's scheme would bias ITL low).
+    m.on_request_response(URL, "r1", timestamp=1.0)
+    for i in range(1, 6):
+        m.on_token_chunk(URL, "r1", timestamp=1.0 + i * 0.1)
+    s = m.get_request_stats(current_time=2.0)[URL]
+    assert abs(s.itl - 0.1) < 1e-6
+    m.on_request_complete(URL, "r1", timestamp=2.0)
+    s = m.get_request_stats(current_time=2.0)[URL]
+    assert s.decoding_length == 6.0  # 1 first chunk + 5 subsequent
+
+
+def test_queueing_delay_measured():
+    m = RequestStatsMonitor()
+    m.on_new_request(URL, "r1", timestamp=10.0)
+    m.on_backend_connected(URL, "r1", timestamp=10.25)
+    s = m.get_request_stats(current_time=11.0)[URL]
+    assert abs(s.queueing_delay - 0.25) < 1e-9
+
+
+def test_failed_request_drops_inflight_without_latency_sample():
+    m = RequestStatsMonitor()
+    m.on_new_request(URL, "r1", timestamp=0.0)
+    m.on_request_failed(URL, "r1", timestamp=1.0)
+    s = m.get_request_stats(current_time=1.0)[URL]
+    assert s.in_prefill_requests == 0
+    assert s.finished_requests == 0
+    assert s.latency == 0.0
+
+
+def test_multiple_engines_isolated():
+    m = RequestStatsMonitor()
+    m.on_new_request("http://a", "r1", timestamp=0.0)
+    m.on_new_request("http://b", "r2", timestamp=0.0)
+    m.on_request_complete("http://a", "r1", timestamp=1.0)
+    stats = m.get_request_stats(current_time=1.0)
+    assert stats["http://a"].finished_requests == 1
+    assert stats["http://b"].finished_requests == 0
+    assert stats["http://b"].uncompleted_requests == 1
