@@ -1,0 +1,11 @@
+"""TPU-native serving engine (JAX/XLA/Pallas).
+
+The reference production stack delegates all compute to external vLLM CUDA
+engine images (SURVEY.md: helm/templates/deployment-vllm-multi.yaml:57-64
+runs ``vllm serve``).  There is no such off-the-shelf image contract for
+TPU, so this package makes the stack standalone: an OpenAI-compatible
+serving engine with paged KV-cache attention, continuous batching, prefix
+caching, KV offload to host DRAM, and SPMD parallelism over a
+``jax.sharding.Mesh`` — designed for the MXU/HBM/ICI cost model rather than
+translated from CUDA.
+"""

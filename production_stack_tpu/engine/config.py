@@ -1,0 +1,190 @@
+"""Engine configuration.
+
+Mirrors the configuration surface the reference exposes per modelSpec in
+helm (helm/values.yaml:16-128: model, dtype, maxModelLen, prefix caching,
+chunked prefill, tensorParallelSize) — expressed TPU-first: parallelism is a
+mesh shape, memory is an HBM fraction for the paged-KV pool.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+
+@dataclasses.dataclass
+class ModelConfig:
+    """Decoder-only transformer architecture (llama family + friends)."""
+
+    name: str = "tiny-llama"
+    vocab_size: int = 384  # covers the 260-entry byte-fallback tokenizer
+    hidden_size: int = 64
+    intermediate_size: int = 128
+    num_layers: int = 2
+    num_heads: int = 4
+    num_kv_heads: int = 2
+    head_dim: Optional[int] = None  # defaults to hidden_size // num_heads
+    max_model_len: int = 2048
+    rope_theta: float = 10000.0
+    rms_norm_eps: float = 1e-5
+    tie_word_embeddings: bool = False
+    dtype: str = "bfloat16"
+    # Architecture switches (cover llama/mistral/qwen-style variants).
+    attention_bias: bool = False
+    mlp_bias: bool = False
+    sliding_window: Optional[int] = None  # mistral-style local attention
+
+    def __post_init__(self):
+        if self.head_dim is None:
+            self.head_dim = self.hidden_size // self.num_heads
+        assert self.num_heads % self.num_kv_heads == 0
+
+    @property
+    def q_per_kv(self) -> int:
+        return self.num_heads // self.num_kv_heads
+
+
+# Preset architectures (shapes from the public HF configs of each family;
+# weights are loaded from local checkpoints or randomly initialized).
+PRESETS = {
+    "tiny-llama": ModelConfig(),
+    "debug-1l": ModelConfig(name="debug-1l", num_layers=1),
+    "llama-3.2-1b": ModelConfig(
+        name="llama-3.2-1b",
+        vocab_size=128256,
+        hidden_size=2048,
+        intermediate_size=8192,
+        num_layers=16,
+        num_heads=32,
+        num_kv_heads=8,
+        head_dim=64,
+        max_model_len=8192,
+        rope_theta=500000.0,
+        tie_word_embeddings=True,
+    ),
+    "llama-3.2-3b": ModelConfig(
+        name="llama-3.2-3b",
+        vocab_size=128256,
+        hidden_size=3072,
+        intermediate_size=8192,
+        num_layers=28,
+        num_heads=24,
+        num_kv_heads=8,
+        head_dim=128,
+        max_model_len=8192,
+        rope_theta=500000.0,
+        tie_word_embeddings=True,
+    ),
+    "llama-3-8b": ModelConfig(
+        name="llama-3-8b",
+        vocab_size=128256,
+        hidden_size=4096,
+        intermediate_size=14336,
+        num_layers=32,
+        num_heads=32,
+        num_kv_heads=8,
+        head_dim=128,
+        max_model_len=8192,
+        rope_theta=500000.0,
+    ),
+    "mistral-7b": ModelConfig(
+        name="mistral-7b",
+        vocab_size=32000,
+        hidden_size=4096,
+        intermediate_size=14336,
+        num_layers=32,
+        num_heads=32,
+        num_kv_heads=8,
+        head_dim=128,
+        max_model_len=8192,
+        rope_theta=10000.0,
+        sliding_window=4096,
+    ),
+}
+
+
+@dataclasses.dataclass
+class CacheConfig:
+    """Paged KV cache (TPU HBM pool + host DRAM offload tier)."""
+
+    block_size: int = 16  # tokens per block
+    num_blocks: Optional[int] = None  # None -> sized from HBM fraction
+    hbm_utilization: float = 0.90  # fraction of free HBM for weights+KV
+    enable_prefix_caching: bool = True
+    # Host-DRAM offload tier (the reference's LMCache CPU-offload analogue,
+    # deployment-vllm-multi.yaml:161-166).
+    host_offload_gb: float = 0.0
+    # Remote shared KV store URL, e.g. "kv://host:port"
+    # (reference lm://host:port, _helpers.tpl:164-166).
+    remote_kv_url: Optional[str] = None
+
+
+@dataclasses.dataclass
+class ParallelConfig:
+    """SPMD mesh layout: data/tensor/sequence/expert axes over ICI.
+
+    The reference only passes --tensor-parallel-size through to vLLM
+    (deployment-vllm-multi.yaml:84-87); here the mesh is first-class.
+    """
+
+    data_parallel: int = 1
+    tensor_parallel: int = 1
+    sequence_parallel: int = 1  # ring-attention axis for long context
+    expert_parallel: int = 1  # reserved for MoE models
+
+    @property
+    def mesh_shape(self) -> Tuple[int, int, int]:
+        return (self.data_parallel, self.tensor_parallel, self.sequence_parallel)
+
+    @property
+    def world_size(self) -> int:
+        return (
+            self.data_parallel
+            * self.tensor_parallel
+            * self.sequence_parallel
+            * self.expert_parallel
+        )
+
+
+@dataclasses.dataclass
+class SchedulerConfig:
+    """Continuous batching (vLLM-style scheduler semantics, TPU twist:
+    fixed shape buckets so every step hits a cached XLA executable)."""
+
+    max_num_seqs: int = 8  # decode batch (padded, static shape)
+    max_prefill_tokens: int = 2048  # prefill bucket ceiling
+    prefill_buckets: Tuple[int, ...] = (128, 256, 512, 1024, 2048)
+    max_model_len: int = 2048
+    # "recompute" (drop + re-prefill) or "offload" (page out to host DRAM)
+    preemption_mode: str = "offload"
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    model: ModelConfig = dataclasses.field(default_factory=ModelConfig)
+    cache: CacheConfig = dataclasses.field(default_factory=CacheConfig)
+    parallel: ParallelConfig = dataclasses.field(default_factory=ParallelConfig)
+    scheduler: SchedulerConfig = dataclasses.field(default_factory=SchedulerConfig)
+    seed: int = 0
+    tokenizer: Optional[str] = None  # HF tokenizer path; None -> byte fallback
+    weights_path: Optional[str] = None  # safetensors dir; None -> random init
+
+    def __post_init__(self):
+        # The scheduler must not admit sequences the cache cannot hold.
+        self.scheduler.max_model_len = min(
+            self.scheduler.max_model_len, self.model.max_model_len
+        )
+
+
+def config_from_preset(name: str, **overrides) -> EngineConfig:
+    if name not in PRESETS:
+        raise ValueError(f"Unknown model preset {name!r}; available: {sorted(PRESETS)}")
+    model = dataclasses.replace(PRESETS[name])
+    cfg = EngineConfig(model=model)
+    for key, value in overrides.items():
+        obj = cfg
+        *path, last = key.split(".")
+        for part in path:
+            obj = getattr(obj, part)
+        setattr(obj, last, value)
+    return cfg

@@ -1,0 +1,1 @@
+"""Engine core: sequences, continuous-batching scheduler, step loop."""

@@ -1,0 +1,404 @@
+"""LLMEngine: the synchronous serving engine core.
+
+Owns params + paged KV caches on device, the block pool, the scheduler and
+the jitted step functions.  ``step()`` executes exactly one scheduler plan
+(one bucketed prefill or one padded decode batch) — every plan shape maps to
+a cached XLA executable, so steady-state serving never recompiles.
+
+The engine is the TPU-side counterpart of what the reference runs as an
+external ``vllm serve`` container (deployment-vllm-multi.yaml:57-64); the
+server wrapper in engine/server/ speaks the same OpenAI + /metrics contract
+the router expects.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from functools import partial
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from production_stack_tpu.engine.config import EngineConfig
+from production_stack_tpu.engine.core.scheduler import (
+    DecodePlan,
+    PrefillPlan,
+    Scheduler,
+)
+from production_stack_tpu.engine.core.sequence import (
+    FinishReason,
+    SamplingParams,
+    Sequence,
+    SequenceStatus,
+    StepOutput,
+)
+from production_stack_tpu.engine.kv.block_pool import BlockPool
+from production_stack_tpu.engine.kv.offload import HostOffloadManager
+from production_stack_tpu.engine.models import get_model
+from production_stack_tpu.engine.models.weights import load_params
+from production_stack_tpu.engine.sampling import sample_tokens
+from production_stack_tpu.engine.tokenizer import get_tokenizer
+
+logger = logging.getLogger(__name__)
+
+
+def _dtype_size(dtype: str) -> int:
+    return jnp.dtype(dtype).itemsize
+
+
+class LLMEngine:
+    def __init__(self, config: EngineConfig):
+        self.config = config
+        cfg = config.model
+        self.model = get_model(cfg.name)
+        self.tokenizer = get_tokenizer(config.tokenizer)
+        if self.tokenizer.vocab_size > cfg.vocab_size:
+            raise ValueError(
+                f"Tokenizer vocab ({self.tokenizer.vocab_size}) exceeds model "
+                f"vocab ({cfg.vocab_size})"
+            )
+
+        logger.info("Loading params for %s ...", cfg.name)
+        self.params = load_params(cfg, config.weights_path, seed=config.seed)
+
+        num_blocks = self._decide_num_blocks()
+        self.block_pool = BlockPool(
+            num_blocks,
+            config.cache.block_size,
+            enable_prefix_caching=config.cache.enable_prefix_caching,
+        )
+        self.scheduler = Scheduler(
+            config.scheduler, self.block_pool, offload_cb=self.offload_seq_blocks
+        )
+        self.kv_caches = self._allocate_kv(num_blocks)
+        logger.info(
+            "KV pool: %d blocks x %d tokens (%.2f GiB)",
+            num_blocks,
+            config.cache.block_size,
+            self._kv_bytes(num_blocks) / 2**30,
+        )
+
+        offload_bytes = int(config.cache.host_offload_gb * 2**30)
+        remote_client = None
+        if config.cache.remote_kv_url:
+            from production_stack_tpu.kvserver.client import RemoteKVClient
+
+            remote_client = RemoteKVClient(config.cache.remote_kv_url)
+        self.offload = HostOffloadManager(offload_bytes, remote_client)
+
+        # Fixed shape constants.
+        self._bmax = config.scheduler.max_model_len // config.cache.block_size
+        self._smax = config.scheduler.max_num_seqs
+
+        # Jitted step functions.  KV caches are donated so updates alias the
+        # same HBM; cfg is closed over (static).
+        self._prefill_fn = jax.jit(
+            partial(self.model.prefill, cfg=cfg), donate_argnames=("kv_caches",)
+        )
+        self._decode_fn = jax.jit(
+            partial(self.model.decode, cfg=cfg), donate_argnames=("kv_caches",)
+        )
+        self._sample_fn = jax.jit(sample_tokens)
+
+        self._step_counter = 0
+        self._seqs: Dict[str, Sequence] = {}
+        # Cumulative counters for /metrics.
+        self.total_prompt_tokens = 0
+        self.total_generated_tokens = 0
+        self.total_finished = 0
+        self._step_time_accum = 0.0
+        self._busy_time_window: List[float] = []
+
+    # -- sizing ------------------------------------------------------------
+
+    def _kv_bytes(self, num_blocks: int) -> int:
+        cfg = self.config.model
+        per_token = 2 * cfg.num_kv_heads * cfg.head_dim * _dtype_size(cfg.dtype)
+        return num_blocks * self.config.cache.block_size * per_token * cfg.num_layers
+
+    def _decide_num_blocks(self) -> int:
+        cache = self.config.cache
+        if cache.num_blocks is not None:
+            return cache.num_blocks
+        device = jax.local_devices()[0]
+        stats = {}
+        try:
+            stats = device.memory_stats() or {}
+        except Exception:
+            pass
+        limit = stats.get("bytes_limit")
+        in_use = stats.get("bytes_in_use", 0)
+        if limit:
+            free = (limit - in_use) * cache.hbm_utilization
+            per_block = self._kv_bytes(1)
+            blocks = max(int(free // per_block), 16)
+        else:
+            # CPU / unknown backend: enough for tests and smoke serving.
+            blocks = 512
+        # Cap the block-table width implied by max_model_len.
+        return blocks
+
+    def _allocate_kv(self, num_blocks: int):
+        cfg = self.config.model
+        shape = (
+            num_blocks,
+            self.config.cache.block_size,
+            cfg.num_kv_heads,
+            cfg.head_dim,
+        )
+        dtype = jnp.dtype(cfg.dtype)
+        return [
+            (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+            for _ in range(cfg.num_layers)
+        ]
+
+    # -- request lifecycle -------------------------------------------------
+
+    def add_request(
+        self,
+        request_id: str,
+        prompt: Optional[str] = None,
+        prompt_token_ids: Optional[List[int]] = None,
+        sampling_params: Optional[SamplingParams] = None,
+    ) -> None:
+        if prompt_token_ids is None:
+            if prompt is None:
+                raise ValueError("need prompt or prompt_token_ids")
+            prompt_token_ids = self.tokenizer.encode(prompt)
+        if not prompt_token_ids:
+            prompt_token_ids = [self.tokenizer.bos_token_id or 0]
+        seq = Sequence(
+            seq_id=request_id,
+            prompt_token_ids=list(prompt_token_ids),
+            sampling_params=sampling_params or SamplingParams(),
+        )
+        self._seqs[request_id] = seq
+        self.scheduler.add_seq(seq)
+        self.total_prompt_tokens += len(prompt_token_ids)
+
+    def abort_request(self, request_id: str) -> None:
+        seq = self.scheduler.abort_seq(request_id)
+        if seq is not None:
+            seq.status = SequenceStatus.FINISHED
+            seq.finish_reason = FinishReason.ABORT
+        self.offload.discard(request_id)
+        self._seqs.pop(request_id, None)
+
+    def has_unfinished(self) -> bool:
+        return self.scheduler.has_unfinished()
+
+    # -- stepping ----------------------------------------------------------
+
+    def step(self) -> List[StepOutput]:
+        t0 = time.time()
+        plan = self.scheduler.schedule()
+        if plan.is_empty:
+            return []
+        if plan.prefill is not None:
+            outputs = self._run_prefill(plan.prefill)
+        else:
+            outputs = self._run_decode(plan.decode)
+        self._step_counter += 1
+        dt = time.time() - t0
+        self._step_time_accum += dt
+        now = time.time()
+        self._busy_time_window.append(now)
+        self._busy_time_window = [t for t in self._busy_time_window if t > now - 10]
+        return outputs
+
+    def _maybe_restore_offloaded(self, plan: PrefillPlan) -> None:
+        """If the sequence was preempted with offload, its KV snapshot is
+        written into freshly allocated blocks and treated as a cached
+        prefix — no recompute."""
+        seq = plan.seq
+        if not seq.offloaded:
+            return
+        seq.offloaded = False
+        entry = self.offload.restore(seq.seq_id)
+        if entry is None:
+            return  # fall back to recompute via normal prefill
+        bs = self.block_pool.block_size
+        nb = len(entry.layers[0][0])
+        usable_tokens = min(entry.num_tokens, len(seq.prompt_token_ids) - 1)
+        usable_blocks = usable_tokens // bs
+        if usable_blocks == 0:
+            return
+        if not self.block_pool.can_allocate(usable_blocks):
+            return
+        restored = self.block_pool.allocate(usable_blocks)
+        ids = jnp.asarray(restored, jnp.int32)
+        for layer_idx, (k_host, v_host) in enumerate(entry.layers):
+            k_cache, v_cache = self.kv_caches[layer_idx]
+            k_cache = k_cache.at[ids].set(jnp.asarray(k_host[:usable_blocks]))
+            v_cache = v_cache.at[ids].set(jnp.asarray(v_host[:usable_blocks]))
+            self.kv_caches[layer_idx] = (k_cache, v_cache)
+        # Rewrite the plan as a prefix-cache hit on the restored blocks.
+        self.block_pool.free(plan.prefix_block_ids)
+        plan.prefix_block_ids = restored
+        plan.cached_len = usable_blocks * bs
+        plan.num_new_tokens = len(seq.prompt_token_ids) - plan.cached_len
+        seq.num_cached_tokens = plan.cached_len
+        seq.block_table = restored + plan.new_block_ids
+
+    def _run_prefill(self, plan: PrefillPlan) -> List[StepOutput]:
+        self._maybe_restore_offloaded(plan)
+        seq = plan.seq
+        bs = self.block_pool.block_size
+        T = plan.bucket_len
+        new_tokens = seq.prompt_token_ids[
+            plan.cached_len : plan.cached_len + plan.num_new_tokens
+        ]
+        tokens = np.zeros((T,), np.int32)
+        tokens[: len(new_tokens)] = new_tokens
+        new_block_ids = np.zeros((T // bs,), np.int32)
+        new_block_ids[: len(plan.new_block_ids)] = plan.new_block_ids
+        pmax = max(self._bmax, 1)
+        prefix_ids = np.zeros((pmax,), np.int32)
+        prefix_ids[: len(plan.prefix_block_ids)] = plan.prefix_block_ids
+
+        logits, self.kv_caches = self._prefill_fn(
+            self.params,
+            tokens=jnp.asarray(tokens),
+            cached_len=jnp.int32(plan.cached_len),
+            prefix_block_ids=jnp.asarray(prefix_ids),
+            new_block_ids=jnp.asarray(new_block_ids),
+            valid_len=jnp.int32(plan.num_new_tokens),
+            kv_caches=self.kv_caches,
+        )
+        token_id = self._sample_batch(logits[None, :], [seq])[0]
+        return self._append_and_check([seq], [token_id], first_token=True)
+
+    def _run_decode(self, plan: DecodePlan) -> List[StepOutput]:
+        seqs = plan.seqs
+        S = self._smax
+        bs = self.block_pool.block_size
+        tokens = np.zeros((S,), np.int32)
+        positions = np.zeros((S,), np.int32)
+        block_tables = np.zeros((S, self._bmax), np.int32)
+        ctx_lens = np.zeros((S,), np.int32)
+        slot_blocks = np.zeros((S,), np.int32)
+        slot_offsets = np.zeros((S,), np.int32)
+        for i, seq in enumerate(seqs):
+            last = seq.all_token_ids[-1]
+            pos = seq.num_tokens - 1
+            tokens[i] = last
+            positions[i] = pos
+            table = seq.block_table[: self._bmax]
+            block_tables[i, : len(table)] = table
+            ctx_lens[i] = seq.num_tokens
+            slot_blocks[i] = seq.block_table[pos // bs]
+            slot_offsets[i] = pos % bs
+
+        logits, self.kv_caches = self._decode_fn(
+            self.params,
+            tokens=jnp.asarray(tokens),
+            positions=jnp.asarray(positions),
+            block_tables=jnp.asarray(block_tables),
+            ctx_lens=jnp.asarray(ctx_lens),
+            slot_block_ids=jnp.asarray(slot_blocks),
+            slot_offsets=jnp.asarray(slot_offsets),
+            kv_caches=self.kv_caches,
+        )
+        token_ids = self._sample_batch(logits[: len(seqs)], seqs)
+        return self._append_and_check(seqs, token_ids, first_token=False)
+
+    def _sample_batch(self, logits: jax.Array, seqs: List[Sequence]) -> List[int]:
+        S = logits.shape[0]
+        temps = np.array(
+            [s.sampling_params.temperature for s in seqs] + [0.0] * (S - len(seqs)),
+            np.float32,
+        )
+        top_ps = np.array(
+            [s.sampling_params.top_p for s in seqs] + [1.0] * (S - len(seqs)),
+            np.float32,
+        )
+        top_ks = np.array(
+            [s.sampling_params.top_k for s in seqs] + [0] * (S - len(seqs)), np.int32
+        )
+        seeds = np.array(
+            [
+                (s.sampling_params.seed if s.sampling_params.seed is not None else idx)
+                for idx, s in enumerate(seqs)
+            ]
+            + [0] * (S - len(seqs)),
+            np.int32,
+        )
+        step_key = jax.random.PRNGKey(self.config.seed + self._step_counter)
+        out = self._sample_fn(
+            logits,
+            jnp.asarray(temps),
+            jnp.asarray(top_ps),
+            jnp.asarray(top_ks),
+            step_key,
+            jnp.asarray(seeds),
+        )
+        return [int(t) for t in np.asarray(out[: len(seqs)])]
+
+    def _append_and_check(
+        self, seqs: List[Sequence], token_ids: List[int], first_token: bool
+    ) -> List[StepOutput]:
+        outputs: List[StepOutput] = []
+        now = time.time()
+        for seq, token_id in zip(seqs, token_ids):
+            seq.output_token_ids.append(token_id)
+            self.total_generated_tokens += 1
+            if seq.first_token_time is None:
+                seq.first_token_time = now
+            finish = self._check_finish(seq, token_id)
+            if finish is not None:
+                seq.finish_reason = finish
+                self.scheduler.finish_seq(seq)
+                self.offload.discard(seq.seq_id)
+                self.total_finished += 1
+                self._seqs.pop(seq.seq_id, None)
+            outputs.append(
+                StepOutput(
+                    seq_id=seq.seq_id,
+                    new_token_id=token_id,
+                    finished=finish is not None,
+                    finish_reason=finish,
+                    num_prompt_tokens=seq.num_prompt_tokens,
+                    num_output_tokens=seq.num_generated,
+                )
+            )
+        return outputs
+
+    def _check_finish(self, seq: Sequence, token_id: int) -> Optional[FinishReason]:
+        sp = seq.sampling_params
+        if (
+            not sp.ignore_eos
+            and self.tokenizer.eos_token_id is not None
+            and token_id == self.tokenizer.eos_token_id
+        ):
+            return FinishReason.STOP
+        if seq.num_generated >= sp.max_tokens:
+            return FinishReason.LENGTH
+        if seq.num_tokens >= self.config.scheduler.max_model_len:
+            return FinishReason.LENGTH
+        return None
+
+    # -- preemption hook (called by scheduler via engine wrapper) ----------
+
+    def offload_seq_blocks(self, seq: Sequence, block_ids: List[int]) -> bool:
+        return self.offload.save(
+            seq.seq_id, self.kv_caches, block_ids, num_tokens=seq.num_tokens
+        )
+
+    # -- metrics -----------------------------------------------------------
+
+    def stats(self) -> Dict[str, float]:
+        return {
+            "num_requests_running": self.scheduler.num_running,
+            "num_requests_waiting": self.scheduler.num_waiting,
+            "hbm_kv_usage_perc": self.block_pool.usage,
+            "prefix_cache_hit_rate": self.block_pool.prefix_hit_rate,
+            "host_kv_usage_perc": self.offload.usage,
+            "duty_cycle": min(1.0, len(self._busy_time_window) / 100.0),
+            "total_prompt_tokens": self.total_prompt_tokens,
+            "total_generated_tokens": self.total_generated_tokens,
+            "total_finished": self.total_finished,
+            "num_preemptions": self.scheduler.num_preemptions,
+        }

@@ -1,0 +1,232 @@
+"""Continuous-batching scheduler (TPU twist: static-shape step plans).
+
+Each call to :meth:`schedule` emits one *step plan*: either a single
+sequence's prefill (bucketed length) or one batched decode over all running
+sequences (padded to ``max_num_seqs``).  Every plan maps to a pre-compiled
+XLA executable — no shape escapes the bucket set, so steady-state serving
+never recompiles.
+
+Preemption: when the block pool cannot back a decode step, the youngest
+running sequence is preempted.  With ``preemption_mode="offload"`` its KV
+blocks are paged to host DRAM (kv/offload.py) and restored on resume —
+cheaper on TPU than recompute because host<->HBM DMA overlaps compute, while
+re-prefill burns MXU FLOPs (the reference reaches the same capability with
+LMCache CPU offload, deployment-vllm-multi.yaml:161-166).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from collections import deque
+from typing import Deque, List, Optional
+
+from production_stack_tpu.engine.config import SchedulerConfig
+from production_stack_tpu.engine.core.sequence import Sequence, SequenceStatus
+from production_stack_tpu.engine.kv.block_pool import BlockPool
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class PrefillPlan:
+    seq: Sequence
+    bucket_len: int  # padded token count (multiple of block size)
+    new_block_ids: List[int]  # blocks receiving the new KV (null-padded)
+    prefix_block_ids: List[int]  # cached-prefix blocks (may be empty)
+    num_new_tokens: int  # valid tokens to prefill
+    cached_len: int
+
+
+@dataclasses.dataclass
+class DecodePlan:
+    seqs: List[Sequence]  # <= max_num_seqs running sequences
+
+
+@dataclasses.dataclass
+class StepPlan:
+    prefill: Optional[PrefillPlan] = None
+    decode: Optional[DecodePlan] = None
+
+    @property
+    def is_empty(self) -> bool:
+        return self.prefill is None and self.decode is None
+
+
+class Scheduler:
+    def __init__(self, config: SchedulerConfig, block_pool: BlockPool, offload_cb=None):
+        self.config = config
+        self.block_pool = block_pool
+        # offload_cb(seq, block_ids) -> bool: page blocks to host DRAM
+        # before they are freed (engine wires HostOffloadManager here).
+        self.offload_cb = offload_cb
+        self.waiting: Deque[Sequence] = deque()
+        self.running: List[Sequence] = []
+        self.preempted: Deque[Sequence] = deque()
+        self.num_preemptions = 0
+
+    # -- admission ---------------------------------------------------------
+
+    def add_seq(self, seq: Sequence) -> None:
+        if seq.num_prompt_tokens >= self.config.max_model_len:
+            raise ValueError(
+                f"Prompt ({seq.num_prompt_tokens} tokens) exceeds max_model_len "
+                f"({self.config.max_model_len})"
+            )
+        bs = self.block_pool.block_size
+        worst_tokens = min(
+            seq.num_prompt_tokens + seq.sampling_params.max_tokens,
+            self.config.max_model_len,
+        )
+        worst_blocks = (worst_tokens + bs - 1) // bs
+        if worst_blocks > self.block_pool.num_blocks - 1:
+            raise ValueError(
+                f"Request needs up to {worst_blocks} KV blocks but the pool "
+                f"only has {self.block_pool.num_blocks - 1}; lower max_tokens "
+                "or raise the KV pool size"
+            )
+        self.waiting.append(seq)
+
+    def abort_seq(self, seq_id: str) -> Optional[Sequence]:
+        for queue in (self.waiting, self.preempted):
+            for seq in list(queue):
+                if seq.seq_id == seq_id:
+                    queue.remove(seq)
+                    self._release(seq)
+                    return seq
+        for seq in self.running:
+            if seq.seq_id == seq_id:
+                self.running.remove(seq)
+                self._release(seq)
+                return seq
+        return None
+
+    def has_unfinished(self) -> bool:
+        return bool(self.waiting or self.running or self.preempted)
+
+    @property
+    def num_waiting(self) -> int:
+        return len(self.waiting) + len(self.preempted)
+
+    @property
+    def num_running(self) -> int:
+        return len(self.running)
+
+    # -- planning ----------------------------------------------------------
+
+    def _bucket_for(self, n_tokens: int) -> Optional[int]:
+        for bucket in self.config.prefill_buckets:
+            if n_tokens <= bucket:
+                return bucket
+        return None
+
+    def schedule(self) -> StepPlan:
+        """Prefer admitting a prefill when a batch slot is open; otherwise
+        decode every running sequence."""
+        plan = self._try_schedule_prefill()
+        if plan is not None:
+            return StepPlan(prefill=plan)
+        decode = self._try_schedule_decode()
+        if decode is not None:
+            return StepPlan(decode=decode)
+        return StepPlan()
+
+    def _try_schedule_prefill(self) -> Optional[PrefillPlan]:
+        if len(self.running) >= self.config.max_num_seqs:
+            return None
+        # Preempted sequences resume first (their progress is largest).
+        queue = self.preempted if self.preempted else self.waiting
+        if not queue:
+            return None
+        seq = queue[0]
+
+        if seq.status == SequenceStatus.PREEMPTED and seq.offloaded:
+            # Restored via offload manager by the engine before this plan
+            # executes; treat like a full-prefix cache hit on resume.
+            pass
+
+        prefix_blocks, cached_len = self.block_pool.match_prefix(seq.prompt_token_ids)
+        num_new = seq.num_prompt_tokens - cached_len
+        bucket = self._bucket_for(num_new)
+        if bucket is None:
+            # Prompt longer than the largest bucket: chunked prefill would
+            # split it; v1 rejects at admission (max_model_len caps this).
+            bucket = self.config.prefill_buckets[-1]
+            num_new = min(num_new, bucket)
+        bs = self.block_pool.block_size
+        blocks_needed = (num_new + bs - 1) // bs
+        if not self.block_pool.can_allocate(blocks_needed):
+            self.block_pool.free(prefix_blocks)
+            return None
+        new_blocks = self.block_pool.allocate(blocks_needed)
+        queue.popleft()
+        seq.status = SequenceStatus.RUNNING
+        seq.num_cached_tokens = cached_len
+        seq.block_table = prefix_blocks + new_blocks
+        self.running.append(seq)
+        return PrefillPlan(
+            seq=seq,
+            bucket_len=bucket,
+            new_block_ids=new_blocks,
+            prefix_block_ids=prefix_blocks,
+            num_new_tokens=num_new,
+            cached_len=cached_len,
+        )
+
+    def _try_schedule_decode(self) -> Optional[DecodePlan]:
+        if not self.running:
+            return None
+        bs = self.block_pool.block_size
+
+        def needs_block(seq: Sequence) -> bool:
+            # The incoming token sits at position num_tokens-1; the table
+            # must cover num_tokens slots.
+            return seq.num_tokens > len(seq.block_table) * bs
+
+        # Ensure every running sequence has a block for its next token;
+        # preempt the youngest until the step fits.
+        while self.running:
+            need = sum(1 for seq in self.running if needs_block(seq))
+            if self.block_pool.can_allocate(need):
+                break
+            self._preempt_youngest()
+        if not self.running:
+            return None
+        for seq in self.running:
+            if needs_block(seq):
+                seq.block_table.extend(self.block_pool.allocate(1))
+        return DecodePlan(seqs=list(self.running))
+
+    # -- preemption / release ---------------------------------------------
+
+    def _preempt_youngest(self) -> None:
+        seq = max(self.running, key=lambda s: s.arrival_time)
+        self.running.remove(seq)
+        seq.status = SequenceStatus.PREEMPTED
+        seq.preempt_count += 1
+        self.num_preemptions += 1
+        if self.config.preemption_mode == "offload" and self.offload_cb is not None:
+            # Page the blocks to host DRAM *before* the pool can reuse them.
+            seq.offloaded = bool(self.offload_cb(seq, list(seq.block_table)))
+        self.block_pool.free(seq.block_table)
+        seq.block_table = []
+        # Re-prefill path treats all prior tokens as the new prompt.
+        seq.outputs_absorbed += len(seq.output_token_ids)
+        seq.prompt_token_ids = seq.all_token_ids
+        seq.output_token_ids = []
+        self.preempted.appendleft(seq)
+        logger.debug("Preempted %s (mode=%s)", seq.seq_id, self.config.preemption_mode)
+
+    def _release(self, seq: Sequence) -> None:
+        if seq.block_table:
+            self.block_pool.free(seq.block_table)
+            seq.block_table = []
+
+    def finish_seq(self, seq: Sequence) -> None:
+        if seq in self.running:
+            self.running.remove(seq)
+        # Register the sequence's full blocks for prefix reuse BEFORE
+        # freeing, so the freed blocks enter the reclaimable LRU tier.
+        self.block_pool.register_prefix(seq.all_token_ids, seq.block_table)
+        self._release(seq)
+        seq.status = SequenceStatus.FINISHED

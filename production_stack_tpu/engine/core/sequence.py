@@ -1,0 +1,91 @@
+"""Request/sequence state for the serving engine."""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import time
+from typing import List, Optional
+
+
+class SequenceStatus(enum.Enum):
+    WAITING = "waiting"
+    RUNNING = "running"
+    PREEMPTED = "preempted"  # paged out (host DRAM) or dropped for recompute
+    FINISHED = "finished"
+
+
+class FinishReason(enum.Enum):
+    STOP = "stop"  # EOS or stop string
+    LENGTH = "length"
+    ABORT = "abort"
+
+
+@dataclasses.dataclass
+class SamplingParams:
+    max_tokens: int = 128
+    temperature: float = 0.0  # 0 -> greedy
+    top_p: float = 1.0
+    top_k: int = 0  # 0 -> disabled
+    stop: Optional[List[str]] = None
+    ignore_eos: bool = False
+    seed: Optional[int] = None
+    logprobs: bool = False
+
+
+@dataclasses.dataclass
+class Sequence:
+    seq_id: str
+    prompt_token_ids: List[int]
+    sampling_params: SamplingParams
+    arrival_time: float = dataclasses.field(default_factory=time.time)
+
+    status: SequenceStatus = SequenceStatus.WAITING
+    output_token_ids: List[int] = dataclasses.field(default_factory=list)
+    block_table: List[int] = dataclasses.field(default_factory=list)
+    num_cached_tokens: int = 0  # prefix-cache hit length at admission
+    finish_reason: Optional[FinishReason] = None
+    first_token_time: Optional[float] = None
+    # Host-offload bookkeeping: host buffer ids per paged-out block.
+    offloaded: bool = False
+    preempt_count: int = 0
+    # Generated tokens absorbed into prompt_token_ids by preemption
+    # (re-prefill path); keeps max_tokens accounting correct across preempts.
+    outputs_absorbed: int = 0
+
+    @property
+    def num_prompt_tokens(self) -> int:
+        return len(self.prompt_token_ids)
+
+    @property
+    def num_tokens(self) -> int:
+        return len(self.prompt_token_ids) + len(self.output_token_ids)
+
+    @property
+    def all_token_ids(self) -> List[int]:
+        return self.prompt_token_ids + self.output_token_ids
+
+    @property
+    def num_generated(self) -> int:
+        """Total tokens generated for this request, across preemptions."""
+        return self.outputs_absorbed + len(self.output_token_ids)
+
+    @property
+    def is_finished(self) -> bool:
+        return self.status == SequenceStatus.FINISHED
+
+    def blocks_needed(self, block_size: int) -> int:
+        """Blocks for the whole sequence (prompt + outputs so far + 1 lookahead)."""
+        return (self.num_tokens + block_size) // block_size
+
+
+@dataclasses.dataclass
+class StepOutput:
+    """One engine step's result for one sequence."""
+
+    seq_id: str
+    new_token_id: int
+    finished: bool
+    finish_reason: Optional[FinishReason]
+    num_prompt_tokens: int
+    num_output_tokens: int

@@ -1,0 +1,1 @@
+"""Paged KV cache management: block pool, prefix cache, host offload."""

@@ -1,0 +1,124 @@
+"""KV offload: TPU HBM -> host DRAM (-> remote shared store).
+
+The reference gets this capability from LMCache env plumbing
+(deployment-vllm-multi.yaml:154-178: LMCACHE_LOCAL_CPU,
+LMCACHE_MAX_LOCAL_CPU_SIZE, LMCACHE_REMOTE_URL); on TPU we own the
+mechanism: preempted sequences' KV blocks are gathered on-device and DMA'd
+to pinned host memory, and restored by scatter when the sequence resumes —
+trading host<->HBM bandwidth (which overlaps TPU compute) for MXU re-prefill
+FLOPs.
+
+Tiering: host DRAM first; optional remote shared KV store
+(kvserver/, ``kv://host:port``) as the cross-replica tier, mirroring the
+reference's cacheserver (`lm://`) layer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class OffloadEntry:
+    seq_id: str
+    num_tokens: int
+    # Per layer: (k_blocks, v_blocks) as host numpy arrays [nb, bs, K, D].
+    layers: List[Tuple[np.ndarray, np.ndarray]]
+    nbytes: int
+    saved_at: float = dataclasses.field(default_factory=time.time)
+
+
+class HostOffloadManager:
+    """Bounded host-DRAM pool of per-sequence KV block snapshots."""
+
+    def __init__(self, capacity_bytes: int, remote_client=None):
+        self.capacity_bytes = int(capacity_bytes)
+        self.used_bytes = 0
+        self._entries: Dict[str, OffloadEntry] = {}
+        self.remote_client = remote_client  # kvserver client (optional tier)
+        self.saves = 0
+        self.restores = 0
+        self.evictions = 0
+
+    @property
+    def usage(self) -> float:
+        if not self.capacity_bytes:
+            return 0.0
+        return self.used_bytes / self.capacity_bytes
+
+    def save(
+        self,
+        seq_id: str,
+        kv_caches,  # list of (k_cache, v_cache) device arrays
+        block_ids: List[int],
+        num_tokens: int,
+    ) -> bool:
+        """Page a sequence's blocks out to host DRAM.  Returns False when it
+        does not fit (caller falls back to recompute)."""
+        if not block_ids or self.capacity_bytes <= 0:
+            return False
+        ids = np.asarray(block_ids, dtype=np.int32)
+        layers: List[Tuple[np.ndarray, np.ndarray]] = []
+        nbytes = 0
+        for k_cache, v_cache in kv_caches:
+            # Device-side gather then one contiguous DMA per layer.
+            k_host = np.asarray(k_cache[ids])
+            v_host = np.asarray(v_cache[ids])
+            layers.append((k_host, v_host))
+            nbytes += k_host.nbytes + v_host.nbytes
+        while self.used_bytes + nbytes > self.capacity_bytes and self._entries:
+            self._evict_oldest()
+        if self.used_bytes + nbytes > self.capacity_bytes:
+            return False
+        self._entries[seq_id] = OffloadEntry(
+            seq_id=seq_id, num_tokens=num_tokens, layers=layers, nbytes=nbytes
+        )
+        self.used_bytes += nbytes
+        self.saves += 1
+        if self.remote_client is not None:
+            try:
+                self.remote_client.put_blocks(seq_id, layers, num_tokens)
+            except Exception:
+                logger.warning("remote KV put failed for %s", seq_id, exc_info=True)
+        return True
+
+    def restore(self, seq_id: str) -> Optional[OffloadEntry]:
+        entry = self._entries.pop(seq_id, None)
+        if entry is not None:
+            self.used_bytes -= entry.nbytes
+            self.restores += 1
+            return entry
+        if self.remote_client is not None:
+            try:
+                fetched = self.remote_client.get_blocks(seq_id)
+            except Exception:
+                logger.warning("remote KV get failed for %s", seq_id, exc_info=True)
+                return None
+            if fetched is not None:
+                layers, num_tokens = fetched
+                self.restores += 1
+                return OffloadEntry(
+                    seq_id=seq_id,
+                    num_tokens=num_tokens,
+                    layers=layers,
+                    nbytes=sum(k.nbytes + v.nbytes for k, v in layers),
+                )
+        return None
+
+    def discard(self, seq_id: str) -> None:
+        entry = self._entries.pop(seq_id, None)
+        if entry is not None:
+            self.used_bytes -= entry.nbytes
+
+    def _evict_oldest(self) -> None:
+        oldest = min(self._entries.values(), key=lambda e: e.saved_at)
+        del self._entries[oldest.seq_id]
+        self.used_bytes -= oldest.nbytes
+        self.evictions += 1

@@ -1,0 +1,12 @@
+"""Model zoo: decoder-only transformer families, functional JAX style.
+
+``llama.py`` covers the Llama 2/3(.x) and Mistral/Qwen-style architectures
+(RMSNorm + rotate-half RoPE + GQA + SwiGLU, optional sliding window).
+``opt.py`` covers OPT (learned positions + ReLU MLP + pre-LN) for tiny CPU
+smoke deployments (the reference's facebook/opt-125m minimal install,
+tutorials/assets/values-01-minimal-example.yaml).
+"""
+
+from production_stack_tpu.engine.models.registry import get_model, MODEL_REGISTRY
+
+__all__ = ["get_model", "MODEL_REGISTRY"]

@@ -1,0 +1,187 @@
+"""Llama-family model (functional JAX, paged-KV attention).
+
+Weight layout matches HF ``LlamaForCausalLM`` modulo transposition (we store
+[in, out] so the forward is ``x @ W``); loaders in weights.py map HF
+safetensors names directly.  Correctness is pinned against the HF torch
+implementation in tests/test_llama_vs_hf.py.
+
+Covers Mistral (sliding_window) and Llama 3.x (GQA, rope_theta, tied
+embeddings) via ModelConfig switches.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from production_stack_tpu.engine.config import ModelConfig
+from production_stack_tpu.engine.ops import attention as attn_ops
+from production_stack_tpu.engine.ops.layers import (
+    apply_rope,
+    rms_norm,
+    rope_cos_sin,
+    swiglu,
+)
+
+Params = Dict
+KVCaches = List[Tuple[jax.Array, jax.Array]]
+
+
+def param_dtype(cfg: ModelConfig):
+    return jnp.dtype(cfg.dtype)
+
+
+def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
+    """Random init with HF-compatible tree structure."""
+    dtype = param_dtype(cfg)
+    h, hd = cfg.hidden_size, cfg.head_dim
+    H, K, I = cfg.num_heads, cfg.num_kv_heads, cfg.intermediate_size
+
+    def dense(key, shape, scale=0.02):
+        return (jax.random.normal(key, shape, jnp.float32) * scale).astype(dtype)
+
+    keys = jax.random.split(key, cfg.num_layers + 3)
+    params: Params = {
+        "embed_tokens": dense(keys[0], (cfg.vocab_size, h)),
+        "norm": jnp.ones((h,), dtype),
+        "layers": [],
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = dense(keys[1], (h, cfg.vocab_size))
+    for i in range(cfg.num_layers):
+        lk = jax.random.split(keys[i + 3], 7)
+        params["layers"].append(
+            {
+                "input_layernorm": jnp.ones((h,), dtype),
+                "post_attention_layernorm": jnp.ones((h,), dtype),
+                "q_proj": dense(lk[0], (h, H * hd)),
+                "k_proj": dense(lk[1], (h, K * hd)),
+                "v_proj": dense(lk[2], (h, K * hd)),
+                "o_proj": dense(lk[3], (H * hd, h)),
+                "gate_proj": dense(lk[4], (h, I)),
+                "up_proj": dense(lk[5], (h, I)),
+                "down_proj": dense(lk[6], (I, h)),
+            }
+        )
+    return params
+
+
+def _project_qkv(layer: Params, x: jax.Array, cfg: ModelConfig):
+    """x: [T, h] -> q [T, H, D], k/v [T, K, D]."""
+    T = x.shape[0]
+    q = jnp.dot(x, layer["q_proj"], preferred_element_type=jnp.float32).astype(x.dtype)
+    k = jnp.dot(x, layer["k_proj"], preferred_element_type=jnp.float32).astype(x.dtype)
+    v = jnp.dot(x, layer["v_proj"], preferred_element_type=jnp.float32).astype(x.dtype)
+    q = q.reshape(T, cfg.num_heads, cfg.head_dim)
+    k = k.reshape(T, cfg.num_kv_heads, cfg.head_dim)
+    v = v.reshape(T, cfg.num_kv_heads, cfg.head_dim)
+    return q, k, v
+
+
+def _lm_head(params: Params, cfg: ModelConfig, hidden: jax.Array) -> jax.Array:
+    """hidden [..., h] -> logits [..., V] in fp32."""
+    if cfg.tie_word_embeddings:
+        w = params["embed_tokens"].T
+    else:
+        w = params["lm_head"]
+    return jnp.dot(hidden, w, preferred_element_type=jnp.float32)
+
+
+def prefill(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jax.Array,  # [T] int32 (padded to a bucket)
+    cached_len: jax.Array,  # scalar int32: prefix tokens already in cache
+    prefix_block_ids: jax.Array,  # [P] int32 (0-padded)
+    new_block_ids: jax.Array,  # [T // block_size] int32 (null-padded)
+    valid_len: jax.Array,  # scalar int32: true number of new tokens
+    kv_caches: KVCaches,
+) -> Tuple[jax.Array, KVCaches]:
+    """One sequence's prefill.  Returns (last-token logits [V], new caches)."""
+    T = tokens.shape[0]
+    scale = cfg.head_dim**-0.5
+    positions = cached_len + jnp.arange(T)
+    cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
+
+    x = params["embed_tokens"][tokens]  # [T, h]
+    new_caches: KVCaches = []
+    for layer, (k_cache, v_cache) in zip(params["layers"], kv_caches):
+        residual = x
+        x_n = rms_norm(x, layer["input_layernorm"], cfg.rms_norm_eps)
+        q, k, v = _project_qkv(layer, x_n, cfg)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        k_prefix, v_prefix = attn_ops.gather_prefix_kv(
+            k_cache, v_cache, prefix_block_ids
+        )
+        out = attn_ops.prefill_attention(
+            q, k, v, k_prefix, v_prefix, cached_len, valid_len,
+            scale=scale, sliding_window=cfg.sliding_window,
+        )
+        k_cache, v_cache = attn_ops.write_prefill_kv(
+            k_cache, v_cache, k, v, new_block_ids
+        )
+        new_caches.append((k_cache, v_cache))
+        out = out.reshape(T, cfg.num_heads * cfg.head_dim)
+        x = residual + jnp.dot(
+            out, layer["o_proj"], preferred_element_type=jnp.float32
+        ).astype(x.dtype)
+        residual = x
+        x_n = rms_norm(x, layer["post_attention_layernorm"], cfg.rms_norm_eps)
+        x = residual + swiglu(
+            x_n, layer["gate_proj"], layer["up_proj"], layer["down_proj"]
+        )
+
+    x = rms_norm(x, params["norm"], cfg.rms_norm_eps)
+    last = x[jnp.maximum(valid_len - 1, 0)]  # [h]
+    return _lm_head(params, cfg, last), new_caches
+
+
+def decode(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jax.Array,  # [S] int32, one token per sequence (padded batch)
+    positions: jax.Array,  # [S] int32 position of each token (=ctx_len-1)
+    block_tables: jax.Array,  # [S, Bmax] int32
+    ctx_lens: jax.Array,  # [S] int32 context length incl. the new token
+    slot_block_ids: jax.Array,  # [S] int32 block receiving the new token
+    slot_offsets: jax.Array,  # [S] int32 offset within that block
+    kv_caches: KVCaches,
+) -> Tuple[jax.Array, KVCaches]:
+    """Batched single-token decode.  Returns (logits [S, V], new caches)."""
+    S = tokens.shape[0]
+    scale = cfg.head_dim**-0.5
+    cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
+
+    x = params["embed_tokens"][tokens]  # [S, h]
+    new_caches: KVCaches = []
+    for layer, (k_cache, v_cache) in zip(params["layers"], kv_caches):
+        residual = x
+        x_n = rms_norm(x, layer["input_layernorm"], cfg.rms_norm_eps)
+        q, k, v = _project_qkv(layer, x_n, cfg)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        # The new token's KV must be visible to its own attention: write
+        # first, then attend (ctx_lens already includes the new token).
+        k_cache, v_cache = attn_ops.append_decode_kv(
+            k_cache, v_cache, k, v, slot_block_ids, slot_offsets
+        )
+        out = attn_ops.paged_decode_attention(
+            q, k_cache, v_cache, block_tables, ctx_lens,
+            scale=scale, sliding_window=cfg.sliding_window,
+        )
+        new_caches.append((k_cache, v_cache))
+        out = out.reshape(S, cfg.num_heads * cfg.head_dim)
+        x = residual + jnp.dot(
+            out, layer["o_proj"], preferred_element_type=jnp.float32
+        ).astype(x.dtype)
+        residual = x
+        x_n = rms_norm(x, layer["post_attention_layernorm"], cfg.rms_norm_eps)
+        x = residual + swiglu(
+            x_n, layer["gate_proj"], layer["up_proj"], layer["down_proj"]
+        )
+
+    x = rms_norm(x, params["norm"], cfg.rms_norm_eps)
+    return _lm_head(params, cfg, x), new_caches

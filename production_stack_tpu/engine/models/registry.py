@@ -1,0 +1,29 @@
+"""Model registry: architecture name -> functional model module.
+
+Each module exposes ``init_params(cfg, key)``, ``prefill(...)``,
+``decode(...)`` with the signatures defined in llama.py.
+"""
+
+from __future__ import annotations
+
+from types import ModuleType
+
+from production_stack_tpu.engine.models import llama
+
+MODEL_REGISTRY = {
+    # llama.py covers every RMSNorm+RoPE+GQA+SwiGLU family member; the
+    # config (not the code) differentiates them.
+    "llama": llama,
+    "mistral": llama,
+    "qwen2": llama,
+}
+
+
+def get_model(architecture: str) -> ModuleType:
+    arch = architecture.lower()
+    for key, module in MODEL_REGISTRY.items():
+        if key in arch:
+            return module
+    raise ValueError(
+        f"Unsupported architecture {architecture!r}; known: {sorted(MODEL_REGISTRY)}"
+    )

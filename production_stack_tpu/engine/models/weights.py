@@ -1,0 +1,105 @@
+"""Checkpoint loading: HF safetensors -> our functional param trees.
+
+Zero-egress friendly: if no checkpoint directory is given (or it is
+missing), models fall back to deterministic random init — throughput
+benchmarking and scale testing need correct shapes, not trained weights.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from production_stack_tpu.engine.config import ModelConfig
+
+logger = logging.getLogger(__name__)
+
+
+def load_params(
+    cfg: ModelConfig,
+    weights_path: Optional[str],
+    *,
+    seed: int = 0,
+):
+    """Load HF-layout safetensors if available, else random init."""
+    from production_stack_tpu.engine.models import llama
+
+    if weights_path and os.path.isdir(weights_path):
+        try:
+            return load_hf_safetensors(cfg, weights_path)
+        except Exception:
+            logger.exception(
+                "Failed to load weights from %s; falling back to random init",
+                weights_path,
+            )
+    return llama.init_params(cfg, jax.random.PRNGKey(seed))
+
+
+def _open_safetensors(weights_path: str) -> Dict[str, np.ndarray]:
+    """Read all tensors from one or more *.safetensors shards."""
+    from safetensors import safe_open  # ships with transformers
+
+    tensors: Dict[str, np.ndarray] = {}
+    index_file = os.path.join(weights_path, "model.safetensors.index.json")
+    if os.path.exists(index_file):
+        with open(index_file) as f:
+            index = json.load(f)
+        shards = sorted(set(index["weight_map"].values()))
+    else:
+        shards = sorted(
+            f for f in os.listdir(weights_path) if f.endswith(".safetensors")
+        )
+    for shard in shards:
+        with safe_open(os.path.join(weights_path, shard), framework="np") as f:
+            for name in f.keys():
+                tensors[name] = f.get_tensor(name)
+    return tensors
+
+
+def load_hf_safetensors(cfg: ModelConfig, weights_path: str):
+    """Map HF LlamaForCausalLM tensor names into our layout.
+
+    torch Linear stores [out, in]; we store [in, out], hence the transposes
+    (see models/llama.py docstring).
+    """
+    sd = _open_safetensors(weights_path)
+    dtype = jnp.dtype(cfg.dtype)
+
+    def take(name: str, transpose: bool = False) -> jax.Array:
+        arr = sd[name]
+        if transpose:
+            arr = arr.T
+        return jnp.asarray(arr, dtype)
+
+    params = {
+        "embed_tokens": take("model.embed_tokens.weight"),
+        "norm": take("model.norm.weight"),
+        "layers": [],
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = take("lm_head.weight", transpose=True)
+    for i in range(cfg.num_layers):
+        p = f"model.layers.{i}."
+        params["layers"].append(
+            {
+                "input_layernorm": take(p + "input_layernorm.weight"),
+                "post_attention_layernorm": take(
+                    p + "post_attention_layernorm.weight"
+                ),
+                "q_proj": take(p + "self_attn.q_proj.weight", transpose=True),
+                "k_proj": take(p + "self_attn.k_proj.weight", transpose=True),
+                "v_proj": take(p + "self_attn.v_proj.weight", transpose=True),
+                "o_proj": take(p + "self_attn.o_proj.weight", transpose=True),
+                "gate_proj": take(p + "mlp.gate_proj.weight", transpose=True),
+                "up_proj": take(p + "mlp.up_proj.weight", transpose=True),
+                "down_proj": take(p + "mlp.down_proj.weight", transpose=True),
+            }
+        )
+    logger.info("Loaded %d tensors from %s", len(sd), weights_path)
+    return params

@@ -1,0 +1,5 @@
+"""Core numeric ops for the TPU engine.
+
+Pure-JAX reference implementations live here; Pallas TPU kernels for the hot
+paths live in ``pallas/`` and are selected at runtime on TPU backends.
+"""

@@ -1,0 +1,163 @@
+"""Paged attention (pure-JAX reference path).
+
+Design notes (TPU-first):
+
+* All shapes are static.  Prefill lengths are bucketed, decode batch is
+  padded to the scheduler's ``max_num_seqs``; invalid slots are masked, and
+  their KV writes land in the reserved *null block* 0 (never read).
+* Softmax runs in fp32 (MXU accumulates fp32, VPU exponentiates fp32);
+  inputs/outputs are bf16.
+* The gather-based decode path below materializes [S, max_ctx, K, D] in HBM
+  — correct everywhere (CPU tests, interpret mode) and fast enough for
+  moderate contexts.  The Pallas kernel in pallas/paged_attention.py streams
+  KV blocks HBM->VMEM instead and is selected on TPU backends.
+
+KV cache layout per layer: ``[num_blocks, block_size, num_kv_heads, head_dim]``
+— block-major so one block is a contiguous DMA unit for both the decode
+kernel and host offload (kv/offload.py).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30  # large-but-finite: keeps masked softmax rows NaN-free
+
+
+def prefill_attention(
+    q: jax.Array,  # [T, H, D]
+    k_new: jax.Array,  # [T, K, D]
+    v_new: jax.Array,  # [T, K, D]
+    k_prefix: jax.Array,  # [C_max, K, D] gathered cached prefix (may be empty)
+    v_prefix: jax.Array,  # [C_max, K, D]
+    cached_len: jax.Array,  # scalar int: valid prefix tokens (< C_max)
+    valid_len: jax.Array,  # scalar int: valid new tokens (<= T)
+    *,
+    scale: float,
+    sliding_window: Optional[int] = None,
+) -> jax.Array:
+    """Causal attention for one sequence's prefill, attending to an optional
+    cached prefix (prefix-cache hit) plus the new tokens themselves."""
+    T, H, D = q.shape
+    C_max = k_prefix.shape[0]
+    K = k_new.shape[1]
+    G = H // K
+
+    keys = jnp.concatenate([k_prefix, k_new], axis=0)  # [C_max+T, K, D]
+    values = jnp.concatenate([v_prefix, v_new], axis=0)
+
+    # Positions: query i sits at cached_len + i; prefix key j at j; new key
+    # j' at cached_len + j'.  Build key-position array of shape [C_max+T].
+    prefix_pos = jnp.arange(C_max)
+    new_pos = cached_len + jnp.arange(T)
+    key_pos = jnp.concatenate([prefix_pos, new_pos])  # [C_max+T]
+    q_pos = cached_len + jnp.arange(T)  # [T]
+
+    # Valid keys: prefix slots < cached_len, new slots < valid_len.
+    key_valid = jnp.concatenate(
+        [prefix_pos < cached_len, jnp.arange(T) < valid_len]
+    )
+
+    mask = key_pos[None, :] <= q_pos[:, None]  # causal
+    mask &= key_valid[None, :]
+    if sliding_window is not None:
+        mask &= key_pos[None, :] > (q_pos[:, None] - sliding_window)
+
+    qg = q.reshape(T, K, G, D)
+    # [T, K, G, D] x [S_k, K, D] -> [K, G, T, S_k]
+    scores = jnp.einsum(
+        "tkgd,skd->kgts", qg, keys, preferred_element_type=jnp.float32
+    )
+    scores = scores * scale
+    scores = jnp.where(mask[None, None, :, :], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum(
+        "kgts,skd->tkgd", probs.astype(values.dtype), values,
+        preferred_element_type=jnp.float32,
+    )
+    return out.reshape(T, H, D).astype(q.dtype)
+
+
+def paged_decode_attention(
+    q: jax.Array,  # [S, H, D] one new token per sequence
+    k_cache: jax.Array,  # [N, bs, K, D]
+    v_cache: jax.Array,  # [N, bs, K, D]
+    block_tables: jax.Array,  # [S, Bmax] int32 (0 = null block)
+    ctx_lens: jax.Array,  # [S] int32: tokens in context incl. current
+    *,
+    scale: float,
+    sliding_window: Optional[int] = None,
+) -> jax.Array:
+    """Decode attention over paged KV via gather (reference path)."""
+    S, H, D = q.shape
+    N, bs, K, _ = k_cache.shape
+    Bmax = block_tables.shape[1]
+    G = H // K
+
+    k = k_cache[block_tables].reshape(S, Bmax * bs, K, D)
+    v = v_cache[block_tables].reshape(S, Bmax * bs, K, D)
+
+    key_pos = jnp.arange(Bmax * bs)[None, :]  # [1, max_ctx]
+    mask = key_pos < ctx_lens[:, None]  # [S, max_ctx]
+    if sliding_window is not None:
+        mask &= key_pos > (ctx_lens[:, None] - 1 - sliding_window)
+
+    qg = q.reshape(S, K, G, D)
+    scores = jnp.einsum(
+        "skgd,stkd->skgt", qg, k, preferred_element_type=jnp.float32
+    )
+    scores = scores * scale
+    scores = jnp.where(mask[:, None, None, :], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum(
+        "skgt,stkd->skgd", probs.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    )
+    return out.reshape(S, H, D).astype(q.dtype)
+
+
+def write_prefill_kv(
+    k_cache: jax.Array,  # [N, bs, K, D]
+    v_cache: jax.Array,
+    k_new: jax.Array,  # [T, K, D], T = num_new_blocks * bs
+    v_new: jax.Array,
+    new_block_ids: jax.Array,  # [T // bs] int32; padding slots -> 0 (null)
+) -> Tuple[jax.Array, jax.Array]:
+    """Scatter freshly computed prefill KV into the paged cache."""
+    N, bs, K, D = k_cache.shape
+    nb = new_block_ids.shape[0]
+    k_blocks = k_new.reshape(nb, bs, K, D).astype(k_cache.dtype)
+    v_blocks = v_new.reshape(nb, bs, K, D).astype(v_cache.dtype)
+    k_cache = k_cache.at[new_block_ids].set(k_blocks)
+    v_cache = v_cache.at[new_block_ids].set(v_blocks)
+    return k_cache, v_cache
+
+
+def append_decode_kv(
+    k_cache: jax.Array,  # [N, bs, K, D]
+    v_cache: jax.Array,
+    k: jax.Array,  # [S, K, D] one token per sequence
+    v: jax.Array,
+    slot_block_ids: jax.Array,  # [S] int32 block holding this token (0=null)
+    slot_offsets: jax.Array,  # [S] int32 offset within the block
+) -> Tuple[jax.Array, jax.Array]:
+    """Scatter one new token's KV per sequence into the paged cache."""
+    k_cache = k_cache.at[slot_block_ids, slot_offsets].set(k.astype(k_cache.dtype))
+    v_cache = v_cache.at[slot_block_ids, slot_offsets].set(v.astype(v_cache.dtype))
+    return k_cache, v_cache
+
+
+def gather_prefix_kv(
+    k_cache: jax.Array,  # [N, bs, K, D]
+    v_cache: jax.Array,
+    prefix_block_ids: jax.Array,  # [P] int32 (0-padded)
+) -> Tuple[jax.Array, jax.Array]:
+    """Gather a cached prefix as [P*bs, K, D] for prefill attention."""
+    N, bs, K, D = k_cache.shape
+    P = prefix_block_ids.shape[0]
+    k = k_cache[prefix_block_ids].reshape(P * bs, K, D)
+    v = v_cache[prefix_block_ids].reshape(P * bs, K, D)
+    return k, v

@@ -1,0 +1,58 @@
+"""Norm / rotary-embedding primitives.
+
+Conventions match the HF llama family exactly (rotate-half RoPE, RMSNorm in
+fp32) so real checkpoints load without weight surgery; verified against
+transformers' torch implementation in tests/test_llama_vs_hf.py.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+    """RMSNorm computed in fp32, cast back to input dtype."""
+    orig_dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    variance = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    normed = x32 * jax.lax.rsqrt(variance + eps)
+    return (normed * weight.astype(jnp.float32)).astype(orig_dtype)
+
+
+def rope_cos_sin(positions: jax.Array, head_dim: int, theta: float):
+    """cos/sin tables for rotate-half RoPE at the given positions.
+
+    positions: int array [...]; returns cos/sin of shape [..., head_dim]
+    (frequencies duplicated across both halves, HF convention).
+    """
+    half = head_dim // 2
+    inv_freq = 1.0 / (
+        theta ** (jnp.arange(0, half, dtype=jnp.float32) / half)
+    )
+    freqs = positions.astype(jnp.float32)[..., None] * inv_freq  # [..., half]
+    emb = jnp.concatenate([freqs, freqs], axis=-1)  # [..., head_dim]
+    return jnp.cos(emb), jnp.sin(emb)
+
+
+def _rotate_half(x: jax.Array) -> jax.Array:
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([-x2, x1], axis=-1)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """x: [..., num_heads, head_dim]; cos/sin: [..., head_dim] (no head axis)."""
+    cos = cos[..., None, :]
+    sin = sin[..., None, :]
+    x32 = x.astype(jnp.float32)
+    out = x32 * cos + _rotate_half(x32) * sin
+    return out.astype(x.dtype)
+
+
+def swiglu(x: jax.Array, gate_w: jax.Array, up_w: jax.Array, down_w: jax.Array) -> jax.Array:
+    """SwiGLU MLP: down( silu(x @ gate) * (x @ up) ), bf16 matmuls on MXU."""
+    gate = jnp.dot(x, gate_w, preferred_element_type=jnp.float32)
+    up = jnp.dot(x, up_w, preferred_element_type=jnp.float32)
+    activated = (jax.nn.silu(gate) * up).astype(x.dtype)
+    return jnp.dot(activated, down_w, preferred_element_type=jnp.float32).astype(x.dtype)

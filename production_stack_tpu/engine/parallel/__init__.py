@@ -1,0 +1,17 @@
+"""SPMD parallelism over the TPU device mesh.
+
+The reference's entire intra-model parallelism story is passing
+``--tensor-parallel-size`` to vLLM plus an NCCL shm volume
+(SURVEY.md section 2.7).  Here it is first-class and TPU-native: a
+``jax.sharding.Mesh`` with (dp, tp, sp) axes, GSPMD-partitioned params and
+KV caches (XLA inserts the all-reduces over ICI), and ring attention over
+the sp axis for sequences that exceed one chip's HBM.
+"""
+
+from production_stack_tpu.engine.parallel.mesh import build_mesh, MeshAxes
+from production_stack_tpu.engine.parallel.shardings import (
+    kv_cache_shardings,
+    param_shardings,
+)
+
+__all__ = ["build_mesh", "MeshAxes", "param_shardings", "kv_cache_shardings"]

@@ -1,0 +1,138 @@
+"""Ring attention: causal self-attention sharded over the sequence axis.
+
+Long-context prefill that exceeds one chip's HBM runs with the sequence
+split over the ``sp`` mesh axis: each device keeps its query shard resident
+while K/V shards rotate around the ring via ``lax.ppermute`` (ICI
+neighbor-to-neighbor), accumulating with an online-softmax (flash-style
+log-sum-exp merge).  Compute on the current shard overlaps the transfer of
+the next — XLA pipelines the ppermute with the einsum.
+
+The reference stack has no sequence parallelism anywhere (SURVEY.md section
+2.7: long context is handled purely by KV offload); this is a TPU-native
+capability on top of parity.
+
+Called inside ``shard_map`` over the mesh, e.g.:
+
+    out = shard_map(
+        lambda q, k, v: ring_self_attention(q, k, v, axis_name="sp", scale=s),
+        mesh=mesh,
+        in_specs=(P("sp", None, None),) * 3,
+        out_specs=P("sp", None, None),
+    )(q, k, v)
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def _chunk_attention(
+    q: jax.Array,  # [Tq, H, D]
+    k: jax.Array,  # [Tk, K, D]
+    v: jax.Array,  # [Tk, K, D]
+    q_pos: jax.Array,  # [Tq] global positions
+    k_pos: jax.Array,  # [Tk] global positions
+    scale: float,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Partial attention of one KV chunk: returns (scores_max, exp_sum,
+    weighted_values) for online-softmax merging.  Shapes:
+    m [H, Tq], l [H, Tq], o [Tq, H, D]."""
+    Tq, H, D = q.shape
+    K = k.shape[1]
+    G = H // K
+    qg = q.reshape(Tq, K, G, D)
+    scores = jnp.einsum("tkgd,skd->kgts", qg, k, preferred_element_type=jnp.float32)
+    scores = scores * scale  # [K, G, Tq, Tk]
+    mask = k_pos[None, None, None, :] <= q_pos[None, None, :, None]
+    scores = jnp.where(mask, scores, NEG_INF)
+    m = jnp.max(scores, axis=-1)  # [K, G, Tq]
+    # Guard fully-masked rows: exp(NEG_INF - NEG_INF) would be 1.
+    safe_m = jnp.maximum(m, -1e29)
+    p = jnp.exp(scores - safe_m[..., None])  # [K, G, Tq, Tk]
+    p = jnp.where(mask, p, 0.0)
+    l = jnp.sum(p, axis=-1)  # [K, G, Tq]
+    o = jnp.einsum(
+        "kgts,skd->tkgd", p.astype(v.dtype), v, preferred_element_type=jnp.float32
+    )  # [Tq, K, G, D]
+    return m, l, o.astype(jnp.float32)
+
+
+def ring_self_attention(
+    q: jax.Array,  # [Tl, H, D] local query shard
+    k: jax.Array,  # [Tl, K, D] local key shard
+    v: jax.Array,  # [Tl, K, D] local value shard
+    *,
+    axis_name: str,
+    scale: float,
+    valid_len: Optional[jax.Array] = None,  # global valid token count
+) -> jax.Array:
+    """Causal self-attention with K/V rotating around the ring."""
+    Tl, H, D = q.shape
+    K = k.shape[1]
+    G = H // K
+    sp = lax.axis_size(axis_name)
+    my_idx = lax.axis_index(axis_name)
+
+    q_pos = my_idx * Tl + jnp.arange(Tl)
+    if valid_len is not None:
+        # Mask padded queries by pushing their positions before all keys.
+        q_pos = jnp.where(q_pos < valid_len, q_pos, -1)
+
+    def body(step, carry):
+        m_acc, l_acc, o_acc, k_cur, v_cur = carry
+        src_idx = (my_idx - step) % sp  # whose shard we currently hold
+        k_pos = src_idx * Tl + jnp.arange(Tl)
+        if valid_len is not None:
+            k_pos = jnp.where(k_pos < valid_len, k_pos, jnp.int32(2**30))
+        m_new, l_new, o_new = _chunk_attention(q, k_cur, v_cur, q_pos, k_pos, scale)
+        # Online-softmax merge.
+        m_tot = jnp.maximum(m_acc, m_new)
+        safe = jnp.maximum(m_tot, -1e29)
+        alpha = jnp.exp(m_acc - safe)  # [K, G, Tq]
+        beta = jnp.exp(m_new - safe)
+        l_tot = l_acc * alpha + l_new * beta
+        o_scale_old = alpha.transpose(2, 0, 1)[..., None]  # [Tq, K, G, 1]
+        o_scale_new = beta.transpose(2, 0, 1)[..., None]
+        o_tot = (
+            o_acc.reshape(Tl, K, G, D) * o_scale_old
+            + o_new.reshape(Tl, K, G, D) * o_scale_new
+        ).reshape(Tl, H, D)
+        # Rotate K/V to the next device (skip after the last chunk).
+        perm = [(i, (i + 1) % sp) for i in range(sp)]
+        k_next = lax.ppermute(k_cur, axis_name, perm)
+        v_next = lax.ppermute(v_cur, axis_name, perm)
+        return m_tot, l_tot, o_tot, k_next, v_next
+
+    m0 = jnp.full((K, G, Tl), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((K, G, Tl), jnp.float32)
+    o0 = jnp.zeros((Tl, H, D), jnp.float32)
+    m_f, l_f, o_f, _, _ = lax.fori_loop(0, sp, body, (m0, l0, o0, k, v))
+
+    denom = jnp.maximum(l_f, 1e-20).transpose(2, 0, 1)[..., None]  # [Tq, K, G, 1]
+    out = o_f.reshape(Tl, K, G, D) / denom
+    return out.reshape(Tl, H, D).astype(q.dtype)
+
+
+def ring_prefill_attention(mesh, q, k, v, *, scale: float, valid_len=None):
+    """Convenience wrapper: shard T over the sp axis and run the ring."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from production_stack_tpu.engine.parallel.mesh import AXES
+
+    fn = lambda q_, k_, v_: ring_self_attention(  # noqa: E731
+        q_, k_, v_, axis_name=AXES.SP, scale=scale, valid_len=valid_len
+    )
+    return shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(P(AXES.SP), P(AXES.SP), P(AXES.SP)),
+        out_specs=P(AXES.SP),
+        check_rep=False,
+    )(q, k, v)

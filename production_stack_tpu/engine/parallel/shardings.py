@@ -1,0 +1,91 @@
+"""GSPMD sharding rules for params, KV caches and activations.
+
+Megatron-style tensor parallelism expressed as PartitionSpecs — XLA inserts
+the ICI collectives (one psum after o_proj, one after down_proj per layer):
+
+  q/k/v_proj  [h, heads*hd]  -> shard output dim over tp (head-parallel)
+  o_proj      [heads*hd, h]  -> shard input dim over tp (psum after)
+  gate/up     [h, I]         -> shard I over tp
+  down        [I, h]         -> shard I over tp (psum after)
+  embed       [V, h]         -> shard V over tp (logits all-gathered)
+  KV cache    [N, bs, K, D]  -> shard K (kv heads) over tp
+  decode batch [S, ...]      -> shard S over dp
+
+Requires num_heads % tp == 0 and num_kv_heads % tp == 0 (GQA: tp beyond
+num_kv_heads would duplicate KV — rejected rather than silently replicated).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from production_stack_tpu.engine.config import ModelConfig
+from production_stack_tpu.engine.parallel.mesh import AXES
+
+TP = AXES.TP
+DP = AXES.DP
+
+
+def validate_tp(cfg: ModelConfig, tp_size: int) -> None:
+    if cfg.num_heads % tp_size:
+        raise ValueError(f"num_heads={cfg.num_heads} not divisible by tp={tp_size}")
+    if cfg.num_kv_heads % tp_size:
+        raise ValueError(
+            f"num_kv_heads={cfg.num_kv_heads} not divisible by tp={tp_size}"
+        )
+    if cfg.intermediate_size % tp_size:
+        raise ValueError(
+            f"intermediate_size={cfg.intermediate_size} not divisible by tp={tp_size}"
+        )
+
+
+def _layer_specs() -> Dict[str, P]:
+    return {
+        "input_layernorm": P(),
+        "post_attention_layernorm": P(),
+        "q_proj": P(None, TP),
+        "k_proj": P(None, TP),
+        "v_proj": P(None, TP),
+        "o_proj": P(TP, None),
+        "gate_proj": P(None, TP),
+        "up_proj": P(None, TP),
+        "down_proj": P(TP, None),
+    }
+
+
+def param_specs(cfg: ModelConfig) -> Dict:
+    """PartitionSpec tree matching the param tree from models/llama.py."""
+    specs: Dict = {
+        "embed_tokens": P(TP, None),
+        "norm": P(),
+        "layers": [_layer_specs() for _ in range(cfg.num_layers)],
+    }
+    if not cfg.tie_word_embeddings:
+        specs["lm_head"] = P(None, TP)
+    return specs
+
+
+def param_shardings(cfg: ModelConfig, mesh: Mesh) -> Dict:
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda spec: NamedSharding(mesh, spec),
+        param_specs(cfg),
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def kv_cache_spec() -> P:
+    # [num_blocks, block_size, num_kv_heads, head_dim]: shard kv heads.
+    return P(None, None, TP, None)
+
+
+def kv_cache_shardings(cfg: ModelConfig, mesh: Mesh) -> List[Tuple]:
+    sharding = NamedSharding(mesh, kv_cache_spec())
+    return [(sharding, sharding) for _ in range(cfg.num_layers)]
+
+
+def decode_batch_spec() -> P:
+    return P(DP)  # shard sequences over data-parallel axis

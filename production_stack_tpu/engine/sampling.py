@@ -1,0 +1,69 @@
+"""Token sampling: greedy / temperature / top-k / top-p, fully vectorized.
+
+One jitted function handles a mixed batch (each sequence has its own
+temperature/top-k/top-p/seed); the greedy-vs-sampled choice is a
+``jnp.where``, not control flow, so the whole batch stays one XLA program.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _apply_top_k(logits: jax.Array, top_k: jax.Array) -> jax.Array:
+    """Mask logits below the k-th largest.  top_k<=0 disables."""
+    V = logits.shape[-1]
+    sorted_desc = jnp.sort(logits, axis=-1)[..., ::-1]  # [S, V]
+    k = jnp.clip(top_k, 1, V)
+    kth = jnp.take_along_axis(sorted_desc, (k - 1)[:, None], axis=-1)  # [S,1]
+    masked = jnp.where(logits < kth, NEG_INF, logits)
+    return jnp.where((top_k > 0)[:, None], masked, logits)
+
+
+def _apply_top_p(logits: jax.Array, top_p: jax.Array) -> jax.Array:
+    """Nucleus filtering.  top_p>=1 disables."""
+    sorted_desc = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_desc, axis=-1)
+    cumulative = jnp.cumsum(probs, axis=-1)
+    # Keep tokens whose cumulative mass (exclusive) is below top_p; the
+    # first token is always kept.
+    keep = (cumulative - probs) < top_p[:, None]
+    # Smallest kept logit is the threshold.
+    threshold = jnp.min(
+        jnp.where(keep, sorted_desc, jnp.inf), axis=-1, keepdims=True
+    )
+    masked = jnp.where(logits < threshold, NEG_INF, logits)
+    return jnp.where((top_p < 1.0)[:, None], masked, logits)
+
+
+def sample_tokens(
+    logits: jax.Array,  # [S, V] fp32
+    temperature: jax.Array,  # [S]
+    top_p: jax.Array,  # [S]
+    top_k: jax.Array,  # [S] int32
+    step_key: jax.Array,  # PRNG key
+    seq_seeds: jax.Array,  # [S] int32 per-sequence seed fold
+) -> jax.Array:
+    """Returns sampled token ids [S] (int32)."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    safe_temp = jnp.where(temperature > 0, temperature, 1.0)
+    scaled = logits / safe_temp[:, None]
+    scaled = _apply_top_k(scaled, top_k)
+    scaled = _apply_top_p(scaled, top_p)
+
+    keys = jax.vmap(lambda s: jax.random.fold_in(step_key, s))(seq_seeds)
+    sampled = jax.vmap(
+        lambda key, row: jax.random.categorical(key, row)
+    )(keys, scaled).astype(jnp.int32)
+
+    return jnp.where(temperature > 0, sampled, greedy)
+
+
+def compute_logprobs(logits: jax.Array, token_ids: jax.Array) -> jax.Array:
+    """Log-prob of the chosen tokens: [S, V], [S] -> [S]."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return jnp.take_along_axis(logp, token_ids[:, None], axis=-1)[:, 0]

@@ -1,0 +1,1 @@
+"""OpenAI-compatible serving front-end for the TPU engine."""

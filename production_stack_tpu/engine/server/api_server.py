@@ -1,0 +1,318 @@
+"""OpenAI-compatible API server for the TPU engine.
+
+Speaks exactly the contract the router (and the reference's router) expects
+from a serving engine: /v1/chat/completions, /v1/completions (SSE streaming
+and non-streaming), /v1/models, /health, and Prometheus /metrics in the
+``tpu:`` vocabulary (production_stack_tpu/router/stats/vocabulary.py).
+This is the process the helm chart runs per engine pod — the TPU analogue of
+``vllm serve`` (reference deployment-vllm-multi.yaml:57-64).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import time
+import uuid
+from typing import Optional
+
+from aiohttp import web
+
+from production_stack_tpu.engine.config import EngineConfig, config_from_preset
+from production_stack_tpu.engine.core.sequence import FinishReason, SamplingParams
+from production_stack_tpu.engine.server.async_engine import AsyncEngine
+from production_stack_tpu.router.stats import vocabulary as vocab
+from production_stack_tpu.utils.log import init_logger
+
+logger = logging.getLogger(__name__)
+
+
+def _sampling_from_body(body: dict) -> SamplingParams:
+    stop = body.get("stop")
+    if isinstance(stop, str):
+        stop = [stop]
+    return SamplingParams(
+        max_tokens=int(
+            body.get("max_tokens") or body.get("max_completion_tokens") or 128
+        ),
+        temperature=float(body.get("temperature") or 0.0),
+        top_p=float(body.get("top_p") or 1.0),
+        top_k=int(body.get("top_k") or 0),
+        stop=stop,
+        ignore_eos=bool(body.get("ignore_eos", False)),
+        seed=body.get("seed"),
+    )
+
+
+class StopChecker:
+    """Incremental detokenization with stop-string truncation."""
+
+    def __init__(self, tokenizer, stop: Optional[list]):
+        self.tokenizer = tokenizer
+        self.stop = stop or []
+        self.token_ids: list = []
+        self.emitted_text = ""
+
+    def push(self, token_id: int):
+        """Returns (delta_text, stopped)."""
+        self.token_ids.append(token_id)
+        text = self.tokenizer.decode(self.token_ids)
+        for s in self.stop:
+            idx = text.find(s)
+            if idx != -1:
+                delta = text[len(self.emitted_text) : idx]
+                self.emitted_text = text[:idx]
+                return delta, True
+        # Hold back a partial-stop-suffix so we never emit half a stop string.
+        hold = 0
+        for s in self.stop:
+            for k in range(1, len(s)):
+                if text.endswith(s[:k]):
+                    hold = max(hold, k)
+        safe = text[: len(text) - hold] if hold else text
+        delta = safe[len(self.emitted_text) :]
+        if delta:
+            self.emitted_text = safe
+        return delta, False
+
+
+def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
+    app = web.Application()
+    app["engine"] = engine
+
+    async def models(_req: web.Request) -> web.Response:
+        return web.json_response(
+            {
+                "object": "list",
+                "data": [
+                    {
+                        "id": served_model,
+                        "object": "model",
+                        "created": int(time.time()),
+                        "owned_by": "production-stack-tpu",
+                    }
+                ],
+            }
+        )
+
+    async def health(_req: web.Request) -> web.Response:
+        return web.json_response({"status": "ok"})
+
+    async def metrics(_req: web.Request) -> web.Response:
+        s = engine.stats()
+        pairs = [
+            (vocab.TPU_NUM_REQUESTS_RUNNING, s["num_requests_running"]),
+            (vocab.TPU_NUM_REQUESTS_WAITING, s["num_requests_waiting"]),
+            (vocab.TPU_HBM_KV_USAGE_PERC, s["hbm_kv_usage_perc"]),
+            (vocab.TPU_PREFIX_CACHE_HIT_RATE, s["prefix_cache_hit_rate"]),
+            (vocab.TPU_HOST_KV_USAGE_PERC, s["host_kv_usage_perc"]),
+            (vocab.TPU_DUTY_CYCLE, s["duty_cycle"]),
+            ("tpu:total_prompt_tokens", s["total_prompt_tokens"]),
+            ("tpu:total_generated_tokens", s["total_generated_tokens"]),
+            ("tpu:total_finished_requests", s["total_finished"]),
+            ("tpu:num_preemptions", s["num_preemptions"]),
+        ]
+        lines = []
+        for name, value in pairs:
+            kind = "counter" if name.startswith("tpu:total") else "gauge"
+            lines.append(f"# TYPE {name} {kind}")
+            lines.append(f"{name} {float(value)}")
+        return web.Response(text="\n".join(lines) + "\n")
+
+    async def chat_completions(request: web.Request) -> web.StreamResponse:
+        return await _serve_completion(request, chat=True)
+
+    async def completions(request: web.Request) -> web.StreamResponse:
+        return await _serve_completion(request, chat=False)
+
+    async def _serve_completion(request: web.Request, chat: bool) -> web.StreamResponse:
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response(
+                {"error": {"message": "invalid JSON", "type": "invalid_request_error"}},
+                status=400,
+            )
+        tokenizer = engine.engine.tokenizer
+        if chat:
+            messages = body.get("messages") or []
+            prompt = tokenizer.apply_chat_template(messages)
+        else:
+            prompt = body.get("prompt") or ""
+            if isinstance(prompt, list):
+                prompt = "\n".join(str(p) for p in prompt)
+        params = _sampling_from_body(body)
+        stream = bool(body.get("stream", False))
+        request_id = request.headers.get("x-request-id") or f"cmpl-{uuid.uuid4().hex[:16]}"
+        created = int(time.time())
+        model_name = body.get("model", served_model)
+        object_name = "chat.completion.chunk" if chat else "text_completion"
+        checker = StopChecker(tokenizer, params.stop)
+        prompt_token_ids = tokenizer.encode(prompt)
+
+        gen = engine.generate(
+            prompt_token_ids=prompt_token_ids,
+            sampling_params=params,
+            request_id=request_id,
+        )
+
+        def chunk_payload(delta_text: str, finish_reason, first: bool):
+            if chat:
+                delta = {}
+                if first:
+                    delta["role"] = "assistant"
+                if delta_text:
+                    delta["content"] = delta_text
+                choice = {"index": 0, "delta": delta, "finish_reason": finish_reason}
+            else:
+                choice = {"index": 0, "text": delta_text, "finish_reason": finish_reason}
+            return {
+                "id": request_id,
+                "object": object_name,
+                "created": created,
+                "model": model_name,
+                "choices": [choice],
+            }
+
+        if stream:
+            response = web.StreamResponse(
+                headers={"Content-Type": "text/event-stream", "Cache-Control": "no-cache"}
+            )
+            await response.prepare(request)
+            first = True
+            n_out = 0
+            try:
+                async for event in gen:
+                    delta, stopped = checker.push(event.token_id)
+                    n_out = event.num_output_tokens
+                    if delta or first:
+                        payload = chunk_payload(delta, None, first)
+                        await response.write(
+                            f"data: {json.dumps(payload)}\n\n".encode()
+                        )
+                        first = False
+                    if stopped or event.finished:
+                        reason = (
+                            "stop"
+                            if stopped
+                            or event.finish_reason == FinishReason.STOP
+                            else "length"
+                        )
+                        if stopped and not event.finished:
+                            await engine.abort(request_id)
+                        final = chunk_payload("", reason, first)
+                        final["usage"] = {
+                            "prompt_tokens": len(prompt_token_ids),
+                            "completion_tokens": n_out,
+                            "total_tokens": len(prompt_token_ids) + n_out,
+                        }
+                        await response.write(f"data: {json.dumps(final)}\n\n".encode())
+                        break
+                await response.write(b"data: [DONE]\n\n")
+                await response.write_eof()
+            except ConnectionResetError:
+                await engine.abort(request_id)
+            return response
+
+        # Non-streaming: accumulate.
+        text_parts = []
+        finish_reason = "length"
+        n_out = 0
+        async for event in gen:
+            delta, stopped = checker.push(event.token_id)
+            text_parts.append(delta)
+            n_out = event.num_output_tokens
+            if stopped:
+                finish_reason = "stop"
+                if not event.finished:
+                    await engine.abort(request_id)
+                break
+            if event.finished:
+                finish_reason = (
+                    "stop" if event.finish_reason == FinishReason.STOP else "length"
+                )
+                break
+        text = "".join(text_parts)
+        if chat:
+            choice = {
+                "index": 0,
+                "message": {"role": "assistant", "content": text},
+                "finish_reason": finish_reason,
+            }
+            obj = "chat.completion"
+        else:
+            choice = {"index": 0, "text": text, "finish_reason": finish_reason}
+            obj = "text_completion"
+        return web.json_response(
+            {
+                "id": request_id,
+                "object": obj,
+                "created": created,
+                "model": model_name,
+                "choices": [choice],
+                "usage": {
+                    "prompt_tokens": len(prompt_token_ids),
+                    "completion_tokens": n_out,
+                    "total_tokens": len(prompt_token_ids) + n_out,
+                },
+            }
+        )
+
+    app.router.add_get("/v1/models", models)
+    app.router.add_get("/health", health)
+    app.router.add_get("/metrics", metrics)
+    app.router.add_post("/v1/chat/completions", chat_completions)
+    app.router.add_post("/v1/completions", completions)
+
+    async def lifecycle(app):
+        await engine.start()
+        yield
+        await engine.close()
+
+    app.cleanup_ctx.append(lifecycle)
+    return app
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(description="TPU serving engine (OpenAI API)")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=8000)
+    parser.add_argument("--model", default="tiny-llama", help="model preset name")
+    parser.add_argument("--served-model-name", default=None)
+    parser.add_argument("--weights-path", default=None)
+    parser.add_argument("--tokenizer", default=None)
+    parser.add_argument("--max-num-seqs", type=int, default=8)
+    parser.add_argument("--max-model-len", type=int, default=2048)
+    parser.add_argument("--block-size", type=int, default=16)
+    parser.add_argument("--num-blocks", type=int, default=None)
+    parser.add_argument("--host-offload-gb", type=float, default=0.0)
+    parser.add_argument("--remote-kv-url", default=None)
+    parser.add_argument("--no-prefix-caching", action="store_true")
+    parser.add_argument("--log-level", default="info")
+    args = parser.parse_args(argv)
+
+    init_logger("production_stack_tpu", args.log_level)
+    config = config_from_preset(
+        args.model,
+        **{
+            "weights_path": args.weights_path,
+            "tokenizer": args.tokenizer,
+            "scheduler.max_num_seqs": args.max_num_seqs,
+            "scheduler.max_model_len": args.max_model_len,
+            "cache.block_size": args.block_size,
+            "cache.num_blocks": args.num_blocks,
+            "cache.host_offload_gb": args.host_offload_gb,
+            "cache.remote_kv_url": args.remote_kv_url,
+            "cache.enable_prefix_caching": not args.no_prefix_caching,
+        },
+    )
+    engine = AsyncEngine(config)
+    served = args.served_model_name or args.model
+    app = build_engine_app(engine, served)
+    logger.info("Starting tpu-engine (%s) on %s:%d", served, args.host, args.port)
+    web.run_app(app, host=args.host, port=args.port, access_log=None)
+
+
+if __name__ == "__main__":
+    main()

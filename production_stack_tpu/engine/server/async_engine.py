@@ -1,0 +1,151 @@
+"""Async bridge between the aiohttp server and the synchronous LLMEngine.
+
+The engine step loop runs in one dedicated thread (device execution releases
+the GIL, so the event loop keeps serving HTTP while XLA runs).  Requests and
+per-token outputs cross the thread boundary via a lock-guarded submission
+list and ``loop.call_soon_threadsafe`` hand-offs into per-request asyncio
+queues — one queue per request, one engine, no polling of shared state from
+the event loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+import threading
+import time
+import uuid
+from typing import AsyncIterator, Dict, List, Optional
+
+from production_stack_tpu.engine.config import EngineConfig
+from production_stack_tpu.engine.core.engine import LLMEngine
+from production_stack_tpu.engine.core.sequence import FinishReason, SamplingParams
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class TokenEvent:
+    token_id: int
+    finished: bool
+    finish_reason: Optional[FinishReason]
+    num_prompt_tokens: int
+    num_output_tokens: int
+
+
+class AsyncEngine:
+    def __init__(self, config: EngineConfig):
+        self.engine = LLMEngine(config)
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._queues: Dict[str, asyncio.Queue] = {}
+        self._pending: List = []  # (request_id, prompt_ids, sampling_params)
+        self._aborts: List[str] = []
+        self._lock = threading.Lock()
+        self._shutdown = threading.Event()
+        self._wakeup = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> None:
+        self._loop = asyncio.get_running_loop()
+        self._thread = threading.Thread(
+            target=self._run_loop, name="engine-step-loop", daemon=True
+        )
+        self._thread.start()
+
+    async def close(self) -> None:
+        self._shutdown.set()
+        self._wakeup.set()
+        if self._thread is not None:
+            await asyncio.to_thread(self._thread.join, 30)
+
+    # -- request API (event-loop side) ------------------------------------
+
+    async def generate(
+        self,
+        prompt: Optional[str] = None,
+        prompt_token_ids: Optional[List[int]] = None,
+        sampling_params: Optional[SamplingParams] = None,
+        request_id: Optional[str] = None,
+    ) -> AsyncIterator[TokenEvent]:
+        request_id = request_id or f"req-{uuid.uuid4().hex[:12]}"
+        queue: asyncio.Queue = asyncio.Queue()
+        self._queues[request_id] = queue
+        if prompt_token_ids is None:
+            prompt_token_ids = self.engine.tokenizer.encode(prompt or "")
+        with self._lock:
+            self._pending.append(
+                (request_id, prompt_token_ids, sampling_params or SamplingParams())
+            )
+        self._wakeup.set()
+        try:
+            while True:
+                event = await queue.get()
+                if isinstance(event, Exception):
+                    raise event
+                yield event
+                if event.finished:
+                    return
+        finally:
+            self._queues.pop(request_id, None)
+            # If the client disconnected mid-generation, abort in-engine.
+
+    async def abort(self, request_id: str) -> None:
+        with self._lock:
+            self._aborts.append(request_id)
+        self._wakeup.set()
+
+    def stats(self) -> Dict[str, float]:
+        return self.engine.stats()
+
+    # -- engine thread -----------------------------------------------------
+
+    def _run_loop(self) -> None:
+        logger.info("engine step loop started")
+        while not self._shutdown.is_set():
+            with self._lock:
+                pending, self._pending = self._pending, []
+                aborts, self._aborts = self._aborts, []
+            for request_id in aborts:
+                self.engine.abort_request(request_id)
+            for request_id, token_ids, params in pending:
+                try:
+                    self.engine.add_request(
+                        request_id,
+                        prompt_token_ids=token_ids,
+                        sampling_params=params,
+                    )
+                except Exception as e:
+                    self._emit(request_id, e)
+            if not self.engine.has_unfinished():
+                self._wakeup.wait(timeout=0.01)
+                self._wakeup.clear()
+                continue
+            try:
+                outputs = self.engine.step()
+            except Exception:
+                logger.exception("engine step failed")
+                time.sleep(0.1)
+                continue
+            for out in outputs:
+                # Drop events for requests whose client vanished.
+                if out.seq_id in self._queues:
+                    self._emit(
+                        out.seq_id,
+                        TokenEvent(
+                            token_id=out.new_token_id,
+                            finished=out.finished,
+                            finish_reason=out.finish_reason,
+                            num_prompt_tokens=out.num_prompt_tokens,
+                            num_output_tokens=out.num_output_tokens,
+                        ),
+                    )
+        logger.info("engine step loop exited")
+
+    def _emit(self, request_id: str, event) -> None:
+        queue = self._queues.get(request_id)
+        if queue is None or self._loop is None:
+            return
+        self._loop.call_soon_threadsafe(queue.put_nowait, event)

@@ -1,0 +1,81 @@
+"""Tokenizer abstraction: HF tokenizer when available, byte-level fallback.
+
+The byte fallback keeps the engine fully functional in zero-egress
+environments (CI, clusterless smoke tests): deterministic, reversible,
+vocab of 256 bytes + 4 specials.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Optional
+
+logger = logging.getLogger(__name__)
+
+
+class ByteTokenizer:
+    """Reversible byte-level tokenizer: token = byte value + 4 specials."""
+
+    PAD, BOS, EOS, UNK = 0, 1, 2, 3
+    OFFSET = 4
+
+    def __init__(self):
+        self.vocab_size = 256 + self.OFFSET
+        self.bos_token_id = self.BOS
+        self.eos_token_id = self.EOS
+        self.pad_token_id = self.PAD
+
+    def encode(self, text: str, add_bos: bool = True) -> List[int]:
+        ids = [b + self.OFFSET for b in text.encode("utf-8")]
+        return ([self.BOS] if add_bos else []) + ids
+
+    def decode(self, ids: List[int]) -> str:
+        # Ids beyond the byte range can appear when the model's vocab is
+        # padded larger than the tokenizer's (random-init smoke models).
+        data = bytes(
+            i - self.OFFSET for i in ids if self.OFFSET <= i < self.OFFSET + 256
+        )
+        return data.decode("utf-8", errors="replace")
+
+    def apply_chat_template(self, messages) -> str:
+        parts = [f"<|{m.get('role', 'user')}|>{m.get('content', '')}" for m in messages]
+        return "\n".join(parts) + "\n<|assistant|>"
+
+
+class HFTokenizer:
+    """Thin wrapper over transformers.AutoTokenizer (local files only)."""
+
+    def __init__(self, path: str):
+        from transformers import AutoTokenizer
+
+        self._tok = AutoTokenizer.from_pretrained(path, local_files_only=True)
+        self.vocab_size = len(self._tok)
+        self.bos_token_id = self._tok.bos_token_id
+        self.eos_token_id = self._tok.eos_token_id
+        self.pad_token_id = self._tok.pad_token_id or 0
+
+    def encode(self, text: str, add_bos: bool = True) -> List[int]:
+        return self._tok.encode(text, add_special_tokens=add_bos)
+
+    def decode(self, ids: List[int]) -> str:
+        return self._tok.decode(ids, skip_special_tokens=True)
+
+    def apply_chat_template(self, messages) -> str:
+        try:
+            return self._tok.apply_chat_template(
+                messages, tokenize=False, add_generation_prompt=True
+            )
+        except Exception:
+            parts = [f"{m.get('role')}: {m.get('content', '')}" for m in messages]
+            return "\n".join(parts) + "\nassistant:"
+
+
+def get_tokenizer(path: Optional[str]):
+    if path:
+        try:
+            return HFTokenizer(path)
+        except Exception:
+            logger.exception(
+                "Could not load HF tokenizer from %s; using byte fallback", path
+            )
+    return ByteTokenizer()

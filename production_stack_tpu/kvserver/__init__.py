@@ -1,0 +1,9 @@
+"""Remote shared KV store (``kv://host:port``).
+
+The cross-replica KV tier: the TPU analogue of the reference's LMCache
+cache-server deployment (deployment-cache-server.yaml, remote URL helper
+``lm://name:port`` at _helpers.tpl:164-166).  A length-prefixed binary TCP
+protocol with a ``naive`` serde (raw little-endian tensors) — see
+protocol.py.  Two interchangeable servers: the C++ epoll server under
+native/kvserver/ (production) and server.py (pure-python fallback, CI).
+"""

@@ -1,0 +1,118 @@
+"""Blocking client for the shared KV store (used from the engine thread).
+
+URL form: ``kv://host:port`` (the reference's cacheserver analogue uses
+``lm://host:port``, _helpers.tpl:164-166).
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import struct
+import threading
+from typing import List, Optional, Tuple
+from urllib.parse import urlparse
+
+import numpy as np
+
+from production_stack_tpu.kvserver import protocol as proto
+
+logger = logging.getLogger(__name__)
+
+
+class RemoteKVClient:
+    def __init__(self, url: str, timeout: float = 10.0):
+        parsed = urlparse(url)
+        if parsed.scheme not in ("kv", "tcp"):
+            raise ValueError(f"Unsupported KV store URL scheme: {url}")
+        self.host = parsed.hostname or "127.0.0.1"
+        self.port = parsed.port or 9400
+        self.timeout = timeout
+        self._lock = threading.Lock()
+        self._sock: Optional[socket.socket] = None
+
+    # -- socket plumbing ---------------------------------------------------
+
+    def _connect(self) -> socket.socket:
+        if self._sock is None:
+            sock = socket.create_connection((self.host, self.port), self.timeout)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._sock = sock
+        return self._sock
+
+    def _reset(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None
+
+    def _recv_exact(self, sock: socket.socket, n: int) -> bytes:
+        chunks = []
+        remaining = n
+        while remaining:
+            chunk = sock.recv(min(remaining, 1 << 20))
+            if not chunk:
+                raise ConnectionError("KV server closed connection")
+            chunks.append(chunk)
+            remaining -= len(chunk)
+        return b"".join(chunks)
+
+    def _call(self, op: int, key: bytes, value: bytes = b"") -> Tuple[int, bytes]:
+        with self._lock:
+            try:
+                sock = self._connect()
+                sock.sendall(proto.pack_request(op, key, value))
+                head = self._recv_exact(sock, 13)
+                magic, status, val_len = struct.unpack("<IBQ", head)
+                if magic != proto.MAGIC:
+                    raise ConnectionError("bad magic from KV server")
+                payload = self._recv_exact(sock, val_len) if val_len else b""
+                return status, payload
+            except Exception:
+                self._reset()
+                raise
+
+    # -- KV snapshot API ---------------------------------------------------
+
+    def put_blocks(
+        self,
+        seq_id: str,
+        layers: List[Tuple[np.ndarray, np.ndarray]],
+        num_tokens: int,
+    ) -> None:
+        blob = proto.encode_kv_snapshot(layers, num_tokens)
+        status, _ = self._call(proto.OP_PUT, seq_id.encode(), blob)
+        if status != proto.ST_OK:
+            raise RuntimeError(f"KV PUT failed with status {status}")
+
+    def get_blocks(
+        self, seq_id: str
+    ) -> Optional[Tuple[List[Tuple[np.ndarray, np.ndarray]], int]]:
+        status, payload = self._call(proto.OP_GET, seq_id.encode())
+        if status == proto.ST_NOT_FOUND:
+            return None
+        if status != proto.ST_OK:
+            raise RuntimeError(f"KV GET failed with status {status}")
+        return proto.decode_kv_snapshot(payload)
+
+    def delete(self, seq_id: str) -> None:
+        self._call(proto.OP_DEL, seq_id.encode())
+
+    def ping(self) -> bool:
+        try:
+            status, _ = self._call(proto.OP_PING, b"")
+            return status == proto.ST_OK
+        except Exception:
+            return False
+
+    def stat(self) -> dict:
+        import json
+
+        status, payload = self._call(proto.OP_STAT, b"")
+        if status != proto.ST_OK:
+            return {}
+        return json.loads(payload)
+
+    def close(self) -> None:
+        self._reset()

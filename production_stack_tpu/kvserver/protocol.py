@@ -1,0 +1,109 @@
+"""Wire protocol for the shared KV store.
+
+Frame (all little-endian):
+
+    magic   u32  = 0x54505543 ("TPUC")
+    op      u8   (1=PUT, 2=GET, 3=DEL, 4=STAT, 5=PING)
+    key_len u16
+    key     bytes
+    val_len u64  (PUT only)
+    value   bytes
+
+Response:
+
+    magic   u32
+    status  u8   (0=OK, 1=NOT_FOUND, 2=ERROR)
+    val_len u64
+    value   bytes
+
+The ``naive`` serde stores a sequence's KV snapshot as:
+
+    num_tokens u32, num_layers u32, then per layer:
+      k: ndim u8, shape u32*ndim, dtype_code u8, data
+      v: same
+
+dtype codes: 0=float32, 1=bfloat16(stored as u16), 2=float16, 3=int8.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+MAGIC = 0x54505543
+OP_PUT, OP_GET, OP_DEL, OP_STAT, OP_PING = 1, 2, 3, 4, 5
+ST_OK, ST_NOT_FOUND, ST_ERROR = 0, 1, 2
+
+_DTYPES = {0: np.float32, 2: np.float16, 3: np.int8}
+_DTYPE_CODES = {np.dtype(np.float32): 0, np.dtype(np.float16): 2, np.dtype(np.int8): 3}
+_BF16_CODE = 1
+
+
+def _encode_array(arr: np.ndarray) -> bytes:
+    if arr.dtype.name == "bfloat16":  # ml_dtypes bfloat16
+        code = _BF16_CODE
+        raw = arr.view(np.uint16)
+    else:
+        code = _DTYPE_CODES.get(arr.dtype)
+        if code is None:
+            arr = arr.astype(np.float32)
+            code = 0
+        raw = arr
+    header = struct.pack("<B", arr.ndim) + struct.pack(f"<{arr.ndim}I", *arr.shape)
+    return header + struct.pack("<B", code) + np.ascontiguousarray(raw).tobytes()
+
+
+def _decode_array(buf: memoryview, offset: int) -> Tuple[np.ndarray, int]:
+    ndim = buf[offset]
+    offset += 1
+    shape = struct.unpack_from(f"<{ndim}I", buf, offset)
+    offset += 4 * ndim
+    code = buf[offset]
+    offset += 1
+    count = int(np.prod(shape)) if shape else 1
+    if code == _BF16_CODE:
+        import ml_dtypes
+
+        raw = np.frombuffer(buf, np.uint16, count, offset)
+        arr = raw.view(ml_dtypes.bfloat16).reshape(shape)
+        offset += 2 * count
+    else:
+        dtype = np.dtype(_DTYPES[code])
+        arr = np.frombuffer(buf, dtype, count, offset).reshape(shape)
+        offset += dtype.itemsize * count
+    return arr, offset
+
+
+def encode_kv_snapshot(
+    layers: List[Tuple[np.ndarray, np.ndarray]], num_tokens: int
+) -> bytes:
+    parts = [struct.pack("<II", num_tokens, len(layers))]
+    for k, v in layers:
+        parts.append(_encode_array(np.asarray(k)))
+        parts.append(_encode_array(np.asarray(v)))
+    return b"".join(parts)
+
+
+def decode_kv_snapshot(data: bytes) -> Tuple[List[Tuple[np.ndarray, np.ndarray]], int]:
+    buf = memoryview(data)
+    num_tokens, num_layers = struct.unpack_from("<II", buf, 0)
+    offset = 8
+    layers = []
+    for _ in range(num_layers):
+        k, offset = _decode_array(buf, offset)
+        v, offset = _decode_array(buf, offset)
+        layers.append((k, v))
+    return layers, num_tokens
+
+
+def pack_request(op: int, key: bytes, value: bytes = b"") -> bytes:
+    head = struct.pack("<IBH", MAGIC, op, len(key)) + key
+    if op == OP_PUT:
+        head += struct.pack("<Q", len(value)) + value
+    return head
+
+
+def pack_response(status: int, value: bytes = b"") -> bytes:
+    return struct.pack("<IBQ", MAGIC, status, len(value)) + value

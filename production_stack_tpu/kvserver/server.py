@@ -1,0 +1,139 @@
+"""Pure-python shared KV store server (asyncio).
+
+CI/test fallback for the C++ epoll server in native/kvserver/ — same wire
+protocol (protocol.py), same CLI shape.  The reference's counterpart is the
+LMCache cache-server deployment (deployment-cache-server.yaml:19-42).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import struct
+import time
+from typing import Dict, Tuple
+
+from production_stack_tpu.kvserver import protocol as proto
+from production_stack_tpu.utils.log import init_logger
+
+logger = logging.getLogger(__name__)
+
+
+class KVStore:
+    def __init__(self, capacity_bytes: int):
+        self.capacity_bytes = capacity_bytes
+        self.used = 0
+        self._data: Dict[bytes, Tuple[bytes, float]] = {}
+        self.hits = 0
+        self.misses = 0
+
+    def put(self, key: bytes, value: bytes) -> None:
+        old = self._data.pop(key, None)
+        if old is not None:
+            self.used -= len(old[0])
+        while self.used + len(value) > self.capacity_bytes and self._data:
+            evict_key = min(self._data, key=lambda k: self._data[k][1])
+            self.used -= len(self._data.pop(evict_key)[0])
+        self._data[key] = (value, time.time())
+        self.used += len(value)
+
+    def get(self, key: bytes):
+        entry = self._data.get(key)
+        if entry is None:
+            self.misses += 1
+            return None
+        self.hits += 1
+        self._data[key] = (entry[0], time.time())  # LRU touch
+        return entry[0]
+
+    def delete(self, key: bytes) -> None:
+        entry = self._data.pop(key, None)
+        if entry is not None:
+            self.used -= len(entry[0])
+
+    def stats(self) -> dict:
+        return {
+            "keys": len(self._data),
+            "used_bytes": self.used,
+            "capacity_bytes": self.capacity_bytes,
+            "hits": self.hits,
+            "misses": self.misses,
+        }
+
+
+async def _recv_exact(reader: asyncio.StreamReader, n: int) -> bytes:
+    return await reader.readexactly(n)
+
+
+async def handle_client(
+    store: KVStore, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+) -> None:
+    peer = writer.get_extra_info("peername")
+    try:
+        while True:
+            try:
+                head = await _recv_exact(reader, 7)
+            except asyncio.IncompleteReadError:
+                break
+            magic, op, key_len = struct.unpack("<IBH", head)
+            if magic != proto.MAGIC:
+                writer.write(proto.pack_response(proto.ST_ERROR))
+                break
+            key = await _recv_exact(reader, key_len) if key_len else b""
+            if op == proto.OP_PUT:
+                (val_len,) = struct.unpack("<Q", await _recv_exact(reader, 8))
+                value = await _recv_exact(reader, val_len)
+                store.put(key, value)
+                writer.write(proto.pack_response(proto.ST_OK))
+            elif op == proto.OP_GET:
+                value = store.get(key)
+                if value is None:
+                    writer.write(proto.pack_response(proto.ST_NOT_FOUND))
+                else:
+                    writer.write(proto.pack_response(proto.ST_OK, value))
+            elif op == proto.OP_DEL:
+                store.delete(key)
+                writer.write(proto.pack_response(proto.ST_OK))
+            elif op == proto.OP_STAT:
+                writer.write(
+                    proto.pack_response(
+                        proto.ST_OK, json.dumps(store.stats()).encode()
+                    )
+                )
+            elif op == proto.OP_PING:
+                writer.write(proto.pack_response(proto.ST_OK))
+            else:
+                writer.write(proto.pack_response(proto.ST_ERROR))
+            await writer.drain()
+    except (ConnectionResetError, BrokenPipeError):
+        pass
+    finally:
+        writer.close()
+        logger.debug("client %s disconnected", peer)
+
+
+async def serve(host: str, port: int, capacity_bytes: int) -> None:
+    store = KVStore(capacity_bytes)
+    server = await asyncio.start_server(
+        lambda r, w: handle_client(store, r, w), host, port
+    )
+    logger.info("KV store serving on %s:%d (%.1f GiB)", host, port, capacity_bytes / 2**30)
+    async with server:
+        await server.serve_forever()
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(description="Shared KV cache server")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=9400)
+    parser.add_argument("--capacity-gb", type=float, default=4.0)
+    parser.add_argument("--log-level", default="info")
+    args = parser.parse_args(argv)
+    init_logger("production_stack_tpu", args.log_level)
+    asyncio.run(serve(args.host, args.port, int(args.capacity_gb * 2**30)))
+
+
+if __name__ == "__main__":
+    main()

@@ -17,7 +17,10 @@ import inspect
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU even when the ambient environment selects a TPU platform
+# (JAX_PLATFORMS=axon is preset on TPU hosts); tests must run on the
+# virtual 8-device CPU mesh.  bench.py is the only TPU-hardware entry.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

@@ -1,0 +1,105 @@
+"""BlockPool: allocation, refcounts, prefix-cache hash chains, LRU eviction."""
+
+import pytest
+
+from production_stack_tpu.engine.kv.block_pool import BlockPool
+
+
+def test_basic_allocate_free():
+    pool = BlockPool(num_blocks=10, block_size=4)
+    assert pool.num_free_blocks == 9  # block 0 reserved
+    blocks = pool.allocate(3)
+    assert len(set(blocks)) == 3 and 0 not in blocks
+    assert pool.num_free_blocks == 6
+    pool.free(blocks)
+    assert pool.num_free_blocks == 9
+
+
+def test_exhaustion_raises():
+    pool = BlockPool(num_blocks=4, block_size=4)
+    pool.allocate(3)
+    with pytest.raises(RuntimeError):
+        pool.allocate(1)
+
+
+def test_usage_metric():
+    pool = BlockPool(num_blocks=11, block_size=4)
+    pool.allocate(5)
+    assert abs(pool.usage - 0.5) < 1e-9
+
+
+def test_prefix_roundtrip():
+    pool = BlockPool(num_blocks=20, block_size=4)
+    tokens = list(range(10))  # 2 full blocks + 2 tail tokens
+    blocks = pool.allocate(3)
+    pool.register_prefix(tokens, blocks)
+    pool.free(blocks)
+
+    matched, cached = pool.match_prefix(tokens)
+    assert cached == 8
+    assert matched == blocks[:2]
+    # Hit-rate metric moved.
+    assert pool.prefix_hit_rate > 0
+
+
+def test_prefix_leaves_one_token_uncached():
+    """A fully-cached prompt must still leave >=1 token for prefill."""
+    pool = BlockPool(num_blocks=20, block_size=4)
+    tokens = list(range(8))  # exactly 2 blocks
+    blocks = pool.allocate(2)
+    pool.register_prefix(tokens, blocks)
+    pool.free(blocks)
+    matched, cached = pool.match_prefix(tokens)
+    assert cached == 4  # only the first block: token 8-1=7 usable
+    pool.free(matched)
+
+
+def test_prefix_mismatch_no_hit():
+    pool = BlockPool(num_blocks=20, block_size=4)
+    blocks = pool.allocate(2)
+    pool.register_prefix(list(range(8)), blocks)
+    pool.free(blocks)
+    matched, cached = pool.match_prefix([99] * 10)
+    assert matched == [] and cached == 0
+
+
+def test_shared_prefix_refcount():
+    pool = BlockPool(num_blocks=20, block_size=4)
+    tokens = list(range(12))
+    blocks = pool.allocate(3)
+    pool.register_prefix(tokens, blocks)
+    # Two concurrent matches share the cached blocks.
+    m1, _ = pool.match_prefix(tokens)
+    m2, _ = pool.match_prefix(tokens)
+    assert m1 == m2
+    pool.free(m1)
+    # Still referenced by m2 + original: freeing once must not reclaim.
+    free_before = pool.num_free_blocks
+    m3, cached = pool.match_prefix(tokens)
+    assert cached > 0
+    assert pool.num_free_blocks == free_before
+
+
+def test_lru_eviction_of_cached_blocks():
+    pool = BlockPool(num_blocks=6, block_size=4, enable_prefix_caching=True)
+    tokens_a = list(range(100, 108))
+    blocks_a = pool.allocate(2)
+    pool.register_prefix(tokens_a, blocks_a)
+    pool.free(blocks_a)
+    assert pool.num_free_blocks == 5
+    # Allocate everything: cached blocks get evicted last (LRU).
+    blocks_b = pool.allocate(5)
+    assert pool.num_free_blocks == 0
+    # The cache entry for A must be gone.
+    matched, cached = pool.match_prefix(tokens_a)
+    assert cached == 0
+    pool.free(blocks_b)
+
+
+def test_disabled_prefix_caching():
+    pool = BlockPool(num_blocks=10, block_size=4, enable_prefix_caching=False)
+    blocks = pool.allocate(2)
+    pool.register_prefix(list(range(8)), blocks)
+    pool.free(blocks)
+    matched, cached = pool.match_prefix(list(range(8)))
+    assert matched == [] and cached == 0

@@ -1,0 +1,134 @@
+"""Scheduler: admission, bucketing, block accounting, preemption."""
+
+from production_stack_tpu.engine.config import SchedulerConfig
+from production_stack_tpu.engine.core.scheduler import Scheduler
+from production_stack_tpu.engine.core.sequence import SamplingParams, Sequence
+from production_stack_tpu.engine.kv.block_pool import BlockPool
+
+
+def make_scheduler(num_blocks=64, max_num_seqs=4, offload_cb=None, **kw):
+    pool = BlockPool(num_blocks=num_blocks, block_size=4)
+    cfg = SchedulerConfig(
+        max_num_seqs=max_num_seqs,
+        prefill_buckets=(8, 16, 32),
+        max_prefill_tokens=32,
+        max_model_len=64,
+        **kw,
+    )
+    return Scheduler(cfg, pool, offload_cb=offload_cb), pool
+
+
+def seq(seq_id, n_tokens, t=0.0, max_tokens=4):
+    s = Sequence(
+        seq_id=seq_id,
+        prompt_token_ids=list(range(n_tokens)),
+        sampling_params=SamplingParams(max_tokens=max_tokens),
+    )
+    s.arrival_time = t
+    return s
+
+
+def test_prefill_scheduled_first():
+    sched, pool = make_scheduler()
+    sched.add_seq(seq("a", 6))
+    plan = sched.schedule()
+    assert plan.prefill is not None
+    assert plan.prefill.bucket_len == 8
+    assert plan.prefill.num_new_tokens == 6
+    assert len(plan.prefill.new_block_ids) == 2  # ceil(6/4)
+    assert sched.num_running == 1
+
+
+def test_decode_after_prefill():
+    sched, pool = make_scheduler()
+    sched.add_seq(seq("a", 6))
+    sched.schedule()  # prefill
+    sched.running[0].output_token_ids.append(1)  # sampled first token
+    plan = sched.schedule()
+    assert plan.decode is not None
+    assert [s.seq_id for s in plan.decode.seqs] == ["a"]
+
+
+def test_decode_extends_block_table_when_needed():
+    sched, pool = make_scheduler()
+    s = seq("a", 8)  # exactly 2 blocks
+    sched.add_seq(s)
+    sched.schedule()
+    s.output_token_ids.append(1)  # num_tokens=9 > 8 slots
+    before = len(s.block_table)
+    plan = sched.schedule()
+    assert plan.decode is not None
+    assert len(s.block_table) == before + 1
+
+
+def test_prefill_admission_respects_batch_cap():
+    sched, pool = make_scheduler(max_num_seqs=2)
+    for i in range(3):
+        sched.add_seq(seq(f"s{i}", 4))
+    assert sched.schedule().prefill is not None
+    assert sched.schedule().prefill is not None
+    # Batch full: third stays waiting, decode is scheduled instead.
+    for s in sched.running:
+        s.output_token_ids.append(1)
+    plan = sched.schedule()
+    assert plan.prefill is None and plan.decode is not None
+    assert sched.num_waiting == 1
+
+
+def test_preemption_when_pool_exhausted():
+    offloaded = []
+    sched, pool = make_scheduler(
+        num_blocks=7,  # 6 usable
+        max_num_seqs=2,
+        offload_cb=lambda s, blocks: offloaded.append(s.seq_id) or True,
+    )
+    s1 = seq("old", 8, t=1.0)  # 2 blocks
+    s2 = seq("young", 8, t=2.0)  # 2 blocks
+    sched.add_seq(s1)
+    sched.add_seq(s2)
+    assert sched.schedule().prefill.seq is s1
+    assert sched.schedule().prefill.seq is s2
+    # Fill the pool so decode growth must preempt.
+    pool.allocate(pool.num_free_blocks)
+    s1.output_token_ids.append(1)  # needs block
+    s2.output_token_ids.append(1)  # needs block
+    plan = sched.schedule()
+    assert plan.decode is not None
+    assert [s.seq_id for s in plan.decode.seqs] == ["old"]
+    assert offloaded == ["young"]
+    assert sched.preempted[0].seq_id == "young"
+    assert sched.preempted[0].offloaded
+
+
+def test_preempted_resumes_before_waiting():
+    sched, pool = make_scheduler()
+    s1 = seq("preempted", 8)
+    s1.status = s1.status.PREEMPTED
+    sched.preempted.append(s1)
+    sched.add_seq(seq("fresh", 8))
+    plan = sched.schedule()
+    assert plan.prefill.seq is s1
+
+
+def test_finish_registers_prefix_and_frees():
+    sched, pool = make_scheduler()
+    s = seq("a", 8)
+    sched.add_seq(s)
+    sched.schedule()
+    free_before_finish = pool.num_free_blocks
+    sched.finish_seq(s)
+    assert pool.num_free_blocks > free_before_finish
+    # Prefix reusable by an identical prompt.
+    matched, cached = pool.match_prefix(list(range(8)))
+    assert cached == 4
+
+
+def test_abort_releases_blocks():
+    sched, pool = make_scheduler()
+    s = seq("a", 8)
+    sched.add_seq(s)
+    sched.schedule()
+    used = pool.num_free_blocks
+    assert sched.abort_seq("a") is s
+    assert pool.num_free_blocks > used
+    assert sched.num_running == 0
