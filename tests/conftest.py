@@ -20,12 +20,31 @@ import sys
 # Force CPU even when the ambient environment selects a TPU platform
 # (JAX_PLATFORMS=axon is preset on TPU hosts); tests must run on the
 # virtual 8-device CPU mesh.  bench.py is the only TPU-hardware entry.
+#
+# The env var alone is NOT enough: a sitecustomize on TPU hosts registers
+# the TPU PJRT plugin at interpreter startup and overrides jax_platforms
+# via jax.config, so we must override it back *after* jax import and drop
+# any already-initialized backends (tests would otherwise run float32
+# matmuls through the TPU's reduced-precision passes and fail HF-parity
+# tolerances).
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+if jax.config.jax_platforms != "cpu":
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        from jax.extend.backend import clear_backends
+
+        clear_backends()
+    except Exception:  # pragma: no cover - older jax fallback
+        pass
+assert jax.devices()[0].platform == "cpu"
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
