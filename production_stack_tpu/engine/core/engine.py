@@ -21,6 +21,7 @@ from typing import Dict, List, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from production_stack_tpu.engine.config import EngineConfig
 from production_stack_tpu.engine.core.scheduler import (
@@ -39,6 +40,8 @@ from production_stack_tpu.engine.kv.block_pool import BlockPool
 from production_stack_tpu.engine.kv.offload import HostOffloadManager
 from production_stack_tpu.engine.models import get_model
 from production_stack_tpu.engine.models.weights import load_params
+from production_stack_tpu.engine.parallel import shardings as shardings_lib
+from production_stack_tpu.engine.parallel.mesh import AXES, build_mesh
 from production_stack_tpu.engine.sampling import sample_tokens
 from production_stack_tpu.engine.tokenizer import get_tokenizer
 
@@ -61,8 +64,44 @@ class LLMEngine:
                 f"vocab ({cfg.vocab_size})"
             )
 
+        # SPMD mesh: dp shards the decode batch, tp shards heads/channels,
+        # sp is the ring-attention axis for long prefill (parallel/mesh.py).
+        # world_size==1 builds a trivial single-device mesh so the code path
+        # is identical on one chip and on a slice.
+        par = config.parallel
+        shardings_lib.validate_tp(cfg, par.tensor_parallel)
+        if config.scheduler.max_num_seqs % par.data_parallel:
+            raise ValueError(
+                f"max_num_seqs={config.scheduler.max_num_seqs} must be "
+                f"divisible by data_parallel={par.data_parallel}"
+            )
+        if par.sequence_parallel > 1:
+            if cfg.sliding_window is not None:
+                raise ValueError(
+                    "sequence_parallel>1 is not supported with "
+                    "sliding_window models (the ring path has no local-"
+                    "attention mask); use sp=1"
+                )
+            span = config.cache.block_size * par.sequence_parallel
+            for bucket in config.scheduler.prefill_buckets:
+                if bucket % span:
+                    raise ValueError(
+                        f"prefill bucket {bucket} not divisible by "
+                        f"block_size*sp={span}"
+                    )
+            if config.scheduler.max_model_len % span:
+                raise ValueError(
+                    f"max_model_len={config.scheduler.max_model_len} not "
+                    f"divisible by block_size*sp={span} (the cached-prefix "
+                    "ring shards the prefix block table over sp)"
+                )
+        self.mesh = build_mesh(par)
+
         logger.info("Loading params for %s ...", cfg.name)
         self.params = load_params(cfg, config.weights_path, seed=config.seed)
+        self.params = jax.device_put(
+            self.params, shardings_lib.param_shardings(cfg, self.mesh)
+        )
 
         num_blocks = self._decide_num_blocks()
         self.block_pool = BlockPool(
@@ -94,12 +133,14 @@ class LLMEngine:
         self._smax = config.scheduler.max_num_seqs
 
         # Jitted step functions.  KV caches are donated so updates alias the
-        # same HBM; cfg is closed over (static).
+        # same HBM; cfg and mesh are closed over (static).
         self._prefill_fn = jax.jit(
-            partial(self.model.prefill, cfg=cfg), donate_argnames=("kv_caches",)
+            partial(self.model.prefill, cfg=cfg, mesh=self.mesh),
+            donate_argnames=("kv_caches",),
         )
         self._decode_fn = jax.jit(
-            partial(self.model.decode, cfg=cfg), donate_argnames=("kv_caches",)
+            partial(self.model.decode, cfg=cfg, mesh=self.mesh),
+            donate_argnames=("kv_caches",),
         )
         self._sample_fn = jax.jit(sample_tokens)
 
@@ -133,7 +174,9 @@ class LLMEngine:
         in_use = stats.get("bytes_in_use", 0)
         if limit:
             free = (limit - in_use) * cache.hbm_utilization
-            per_block = self._kv_bytes(1)
+            # KV heads are sharded over tp, so each device holds 1/tp of a
+            # block; size the pool against per-device free HBM.
+            per_block = self._kv_bytes(1) / self.config.parallel.tensor_parallel
             blocks = max(int(free // per_block), 16)
         else:
             # CPU / unknown backend: enough for tests and smoke serving.
@@ -150,10 +193,18 @@ class LLMEngine:
             cfg.head_dim,
         )
         dtype = jnp.dtype(cfg.dtype)
-        return [
-            (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
-            for _ in range(cfg.num_layers)
-        ]
+        # Allocate directly sharded (jit with out_shardings): materializing
+        # the full unsharded layer on one device first would OOM at high tp.
+        layer_shardings = shardings_lib.kv_cache_shardings(cfg, self.mesh)
+        zeros = jax.jit(
+            lambda: jnp.zeros(shape, dtype),
+            out_shardings=layer_shardings[0][0],
+        )
+        return [(zeros(), zeros()) for _ in range(cfg.num_layers)]
+
+    def _put(self, arr: np.ndarray, spec: P) -> jax.Array:
+        """Host array -> device array with an explicit mesh sharding."""
+        return jax.device_put(jnp.asarray(arr), NamedSharding(self.mesh, spec))
 
     # -- request lifecycle -------------------------------------------------
 
@@ -261,10 +312,10 @@ class LLMEngine:
 
         logits, self.kv_caches = self._prefill_fn(
             self.params,
-            tokens=jnp.asarray(tokens),
+            tokens=self._put(tokens, P(AXES.SP)),
             cached_len=jnp.int32(plan.cached_len),
-            prefix_block_ids=jnp.asarray(prefix_ids),
-            new_block_ids=jnp.asarray(new_block_ids),
+            prefix_block_ids=self._put(prefix_ids, P(AXES.SP)),
+            new_block_ids=self._put(new_block_ids, P(AXES.SP)),
             valid_len=jnp.int32(plan.num_new_tokens),
             kv_caches=self.kv_caches,
         )
@@ -292,14 +343,15 @@ class LLMEngine:
             slot_blocks[i] = seq.block_table[pos // bs]
             slot_offsets[i] = pos % bs
 
+        batch_spec = shardings_lib.decode_batch_spec()
         logits, self.kv_caches = self._decode_fn(
             self.params,
-            tokens=jnp.asarray(tokens),
-            positions=jnp.asarray(positions),
-            block_tables=jnp.asarray(block_tables),
-            ctx_lens=jnp.asarray(ctx_lens),
-            slot_block_ids=jnp.asarray(slot_blocks),
-            slot_offsets=jnp.asarray(slot_offsets),
+            tokens=self._put(tokens, batch_spec),
+            positions=self._put(positions, batch_spec),
+            block_tables=self._put(block_tables, P(AXES.DP, None)),
+            ctx_lens=self._put(ctx_lens, batch_spec),
+            slot_block_ids=self._put(slot_blocks, batch_spec),
+            slot_offsets=self._put(slot_offsets, batch_spec),
             kv_caches=self.kv_caches,
         )
         token_ids = self._sample_batch(logits[: len(seqs)], seqs)

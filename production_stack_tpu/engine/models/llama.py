@@ -11,10 +11,13 @@ embeddings) via ModelConfig switches.
 
 from __future__ import annotations
 
-from typing import Dict, List, Tuple
+from functools import partial
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from production_stack_tpu.engine.config import ModelConfig
 from production_stack_tpu.engine.ops import attention as attn_ops
@@ -24,9 +27,21 @@ from production_stack_tpu.engine.ops.layers import (
     rope_cos_sin,
     swiglu,
 )
+from production_stack_tpu.engine.parallel.mesh import AXES
 
 Params = Dict
 KVCaches = List[Tuple[jax.Array, jax.Array]]
+
+
+def _sp_size(mesh: Optional[Mesh]) -> int:
+    return mesh.shape[AXES.SP] if mesh is not None else 1
+
+
+def _constrain(x: jax.Array, mesh: Optional[Mesh], spec: P) -> jax.Array:
+    """Pin an activation's sharding (no-op off-mesh)."""
+    if mesh is None:
+        return x
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
 
 
 def param_dtype(cfg: ModelConfig):
@@ -98,14 +113,23 @@ def prefill(
     new_block_ids: jax.Array,  # [T // block_size] int32 (null-padded)
     valid_len: jax.Array,  # scalar int32: true number of new tokens
     kv_caches: KVCaches,
+    mesh: Optional[Mesh] = None,  # SPMD mesh; sp>1 -> ring attention
 ) -> Tuple[jax.Array, KVCaches]:
-    """One sequence's prefill.  Returns (last-token logits [V], new caches)."""
+    """One sequence's prefill.  Returns (last-token logits [V], new caches).
+
+    Under a mesh, the token axis is sharded over ``sp`` (every projection /
+    MLP matmul computes on T/sp rows per device) and attention runs the
+    ring (parallel/ring_attention.py) so no device ever materializes the
+    full [T, T] score matrix; head/channel dims are sharded over ``tp``
+    (GSPMD inserts the psum after o_proj / down_proj)."""
     T = tokens.shape[0]
     scale = cfg.head_dim**-0.5
+    use_ring = _sp_size(mesh) > 1
     positions = cached_len + jnp.arange(T)
     cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
 
     x = params["embed_tokens"][tokens]  # [T, h]
+    x = _constrain(x, mesh, P(AXES.SP, None))
     new_caches: KVCaches = []
     for layer, (k_cache, v_cache) in zip(params["layers"], kv_caches):
         residual = x
@@ -116,10 +140,33 @@ def prefill(
         k_prefix, v_prefix = attn_ops.gather_prefix_kv(
             k_cache, v_cache, prefix_block_ids
         )
-        out = attn_ops.prefill_attention(
-            q, k, v, k_prefix, v_prefix, cached_len, valid_len,
-            scale=scale, sliding_window=cfg.sliding_window,
-        )
+        if use_ring:
+            from production_stack_tpu.engine.parallel.ring_attention import (
+                ring_prefill_with_prefix,
+            )
+
+            out = shard_map(
+                partial(
+                    ring_prefill_with_prefix, axis_name=AXES.SP, scale=scale
+                ),
+                mesh=mesh,
+                in_specs=(
+                    P(AXES.SP, AXES.TP, None),  # q [T, H, D]
+                    P(AXES.SP, AXES.TP, None),  # k [T, K, D]
+                    P(AXES.SP, AXES.TP, None),  # v
+                    P(AXES.SP, AXES.TP, None),  # k_prefix (ring-sharded too)
+                    P(AXES.SP, AXES.TP, None),  # v_prefix
+                    P(),  # cached_len
+                    P(),  # valid_len
+                ),
+                out_specs=P(AXES.SP, AXES.TP, None),
+                check_vma=False,
+            )(q, k, v, k_prefix, v_prefix, cached_len, valid_len)
+        else:
+            out = attn_ops.prefill_attention(
+                q, k, v, k_prefix, v_prefix, cached_len, valid_len,
+                scale=scale, sliding_window=cfg.sliding_window,
+            )
         k_cache, v_cache = attn_ops.write_prefill_kv(
             k_cache, v_cache, k, v, new_block_ids
         )
@@ -149,13 +196,19 @@ def decode(
     slot_block_ids: jax.Array,  # [S] int32 block receiving the new token
     slot_offsets: jax.Array,  # [S] int32 offset within that block
     kv_caches: KVCaches,
+    mesh: Optional[Mesh] = None,  # SPMD mesh; batch sharded over dp
 ) -> Tuple[jax.Array, KVCaches]:
-    """Batched single-token decode.  Returns (logits [S, V], new caches)."""
+    """Batched single-token decode.  Returns (logits [S, V], new caches).
+
+    Under a mesh the batch axis is sharded over ``dp`` (each dp group
+    decodes S/dp sequences) and heads over ``tp``; the paged KV pool is
+    replicated across dp so any sequence can land on any dp group."""
     S = tokens.shape[0]
     scale = cfg.head_dim**-0.5
     cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
 
     x = params["embed_tokens"][tokens]  # [S, h]
+    x = _constrain(x, mesh, P(AXES.DP, None))
     new_caches: KVCaches = []
     for layer, (k_cache, v_cache) in zip(params["layers"], kv_caches):
         residual = x
