@@ -221,9 +221,9 @@ def decode(
         k_cache, v_cache = attn_ops.append_decode_kv(
             k_cache, v_cache, k, v, slot_block_ids, slot_offsets
         )
-        out = attn_ops.paged_decode_attention(
+        out = attn_ops.decode_attention(
             q, k_cache, v_cache, block_tables, ctx_lens,
-            scale=scale, sliding_window=cfg.sliding_window,
+            scale=scale, sliding_window=cfg.sliding_window, mesh=mesh,
         )
         new_caches.append((k_cache, v_cache))
         out = out.reshape(S, cfg.num_heads * cfg.head_dim)

@@ -9,8 +9,11 @@ Design notes (TPU-first):
   inputs/outputs are bf16.
 * The gather-based decode path below materializes [S, max_ctx, K, D] in HBM
   — correct everywhere (CPU tests, interpret mode) and fast enough for
-  moderate contexts.  The Pallas kernel in pallas/paged_attention.py streams
-  KV blocks HBM->VMEM instead and is selected on TPU backends.
+  moderate contexts.  ``decode_attention`` dispatches to the Pallas kernel
+  in pallas/paged_attention.py on TPU backends (set
+  ``PSTPU_DISABLE_PALLAS=1`` to force the gather path, e.g. for A/B
+  benchmarking); under a multi-device mesh the kernel runs per-shard via
+  shard_map (batch over dp, heads over tp).
 
 KV cache layout per layer: ``[num_blocks, block_size, num_kv_heads, head_dim]``
 — block-major so one block is a contiguous DMA unit for both the decode
@@ -19,12 +22,85 @@ kernel and host offload (kv/offload.py).
 
 from __future__ import annotations
 
+import os
+from functools import partial
 from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
 
 NEG_INF = -1e30  # large-but-finite: keeps masked softmax rows NaN-free
+
+
+def use_pallas_decode(num_kv_heads: int = 128, head_dim: int = 128) -> bool:
+    """Trace-time dispatch check for the streaming decode kernel.
+
+    Needs a real TPU and a 128-lane-aligned head_dim: the kernel splits
+    the DMA'd KV row back into heads in VMEM, and Mosaic only lowers that
+    shape cast when head_dim is a multiple of the 128-lane tile.  Covers
+    llama-3-8b / llama-3.2-3b / mistral-7b (D=128); head_dim-64 models
+    (llama-3.2-1b) and the tiny test models use the gather path."""
+    if os.environ.get("PSTPU_DISABLE_PALLAS"):
+        return False
+    if num_kv_heads < 1 or head_dim % 128:
+        return False
+    return jax.default_backend() == "tpu"
+
+
+def decode_attention(
+    q: jax.Array,  # [S, H, D]
+    k_cache: jax.Array,  # [N, bs, K, D]
+    v_cache: jax.Array,
+    block_tables: jax.Array,  # [S, Bmax]
+    ctx_lens: jax.Array,  # [S]
+    *,
+    scale: float,
+    sliding_window: Optional[int] = None,
+    mesh: Optional[Mesh] = None,
+) -> jax.Array:
+    """Decode attention with backend dispatch (Pallas on TPU, gather else).
+
+    Under a multi-device mesh the Pallas kernel runs per-shard inside
+    shard_map: the decode batch (and its block table / context rows) is
+    sharded over dp, heads over tp; the KV pool's block axis is replicated
+    so per-shard block ids stay valid.
+    """
+    from production_stack_tpu.engine.parallel.mesh import AXES
+
+    K, D = k_cache.shape[2], k_cache.shape[3]
+    # Under tp the kernel sees K/tp heads per shard; alignment must hold
+    # for the per-shard KV row.
+    tp = mesh.shape[AXES.TP] if mesh is not None and mesh.size > 1 else 1
+    if not use_pallas_decode(K // tp, D):
+        return paged_decode_attention(
+            q, k_cache, v_cache, block_tables, ctx_lens,
+            scale=scale, sliding_window=sliding_window,
+        )
+    from production_stack_tpu.engine.ops.pallas.paged_attention import (
+        paged_decode_attention_pallas,
+    )
+
+    kernel = partial(
+        paged_decode_attention_pallas, scale=scale, sliding_window=sliding_window
+    )
+    if mesh is None or mesh.size == 1:
+        return kernel(q, k_cache, v_cache, block_tables, ctx_lens)
+
+    return shard_map(
+        kernel,
+        mesh=mesh,
+        in_specs=(
+            P(AXES.DP, AXES.TP, None),  # q: batch over dp, heads over tp
+            P(None, None, AXES.TP, None),  # k_cache: kv heads over tp
+            P(None, None, AXES.TP, None),  # v_cache
+            P(AXES.DP, None),  # block_tables rows follow the batch
+            P(AXES.DP),  # ctx_lens
+        ),
+        out_specs=P(AXES.DP, AXES.TP, None),
+        check_vma=False,
+    )(q, k_cache, v_cache, block_tables, ctx_lens)
 
 
 def prefill_attention(

@@ -1,0 +1,7 @@
+"""Pallas TPU kernels for the hot serving ops.
+
+paged_attention: decode-phase attention streaming paged KV blocks
+HBM->VMEM with double-buffered DMA (selected on TPU backends by
+ops/attention.py; the pure-JAX gather path stays as the reference
+implementation and the CPU/test path).
+"""
