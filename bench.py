@@ -271,7 +271,7 @@ def main() -> None:
         kv_bytes = S * (-(-ctx // bs)) * bs * cfg.num_kv_heads * cfg.head_dim \
             * 2 * 2 * cfg.num_layers
         roofline_step = (param_bytes + kv_bytes) / (peak_gbs * 1e9)
-        vs_baseline = round((S / roofline_step) and decode_tps / (S / roofline_step), 3)
+        vs_baseline = round(decode_tps * roofline_step / S, 3)
         detail["decode_roofline_tokens_per_s"] = round(S / roofline_step)
 
     if not args.quick and on_tpu:

@@ -110,7 +110,10 @@ class LLMEngine:
             enable_prefix_caching=config.cache.enable_prefix_caching,
         )
         self.scheduler = Scheduler(
-            config.scheduler, self.block_pool, offload_cb=self.offload_seq_blocks
+            config.scheduler,
+            self.block_pool,
+            offload_cb=self.offload_seq_blocks,
+            restore_cb=self.restore_seq_blocks,
         )
         self.kv_caches = self._allocate_kv(num_blocks)
         logger.info(
@@ -151,7 +154,10 @@ class LLMEngine:
         self.total_generated_tokens = 0
         self.total_finished = 0
         self._step_time_accum = 0.0
-        self._busy_time_window: List[float] = []
+        # (end_time, duration) of recent steps; duty_cycle = busy fraction
+        # of the trailing window (the HPA/dashboard signal, vocabulary.py).
+        self._busy_window: List[tuple] = []
+        self._busy_window_s = 10.0
 
     # -- sizing ------------------------------------------------------------
 
@@ -253,32 +259,30 @@ class LLMEngine:
         else:
             outputs = self._run_decode(plan.decode)
         self._step_counter += 1
-        dt = time.time() - t0
-        self._step_time_accum += dt
         now = time.time()
-        self._busy_time_window.append(now)
-        self._busy_time_window = [t for t in self._busy_time_window if t > now - 10]
+        dt = now - t0
+        self._step_time_accum += dt
+        self._busy_window.append((now, dt))
+        cutoff = now - self._busy_window_s
+        self._busy_window = [(t, d) for (t, d) in self._busy_window if t > cutoff]
         return outputs
 
-    def _maybe_restore_offloaded(self, plan: PrefillPlan) -> None:
-        """If the sequence was preempted with offload, its KV snapshot is
-        written into freshly allocated blocks and treated as a cached
-        prefix — no recompute."""
-        seq = plan.seq
-        if not seq.offloaded:
-            return
-        seq.offloaded = False
+    def restore_seq_blocks(self, seq: Sequence) -> bool:
+        """Scheduler restore_cb: page an offloaded sequence's KV snapshot
+        back into freshly allocated blocks.  On success the sequence holds
+        those blocks as a partial-prefill prefix (scheduler.py resumes from
+        it — no recompute)."""
         entry = self.offload.restore(seq.seq_id)
         if entry is None:
-            return  # fall back to recompute via normal prefill
+            return False  # fall back to recompute via normal prefill
         bs = self.block_pool.block_size
-        nb = len(entry.layers[0][0])
         usable_tokens = min(entry.num_tokens, len(seq.prompt_token_ids) - 1)
         usable_blocks = usable_tokens // bs
-        if usable_blocks == 0:
-            return
-        if not self.block_pool.can_allocate(usable_blocks):
-            return
+        if usable_blocks == 0 or not self.block_pool.can_allocate(usable_blocks):
+            # Transient pool pressure must not cost the snapshot: put it
+            # back so a later attempt (or another replica) can still use it.
+            self.offload.reinsert(entry)
+            return False
         restored = self.block_pool.allocate(usable_blocks)
         ids = jnp.asarray(restored, jnp.int32)
         for layer_idx, (k_host, v_host) in enumerate(entry.layers):
@@ -286,16 +290,12 @@ class LLMEngine:
             k_cache = k_cache.at[ids].set(jnp.asarray(k_host[:usable_blocks]))
             v_cache = v_cache.at[ids].set(jnp.asarray(v_host[:usable_blocks]))
             self.kv_caches[layer_idx] = (k_cache, v_cache)
-        # Rewrite the plan as a prefix-cache hit on the restored blocks.
-        self.block_pool.free(plan.prefix_block_ids)
-        plan.prefix_block_ids = restored
-        plan.cached_len = usable_blocks * bs
-        plan.num_new_tokens = len(seq.prompt_token_ids) - plan.cached_len
-        seq.num_cached_tokens = plan.cached_len
-        seq.block_table = restored + plan.new_block_ids
+        seq.block_table = restored
+        seq.num_cached_tokens = usable_blocks * bs
+        seq.partial_prefill = True
+        return True
 
     def _run_prefill(self, plan: PrefillPlan) -> List[StepOutput]:
-        self._maybe_restore_offloaded(plan)
         seq = plan.seq
         bs = self.block_pool.block_size
         T = plan.bucket_len
@@ -319,6 +319,10 @@ class LLMEngine:
             valid_len=jnp.int32(plan.num_new_tokens),
             kv_caches=self.kv_caches,
         )
+        if not plan.is_final:
+            # Non-final chunk of a long prompt: KV is written, but the
+            # logits are mid-prompt — nothing to sample yet.
+            return []
         token_id = self._sample_batch(logits[None, :], [seq])[0]
         return self._append_and_check([seq], [token_id], first_token=True)
 
@@ -441,6 +445,18 @@ class LLMEngine:
 
     # -- metrics -----------------------------------------------------------
 
+    def _duty_cycle(self) -> float:
+        """Fraction of the trailing window spent inside step()."""
+        now = time.time()
+        cutoff = now - self._busy_window_s
+        busy = sum(
+            # Clip a step straddling the window edge to the in-window part.
+            min(d, t - cutoff)
+            for (t, d) in self._busy_window
+            if t > cutoff
+        )
+        return min(1.0, busy / self._busy_window_s)
+
     def stats(self) -> Dict[str, float]:
         return {
             "num_requests_running": self.scheduler.num_running,
@@ -448,7 +464,7 @@ class LLMEngine:
             "hbm_kv_usage_perc": self.block_pool.usage,
             "prefix_cache_hit_rate": self.block_pool.prefix_hit_rate,
             "host_kv_usage_perc": self.offload.usage,
-            "duty_cycle": min(1.0, len(self._busy_time_window) / 100.0),
+            "duty_cycle": self._duty_cycle(),
             "total_prompt_tokens": self.total_prompt_tokens,
             "total_generated_tokens": self.total_generated_tokens,
             "total_finished": self.total_finished,

@@ -36,6 +36,9 @@ class PrefillPlan:
     prefix_block_ids: List[int]  # cached-prefix blocks (may be empty)
     num_new_tokens: int  # valid tokens to prefill
     cached_len: int
+    # False for a non-final chunk of a long prompt (chunked prefill): the
+    # engine writes KV but must not sample — the logits are mid-prompt.
+    is_final: bool = True
 
 
 @dataclasses.dataclass
@@ -54,12 +57,22 @@ class StepPlan:
 
 
 class Scheduler:
-    def __init__(self, config: SchedulerConfig, block_pool: BlockPool, offload_cb=None):
+    def __init__(
+        self,
+        config: SchedulerConfig,
+        block_pool: BlockPool,
+        offload_cb=None,
+        restore_cb=None,
+    ):
         self.config = config
         self.block_pool = block_pool
         # offload_cb(seq, block_ids) -> bool: page blocks to host DRAM
         # before they are freed (engine wires HostOffloadManager here).
         self.offload_cb = offload_cb
+        # restore_cb(seq) -> bool: page an offloaded sequence's KV back in;
+        # on success the engine sets seq.block_table/num_cached_tokens/
+        # partial_prefill so the plan below resumes as a held prefix.
+        self.restore_cb = restore_cb
         self.waiting: Deque[Sequence] = deque()
         self.running: List[Sequence] = []
         self.preempted: Deque[Sequence] = deque()
@@ -129,7 +142,34 @@ class Scheduler:
         decode = self._try_schedule_decode()
         if decode is not None:
             return StepPlan(decode=decode)
+        # No step possible.  Two partially-prefilled sequences can coexist
+        # (one per queue, or via offload restore) and deadlock each other
+        # by jointly holding the pool; roll back the youngest — freeing its
+        # blocks for recompute later — until something schedules again.
+        while self._rollback_youngest_partial():
+            plan = self._try_schedule_prefill()
+            if plan is not None:
+                return StepPlan(prefill=plan)
         return StepPlan()
+
+    def _rollback_youngest_partial(self) -> bool:
+        """Free a stalled mid-prefill sequence's held blocks (its chunks
+        will recompute).  Progress guarantee for the chunked-prefill path:
+        admission bounds every single sequence to fit the pool alone."""
+        partials = [
+            s
+            for s in list(self.preempted) + list(self.waiting)
+            if s.partial_prefill
+        ]
+        if not partials:
+            return False
+        seq = max(partials, key=lambda s: s.arrival_time)
+        logger.debug("Rolling back partial prefill of %s (pool pressure)", seq.seq_id)
+        self.block_pool.free(seq.block_table)
+        seq.block_table = []
+        seq.num_cached_tokens = 0
+        seq.partial_prefill = False
+        return True
 
     def _try_schedule_prefill(self) -> Optional[PrefillPlan]:
         if len(self.running) >= self.config.max_num_seqs:
@@ -140,30 +180,49 @@ class Scheduler:
             return None
         seq = queue[0]
 
-        if seq.status == SequenceStatus.PREEMPTED and seq.offloaded:
-            # Restored via offload manager by the engine before this plan
-            # executes; treat like a full-prefix cache hit on resume.
-            pass
+        if seq.offloaded:
+            # Page the KV snapshot back in; on success the engine has set
+            # block_table/num_cached_tokens/partial_prefill and the plan
+            # below resumes from that held prefix (no recompute).  On
+            # failure we fall through to a plain re-prefill.
+            if self.restore_cb is not None:
+                self.restore_cb(seq)
+            seq.offloaded = False
 
-        prefix_blocks, cached_len = self.block_pool.match_prefix(seq.prompt_token_ids)
+        if seq.partial_prefill:
+            # Chunks already written: the sequence owns its blocks.
+            prefix_blocks = list(seq.block_table)
+            cached_len = seq.num_cached_tokens
+        else:
+            prefix_blocks, cached_len = self.block_pool.match_prefix(
+                seq.prompt_token_ids
+            )
         num_new = seq.num_prompt_tokens - cached_len
         bucket = self._bucket_for(num_new)
+        is_final = bucket is not None
         if bucket is None:
-            # Prompt longer than the largest bucket: chunked prefill would
-            # split it; v1 rejects at admission (max_model_len caps this).
+            # Prompt longer than the largest bucket: chunked prefill — run
+            # one full-bucket chunk now, keep the sequence at the queue
+            # head, and continue next step from the accumulated prefix.
             bucket = self.config.prefill_buckets[-1]
-            num_new = min(num_new, bucket)
+            num_new = bucket
         bs = self.block_pool.block_size
         blocks_needed = (num_new + bs - 1) // bs
         if not self.block_pool.can_allocate(blocks_needed):
-            self.block_pool.free(prefix_blocks)
+            if not seq.partial_prefill:
+                self.block_pool.free(prefix_blocks)
             return None
         new_blocks = self.block_pool.allocate(blocks_needed)
-        queue.popleft()
-        seq.status = SequenceStatus.RUNNING
         seq.num_cached_tokens = cached_len
         seq.block_table = prefix_blocks + new_blocks
-        self.running.append(seq)
+        if is_final:
+            queue.popleft()
+            seq.status = SequenceStatus.RUNNING
+            seq.partial_prefill = False
+            self.running.append(seq)
+        else:
+            seq.partial_prefill = True
+            seq.num_cached_tokens = cached_len + num_new
         return PrefillPlan(
             seq=seq,
             bucket_len=bucket,
@@ -171,6 +230,7 @@ class Scheduler:
             prefix_block_ids=prefix_blocks,
             num_new_tokens=num_new,
             cached_len=cached_len,
+            is_final=is_final,
         )
 
     def _try_schedule_decode(self) -> Optional[DecodePlan]:

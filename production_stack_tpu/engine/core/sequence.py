@@ -48,6 +48,10 @@ class Sequence:
     first_token_time: Optional[float] = None
     # Host-offload bookkeeping: host buffer ids per paged-out block.
     offloaded: bool = False
+    # Mid-chunked-prefill: the sequence sits at its queue's head holding
+    # block_table/num_cached_tokens for the chunks already written; the
+    # next prefill plan continues from there (scheduler.py).
+    partial_prefill: bool = False
     preempt_count: int = 0
     # Generated tokens absorbed into prompt_token_ids by preemption
     # (re-prefill path); keeps max_tokens accounting correct across preempts.

@@ -43,6 +43,10 @@ class HostOffloadManager:
         self.used_bytes = 0
         self._entries: Dict[str, OffloadEntry] = {}
         self.remote_client = remote_client  # kvserver client (optional tier)
+        # seq_ids known to have a snapshot in the remote store (local put
+        # or remote fetch): bounds discard() to one DEL for those only —
+        # never a blocking RPC for sequences that were never offloaded.
+        self._remote_keys: set = set()
         self.saves = 0
         self.restores = 0
         self.evictions = 0
@@ -85,6 +89,7 @@ class HostOffloadManager:
         if self.remote_client is not None:
             try:
                 self.remote_client.put_blocks(seq_id, layers, num_tokens)
+                self._remote_keys.add(seq_id)
             except Exception:
                 logger.warning("remote KV put failed for %s", seq_id, exc_info=True)
         return True
@@ -104,6 +109,7 @@ class HostOffloadManager:
             if fetched is not None:
                 layers, num_tokens = fetched
                 self.restores += 1
+                self._remote_keys.add(seq_id)
                 return OffloadEntry(
                     seq_id=seq_id,
                     num_tokens=num_tokens,
@@ -112,10 +118,30 @@ class HostOffloadManager:
                 )
         return None
 
+    def reinsert(self, entry: OffloadEntry) -> bool:
+        """Put a restore()d-but-unused entry back (e.g. the pool could not
+        host it yet); also caches remote fetches locally.  Drops silently
+        when over capacity — same outcome as an eviction."""
+        if self.used_bytes + entry.nbytes > self.capacity_bytes:
+            return False
+        self._entries[entry.seq_id] = entry
+        self.used_bytes += entry.nbytes
+        self.restores -= 1  # the paired restore() did not take effect
+        return True
+
     def discard(self, seq_id: str) -> None:
+        """Drop a finished/aborted sequence's snapshot from every tier —
+        including the remote store, or the shared cache leaks one snapshot
+        per finished sequence forever."""
         entry = self._entries.pop(seq_id, None)
         if entry is not None:
             self.used_bytes -= entry.nbytes
+        if self.remote_client is not None and seq_id in self._remote_keys:
+            self._remote_keys.discard(seq_id)
+            try:
+                self.remote_client.delete(seq_id)
+            except Exception:
+                logger.debug("remote KV delete failed for %s", seq_id, exc_info=True)
 
     def _evict_oldest(self) -> None:
         oldest = min(self._entries.values(), key=lambda e: e.saved_at)

@@ -13,6 +13,7 @@ from __future__ import annotations
 import argparse
 import json
 import logging
+import os
 import time
 import uuid
 from typing import Optional
@@ -274,6 +275,24 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
     return app
 
 
+def _parse_buckets(args):
+    """Validate --prefill-buckets at parse time: each bucket must be a
+    positive multiple of --block-size (the prefill plan sizes new_block_ids
+    as bucket//block_size), returned ascending (the scheduler chunks long
+    prompts at prefill_buckets[-1])."""
+    try:
+        buckets = sorted(int(b) for b in args.prefill_buckets.split(","))
+    except ValueError:
+        raise SystemExit(f"--prefill-buckets must be integers: {args.prefill_buckets!r}")
+    for b in buckets:
+        if b <= 0 or b % args.block_size:
+            raise SystemExit(
+                f"--prefill-buckets entries must be positive multiples of "
+                f"--block-size={args.block_size}; got {b}"
+            )
+    return tuple(buckets)
+
+
 def main(argv=None) -> None:
     parser = argparse.ArgumentParser(description="TPU serving engine (OpenAI API)")
     parser.add_argument("--host", default="0.0.0.0")
@@ -286,6 +305,12 @@ def main(argv=None) -> None:
     parser.add_argument("--max-model-len", type=int, default=2048)
     parser.add_argument("--block-size", type=int, default=16)
     parser.add_argument("--num-blocks", type=int, default=None)
+    parser.add_argument(
+        "--prefill-buckets",
+        default=None,
+        help="comma-separated prefill bucket lengths (prompts beyond the "
+        "largest bucket run as chunked prefill)",
+    )
     parser.add_argument("--host-offload-gb", type=float, default=0.0)
     parser.add_argument("--remote-kv-url", default=None)
     parser.add_argument("--no-prefix-caching", action="store_true")
@@ -293,6 +318,13 @@ def main(argv=None) -> None:
     args = parser.parse_args(argv)
 
     init_logger("production_stack_tpu", args.log_level)
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # TPU hosts ship a sitecustomize that pins the TPU plugin at
+        # interpreter startup; honor an explicit CPU request anyway (same
+        # dance as tests/conftest.py and bench.py).
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
     config = config_from_preset(
         args.model,
         **{
@@ -300,6 +332,11 @@ def main(argv=None) -> None:
             "tokenizer": args.tokenizer,
             "scheduler.max_num_seqs": args.max_num_seqs,
             "scheduler.max_model_len": args.max_model_len,
+            **(
+                {"scheduler.prefill_buckets": _parse_buckets(args)}
+                if args.prefill_buckets
+                else {}
+            ),
             "cache.block_size": args.block_size,
             "cache.num_blocks": args.num_blocks,
             "cache.host_offload_gb": args.host_offload_gb,

@@ -144,6 +144,12 @@ async def route_general_request(
         (time.time() - in_router_time) * 1e3,
     )
 
+    # Connect-stage failover list: if the routed backend dies between
+    # scrapes, surviving replicas still serve the request (the reference
+    # 502s here — SURVEY.md section 5; see test_router_e2e).  Once a byte
+    # has streamed there is no failover (the client has partial state).
+    fallback_urls = [ep.url for ep in endpoints if ep.url != server_url]
+
     return await process_request(
         request,
         body_bytes=body_bytes,
@@ -153,6 +159,7 @@ async def route_general_request(
         request_id=request_id,
         in_router_time=in_router_time,
         background=background,
+        fallback_urls=fallback_urls,
     )
 
 
@@ -166,9 +173,14 @@ async def process_request(
     request_id: str,
     in_router_time: float,
     background: Optional[Any] = None,
+    fallback_urls: Optional[list] = None,
 ) -> web.StreamResponse:
     """Open one backend stream and relay chunks, feeding the stats lifecycle
-    (reference process_request, request.py:44-117)."""
+    (reference process_request, request.py:44-117).
+
+    ``fallback_urls``: tried in order when the routed backend fails at the
+    connect stage (before any response byte).  Mid-stream failures never
+    fail over — the client already holds partial state."""
     registry = request.app["registry"]
     monitor = registry.get(REQUEST_STATS_MONITOR)
     session: aiohttp.ClientSession = registry.require(CLIENT_SESSION)
@@ -176,67 +188,76 @@ async def process_request(
     headers = _forward_headers(request.headers)
     headers["x-request-id"] = request_id
 
-    if monitor:
-        monitor.on_new_request(server_url, request_id, in_router_time)
-
+    candidates = [server_url] + list(fallback_urls or [])
     collected: list = []
     want_store = background is not None
-    first_chunk_seen = False
-    response: Optional[web.StreamResponse] = None
-    try:
-        async with session.request(
-            request.method,
-            f"{server_url}{endpoint_path}",
-            data=body_bytes if body_bytes else None,
-            headers=headers,
-        ) as backend:
-            if monitor:
-                monitor.on_backend_connected(server_url, request_id, time.time())
-            response = web.StreamResponse(
-                status=backend.status, headers=_forward_headers(backend.headers)
-            )
-            await response.prepare(request)
-            async for chunk in backend.content.iter_any():
-                if not chunk:
-                    continue
-                now = time.time()
-                if monitor:
-                    if not first_chunk_seen:
-                        # Seeds the token clock + counts this chunk; no ITL
-                        # sample (the first chunk defines no interval).
-                        monitor.on_request_response(server_url, request_id, now)
-                        first_chunk_seen = True
-                    else:
-                        monitor.on_token_chunk(server_url, request_id, now)
-                if want_store:
-                    collected.append(chunk)
-                await response.write(chunk)
-            await response.write_eof()
-        if monitor:
-            monitor.on_request_complete(server_url, request_id, time.time())
-    except asyncio.CancelledError:
-        # Client disconnected (or server shutdown): release in-flight stats,
-        # then propagate — cancellation must never be swallowed.
-        if monitor:
-            monitor.on_request_failed(server_url, request_id, time.time())
-        raise
-    except (aiohttp.ClientError, ConnectionResetError) as e:
-        if monitor:
-            monitor.on_request_failed(server_url, request_id, time.time())
-        if response is None:
-            logger.warning("Backend %s failed before response: %s", server_url, e)
-            return _error_response(
-                502, f"Serving engine {server_url} is unreachable", "bad_gateway"
-            )
-        # Mid-stream failure: the client already has a partial body; all we
-        # can do is terminate the stream (matches reference behavior,
-        # SURVEY.md section 5 "no request retry/failover mid-stream").
-        logger.warning("Backend %s failed mid-stream: %s", server_url, e)
-        raise
 
-    if want_store and collected and body_json is not None:
+    for attempt, url in enumerate(candidates):
+        if monitor:
+            monitor.on_new_request(url, request_id, in_router_time)
+        first_chunk_seen = False
+        response: Optional[web.StreamResponse] = None
         try:
-            await background(body_json, b"".join(collected))
-        except Exception:
-            logger.exception("post-response background hook failed")
-    return response
+            async with session.request(
+                request.method,
+                f"{url}{endpoint_path}",
+                data=body_bytes if body_bytes else None,
+                headers=headers,
+            ) as backend:
+                if monitor:
+                    monitor.on_backend_connected(url, request_id, time.time())
+                response = web.StreamResponse(
+                    status=backend.status, headers=_forward_headers(backend.headers)
+                )
+                await response.prepare(request)
+                async for chunk in backend.content.iter_any():
+                    if not chunk:
+                        continue
+                    now = time.time()
+                    if monitor:
+                        if not first_chunk_seen:
+                            # Seeds the token clock + counts this chunk; no
+                            # ITL sample (first chunk defines no interval).
+                            monitor.on_request_response(url, request_id, now)
+                            first_chunk_seen = True
+                        else:
+                            monitor.on_token_chunk(url, request_id, now)
+                    if want_store:
+                        collected.append(chunk)
+                    await response.write(chunk)
+                await response.write_eof()
+            if monitor:
+                monitor.on_request_complete(url, request_id, time.time())
+        except asyncio.CancelledError:
+            # Client disconnected (or server shutdown): release in-flight
+            # stats, then propagate — cancellation must not be swallowed.
+            if monitor:
+                monitor.on_request_failed(url, request_id, time.time())
+            raise
+        except (aiohttp.ClientError, ConnectionResetError) as e:
+            if monitor:
+                monitor.on_request_failed(url, request_id, time.time())
+            if response is not None:
+                # Mid-stream failure: the client already has a partial
+                # body; terminate the stream (reference behavior, SURVEY.md
+                # section 5 "no request retry/failover mid-stream").
+                logger.warning("Backend %s failed mid-stream: %s", url, e)
+                raise
+            if attempt + 1 < len(candidates):
+                logger.warning(
+                    "Backend %s unreachable (%s); failing over to %s",
+                    url, e, candidates[attempt + 1],
+                )
+                continue
+            logger.warning("Backend %s failed before response: %s", url, e)
+            return _error_response(
+                502, "All serving engines for this model are unreachable",
+                "bad_gateway",
+            )
+
+        if want_store and collected and body_json is not None:
+            try:
+                await background(body_json, b"".join(collected))
+            except Exception:
+                logger.exception("post-response background hook failed")
+        return response

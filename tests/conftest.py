@@ -34,17 +34,29 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
-import jax  # noqa: E402
+try:
+    import jax
+except ImportError:  # router-only environment: engine tests will skip
+    jax = None
 
-if jax.config.jax_platforms != "cpu":
-    jax.config.update("jax_platforms", "cpu")
-    try:
-        from jax.extend.backend import clear_backends
-
-        clear_backends()
-    except Exception:  # pragma: no cover - older jax fallback
-        pass
-assert jax.devices()[0].platform == "cpu"
+if jax is not None:
+    if jax.config.jax_platforms != "cpu":
+        jax.config.update("jax_platforms", "cpu")
+        try:
+            from jax.extend.backend import clear_backends
+        except ImportError:  # pragma: no cover - older jax fallback
+            clear_backends = None
+        if clear_backends is not None:
+            try:
+                clear_backends()
+            except Exception:  # pragma: no cover - mid-init backend state
+                pass
+    if jax.devices()[0].platform != "cpu":
+        raise RuntimeError(
+            "tests must run on the virtual CPU mesh; got "
+            f"{jax.devices()[0].platform!r} (TPU float32 matmuls break "
+            "HF-parity tolerances)"
+        )
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

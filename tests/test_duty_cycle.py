@@ -1,0 +1,38 @@
+"""duty_cycle gauge = busy-time fraction of the trailing window (the
+HPA/dashboard signal, vocabulary.py) — not a step count."""
+
+import time
+
+from tests.test_engine_e2e import tiny_engine
+
+
+def test_duty_cycle_measures_busy_fraction():
+    engine = tiny_engine()
+    now = time.time()
+    # 10 steps of 300ms each ending within the window: 3s busy / 10s = 0.3.
+    engine._busy_window = [(now - i, 0.3) for i in range(10)]
+    duty = engine._duty_cycle()
+    assert 0.25 <= duty <= 0.35, duty
+
+
+def test_duty_cycle_many_fast_steps_stays_low():
+    """The round-1 gauge reported steps/100 (10 fast steps/s -> 0.1 even at
+    90% busy; 200 instant steps -> saturated 1.0).  Busy-time says ~0."""
+    engine = tiny_engine()
+    now = time.time()
+    engine._busy_window = [(now - i * 0.01, 0.0005) for i in range(200)]
+    assert engine._duty_cycle() < 0.05
+
+
+def test_duty_cycle_clips_to_window():
+    engine = tiny_engine()
+    now = time.time()
+    # One 60s step that just ended: only the in-window part counts.
+    engine._busy_window = [(now, 60.0)]
+    assert engine._duty_cycle() >= 0.95  # ~1.0 modulo clock read skew
+
+
+def test_duty_cycle_in_stats():
+    engine = tiny_engine()
+    stats = engine.stats()
+    assert 0.0 <= stats["duty_cycle"] <= 1.0

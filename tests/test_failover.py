@@ -1,0 +1,108 @@
+"""Connect-stage failover: a backend that dies between scrapes must not
+502 the request when healthy replicas exist (the reference 502s here —
+SURVEY.md section 5 'no request retry/failover').
+"""
+
+import aiohttp
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from production_stack_tpu.router.services.request_service.request import (
+    CLIENT_SESSION,
+    process_request,
+)
+from production_stack_tpu.utils.registry import ServiceRegistry
+
+from tests.test_router_e2e import start_fake_engine, start_router
+
+DEAD_URL = "http://127.0.0.1:1"  # nothing listens on port 1
+
+
+async def test_process_request_fails_over_to_next_endpoint():
+    state, engine = await start_fake_engine()
+    alive_url = str(engine.make_url("")).rstrip("/")
+    registry = ServiceRegistry()
+    session = aiohttp.ClientSession()
+    registry.set(CLIENT_SESSION, session)
+
+    async def handler(request: web.Request) -> web.StreamResponse:
+        return await process_request(
+            request,
+            body_bytes=await request.read(),
+            body_json=None,
+            server_url=DEAD_URL,
+            endpoint_path="/v1/completions",
+            request_id="t-1",
+            in_router_time=0.0,
+            fallback_urls=[alive_url],
+        )
+
+    app = web.Application()
+    app["registry"] = registry
+    app.router.add_post("/v1/completions", handler)
+    server = TestServer(app)
+    client = TestClient(server)
+    await client.start_server()
+    try:
+        resp = await client.post(
+            "/v1/completions",
+            json={"model": "fake/llama-3-8b", "prompt": "x", "max_tokens": 2},
+        )
+        assert resp.status == 200, await resp.text()
+    finally:
+        await client.close()
+        await session.close()
+        await engine.close()
+
+
+async def test_process_request_502_only_when_all_down():
+    registry = ServiceRegistry()
+    session = aiohttp.ClientSession()
+    registry.set(CLIENT_SESSION, session)
+
+    async def handler(request: web.Request) -> web.StreamResponse:
+        return await process_request(
+            request,
+            body_bytes=b"{}",
+            body_json=None,
+            server_url=DEAD_URL,
+            endpoint_path="/v1/completions",
+            request_id="t-2",
+            in_router_time=0.0,
+            fallback_urls=["http://127.0.0.1:2"],
+        )
+
+    app = web.Application()
+    app["registry"] = registry
+    app.router.add_post("/v1/completions", handler)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        resp = await client.post("/v1/completions", json={})
+        assert resp.status == 502
+    finally:
+        await client.close()
+        await session.close()
+
+
+async def test_e2e_no_502_with_one_dead_backend():
+    """Through the full router: every request succeeds while one of two
+    configured backends is dead, whichever way routing + gating land."""
+    state, engine = await start_fake_engine()
+    alive_url = str(engine.make_url("")).rstrip("/")
+    try:
+        app, server, client = await start_router(
+            [DEAD_URL, alive_url],
+            ["fake/llama-3-8b", "fake/llama-3-8b"],
+        )
+        try:
+            for _ in range(4):
+                resp = await client.post(
+                    "/v1/completions",
+                    json={"model": "fake/llama-3-8b", "prompt": "x", "max_tokens": 2},
+                )
+                assert resp.status == 200, await resp.text()
+        finally:
+            await client.close()
+    finally:
+        await engine.close()

@@ -1,0 +1,174 @@
+"""Shared KV store: wire protocol, server+client over real TCP, and the
+HostOffloadManager remote tier (save -> remote put, restore-from-remote
+after local eviction, discard -> remote delete so the shared store never
+leaks finished sequences' snapshots).
+"""
+
+import asyncio
+import threading
+
+import numpy as np
+import pytest
+
+from production_stack_tpu.engine.kv.offload import HostOffloadManager
+from production_stack_tpu.kvserver import protocol as proto
+from production_stack_tpu.kvserver.client import RemoteKVClient
+from production_stack_tpu.kvserver.server import KVStore, handle_client
+
+
+def make_layers(num_layers=2, nb=3, bs=4, K=2, D=8, dtype=np.float32):
+    rng = np.random.default_rng(0)
+    return [
+        (
+            rng.standard_normal((nb, bs, K, D)).astype(dtype),
+            rng.standard_normal((nb, bs, K, D)).astype(dtype),
+        )
+        for _ in range(num_layers)
+    ]
+
+
+# -- protocol ---------------------------------------------------------------
+
+
+def test_snapshot_roundtrip_f32():
+    layers = make_layers()
+    blob = proto.encode_kv_snapshot(layers, num_tokens=11)
+    decoded, num_tokens = proto.decode_kv_snapshot(blob)
+    assert num_tokens == 11
+    assert len(decoded) == len(layers)
+    for (k, v), (dk, dv) in zip(layers, decoded):
+        np.testing.assert_array_equal(k, dk)
+        np.testing.assert_array_equal(v, dv)
+
+
+def test_snapshot_roundtrip_bf16():
+    ml_dtypes = pytest.importorskip("ml_dtypes")
+    layers = [
+        (
+            np.full((2, 4, 2, 8), 1.5, ml_dtypes.bfloat16),
+            np.full((2, 4, 2, 8), -2.0, ml_dtypes.bfloat16),
+        )
+    ]
+    blob = proto.encode_kv_snapshot(layers, num_tokens=8)
+    decoded, num_tokens = proto.decode_kv_snapshot(blob)
+    assert decoded[0][0].dtype == ml_dtypes.bfloat16
+    np.testing.assert_array_equal(np.asarray(decoded[0][0]), np.asarray(layers[0][0]))
+    np.testing.assert_array_equal(np.asarray(decoded[0][1]), np.asarray(layers[0][1]))
+
+
+# -- live server fixture ----------------------------------------------------
+
+
+@pytest.fixture()
+def kv_server():
+    """Asyncio KV server on an ephemeral port, in a daemon thread (the
+    client is blocking-socket, as used from the engine thread)."""
+    store = KVStore(capacity_bytes=1 << 20)
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+    state = {}
+
+    def run():
+        asyncio.set_event_loop(loop)
+
+        async def boot():
+            server = await asyncio.start_server(
+                lambda r, w: handle_client(store, r, w), "127.0.0.1", 0
+            )
+            state["port"] = server.sockets[0].getsockname()[1]
+            state["server"] = server
+            started.set()
+
+        loop.run_until_complete(boot())
+        loop.run_forever()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert started.wait(5)
+    yield store, state["port"]
+    loop.call_soon_threadsafe(loop.stop)
+    t.join(timeout=5)
+
+
+def test_client_put_get_delete_stat_ping(kv_server):
+    store, port = kv_server
+    client = RemoteKVClient(f"kv://127.0.0.1:{port}")
+    assert client.ping()
+
+    layers = make_layers()
+    client.put_blocks("seq-1", layers, num_tokens=9)
+    fetched = client.get_blocks("seq-1")
+    assert fetched is not None
+    got_layers, num_tokens = fetched
+    assert num_tokens == 9
+    np.testing.assert_array_equal(got_layers[0][0], layers[0][0])
+
+    stats = client.stat()
+    assert stats["keys"] == 1 and stats["hits"] == 1
+
+    client.delete("seq-1")
+    assert client.get_blocks("seq-1") is None
+    assert client.get_blocks("never-put") is None
+    client.close()
+
+
+def test_server_lru_eviction(kv_server):
+    store, port = kv_server
+    client = RemoteKVClient(f"kv://127.0.0.1:{port}")
+    big = make_layers(num_layers=4, nb=20, bs=8, K=4, D=32)  # ~640KB encoded > capacity/2
+    client.put_blocks("old", big, num_tokens=1)
+    client.put_blocks("new", big, num_tokens=2)
+    # Capacity 1 MiB forces LRU eviction of "old".
+    assert client.get_blocks("old") is None
+    assert client.get_blocks("new") is not None
+    client.close()
+
+
+# -- offload manager remote tier -------------------------------------------
+
+
+def test_offload_remote_tier_restore_and_discard(kv_server):
+    store, port = kv_server
+    client = RemoteKVClient(f"kv://127.0.0.1:{port}")
+    layers = make_layers()
+    nbytes = sum(k.nbytes + v.nbytes for k, v in layers)
+
+    mgr = HostOffloadManager(capacity_bytes=nbytes * 2, remote_client=client)
+
+    class FakeCache:
+        def __init__(self, arr):
+            self.arr = arr
+
+        def __getitem__(self, ids):
+            return self.arr[np.asarray(ids)]
+
+    kv_caches = [(FakeCache(k), FakeCache(v)) for k, v in make_layers(nb=16)]
+    assert mgr.save("s1", kv_caches, block_ids=[1, 2, 3], num_tokens=12)
+    # Remote now holds the snapshot too.
+    assert client.get_blocks("s1") is not None
+
+    # Evict locally (fill with another entry), then restore from remote.
+    mgr._entries.clear()
+    mgr.used_bytes = 0
+    entry = mgr.restore("s1")
+    assert entry is not None and entry.num_tokens == 12
+
+    # discard() must delete the remote copy (leak fix).
+    mgr.discard("s1")
+    assert client.get_blocks("s1") is None
+
+    # Sequences that never touched the remote tier cost no RPC and no error.
+    mgr.discard("never-offloaded")
+    client.close()
+
+
+def test_offload_discard_skips_remote_when_unknown(kv_server):
+    """discard() for a seq the remote never saw must not even connect."""
+    store, port = kv_server
+
+    class ExplodingClient:
+        def delete(self, seq_id):
+            raise AssertionError("must not be called")
+
+    mgr = HostOffloadManager(capacity_bytes=1 << 20, remote_client=ExplodingClient())
+    mgr.discard("nope")  # no snapshot anywhere: no RPC
