@@ -267,22 +267,25 @@ class LLMEngine:
         self._busy_window = [(t, d) for (t, d) in self._busy_window if t > cutoff]
         return outputs
 
-    def restore_seq_blocks(self, seq: Sequence) -> bool:
+    def restore_seq_blocks(self, seq: Sequence) -> str:
         """Scheduler restore_cb: page an offloaded sequence's KV snapshot
-        back into freshly allocated blocks.  On success the sequence holds
-        those blocks as a partial-prefill prefix (scheduler.py resumes from
-        it — no recompute)."""
+        back into freshly allocated blocks.  Returns "restored" (sequence
+        now holds the blocks as a partial-prefill prefix — no recompute),
+        "gone" (no snapshot: recompute), or "retry" (transient pool
+        pressure: snapshot reinserted, try again next step)."""
         entry = self.offload.restore(seq.seq_id)
         if entry is None:
-            return False  # fall back to recompute via normal prefill
+            return "gone"  # fall back to recompute via normal prefill
         bs = self.block_pool.block_size
         usable_tokens = min(entry.num_tokens, len(seq.prompt_token_ids) - 1)
         usable_blocks = usable_tokens // bs
-        if usable_blocks == 0 or not self.block_pool.can_allocate(usable_blocks):
+        if usable_blocks == 0:
+            return "gone"
+        if not self.block_pool.can_allocate(usable_blocks):
             # Transient pool pressure must not cost the snapshot: put it
-            # back so a later attempt (or another replica) can still use it.
+            # back so the next scheduling attempt can still use it.
             self.offload.reinsert(entry)
-            return False
+            return "retry"
         restored = self.block_pool.allocate(usable_blocks)
         ids = jnp.asarray(restored, jnp.int32)
         for layer_idx, (k_host, v_host) in enumerate(entry.layers):
@@ -293,7 +296,7 @@ class LLMEngine:
         seq.block_table = restored
         seq.num_cached_tokens = usable_blocks * bs
         seq.partial_prefill = True
-        return True
+        return "restored"
 
     def _run_prefill(self, plan: PrefillPlan) -> List[StepOutput]:
         seq = plan.seq

@@ -181,12 +181,15 @@ class Scheduler:
         seq = queue[0]
 
         if seq.offloaded:
-            # Page the KV snapshot back in; on success the engine has set
-            # block_table/num_cached_tokens/partial_prefill and the plan
-            # below resumes from that held prefix (no recompute).  On
-            # failure we fall through to a plain re-prefill.
-            if self.restore_cb is not None:
-                self.restore_cb(seq)
+            # Page the KV snapshot back in; on "restored" the engine has
+            # set block_table/num_cached_tokens/partial_prefill and the
+            # plan below resumes from that held prefix (no recompute).
+            # "retry" (transient pool pressure, snapshot kept) leaves the
+            # offloaded flag set and lets decode free blocks first;
+            # "gone" falls through to a plain re-prefill.
+            result = self.restore_cb(seq) if self.restore_cb is not None else "gone"
+            if result == "retry":
+                return None
             seq.offloaded = False
 
         if seq.partial_prefill:

@@ -120,13 +120,16 @@ class HostOffloadManager:
 
     def reinsert(self, entry: OffloadEntry) -> bool:
         """Put a restore()d-but-unused entry back (e.g. the pool could not
-        host it yet); also caches remote fetches locally.  Drops silently
-        when over capacity — same outcome as an eviction."""
+        host it yet); also caches remote fetches locally.  Evicts older
+        entries like save() — the reinserted snapshot is the one about to
+        be needed, so it outranks stale residents."""
+        self.restores -= 1  # the paired restore() did not take effect
+        while self.used_bytes + entry.nbytes > self.capacity_bytes and self._entries:
+            self._evict_oldest()
         if self.used_bytes + entry.nbytes > self.capacity_bytes:
             return False
         self._entries[entry.seq_id] = entry
         self.used_bytes += entry.nbytes
-        self.restores -= 1  # the paired restore() did not take effect
         return True
 
     def discard(self, seq_id: str) -> None:

@@ -18,7 +18,7 @@ from production_stack_tpu.router import parser as router_parser
 from production_stack_tpu.router.routing import initialize_routing_logic
 from production_stack_tpu.router.service_discovery import (
     DISCOVERY_SERVICE,
-    StaticServiceDiscovery,
+    build_service_discovery,
 )
 from production_stack_tpu.router.services.request_service.request import (
     CLIENT_SESSION,
@@ -33,50 +33,10 @@ from production_stack_tpu.router.stats.engine_stats import EngineStatsScraper
 from production_stack_tpu.router.stats.log_stats import log_stats_task
 from production_stack_tpu.router.stats.request_stats import RequestStatsMonitor
 from production_stack_tpu.utils.log import init_logger
-from production_stack_tpu.utils.net import (
-    parse_static_aliases,
-    parse_static_models,
-    parse_static_urls,
-    set_ulimit,
-)
+from production_stack_tpu.utils.net import parse_static_aliases, set_ulimit
 from production_stack_tpu.utils.registry import ServiceRegistry
 
 logger = logging.getLogger(__name__)
-
-
-def _build_service_discovery(args):
-    if args.service_discovery == "static":
-        urls = parse_static_urls(args.static_backends)
-        if args.static_models:
-            # ';' separates multiple models on one backend.
-            models = [entry.split(";") for entry in parse_static_models(args.static_models)]
-        else:
-            models = [[] for _ in urls]
-        labels = parse_static_models(args.static_model_labels) if args.static_model_labels else None
-        types = (
-            [entry.split(";") for entry in parse_static_models(args.static_model_types)]
-            if args.static_model_types
-            else None
-        )
-        return StaticServiceDiscovery(
-            urls,
-            models,
-            model_labels=labels,
-            model_types=types,
-            probe_models=args.static_probe_models,
-        )
-    # Lazy import: K8s discovery pulls in token/CA file handling not needed
-    # for static mode (reference gates this on args too, app.py:108-122).
-    try:
-        from production_stack_tpu.router.k8s_discovery import K8sServiceDiscovery
-    except ImportError as e:
-        _unavailable("--service-discovery k8s", e)
-
-    return K8sServiceDiscovery(
-        namespace=args.k8s_namespace,
-        port=args.k8s_port,
-        label_selector=args.k8s_label_selector,
-    )
 
 
 def initialize_all(app: web.Application, args) -> ServiceRegistry:
@@ -84,7 +44,7 @@ def initialize_all(app: web.Application, args) -> ServiceRegistry:
     (reference initialize_all, app.py:97-207)."""
     registry: ServiceRegistry = app["registry"]
 
-    discovery = _build_service_discovery(args)
+    discovery = build_service_discovery(args)
     registry.set(DISCOVERY_SERVICE, discovery)
 
     monitor = RequestStatsMonitor(sliding_window_size=args.request_stats_window)

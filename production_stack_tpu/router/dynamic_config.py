@@ -1,0 +1,196 @@
+"""Hot-reload of router config from a watched JSON file.
+
+Reference counterpart: src/vllm_router/dynamic_config.py:20-209
+(DynamicRouterConfig :20-76, DynamicConfigWatcher :79-209).  The file is
+written by the operator's ConfigMap pipeline (native/operator; reference
+staticroute_controller.go:134-184) and projected into the router pod.
+
+Differences from the reference:
+
+* asyncio task instead of a polling thread.
+* Reconfiguration swaps services in the ServiceRegistry and re-points the
+  stats scraper — no singleton-registry purge (the reference tears down
+  metaclass singletons in place, routing_logic.py:189-196, a documented
+  hot-reconfig race in SURVEY.md section 7).
+* The watcher also tracks the file's mtime so an unchanged config costs a
+  stat(), not a parse.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import dataclasses
+import hashlib
+import json
+import logging
+import os
+from typing import Optional
+
+from production_stack_tpu.router.routing import reconfigure_routing_logic
+from production_stack_tpu.router.service_discovery import (
+    DISCOVERY_SERVICE,
+    build_service_discovery,
+)
+from production_stack_tpu.router.services.request_service.request import (
+    ENGINE_STATS_SCRAPER,
+)
+
+logger = logging.getLogger(__name__)
+
+DYNAMIC_CONFIG_WATCHER = "dynamic_config_watcher"
+
+
+@dataclasses.dataclass
+class DynamicRouterConfig:
+    """Hot-reconfigurable subset of the router CLI surface
+    (reference dynamic_config.py:20-76)."""
+
+    service_discovery: str
+    routing_logic: str
+    static_backends: Optional[str] = None
+    static_models: Optional[str] = None
+    k8s_port: Optional[int] = None
+    k8s_namespace: Optional[str] = None
+    k8s_label_selector: Optional[str] = None
+    session_key: Optional[str] = None
+
+    @staticmethod
+    def from_json(path: str) -> "DynamicRouterConfig":
+        with open(path) as f:
+            data = json.load(f)
+        known = {f.name for f in dataclasses.fields(DynamicRouterConfig)}
+        unknown = set(data) - known
+        if unknown:
+            logger.warning("dynamic config: ignoring unknown keys %s", sorted(unknown))
+        return DynamicRouterConfig(**{k: v for k, v in data.items() if k in known})
+
+    @staticmethod
+    def from_args(args) -> "DynamicRouterConfig":
+        return DynamicRouterConfig(
+            service_discovery=args.service_discovery,
+            routing_logic=args.routing_logic,
+            static_backends=args.static_backends,
+            static_models=args.static_models,
+            k8s_port=args.k8s_port,
+            k8s_namespace=args.k8s_namespace,
+            k8s_label_selector=args.k8s_label_selector,
+            session_key=args.session_key,
+        )
+
+
+class DynamicConfigWatcher:
+    """Polls the JSON file; on change rebuilds discovery + routing in the
+    registry (reference _watch_worker, dynamic_config.py:180-201)."""
+
+    def __init__(self, config_json: str, registry, args, watch_interval: float = 10.0):
+        self.config_json = config_json
+        self.registry = registry
+        self.args = args
+        self.watch_interval = watch_interval
+        self.current_config = DynamicRouterConfig.from_args(args)
+        self.reconfig_count = 0
+        self._mtime: Optional[float] = None
+        self._task: Optional[asyncio.Task] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> None:
+        # Apply immediately if the file already exists (the operator may
+        # have written it before the router started).
+        await self._check_once()
+        self._task = asyncio.create_task(self._run())
+
+    async def close(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    def get_health(self) -> bool:
+        return self._task is not None and not self._task.done()
+
+    def get_current_config(self) -> DynamicRouterConfig:
+        return self.current_config
+
+    def current_config_digest(self) -> str:
+        """Short stable digest surfaced in /health so operators (and the
+        native operator's health poll) can confirm which config is live."""
+        blob = json.dumps(dataclasses.asdict(self.current_config), sort_keys=True)
+        return hashlib.sha256(blob.encode()).hexdigest()[:12]
+
+    # -- watch loop --------------------------------------------------------
+
+    async def _run(self) -> None:
+        while True:
+            await asyncio.sleep(self.watch_interval)
+            await self._check_once()
+
+    async def _check_once(self) -> None:
+        try:
+            mtime = os.stat(self.config_json).st_mtime
+        except OSError:
+            return  # file not written yet
+        if mtime == self._mtime:
+            return
+        try:
+            config = DynamicRouterConfig.from_json(self.config_json)
+        except (json.JSONDecodeError, TypeError, OSError) as e:
+            # Leave _mtime stale: the next poll retries (and keeps warning)
+            # until the operator writes a loadable file.
+            logger.warning("dynamic config: failed to load %s: %s", self.config_json, e)
+            return
+        if config == self.current_config:
+            self._mtime = mtime
+            return
+        logger.info("dynamic config changed; reconfiguring router")
+        try:
+            await self._reconfigure(config)
+        except Exception:
+            # Transient failure (e.g. K8s API unreachable): keep _mtime
+            # stale so the next poll retries the same file.
+            logger.exception("dynamic config: reconfiguration failed")
+            return
+        self._mtime = mtime
+        self.current_config = config
+        self.reconfig_count += 1
+        logger.info("dynamic config: reconfiguration complete")
+
+    # -- reconfiguration ---------------------------------------------------
+
+    async def _reconfigure(self, config: DynamicRouterConfig) -> None:
+        await self._reconfigure_discovery(config)
+        self._reconfigure_routing(config)
+
+    def _merged_args(self, config: DynamicRouterConfig) -> argparse.Namespace:
+        """Overlay the dynamic config onto the startup args so the shared
+        builder keeps everything the dynamic surface does not cover
+        (model labels/types, probing, ...)."""
+        merged = argparse.Namespace(**vars(self.args))
+        for field in dataclasses.fields(config):
+            value = getattr(config, field.name)
+            if value is not None:
+                setattr(merged, field.name, value)
+        return merged
+
+    async def _reconfigure_discovery(self, config: DynamicRouterConfig) -> None:
+        new = build_service_discovery(self._merged_args(config))
+        await new.start()
+        old = self.registry.get(DISCOVERY_SERVICE)
+        self.registry.replace(DISCOVERY_SERVICE, lambda: new)
+        # The scraper holds a direct reference; re-point it at the new
+        # discovery so the next scrape cycle sees the new endpoint set.
+        scraper = self.registry.get(ENGINE_STATS_SCRAPER)
+        if scraper is not None:
+            scraper.service_discovery = new
+        if old is not None:
+            await old.close()
+
+    def _reconfigure_routing(self, config: DynamicRouterConfig) -> None:
+        kwargs = {}
+        if config.routing_logic == "session":
+            kwargs["session_key"] = config.session_key or self.args.session_key
+        reconfigure_routing_logic(self.registry, config.routing_logic, **kwargs)

@@ -125,5 +125,48 @@ class StaticServiceDiscovery(ServiceDiscovery):
         return list(self._endpoints)
 
 
+def build_service_discovery(args) -> ServiceDiscovery:
+    """Build a discovery backend from (possibly merged) CLI args — the one
+    construction path shared by app startup and dynamic reconfiguration,
+    so hot reloads keep labels/types/probing behavior."""
+    from production_stack_tpu.utils.net import parse_static_models, parse_static_urls
+
+    if args.service_discovery == "static":
+        urls = parse_static_urls(args.static_backends)
+        if args.static_models:
+            # ';' separates multiple models on one backend.
+            models = [
+                entry.split(";") for entry in parse_static_models(args.static_models)
+            ]
+        else:
+            models = [[] for _ in urls]
+        labels = (
+            parse_static_models(args.static_model_labels)
+            if args.static_model_labels
+            else None
+        )
+        types = (
+            [entry.split(";") for entry in parse_static_models(args.static_model_types)]
+            if args.static_model_types
+            else None
+        )
+        return StaticServiceDiscovery(
+            urls,
+            models,
+            model_labels=labels,
+            model_types=types,
+            probe_models=args.static_probe_models,
+        )
+    if args.service_discovery == "k8s":
+        from production_stack_tpu.router.k8s_discovery import K8sServiceDiscovery
+
+        return K8sServiceDiscovery(
+            namespace=args.k8s_namespace,
+            port=args.k8s_port,
+            label_selector=args.k8s_label_selector,
+        )
+    raise ValueError(f"Invalid service discovery type: {args.service_discovery}")
+
+
 def get_service_discovery(registry) -> ServiceDiscovery:
     return registry.require(DISCOVERY_SERVICE)

@@ -1,0 +1,161 @@
+"""Dynamic config watcher: file change -> discovery + routing swap in the
+registry (reference dynamic_config.py:79-209, here registry-based instead
+of singleton purge).
+"""
+
+import json
+
+from production_stack_tpu.router.dynamic_config import (
+    DynamicConfigWatcher,
+    DynamicRouterConfig,
+)
+from production_stack_tpu.router.routing import ROUTING_SERVICE
+from production_stack_tpu.router.routing.round_robin import RoundRobinRouter
+from production_stack_tpu.router.routing.session import SessionRouter
+from production_stack_tpu.router.service_discovery import DISCOVERY_SERVICE
+from production_stack_tpu.router.services.request_service.request import (
+    ENGINE_STATS_SCRAPER,
+)
+from production_stack_tpu.router.parser import parse_args
+
+from tests.test_router_e2e import start_fake_engine, start_router
+
+
+def base_args(path):
+    return parse_args(
+        [
+            "--static-backends",
+            "http://127.0.0.1:9001",
+            "--static-models",
+            "m-old",
+            "--dynamic-config-json",
+            str(path),
+        ]
+    )
+
+
+def write_config(path, **kwargs):
+    path.write_text(json.dumps(kwargs))
+
+
+async def test_reconfigure_swaps_discovery_and_routing(tmp_path, registry):
+    cfg_path = tmp_path / "dyn.json"
+    args = base_args(cfg_path)
+
+    from production_stack_tpu.router.routing import initialize_routing_logic
+    from production_stack_tpu.router.service_discovery import StaticServiceDiscovery
+
+    registry.set(DISCOVERY_SERVICE, StaticServiceDiscovery(["http://127.0.0.1:9001"], [["m-old"]]))
+    initialize_routing_logic(registry, "roundrobin")
+
+    class FakeScraper:
+        service_discovery = registry.get(DISCOVERY_SERVICE)
+
+    scraper = FakeScraper()
+    registry.set(ENGINE_STATS_SCRAPER, scraper)
+
+    watcher = DynamicConfigWatcher(str(cfg_path), registry, args)
+    assert isinstance(registry.get(ROUTING_SERVICE), RoundRobinRouter)
+
+    write_config(
+        cfg_path,
+        service_discovery="static",
+        routing_logic="session",
+        session_key="x-user-id",
+        static_backends="http://127.0.0.1:9002,http://127.0.0.1:9003",
+        static_models="m-new,m-new",
+    )
+    await watcher._check_once()
+
+    assert watcher.reconfig_count == 1
+    discovery = registry.get(DISCOVERY_SERVICE)
+    assert [ep.url for ep in discovery.get_endpoint_info()] == [
+        "http://127.0.0.1:9002",
+        "http://127.0.0.1:9003",
+    ]
+    assert discovery.get_endpoint_info()[0].model_names == ["m-new"]
+    assert isinstance(registry.get(ROUTING_SERVICE), SessionRouter)
+    # Scraper re-pointed at the new discovery.
+    assert scraper.service_discovery is discovery
+
+
+async def test_bad_json_keeps_old_config(tmp_path, registry):
+    cfg_path = tmp_path / "dyn.json"
+    args = base_args(cfg_path)
+
+    from production_stack_tpu.router.routing import initialize_routing_logic
+    from production_stack_tpu.router.service_discovery import StaticServiceDiscovery
+
+    old_disc = StaticServiceDiscovery(["http://127.0.0.1:9001"], [["m-old"]])
+    registry.set(DISCOVERY_SERVICE, old_disc)
+    initialize_routing_logic(registry, "roundrobin")
+
+    watcher = DynamicConfigWatcher(str(cfg_path), registry, args)
+    cfg_path.write_text("{not json")
+    await watcher._check_once()
+    assert watcher.reconfig_count == 0
+    assert registry.get(DISCOVERY_SERVICE) is old_disc
+
+
+async def test_unknown_keys_ignored(tmp_path):
+    cfg_path = tmp_path / "dyn.json"
+    write_config(
+        cfg_path,
+        service_discovery="static",
+        routing_logic="roundrobin",
+        static_backends="http://127.0.0.1:9001",
+        some_future_knob=42,
+    )
+    cfg = DynamicRouterConfig.from_json(str(cfg_path))
+    assert cfg.routing_logic == "roundrobin"
+
+
+async def test_e2e_requests_follow_reconfigured_backends(tmp_path):
+    """Full router: initial backend A; dynamic config moves to backend B;
+    requests land on B."""
+    sa, ea = await start_fake_engine(model="m-dyn")
+    sb, eb = await start_fake_engine(model="m-dyn")
+    cfg_path = tmp_path / "dyn.json"
+    try:
+        app, server, client = await start_router(
+            [str(ea.make_url("")).rstrip("/")],
+            ["m-dyn"],
+            extra_args=["--dynamic-config-json", str(cfg_path)],
+        )
+        try:
+            # /health must work (and expose the config digest) with the
+            # watcher enabled — regression: digest method was missing.
+            resp = await client.get("/health")
+            assert resp.status == 200, await resp.text()
+            health = await resp.json()
+            digest_before = health["dynamic_config"]
+
+            resp = await client.post(
+                "/v1/completions", json={"model": "m-dyn", "prompt": "x", "max_tokens": 2}
+            )
+            assert resp.status == 200
+            assert sa.total_requests == 1 and sb.total_requests == 0
+
+            write_config(
+                cfg_path,
+                service_discovery="static",
+                routing_logic="roundrobin",
+                static_backends=str(eb.make_url("")).rstrip("/"),
+                static_models="m-dyn",
+            )
+            watcher = app["registry"].get("dynamic_config_watcher")
+            await watcher._check_once()
+
+            resp = await client.post(
+                "/v1/completions", json={"model": "m-dyn", "prompt": "x", "max_tokens": 2}
+            )
+            assert resp.status == 200
+            assert sb.total_requests == 1
+
+            resp = await client.get("/health")
+            assert (await resp.json())["dynamic_config"] != digest_before
+        finally:
+            await client.close()
+    finally:
+        await ea.close()
+        await eb.close()

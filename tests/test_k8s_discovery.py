@@ -1,0 +1,178 @@
+"""K8s pod-watch discovery against a fake API server (list + watch stream),
+mirroring the reference's fake-backend test strategy (SURVEY.md section 4).
+"""
+
+import asyncio
+import json
+
+from aiohttp import web
+from aiohttp.test_utils import TestServer
+
+from production_stack_tpu.router.k8s_discovery import K8sServiceDiscovery
+from tests.test_router_e2e import start_fake_engine
+
+
+def make_pod(name, ip, ready=True, rv="1", labels=None):
+    return {
+        "metadata": {"name": name, "resourceVersion": rv, "labels": labels or {}},
+        "status": {
+            "podIP": ip,
+            "containerStatuses": [{"ready": ready}],
+        },
+    }
+
+
+class FakeK8sApi:
+    """Minimal /api/v1/namespaces/{ns}/pods with list + watch=1 stream."""
+
+    def __init__(self):
+        self.pods = {}
+        self.watch_queues = []
+        self.seen_auth = []
+        self.app = web.Application()
+        self.app.router.add_get(
+            "/api/v1/namespaces/{ns}/pods", self.handle_pods
+        )
+
+    async def handle_pods(self, request: web.Request):
+        self.seen_auth.append(request.headers.get("Authorization"))
+        if request.query.get("watch"):
+            resp = web.StreamResponse()
+            resp.content_type = "application/json"
+            await resp.prepare(request)
+            queue = asyncio.Queue()
+            self.watch_queues.append(queue)
+            try:
+                while True:
+                    event = await queue.get()
+                    if event is None:
+                        break
+                    await resp.write(json.dumps(event).encode() + b"\n")
+            finally:
+                self.watch_queues.remove(queue)
+            return resp
+        return web.json_response(
+            {
+                "metadata": {"resourceVersion": "10"},
+                "items": list(self.pods.values()),
+            }
+        )
+
+    async def emit(self, etype, pod):
+        for queue in list(self.watch_queues):
+            await queue.put({"type": etype, "object": pod})
+
+    async def wait_for_watcher(self, timeout=5.0):
+        for _ in range(int(timeout / 0.05)):
+            if self.watch_queues:
+                return
+            await asyncio.sleep(0.05)
+        raise TimeoutError("watch stream never connected")
+
+
+async def settle(predicate, timeout=5.0):
+    for _ in range(int(timeout / 0.05)):
+        if predicate():
+            return
+        await asyncio.sleep(0.05)
+    raise TimeoutError("condition not reached")
+
+
+async def start_discovery(api, engine_port, **kwargs):
+    api_server = TestServer(api.app)
+    await api_server.start_server()
+    disc = K8sServiceDiscovery(
+        namespace="ns1",
+        port=engine_port,
+        api_server=str(api_server.make_url("")).rstrip("/"),
+        token="test-token",
+        **kwargs,
+    )
+    await disc.start()
+    return disc, api_server
+
+
+async def test_initial_list_discovers_ready_pods():
+    state, engine = await start_fake_engine(model="m-k8s")
+    port = engine.port
+    api = FakeK8sApi()
+    api.pods["pod-a"] = make_pod("pod-a", "127.0.0.1")
+    api.pods["pod-b"] = make_pod("pod-b", "127.0.0.1", ready=False)
+    disc, api_server = await start_discovery(api, port)
+    try:
+        eps = disc.get_endpoint_info()
+        assert len(eps) == 1  # only the ready pod
+        assert eps[0].pod_name == "pod-a"
+        assert eps[0].model_names == ["m-k8s"]
+        assert eps[0].url == f"http://127.0.0.1:{port}"
+        assert disc.get_health()
+        # Bearer token forwarded to the API server.
+        assert api.seen_auth[0] == "Bearer test-token"
+    finally:
+        await disc.close()
+        await api_server.close()
+        await engine.close()
+
+
+async def test_watch_add_modify_delete():
+    state, engine = await start_fake_engine(model="m-watch")
+    port = engine.port
+    api = FakeK8sApi()
+    disc, api_server = await start_discovery(api, port)
+    try:
+        await api.wait_for_watcher()
+        # ADDED ready pod -> appears.
+        await api.emit("ADDED", make_pod("pod-new", "127.0.0.1", rv="11"))
+        await settle(lambda: len(disc.get_endpoint_info()) == 1)
+
+        # MODIFIED to not-ready -> removed (readiness gating).
+        await api.emit(
+            "MODIFIED", make_pod("pod-new", "127.0.0.1", ready=False, rv="12")
+        )
+        await settle(lambda: len(disc.get_endpoint_info()) == 0)
+
+        # Ready again -> back.
+        await api.emit("MODIFIED", make_pod("pod-new", "127.0.0.1", rv="13"))
+        await settle(lambda: len(disc.get_endpoint_info()) == 1)
+
+        # DELETED -> gone.
+        await api.emit("DELETED", make_pod("pod-new", "127.0.0.1", rv="14"))
+        await settle(lambda: len(disc.get_endpoint_info()) == 0)
+    finally:
+        await disc.close()
+        await api_server.close()
+        await engine.close()
+
+
+async def test_watch_reconnect_relists():
+    """When the watch stream ends, the loop re-lists: pods deleted while
+    disconnected disappear."""
+    state, engine = await start_fake_engine(model="m-r")
+    port = engine.port
+    api = FakeK8sApi()
+    api.pods["pod-x"] = make_pod("pod-x", "127.0.0.1")
+    disc, api_server = await start_discovery(api, port)
+    try:
+        await api.wait_for_watcher()
+        assert len(disc.get_endpoint_info()) == 1
+        del api.pods["pod-x"]
+        # Close the watch stream -> loop re-lists -> pod-x gone.
+        for queue in list(api.watch_queues):
+            await queue.put(None)
+        await settle(lambda: len(disc.get_endpoint_info()) == 0)
+    finally:
+        await disc.close()
+        await api_server.close()
+        await engine.close()
+
+
+async def test_probe_failure_excludes_pod():
+    api = FakeK8sApi()
+    # Ready pod whose engine port serves nothing.
+    api.pods["pod-dead"] = make_pod("pod-dead", "127.0.0.1")
+    disc, api_server = await start_discovery(api, engine_port=1, probe_timeout=0.2)
+    try:
+        assert disc.get_endpoint_info() == []
+    finally:
+        await disc.close()
+        await api_server.close()
