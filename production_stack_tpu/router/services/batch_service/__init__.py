@@ -1,0 +1,454 @@
+"""OpenAI Batch API: SQLite-backed queue + background processor that
+executes every batch line through the router's real routing/proxy stack.
+
+Reference counterpart: src/vllm_router/services/batch_service/
+(BatchInfo batch.py:6-91, LocalBatchProcessor local_processor.py:19-208).
+The reference's processor never executes anything — its body is a
+simulation stub (local_processor.py:179-195, "simulate processing" sleep +
+canned output).  Here each input line is routed exactly like a live
+request: model-filtered endpoints -> routing logic -> POST to the chosen
+engine, with bounded concurrency, per-line error capture into an OpenAI
+error file, and request_counts bookkeeping.
+
+aiosqlite is not available on TPU images; sqlite3 runs in worker threads
+(one short-lived connection per operation — the queue is low-QPS control
+plane, not a data path).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import enum
+import json
+import logging
+import os
+import sqlite3
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+from production_stack_tpu.router.routing import ROUTING_SERVICE
+from production_stack_tpu.router.service_discovery import DISCOVERY_SERVICE
+from production_stack_tpu.router.services.files_service import FILE_STORAGE, Storage
+from production_stack_tpu.router.services.request_service.request import (
+    CLIENT_SESSION,
+    ENGINE_STATS_SCRAPER,
+    REQUEST_STATS_MONITOR,
+)
+
+logger = logging.getLogger(__name__)
+
+BATCH_PROCESSOR = "batch_processor"
+
+
+class BatchStatus(str, enum.Enum):
+    """OpenAI batch lifecycle (the reference uses pending/running;
+    we emit the OpenAI status vocabulary for client compatibility)."""
+
+    VALIDATING = "validating"
+    IN_PROGRESS = "in_progress"
+    FINALIZING = "finalizing"
+    COMPLETED = "completed"
+    FAILED = "failed"
+    CANCELLED = "cancelled"
+
+
+BATCH_ENDPOINTS = ("/v1/chat/completions", "/v1/completions", "/v1/embeddings")
+
+
+@dataclasses.dataclass
+class BatchInfo:
+    """OpenAI batch object
+    (https://platform.openai.com/docs/api-reference/batch/object)."""
+
+    id: str
+    status: BatchStatus
+    input_file_id: str
+    endpoint: str
+    completion_window: str
+    created_at: int
+    output_file_id: Optional[str] = None
+    error_file_id: Optional[str] = None
+    in_progress_at: Optional[int] = None
+    completed_at: Optional[int] = None
+    failed_at: Optional[int] = None
+    cancelled_at: Optional[int] = None
+    total_requests: int = 0
+    completed_requests: int = 0
+    failed_requests: int = 0
+    metadata: Optional[Dict[str, Any]] = None
+
+    def to_dict(self) -> dict:
+        return {
+            "id": self.id,
+            "object": "batch",
+            "endpoint": self.endpoint,
+            "input_file_id": self.input_file_id,
+            "completion_window": self.completion_window,
+            "status": self.status.value,
+            "output_file_id": self.output_file_id,
+            "error_file_id": self.error_file_id,
+            "created_at": self.created_at,
+            "in_progress_at": self.in_progress_at,
+            "completed_at": self.completed_at,
+            "failed_at": self.failed_at,
+            "cancelled_at": self.cancelled_at,
+            "request_counts": {
+                "total": self.total_requests,
+                "completed": self.completed_requests,
+                "failed": self.failed_requests,
+            },
+            "metadata": self.metadata,
+        }
+
+
+_COLUMNS = (
+    "batch_id, status, input_file_id, endpoint, completion_window, created_at, "
+    "output_file_id, error_file_id, in_progress_at, completed_at, failed_at, "
+    "cancelled_at, total_requests, completed_requests, failed_requests, metadata"
+)
+
+
+def _row_to_info(row) -> BatchInfo:
+    return BatchInfo(
+        id=row[0],
+        status=BatchStatus(row[1]),
+        input_file_id=row[2],
+        endpoint=row[3],
+        completion_window=row[4],
+        created_at=row[5],
+        output_file_id=row[6],
+        error_file_id=row[7],
+        in_progress_at=row[8],
+        completed_at=row[9],
+        failed_at=row[10],
+        cancelled_at=row[11],
+        total_requests=row[12],
+        completed_requests=row[13],
+        failed_requests=row[14],
+        metadata=json.loads(row[15]) if row[15] else None,
+    )
+
+
+class _BatchRequestStub:
+    """Duck-typed routing.base.Request for batch-originated requests."""
+
+    def __init__(self, headers: Dict[str, str]):
+        self.headers = headers
+
+
+class LocalBatchProcessor:
+    """SQLite queue + poller task (reference local_processor.py:19-208,
+    with real execution instead of the simulation stub)."""
+
+    def __init__(
+        self,
+        db_dir: str,
+        storage: Storage,
+        registry,
+        poll_interval: float = 1.0,
+        max_concurrency: int = 8,
+    ):
+        os.makedirs(db_dir, exist_ok=True)
+        self.db_path = os.path.join(db_dir, "batch_queue.db")
+        self.storage = storage
+        self.registry = registry
+        self.poll_interval = poll_interval
+        self.max_concurrency = max_concurrency
+        self._task: Optional[asyncio.Task] = None
+        self._setup()
+
+    # -- sqlite plumbing (worker threads) ----------------------------------
+
+    def _setup(self) -> None:
+        with sqlite3.connect(self.db_path) as db:
+            db.execute(
+                "CREATE TABLE IF NOT EXISTS batch_queue ("
+                "batch_id TEXT PRIMARY KEY, status TEXT, input_file_id TEXT, "
+                "endpoint TEXT, completion_window TEXT, created_at INTEGER, "
+                "output_file_id TEXT, error_file_id TEXT, in_progress_at INTEGER, "
+                "completed_at INTEGER, failed_at INTEGER, cancelled_at INTEGER, "
+                "total_requests INTEGER DEFAULT 0, "
+                "completed_requests INTEGER DEFAULT 0, "
+                "failed_requests INTEGER DEFAULT 0, metadata TEXT)"
+            )
+
+    async def _db(self, fn):
+        def run():
+            with sqlite3.connect(self.db_path) as db:
+                return fn(db)
+
+        return await asyncio.to_thread(run)
+
+    async def _write_info(self, info: BatchInfo) -> None:
+        values = (
+            info.id, info.status.value, info.input_file_id, info.endpoint,
+            info.completion_window, info.created_at, info.output_file_id,
+            info.error_file_id, info.in_progress_at, info.completed_at,
+            info.failed_at, info.cancelled_at, info.total_requests,
+            info.completed_requests, info.failed_requests,
+            json.dumps(info.metadata) if info.metadata else None,
+        )
+        placeholders = ",".join("?" * 16)
+        await self._db(
+            lambda db: db.execute(
+                f"INSERT OR REPLACE INTO batch_queue ({_COLUMNS}) "
+                f"VALUES ({placeholders})",
+                values,
+            )
+        )
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.create_task(self._poll_loop())
+
+    async def close(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    # -- API ---------------------------------------------------------------
+
+    async def create_batch(
+        self,
+        input_file_id: str,
+        endpoint: str,
+        completion_window: str = "24h",
+        metadata: Optional[dict] = None,
+    ) -> BatchInfo:
+        if endpoint not in BATCH_ENDPOINTS:
+            raise ValueError(
+                f"Unsupported batch endpoint {endpoint!r}; supported: {BATCH_ENDPOINTS}"
+            )
+        info = BatchInfo(
+            id="batch_" + uuid.uuid4().hex[:12],
+            status=BatchStatus.VALIDATING,
+            input_file_id=input_file_id,
+            endpoint=endpoint,
+            completion_window=completion_window,
+            created_at=int(time.time()),
+            metadata=metadata,
+        )
+        await self._write_info(info)
+        logger.info("Created batch %s (input %s)", info.id, input_file_id)
+        return info
+
+    async def retrieve_batch(self, batch_id: str) -> BatchInfo:
+        row = await self._db(
+            lambda db: db.execute(
+                f"SELECT {_COLUMNS} FROM batch_queue WHERE batch_id = ?",
+                (batch_id,),
+            ).fetchone()
+        )
+        if row is None:
+            raise FileNotFoundError(batch_id)
+        return _row_to_info(row)
+
+    async def list_batches(
+        self, limit: int = 20, after: Optional[str] = None
+    ) -> List[BatchInfo]:
+        def query(db):
+            if after:
+                anchor = db.execute(
+                    "SELECT created_at FROM batch_queue WHERE batch_id = ?",
+                    (after,),
+                ).fetchone()
+                if anchor is None:
+                    return []
+                return db.execute(
+                    f"SELECT {_COLUMNS} FROM batch_queue WHERE created_at <= ? "
+                    "AND batch_id != ? ORDER BY created_at DESC, batch_id LIMIT ?",
+                    (anchor[0], after, limit),
+                ).fetchall()
+            return db.execute(
+                f"SELECT {_COLUMNS} FROM batch_queue "
+                "ORDER BY created_at DESC, batch_id LIMIT ?",
+                (limit,),
+            ).fetchall()
+
+        return [_row_to_info(r) for r in await self._db(query)]
+
+    async def cancel_batch(self, batch_id: str) -> BatchInfo:
+        info = await self.retrieve_batch(batch_id)
+        if info.status in (BatchStatus.VALIDATING, BatchStatus.IN_PROGRESS):
+            info.status = BatchStatus.CANCELLED
+            info.cancelled_at = int(time.time())
+            await self._write_info(info)
+        return info
+
+    # -- processing --------------------------------------------------------
+
+    async def _poll_loop(self) -> None:
+        while True:
+            try:
+                row = await self._db(
+                    lambda db: db.execute(
+                        f"SELECT {_COLUMNS} FROM batch_queue WHERE status = ? "
+                        "ORDER BY created_at LIMIT 1",
+                        (BatchStatus.VALIDATING.value,),
+                    ).fetchone()
+                )
+                if row is not None:
+                    await self._process_batch(_row_to_info(row))
+                    continue  # drain the queue before sleeping
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("batch poll loop error")
+            await asyncio.sleep(self.poll_interval)
+
+    async def _process_batch(self, info: BatchInfo) -> None:
+        logger.info("Processing batch %s", info.id)
+        try:
+            content = await self.storage.get_file_content(info.input_file_id)
+        except FileNotFoundError:
+            info.status = BatchStatus.FAILED
+            info.failed_at = int(time.time())
+            await self._write_info(info)
+            return
+
+        lines = [ln for ln in content.decode("utf-8").splitlines() if ln.strip()]
+        info.status = BatchStatus.IN_PROGRESS
+        info.in_progress_at = int(time.time())
+        info.total_requests = len(lines)
+        await self._write_info(info)
+
+        semaphore = asyncio.Semaphore(self.max_concurrency)
+
+        async def run_line(idx: int, line: str):
+            async with semaphore:
+                return await self._execute_line(info, idx, line)
+
+        results = await asyncio.gather(
+            *(run_line(i, line) for i, line in enumerate(lines))
+        )
+
+        # Cancelled mid-flight? Leave the terminal state alone.
+        current = await self.retrieve_batch(info.id)
+        if current.status == BatchStatus.CANCELLED:
+            return
+
+        info.status = BatchStatus.FINALIZING
+        await self._write_info(info)
+
+        outputs = [json.dumps(r) + "\n" for r in results if "response" in r]
+        errors = [json.dumps(r) + "\n" for r in results if "error" in r]
+        info.completed_requests = len(outputs)
+        info.failed_requests = len(errors)
+        if outputs:
+            out_file = await self.storage.save_file(
+                file_name=f"{info.id}_output.jsonl",
+                content="".join(outputs).encode(),
+                purpose="batch_output",
+            )
+            info.output_file_id = out_file.id
+        if errors:
+            err_file = await self.storage.save_file(
+                file_name=f"{info.id}_errors.jsonl",
+                content="".join(errors).encode(),
+                purpose="batch_output",
+            )
+            info.error_file_id = err_file.id
+        info.status = BatchStatus.COMPLETED
+        info.completed_at = int(time.time())
+        await self._write_info(info)
+        logger.info(
+            "Batch %s done: %d ok, %d failed",
+            info.id, info.completed_requests, info.failed_requests,
+        )
+
+    async def _execute_line(self, info: BatchInfo, idx: int, line: str) -> dict:
+        """Route and execute one batch input line through the live stack
+        (the step the reference stubs out, local_processor.py:179-195)."""
+        base = {"id": f"{info.id}_{idx}", "custom_id": None}
+        try:
+            item = json.loads(line)
+        except json.JSONDecodeError as e:
+            return {**base, "error": {"code": "invalid_json", "message": str(e)}}
+        base["custom_id"] = item.get("custom_id")
+        body = item.get("body") or {}
+        url_path = item.get("url") or info.endpoint
+        model = body.get("model")
+
+        discovery = self.registry.get(DISCOVERY_SERVICE)
+        router = self.registry.get(ROUTING_SERVICE)
+        session = self.registry.get(CLIENT_SESSION)
+        if discovery is None or router is None or session is None:
+            return {**base, "error": {"code": "router_not_ready", "message": "router services unavailable"}}
+
+        endpoints = [ep for ep in discovery.get_endpoint_info() if not ep.sleep]
+        scraper = self.registry.get(ENGINE_STATS_SCRAPER)
+        if scraper is not None:
+            unreachable = scraper.get_unreachable_urls()
+            reachable = [ep for ep in endpoints if ep.url not in unreachable]
+            if reachable:
+                endpoints = reachable
+        if model is not None:
+            endpoints = [
+                ep for ep in endpoints
+                if not ep.model_names or model in ep.model_names
+            ]
+        engine_stats = scraper.get_engine_stats() if scraper else {}
+        monitor = self.registry.get(REQUEST_STATS_MONITOR)
+        request_stats = monitor.get_request_stats(time.time()) if monitor else {}
+        try:
+            server_url = router.route_request(
+                endpoints, engine_stats, request_stats,
+                _BatchRequestStub(headers={}), body,
+            )
+        except ValueError as e:
+            return {**base, "error": {"code": "no_backend", "message": str(e)}}
+
+        request_id = f"{info.id}-{idx}"
+        if monitor:
+            monitor.on_new_request(server_url, request_id, time.time())
+        try:
+            async with session.post(
+                f"{server_url}{url_path}", json=body,
+                headers={"x-request-id": request_id},
+            ) as resp:
+                if monitor:
+                    monitor.on_backend_connected(server_url, request_id, time.time())
+                payload = await resp.read()
+                if monitor:
+                    monitor.on_request_response(server_url, request_id, time.time())
+                    monitor.on_request_complete(server_url, request_id, time.time())
+                try:
+                    parsed = json.loads(payload)
+                except json.JSONDecodeError:
+                    parsed = payload.decode("utf-8", "replace")
+                if resp.status >= 400:
+                    return {
+                        **base,
+                        "error": {"code": f"http_{resp.status}", "message": parsed},
+                    }
+                return {
+                    **base,
+                    "response": {"status_code": resp.status, "body": parsed},
+                }
+        except Exception as e:
+            if monitor:
+                monitor.on_request_failed(server_url, request_id, time.time())
+            return {**base, "error": {"code": "request_failed", "message": str(e)}}
+
+
+def initialize_batch_service(app, registry, args) -> None:
+    """Wire storage + processor (called from app.initialize_all when
+    --enable-batch-api is set)."""
+    from production_stack_tpu.router.services.files_service import LocalFileStorage
+
+    storage = LocalFileStorage(args.file_storage_path)
+    registry.set(FILE_STORAGE, storage)
+    processor = LocalBatchProcessor(
+        db_dir=args.file_storage_path,
+        storage=storage,
+        registry=registry,
+    )
+    registry.set(BATCH_PROCESSOR, processor)

@@ -1,0 +1,180 @@
+"""OpenAI Files API storage (local disk).
+
+Reference counterpart: src/vllm_router/services/files_service/
+(Storage ABC storage.py:7-157, FileStorage file_storage.py:14-120,
+OpenAIFile openai_files.py:5-48).
+
+Differences from the reference:
+
+* Metadata (filename, purpose, created_at) persists in a sidecar JSON, so
+  file listings survive router restarts (the reference loses filenames).
+* list_files is part of the storage interface (the reference ABC declares
+  it but the OpenAI list endpoint was never wired).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import json
+import os
+import time
+import uuid
+from typing import List, Optional
+
+FILE_STORAGE = "file_storage"
+
+DEFAULT_USER_ID = "default"
+
+
+@dataclasses.dataclass
+class OpenAIFile:
+    """OpenAI file object (https://platform.openai.com/docs/api-reference/files/object)."""
+
+    id: str
+    bytes: int
+    created_at: int
+    filename: str
+    purpose: str
+    object: str = "file"
+
+    def metadata(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class Storage:
+    """Interface (reference storage.py:7-139)."""
+
+    async def save_file(
+        self,
+        file_name: str,
+        content: bytes,
+        purpose: str = "batch",
+        file_id: Optional[str] = None,
+        user_id: str = DEFAULT_USER_ID,
+    ) -> OpenAIFile:
+        raise NotImplementedError
+
+    async def get_file(self, file_id: str, user_id: str = DEFAULT_USER_ID) -> OpenAIFile:
+        raise NotImplementedError
+
+    async def get_file_content(
+        self, file_id: str, user_id: str = DEFAULT_USER_ID
+    ) -> bytes:
+        raise NotImplementedError
+
+    async def list_files(self, user_id: str = DEFAULT_USER_ID) -> List[OpenAIFile]:
+        raise NotImplementedError
+
+    async def delete_file(self, file_id: str, user_id: str = DEFAULT_USER_ID) -> None:
+        raise NotImplementedError
+
+
+class LocalFileStorage(Storage):
+    """Local-disk store: ``<base>/<user>/<file_id>`` + ``<file_id>.json``
+    metadata sidecar.  IO runs in a worker thread (files can be large;
+    the event loop must not block — reference uses aiofiles for the same
+    reason, file_storage.py:52)."""
+
+    def __init__(self, base_path: str = "/tmp/tpu_router_storage"):
+        self.base_path = base_path
+        os.makedirs(base_path, exist_ok=True)
+
+    def _user_dir(self, user_id: str) -> str:
+        path = os.path.join(self.base_path, user_id)
+        os.makedirs(path, exist_ok=True)
+        return path
+
+    def _paths(self, file_id: str, user_id: str):
+        base = os.path.join(self._user_dir(user_id), file_id)
+        return base, base + ".json"
+
+    async def save_file(
+        self,
+        file_name: str,
+        content: bytes,
+        purpose: str = "batch",
+        file_id: Optional[str] = None,
+        user_id: str = DEFAULT_USER_ID,
+    ) -> OpenAIFile:
+        if content is None:
+            raise ValueError("content cannot be None")
+        file_id = file_id or f"file-{uuid.uuid4().hex[:12]}"
+        if "/" in file_id or file_id.startswith("."):
+            raise ValueError(f"invalid file id {file_id!r}")
+        info = OpenAIFile(
+            id=file_id,
+            bytes=len(content),
+            created_at=int(time.time()),
+            filename=file_name or file_id,
+            purpose=purpose,
+        )
+        content_path, meta_path = self._paths(file_id, user_id)
+
+        def write():
+            with open(content_path, "wb") as f:
+                f.write(content)
+            with open(meta_path, "w") as f:
+                json.dump(info.metadata(), f)
+
+        await asyncio.to_thread(write)
+        return info
+
+    async def get_file(self, file_id: str, user_id: str = DEFAULT_USER_ID) -> OpenAIFile:
+        _, meta_path = self._paths(file_id, user_id)
+
+        def read():
+            with open(meta_path) as f:
+                return json.load(f)
+
+        try:
+            return OpenAIFile(**await asyncio.to_thread(read))
+        except OSError:
+            raise FileNotFoundError(file_id)
+
+    async def get_file_content(
+        self, file_id: str, user_id: str = DEFAULT_USER_ID
+    ) -> bytes:
+        content_path, _ = self._paths(file_id, user_id)
+
+        def read():
+            with open(content_path, "rb") as f:
+                return f.read()
+
+        try:
+            return await asyncio.to_thread(read)
+        except OSError:
+            raise FileNotFoundError(file_id)
+
+    async def list_files(self, user_id: str = DEFAULT_USER_ID) -> List[OpenAIFile]:
+        user_dir = self._user_dir(user_id)
+
+        def read_all():
+            out = []
+            for name in sorted(os.listdir(user_dir)):
+                if not name.endswith(".json"):
+                    continue
+                try:
+                    with open(os.path.join(user_dir, name)) as f:
+                        out.append(OpenAIFile(**json.load(f)))
+                except (OSError, TypeError, ValueError):
+                    continue
+            return out
+
+        return await asyncio.to_thread(read_all)
+
+    async def delete_file(self, file_id: str, user_id: str = DEFAULT_USER_ID) -> None:
+        content_path, meta_path = self._paths(file_id, user_id)
+
+        def rm():
+            found = False
+            for path in (content_path, meta_path):
+                try:
+                    os.remove(path)
+                    found = True
+                except OSError:
+                    pass
+            if not found:
+                raise FileNotFoundError(file_id)
+
+        await asyncio.to_thread(rm)
