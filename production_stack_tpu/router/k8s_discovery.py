@@ -59,6 +59,7 @@ class K8sServiceDiscovery(ServiceDiscovery):
         ca_path: Optional[str] = None,
         probe_timeout: float = 5.0,
         watch_timeout_s: int = 30,
+        probe_ttl: float = 60.0,
     ):
         self.namespace = namespace
         self.port = port
@@ -68,6 +69,8 @@ class K8sServiceDiscovery(ServiceDiscovery):
         self._ca_path = ca_path
         self._probe_timeout = probe_timeout
         self._watch_timeout_s = watch_timeout_s
+        self._probe_ttl = probe_ttl
+        self._probe_times: Dict[str, float] = {}  # pod name -> last probe
         self._endpoints: Dict[str, EndpointInfo] = {}  # pod name -> endpoint
         self._task: Optional[asyncio.Task] = None
         self._session: Optional[aiohttp.ClientSession] = None
@@ -188,10 +191,7 @@ class K8sServiceDiscovery(ServiceDiscovery):
                 self._resource_version = None
                 return
             resp.raise_for_status()
-            async for line in resp.content:
-                line = line.strip()
-                if not line:
-                    continue
+            async for line in self._iter_lines(resp.content):
                 event = json.loads(line)
                 etype = event.get("type")
                 obj = event.get("object", {})
@@ -208,6 +208,27 @@ class K8sServiceDiscovery(ServiceDiscovery):
                 if rv:
                     self._resource_version = rv
                 await self._on_pod_event(etype, obj)
+
+    @staticmethod
+    async def _iter_lines(stream: aiohttp.StreamReader):
+        """Split the watch stream on newlines ourselves: aiohttp's readline
+        has a ~64 KiB line limit, and a single pod object with managedFields
+        routinely exceeds it — hitting the limit raised ValueError every
+        watch cycle and silently degraded the watcher into a list-poll loop."""
+        buf = bytearray()
+        async for chunk in stream.iter_any():
+            buf.extend(chunk)
+            while True:
+                nl = buf.find(b"\n")
+                if nl < 0:
+                    break
+                line = bytes(buf[:nl]).strip()
+                del buf[: nl + 1]
+                if line:
+                    yield line
+        tail = bytes(buf).strip()
+        if tail:
+            yield tail
 
     # -- pod event handling (reference :184-239 semantics) -----------------
 
@@ -240,7 +261,21 @@ class K8sServiceDiscovery(ServiceDiscovery):
         if etype not in ("ADDED", "MODIFIED"):
             return
         if pod_ip and self._pod_ready(pod):
+            # Steady-state MODIFIED churn for an already-known pod at the
+            # same IP must not trigger a blocking model probe on every event
+            # (each probe serializes the whole watch stream for up to
+            # probe_timeout).  A TTL bounds model-list staleness instead:
+            # multi-model engines that load another model are picked up
+            # within probe_ttl via the periodic re-list.
+            existing = self._endpoints.get(name)
+            if (
+                existing is not None
+                and existing.url == f"http://{pod_ip}:{self.port}"
+                and time.time() - self._probe_times.get(name, 0.0) < self._probe_ttl
+            ):
+                return
             models = await self._probe_models(pod_ip)
+            self._probe_times[name] = time.time()
             if models:
                 labels = meta.get("labels", {})
                 self._add_engine(name, pod_ip, models, labels)
@@ -265,5 +300,6 @@ class K8sServiceDiscovery(ServiceDiscovery):
         )
 
     def _delete_engine(self, name: str) -> None:
+        self._probe_times.pop(name, None)
         if self._endpoints.pop(name, None) is not None:
             logger.info("Engine pod %s removed", name)

@@ -1,0 +1,104 @@
+"""OpenAI Batch API endpoints.
+
+Reference counterpart: src/vllm_router/routers/batches_router.py:10-100.
+Cancellation is exposed both as DELETE /v1/batches/{id} (the reference's
+route) and POST /v1/batches/{id}/cancel (the actual OpenAI route).
+"""
+
+from __future__ import annotations
+
+from aiohttp import web
+
+from production_stack_tpu.router.services.batch_service import BATCH_PROCESSOR
+from production_stack_tpu.router.services.files_service import FILE_STORAGE
+
+routes = web.RouteTableDef()
+
+
+def _processor(request: web.Request):
+    processor = request.app["registry"].get(BATCH_PROCESSOR)
+    if processor is None:
+        raise web.HTTPServiceUnavailable(
+            text='{"error": "batch processor not initialized (--enable-batch-api)"}',
+            content_type="application/json",
+        )
+    return processor
+
+
+@routes.post("/v1/batches")
+async def create_batch(request: web.Request) -> web.Response:
+    try:
+        body = await request.json()
+    except Exception:
+        return web.json_response({"error": "invalid JSON body"}, status=400)
+    for field in ("input_file_id", "endpoint"):
+        if field not in body:
+            return web.json_response(
+                {"error": f"Missing required parameter '{field}'"}, status=400
+            )
+    file_id = body["input_file_id"]
+    storage = request.app["registry"].get(FILE_STORAGE)
+    try:
+        await storage.get_file(file_id)
+    except FileNotFoundError:
+        return web.json_response(
+            {"error": f"File {file_id} not found"}, status=404
+        )
+    try:
+        info = await _processor(request).create_batch(
+            input_file_id=file_id,
+            endpoint=body["endpoint"],
+            completion_window=body.get("completion_window", "24h"),
+            metadata=body.get("metadata"),
+        )
+    except ValueError as e:
+        return web.json_response({"error": str(e)}, status=400)
+    return web.json_response(info.to_dict())
+
+
+@routes.get("/v1/batches")
+async def list_batches(request: web.Request) -> web.Response:
+    try:
+        limit = int(request.query.get("limit", "20"))
+    except ValueError:
+        return web.json_response({"error": "limit must be an integer"}, status=400)
+    # OpenAI clamps to 1..100; also keeps SQLite's LIMIT -1 (= unlimited)
+    # and the has_more=true-on-empty-page degenerate cases out.
+    limit = max(1, min(limit, 100))
+    after = request.query.get("after")
+    batches = await _processor(request).list_batches(limit=limit, after=after)
+    data = [b.to_dict() for b in batches]
+    return web.json_response({
+        "object": "list",
+        "data": data,
+        "first_id": data[0]["id"] if data else None,
+        "last_id": data[-1]["id"] if data else None,
+        "has_more": len(data) == limit,
+    })
+
+
+@routes.get("/v1/batches/{batch_id}")
+async def get_batch(request: web.Request) -> web.Response:
+    batch_id = request.match_info["batch_id"]
+    try:
+        info = await _processor(request).retrieve_batch(batch_id)
+    except FileNotFoundError:
+        return web.json_response(
+            {"error": f"Batch {batch_id} not found"}, status=404
+        )
+    return web.json_response(info.to_dict())
+
+
+async def _cancel(request: web.Request) -> web.Response:
+    batch_id = request.match_info["batch_id"]
+    try:
+        info = await _processor(request).cancel_batch(batch_id)
+    except FileNotFoundError:
+        return web.json_response(
+            {"error": f"Batch {batch_id} not found"}, status=404
+        )
+    return web.json_response(info.to_dict())
+
+
+routes.delete("/v1/batches/{batch_id}")(_cancel)
+routes.post("/v1/batches/{batch_id}/cancel")(_cancel)

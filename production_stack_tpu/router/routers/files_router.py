@@ -1,0 +1,94 @@
+"""OpenAI Files API endpoints.
+
+Reference counterpart: src/vllm_router/routers/files_router.py:10-68.
+Additions over the reference: GET /v1/files (list) and DELETE
+/v1/files/{file_id} — both part of the OpenAI surface, declared by the
+reference's Storage ABC but never wired to routes.
+"""
+
+from __future__ import annotations
+
+from aiohttp import web
+
+from production_stack_tpu.router.services.files_service import FILE_STORAGE
+
+routes = web.RouteTableDef()
+
+
+def _storage(request: web.Request):
+    storage = request.app["registry"].get(FILE_STORAGE)
+    if storage is None:
+        raise web.HTTPServiceUnavailable(
+            text='{"error": "file storage not initialized (--enable-batch-api)"}',
+            content_type="application/json",
+        )
+    return storage
+
+
+@routes.post("/v1/files")
+async def upload_file(request: web.Request) -> web.Response:
+    """Multipart upload with `file` + `purpose` fields
+    (reference files_router.py:11-42)."""
+    form = await request.post()
+    if "file" not in form:
+        return web.json_response(
+            {"error": "Missing required parameter 'file'"}, status=400
+        )
+    field = form["file"]
+    if not isinstance(field, web.FileField):
+        return web.json_response(
+            {"error": "'file' must be a file upload"}, status=400
+        )
+    purpose = str(form.get("purpose", "unknown"))
+    content = field.file.read()
+    try:
+        info = await _storage(request).save_file(
+            file_name=field.filename, content=content, purpose=purpose
+        )
+    except ValueError as e:
+        return web.json_response({"error": str(e)}, status=400)
+    return web.json_response(info.metadata())
+
+
+@routes.get("/v1/files")
+async def list_files(request: web.Request) -> web.Response:
+    files = await _storage(request).list_files()
+    return web.json_response(
+        {"object": "list", "data": [f.metadata() for f in files]}
+    )
+
+
+@routes.get("/v1/files/{file_id}")
+async def get_file(request: web.Request) -> web.Response:
+    file_id = request.match_info["file_id"]
+    try:
+        info = await _storage(request).get_file(file_id)
+    except FileNotFoundError:
+        return web.json_response(
+            {"error": f"File {file_id} not found"}, status=404
+        )
+    return web.json_response(info.metadata())
+
+
+@routes.get("/v1/files/{file_id}/content")
+async def get_file_content(request: web.Request) -> web.Response:
+    file_id = request.match_info["file_id"]
+    try:
+        content = await _storage(request).get_file_content(file_id)
+    except FileNotFoundError:
+        return web.json_response(
+            {"error": f"File {file_id} not found"}, status=404
+        )
+    return web.Response(body=content, content_type="application/octet-stream")
+
+
+@routes.delete("/v1/files/{file_id}")
+async def delete_file(request: web.Request) -> web.Response:
+    file_id = request.match_info["file_id"]
+    try:
+        await _storage(request).delete_file(file_id)
+    except FileNotFoundError:
+        return web.json_response(
+            {"error": f"File {file_id} not found"}, status=404
+        )
+    return web.json_response({"id": file_id, "object": "file", "deleted": True})

@@ -278,9 +278,20 @@ class LocalBatchProcessor:
     async def cancel_batch(self, batch_id: str) -> BatchInfo:
         info = await self.retrieve_batch(batch_id)
         if info.status in (BatchStatus.VALIDATING, BatchStatus.IN_PROGRESS):
-            info.status = BatchStatus.CANCELLED
-            info.cancelled_at = int(time.time())
-            await self._write_info(info)
+            # Conditional UPDATE: if the processor finished the batch between
+            # our read and this write, COMPLETED must win — a blanket
+            # REPLACE would orphan the output/error files.
+            await self._db(
+                lambda db: db.execute(
+                    "UPDATE batch_queue SET status = ?, cancelled_at = ? "
+                    "WHERE batch_id = ? AND status IN (?, ?)",
+                    (
+                        BatchStatus.CANCELLED.value, int(time.time()), batch_id,
+                        BatchStatus.VALIDATING.value, BatchStatus.IN_PROGRESS.value,
+                    ),
+                )
+            )
+            info = await self.retrieve_batch(batch_id)
         return info
 
     # -- processing --------------------------------------------------------
@@ -318,25 +329,95 @@ class LocalBatchProcessor:
         info.status = BatchStatus.IN_PROGRESS
         info.in_progress_at = int(time.time())
         info.total_requests = len(lines)
-        await self._write_info(info)
+        # Conditional transition: a cancel that landed between the poller's
+        # SELECT and this write must win (stay CANCELLED), not be overwritten
+        # back to IN_PROGRESS.
+        claimed = await self._db(
+            lambda db: db.execute(
+                "UPDATE batch_queue SET status = ?, in_progress_at = ?, "
+                "total_requests = ? WHERE batch_id = ? AND status = ?",
+                (
+                    info.status.value, info.in_progress_at, info.total_requests,
+                    info.id, BatchStatus.VALIDATING.value,
+                ),
+            ).rowcount
+        )
+        if not claimed:
+            logger.info("Batch %s no longer pending (cancelled?); skipping", info.id)
+            return
 
+        try:
+            await self._run_claimed_batch(info, lines)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            # Without this, any post-claim error wedges the batch in
+            # IN_PROGRESS forever (the poller only selects VALIDATING rows).
+            logger.exception("Batch %s failed", info.id)
+            await self._db(
+                lambda db: db.execute(
+                    "UPDATE batch_queue SET status = ?, failed_at = ? "
+                    "WHERE batch_id = ? AND status IN (?, ?)",
+                    (
+                        BatchStatus.FAILED.value, int(time.time()), info.id,
+                        BatchStatus.IN_PROGRESS.value, BatchStatus.FINALIZING.value,
+                    ),
+                )
+            )
+
+    async def _run_claimed_batch(self, info: BatchInfo, lines: List[str]) -> None:
         semaphore = asyncio.Semaphore(self.max_concurrency)
+        cancelled = asyncio.Event()
+
+        async def watch_cancel():
+            # One row read per poll interval (not per line) keeps the stop
+            # latency bounded without O(lines) sqlite hops.
+            while not cancelled.is_set():
+                current = await self.retrieve_batch(info.id)
+                if current.status == BatchStatus.CANCELLED:
+                    cancelled.set()
+                    return
+                await asyncio.sleep(self.poll_interval)
 
         async def run_line(idx: int, line: str):
             async with semaphore:
+                if cancelled.is_set():
+                    return None
                 return await self._execute_line(info, idx, line)
 
-        results = await asyncio.gather(
-            *(run_line(i, line) for i, line in enumerate(lines))
-        )
+        watcher = asyncio.create_task(watch_cancel())
+        try:
+            results = [
+                r for r in await asyncio.gather(
+                    *(run_line(i, line) for i, line in enumerate(lines))
+                )
+                if r is not None
+            ]
+        finally:
+            cancelled.set()
+            watcher.cancel()
+            try:
+                await watcher
+            except (asyncio.CancelledError, Exception):
+                # A watcher that died of e.g. a transient sqlite error must
+                # not mask the batch result.
+                pass
 
-        # Cancelled mid-flight? Leave the terminal state alone.
-        current = await self.retrieve_batch(info.id)
-        if current.status == BatchStatus.CANCELLED:
-            return
-
+        # Conditional IN_PROGRESS -> FINALIZING: a cancel landing any time
+        # after the claim must stay terminal.
         info.status = BatchStatus.FINALIZING
-        await self._write_info(info)
+        advanced = await self._db(
+            lambda db: db.execute(
+                "UPDATE batch_queue SET status = ? "
+                "WHERE batch_id = ? AND status = ?",
+                (
+                    BatchStatus.FINALIZING.value, info.id,
+                    BatchStatus.IN_PROGRESS.value,
+                ),
+            ).rowcount
+        )
+        if not advanced:
+            return
 
         outputs = [json.dumps(r) + "\n" for r in results if "response" in r]
         errors = [json.dumps(r) + "\n" for r in results if "error" in r]
@@ -358,7 +439,21 @@ class LocalBatchProcessor:
             info.error_file_id = err_file.id
         info.status = BatchStatus.COMPLETED
         info.completed_at = int(time.time())
-        await self._write_info(info)
+        # FINALIZING -> COMPLETED, again conditionally (cancel can't land in
+        # FINALIZING via cancel_batch, but stay single-writer-safe anyway).
+        await self._db(
+            lambda db: db.execute(
+                "UPDATE batch_queue SET status = ?, completed_at = ?, "
+                "output_file_id = ?, error_file_id = ?, "
+                "completed_requests = ?, failed_requests = ? "
+                "WHERE batch_id = ? AND status = ?",
+                (
+                    info.status.value, info.completed_at, info.output_file_id,
+                    info.error_file_id, info.completed_requests,
+                    info.failed_requests, info.id, BatchStatus.FINALIZING.value,
+                ),
+            )
+        )
         logger.info(
             "Batch %s done: %d ok, %d failed",
             info.id, info.completed_requests, info.failed_requests,
@@ -372,6 +467,11 @@ class LocalBatchProcessor:
             item = json.loads(line)
         except json.JSONDecodeError as e:
             return {**base, "error": {"code": "invalid_json", "message": str(e)}}
+        if not isinstance(item, dict):
+            return {**base, "error": {
+                "code": "invalid_request",
+                "message": "each batch line must be a JSON object",
+            }}
         base["custom_id"] = item.get("custom_id")
         body = item.get("body") or {}
         url_path = item.get("url") or info.endpoint

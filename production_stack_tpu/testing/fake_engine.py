@@ -42,6 +42,7 @@ class FakeEngineState:
         self.num_running = 0
         self.num_waiting = 0
         self.total_requests = 0
+        self.total_model_probes = 0  # GETs of /v1/models (discovery probes)
         self.prefix_hits = 0
         self.prefix_queries = 0
         self._rng = random.Random(seed)
@@ -83,6 +84,7 @@ def build_fake_engine_app(state: FakeEngineState | None = None) -> web.Applicati
     app["state"] = state
 
     async def models(_request: web.Request) -> web.Response:
+        state.total_model_probes += 1
         return web.json_response(
             {
                 "object": "list",

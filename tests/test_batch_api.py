@@ -1,0 +1,324 @@
+"""E2E tests for the OpenAI Files + Batches APIs.
+
+Round-2 verdict items: the routers were missing (--enable-batch-api crashed
+at startup with ModuleNotFoundError, app.py:112) and the 634-LoC services
+were unreachable dead code with zero tests.  This file drives the full
+path: multipart upload -> create batch -> lines execute through the routing
+stack against a fake engine -> output/error files retrievable.
+
+Reference surface: src/vllm_router/routers/files_router.py:10-68,
+batches_router.py:10-100.
+"""
+
+import asyncio
+import json
+
+import aiohttp
+from aiohttp.test_utils import TestClient, TestServer
+
+from production_stack_tpu.router.app import build_app
+from production_stack_tpu.router.parser import parse_args
+from production_stack_tpu.router.services.batch_service import (
+    BATCH_PROCESSOR,
+    BatchStatus,
+)
+from production_stack_tpu.testing.fake_engine import (
+    FakeEngineState,
+    build_fake_engine_app,
+)
+
+
+async def start_fake_engine(model="fake/llama-3-8b"):
+    state = FakeEngineState(model=model, tokens_per_sec=5000.0, ttft=0.001)
+    server = TestServer(build_fake_engine_app(state))
+    await server.start_server()
+    return state, server
+
+
+async def start_batch_router(backends, models, tmp_path, extra_args=()):
+    argv = [
+        "--static-backends", ",".join(backends),
+        "--static-models", ",".join(models),
+        "--engine-stats-interval", "1",
+        "--enable-batch-api",
+        "--file-storage-path", str(tmp_path),
+        *extra_args,
+    ]
+    args = parse_args(argv)
+    app = build_app(args)
+    # Fast polling so tests don't wait out the 1 s default.
+    app["registry"].require(BATCH_PROCESSOR).poll_interval = 0.05
+    server = TestServer(app)
+    await server.start_server()
+    client = TestClient(server)
+    return app, server, client
+
+
+def multipart_file(content: bytes, filename="input.jsonl", purpose="batch"):
+    form = aiohttp.FormData()
+    form.add_field("purpose", purpose)
+    form.add_field("file", content, filename=filename,
+                   content_type="application/jsonl")
+    return form
+
+
+async def wait_for_status(client, batch_id, statuses, timeout=10.0):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while True:
+        resp = await client.get(f"/v1/batches/{batch_id}")
+        body = await resp.json()
+        if body["status"] in statuses:
+            return body
+        if asyncio.get_event_loop().time() > deadline:
+            raise AssertionError(f"batch stuck in {body['status']}: {body}")
+        await asyncio.sleep(0.05)
+
+
+async def test_files_crud(tmp_path):
+    state, engine = await start_fake_engine()
+    try:
+        app, server, client = await start_batch_router(
+            [str(engine.make_url("")).rstrip("/")], ["fake/llama-3-8b"], tmp_path
+        )
+        try:
+            resp = await client.post(
+                "/v1/files", data=multipart_file(b"hello world", "greet.txt", "test")
+            )
+            assert resp.status == 200, await resp.text()
+            meta = await resp.json()
+            assert meta["filename"] == "greet.txt"
+            assert meta["purpose"] == "test"
+            assert meta["bytes"] == 11
+            file_id = meta["id"]
+
+            resp = await client.get(f"/v1/files/{file_id}")
+            assert (await resp.json())["id"] == file_id
+
+            resp = await client.get(f"/v1/files/{file_id}/content")
+            assert await resp.read() == b"hello world"
+
+            resp = await client.get("/v1/files")
+            listing = await resp.json()
+            assert file_id in {f["id"] for f in listing["data"]}
+
+            resp = await client.delete(f"/v1/files/{file_id}")
+            assert (await resp.json())["deleted"] is True
+            resp = await client.get(f"/v1/files/{file_id}")
+            assert resp.status == 404
+
+            # Missing file field -> 400; unknown id -> 404.
+            resp = await client.post("/v1/files", data={"purpose": "x"})
+            assert resp.status == 400
+            resp = await client.get("/v1/files/file-nope")
+            assert resp.status == 404
+        finally:
+            await client.close()
+    finally:
+        await engine.close()
+
+
+async def test_batch_executes_lines_against_engine(tmp_path):
+    state, engine = await start_fake_engine()
+    try:
+        app, server, client = await start_batch_router(
+            [str(engine.make_url("")).rstrip("/")], ["fake/llama-3-8b"], tmp_path
+        )
+        try:
+            lines = [
+                json.dumps({
+                    "custom_id": f"req-{i}",
+                    "method": "POST",
+                    "url": "/v1/chat/completions",
+                    "body": {
+                        "model": "fake/llama-3-8b",
+                        "messages": [{"role": "user", "content": f"line {i}"}],
+                        "max_tokens": 3,
+                    },
+                })
+                for i in range(3)
+            ]
+            # One bad line -> error file.
+            lines.append(json.dumps({
+                "custom_id": "req-bad",
+                "method": "POST",
+                "url": "/v1/chat/completions",
+                "body": {"model": "no-such-model", "messages": [], "max_tokens": 1},
+            }))
+            content = ("\n".join(lines) + "\n").encode()
+
+            resp = await client.post("/v1/files", data=multipart_file(content))
+            file_id = (await resp.json())["id"]
+
+            resp = await client.post("/v1/batches", json={
+                "input_file_id": file_id,
+                "endpoint": "/v1/chat/completions",
+                "metadata": {"suite": "e2e"},
+            })
+            assert resp.status == 200, await resp.text()
+            batch = await resp.json()
+            assert batch["status"] == "validating"
+            assert batch["metadata"] == {"suite": "e2e"}
+
+            done = await wait_for_status(client, batch["id"], {"completed"})
+            assert done["request_counts"]["total"] == 4
+            assert done["request_counts"]["completed"] == 3
+            assert done["request_counts"]["failed"] == 1
+            assert state.total_requests == 3  # bad line never reached the engine
+
+            out = await client.get(f"/v1/files/{done['output_file_id']}/content")
+            rows = [json.loads(l) for l in (await out.read()).splitlines()]
+            assert {r["custom_id"] for r in rows} == {"req-0", "req-1", "req-2"}
+            for row in rows:
+                body = row["response"]["body"]
+                assert body["choices"][0]["message"]["content"]
+
+            err = await client.get(f"/v1/files/{done['error_file_id']}/content")
+            err_rows = [json.loads(l) for l in (await err.read()).splitlines()]
+            assert err_rows[0]["custom_id"] == "req-bad"
+            assert err_rows[0]["error"]["code"] == "no_backend"
+
+            # Listing includes the batch.
+            resp = await client.get("/v1/batches")
+            listing = await resp.json()
+            assert batch["id"] in {b["id"] for b in listing["data"]}
+        finally:
+            await client.close()
+    finally:
+        await engine.close()
+
+
+async def test_batch_validation_errors(tmp_path):
+    state, engine = await start_fake_engine()
+    try:
+        app, server, client = await start_batch_router(
+            [str(engine.make_url("")).rstrip("/")], ["fake/llama-3-8b"], tmp_path
+        )
+        try:
+            resp = await client.post("/v1/batches", json={"endpoint": "/v1/chat/completions"})
+            assert resp.status == 400
+            resp = await client.post(
+                "/v1/batches",
+                json={"input_file_id": "file-nope", "endpoint": "/v1/chat/completions"},
+            )
+            assert resp.status == 404
+            # Unsupported endpoint -> 400 from the processor.
+            upload = await client.post("/v1/files", data=multipart_file(b"{}\n"))
+            file_id = (await upload.json())["id"]
+            resp = await client.post(
+                "/v1/batches", json={"input_file_id": file_id, "endpoint": "/v1/nope"}
+            )
+            assert resp.status == 400
+            resp = await client.get("/v1/batches/batch_nope")
+            assert resp.status == 404
+        finally:
+            await client.close()
+    finally:
+        await engine.close()
+
+
+async def test_batch_non_object_lines_go_to_error_file(tmp_path):
+    """Valid JSON that isn't an object (e.g. `123`) must become an error
+    row, not wedge the batch in in_progress."""
+    state, engine = await start_fake_engine()
+    try:
+        app, server, client = await start_batch_router(
+            [str(engine.make_url("")).rstrip("/")], ["fake/llama-3-8b"], tmp_path
+        )
+        try:
+            content = b'123\n"just a string"\nnot json at all\n'
+            upload = await client.post("/v1/files", data=multipart_file(content))
+            file_id = (await upload.json())["id"]
+            resp = await client.post("/v1/batches", json={
+                "input_file_id": file_id, "endpoint": "/v1/completions",
+            })
+            batch = await resp.json()
+            done = await wait_for_status(client, batch["id"], {"completed"})
+            assert done["request_counts"] == {
+                "total": 3, "completed": 0, "failed": 3,
+            }
+        finally:
+            await client.close()
+    finally:
+        await engine.close()
+
+
+async def test_batch_cancel_before_processing(tmp_path):
+    """A cancel that lands while the batch is still pending must win even
+    against the poller's claim (the conditional-UPDATE path)."""
+    state, engine = await start_fake_engine()
+    try:
+        app, server, client = await start_batch_router(
+            [str(engine.make_url("")).rstrip("/")], ["fake/llama-3-8b"], tmp_path
+        )
+        try:
+            processor = app["registry"].require(BATCH_PROCESSOR)
+            # Freeze the poller so the cancel always lands first.
+            await processor.close()
+
+            upload = await client.post("/v1/files", data=multipart_file(
+                json.dumps({"body": {"model": "fake/llama-3-8b",
+                                     "prompt": "x", "max_tokens": 1},
+                            "url": "/v1/completions"}).encode() + b"\n"
+            ))
+            file_id = (await upload.json())["id"]
+            resp = await client.post("/v1/batches", json={
+                "input_file_id": file_id, "endpoint": "/v1/completions",
+            })
+            batch = await resp.json()
+
+            resp = await client.post(f"/v1/batches/{batch['id']}/cancel")
+            assert (await resp.json())["status"] == "cancelled"
+
+            # Restart the poller: the cancelled batch must not run.
+            await processor.start()
+            await asyncio.sleep(0.3)
+            resp = await client.get(f"/v1/batches/{batch['id']}")
+            body = await resp.json()
+            assert body["status"] == "cancelled"
+            assert state.total_requests == 0
+
+            # DELETE route (reference's cancel spelling) also answers.
+            resp = await client.delete(f"/v1/batches/{batch['id']}")
+            assert (await resp.json())["status"] == "cancelled"
+        finally:
+            await client.close()
+    finally:
+        await engine.close()
+
+
+async def test_batch_db_survives_restart(tmp_path):
+    """The SQLite queue is the durability story (SURVEY section 5): a new
+    processor over the same directory sees prior batches."""
+    state, engine = await start_fake_engine()
+    try:
+        app, server, client = await start_batch_router(
+            [str(engine.make_url("")).rstrip("/")], ["fake/llama-3-8b"], tmp_path
+        )
+        try:
+            upload = await client.post("/v1/files", data=multipart_file(
+                json.dumps({"body": {"model": "fake/llama-3-8b",
+                                     "prompt": "x", "max_tokens": 1},
+                            "url": "/v1/completions"}).encode() + b"\n"
+            ))
+            file_id = (await upload.json())["id"]
+            resp = await client.post("/v1/batches", json={
+                "input_file_id": file_id, "endpoint": "/v1/completions",
+            })
+            batch_id = (await resp.json())["id"]
+            await wait_for_status(client, batch_id, {"completed"})
+        finally:
+            await client.close()
+
+        # Second router over the same storage dir.
+        app2, server2, client2 = await start_batch_router(
+            [str(engine.make_url("")).rstrip("/")], ["fake/llama-3-8b"], tmp_path
+        )
+        try:
+            resp = await client2.get(f"/v1/batches/{batch_id}")
+            body = await resp.json()
+            assert body["status"] == BatchStatus.COMPLETED.value
+            assert body["output_file_id"]
+        finally:
+            await client2.close()
+    finally:
+        await engine.close()

@@ -166,6 +166,56 @@ async def test_watch_reconnect_relists():
         await engine.close()
 
 
+async def test_watch_event_larger_than_readline_limit():
+    """A single watch event bigger than aiohttp's 64 KiB readline limit
+    (typical for pods with managedFields) must parse, not ValueError the
+    watcher into a degraded re-list loop."""
+    state, engine = await start_fake_engine(model="m-big")
+    port = engine.port
+    api = FakeK8sApi()
+    disc, api_server = await start_discovery(api, port)
+    try:
+        await api.wait_for_watcher()
+        big_pod = make_pod("pod-big", "127.0.0.1", rv="21")
+        # ~200 KiB of managedFields-style metadata on one JSON line.
+        big_pod["metadata"]["managedFields"] = [
+            {"manager": "kubelet", "fieldsV1": {"f": "x" * 1000}}
+            for _ in range(200)
+        ]
+        await api.emit("ADDED", big_pod)
+        await settle(lambda: len(disc.get_endpoint_info()) == 1)
+        assert disc.get_endpoint_info()[0].pod_name == "pod-big"
+        # The watch stream survived (no reconnect churn needed).
+        assert api.watch_queues
+    finally:
+        await disc.close()
+        await api_server.close()
+        await engine.close()
+
+
+async def test_steady_state_modified_skips_probe():
+    """MODIFIED events for an already-known ready pod at the same IP must
+    not re-probe /v1/models (a blocking probe serializes the watch)."""
+    state, engine = await start_fake_engine(model="m-mod")
+    port = engine.port
+    api = FakeK8sApi()
+    api.pods["pod-m"] = make_pod("pod-m", "127.0.0.1")
+    disc, api_server = await start_discovery(api, port)
+    try:
+        await api.wait_for_watcher()
+        assert len(disc.get_endpoint_info()) == 1
+        probes_after_list = state.total_model_probes
+        for rv in ("31", "32", "33"):
+            await api.emit("MODIFIED", make_pod("pod-m", "127.0.0.1", rv=rv))
+        await asyncio.sleep(0.2)  # let the events drain
+        assert len(disc.get_endpoint_info()) == 1
+        assert state.total_model_probes == probes_after_list
+    finally:
+        await disc.close()
+        await api_server.close()
+        await engine.close()
+
+
 async def test_probe_failure_excludes_pod():
     api = FakeK8sApi()
     # Ready pod whose engine port serves nothing.
