@@ -314,6 +314,13 @@ def main(argv=None) -> None:
     parser.add_argument("--host-offload-gb", type=float, default=0.0)
     parser.add_argument("--remote-kv-url", default=None)
     parser.add_argument("--no-prefix-caching", action="store_true")
+    parser.add_argument("--dtype", default=None, help="override preset dtype")
+    # Mesh axes (TPU-first: the reference chart only passes
+    # --tensor-parallel-size through to vLLM, deployment-vllm-multi.yaml:84-87;
+    # here dp/tp/sp are first-class — config.ParallelConfig).
+    parser.add_argument("--data-parallel", type=int, default=1)
+    parser.add_argument("--tensor-parallel", type=int, default=1)
+    parser.add_argument("--sequence-parallel", type=int, default=1)
     parser.add_argument("--log-level", default="info")
     args = parser.parse_args(argv)
 
@@ -342,6 +349,10 @@ def main(argv=None) -> None:
             "cache.host_offload_gb": args.host_offload_gb,
             "cache.remote_kv_url": args.remote_kv_url,
             "cache.enable_prefix_caching": not args.no_prefix_caching,
+            **({"model.dtype": args.dtype} if args.dtype else {}),
+            "parallel.data_parallel": args.data_parallel,
+            "parallel.tensor_parallel": args.tensor_parallel,
+            "parallel.sequence_parallel": args.sequence_parallel,
         },
     )
     engine = AsyncEngine(config)

@@ -1,0 +1,500 @@
+"""Minimal Helm-template renderer (Go text/template subset + sprig bits).
+
+There is no ``helm`` binary in the CI/TPU images, but "the chart renders
+clean" must still be testable (reference CI lints + template-renders the
+chart on every PR, .github/workflows/functionality-helm-chart.yml:25-50).
+This renderer implements exactly the template dialect used by
+``helm/templates/*.yaml`` in this repo:
+
+  actions        {{ expr }} with {{- / -}} whitespace trimming
+  pipelines      value | fn arg | fn
+  data access    .Values.a.b, $m.field, $.Release.Name, quoted strings, ints
+  control flow   if / else / end, range $var := expr
+  functions      default, quote, toYaml, nindent, indent, required,
+                 eq, ne, not, and, or, kindIs
+
+It is NOT a general Helm implementation — unsupported constructs raise so
+the chart cannot silently drift outside the tested subset.  Also usable as
+a clusterless ``helm template`` stand-in:
+
+  python -m production_stack_tpu.testing.helm_render helm \
+      [-f overrides.yaml] [--set-name release]
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = ["render_chart", "render_template", "HelmTemplateError"]
+
+
+class HelmTemplateError(Exception):
+    pass
+
+
+_TOKEN_RE = re.compile(r"\{\{(-?)\s*(.*?)\s*(-?)\}\}", re.S)
+
+
+def _tokenize(source: str):
+    """Yield ('text', str) and ('action', body, trim_left, trim_right)."""
+    pos = 0
+    for m in _TOKEN_RE.finditer(source):
+        if m.start() > pos:
+            yield ("text", source[pos : m.start()])
+        yield ("action", m.group(2), m.group(1) == "-", m.group(3) == "-")
+        pos = m.end()
+    if pos < len(source):
+        yield ("text", source[pos:])
+
+
+# -- expression parsing ----------------------------------------------------
+
+_WORD_RE = re.compile(
+    r"""
+      "(?:[^"\\]|\\.)*"      # double-quoted string
+    | `[^`]*`                # raw string
+    | \(|\)
+    | \|
+    | [^\s()|]+
+    """,
+    re.X,
+)
+
+
+def _lex_expr(expr: str) -> List[str]:
+    return _WORD_RE.findall(expr)
+
+
+class _Parser:
+    """Pratt-less recursive parser for the tiny pipeline grammar."""
+
+    def __init__(self, tokens: List[str]):
+        self.tokens = tokens
+        self.i = 0
+
+    def peek(self) -> Optional[str]:
+        return self.tokens[self.i] if self.i < len(self.tokens) else None
+
+    def next(self) -> str:
+        tok = self.tokens[self.i]
+        self.i += 1
+        return tok
+
+    def parse_pipeline(self):
+        """pipeline := command ('|' command)*  — returns nested call AST."""
+        node = self.parse_command()
+        while self.peek() == "|":
+            self.next()
+            fn = self.parse_command()
+            # value | fn a b  ==  fn a b value
+            if fn[0] != "call":
+                fn = ("call", fn, [])
+            node = ("call", fn[1], fn[2] + [node])
+        return node
+
+    def parse_command(self):
+        """command := term term*  (first term is the function if >1)."""
+        terms = [self.parse_term()]
+        while self.peek() not in (None, "|", ")"):
+            terms.append(self.parse_term())
+        if len(terms) == 1:
+            return terms[0]
+        return ("call", terms[0], terms[1:])
+
+    def parse_term(self):
+        tok = self.next()
+        if tok == "(":
+            node = self.parse_pipeline()
+            if self.next() != ")":
+                raise HelmTemplateError("expected ')'")
+            return node
+        if tok.startswith('"'):
+            return ("lit", json.loads(tok))
+        if tok.startswith("`"):
+            return ("lit", tok[1:-1])
+        if re.fullmatch(r"-?\d+", tok):
+            return ("lit", int(tok))
+        if re.fullmatch(r"-?\d+\.\d+", tok):
+            return ("lit", float(tok))
+        if tok in ("true", "false"):
+            return ("lit", tok == "true")
+        if tok in ("nil", "null"):
+            return ("lit", None)
+        if tok.startswith("$") or tok.startswith("."):
+            return ("path", tok)
+        return ("name", tok)
+
+
+def _parse_expr(expr: str):
+    parser = _Parser(_lex_expr(expr))
+    node = parser.parse_pipeline()
+    if parser.peek() is not None:
+        raise HelmTemplateError(f"trailing tokens in expression: {expr!r}")
+    return node
+
+
+# -- evaluation ------------------------------------------------------------
+
+
+def _to_yaml(value: Any, indent: int = 0) -> str:
+    """Subset YAML emitter (block style, deterministic order) matching what
+    the chart needs from sprig's toYaml."""
+    pad = " " * indent
+    if isinstance(value, dict):
+        if not value:
+            return pad + "{}"
+        lines = []
+        for key, v in value.items():
+            if isinstance(v, (dict, list)) and v:
+                lines.append(f"{pad}{key}:")
+                lines.append(_to_yaml(v, indent + 2))
+            else:
+                lines.append(f"{pad}{key}: {_scalar(v)}")
+        return "\n".join(lines)
+    if isinstance(value, list):
+        if not value:
+            return pad + "[]"
+        lines = []
+        for v in value:
+            if isinstance(v, (dict, list)) and v:
+                sub = _to_yaml(v, indent + 2)
+                # fold the first key onto the dash line
+                first, _, rest = sub.partition("\n")
+                lines.append(f"{pad}- {first.strip()}")
+                if rest:
+                    lines.append(rest)
+            else:
+                lines.append(f"{pad}- {_scalar(v)}")
+        return "\n".join(lines)
+    return pad + _scalar(value)
+
+
+_AMBIGUOUS_SCALAR_RE = re.compile(
+    # Strings that YAML would re-type as bool/null/number must stay quoted
+    # (sprig's toYaml quotes these; "2" as a label value must not become 2).
+    r"^(true|false|yes|no|on|off|null|~|"
+    r"[-+]?\d+|[-+]?\d*\.\d+([eE][-+]?\d+)?|0x[0-9a-fA-F]+)$",
+    re.I,
+)
+
+
+def _scalar(v: Any) -> str:
+    if v is None:
+        return "null"
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, (int, float)):
+        return str(v)
+    s = str(v)
+    if (
+        s == ""
+        or re.search(r"[:#{}\[\],&*?|>'\"%@`]", s)
+        or s != s.strip()
+        or _AMBIGUOUS_SCALAR_RE.match(s)
+    ):
+        return json.dumps(s)
+    return s
+
+
+def _truthy(v: Any) -> bool:
+    return bool(v) and v != 0
+
+
+class _Env:
+    def __init__(self, root: Dict[str, Any]):
+        self.root = root
+        self.vars: Dict[str, Any] = {"$": root}
+        self.dot: Any = root
+
+    def child(self) -> "_Env":
+        env = _Env(self.root)
+        env.vars = dict(self.vars)
+        env.dot = self.dot
+        return env
+
+    def lookup_path(self, path: str) -> Any:
+        if path.startswith("$"):
+            name, _, rest = path.partition(".")
+            base = self.vars.get(name)
+            if name not in self.vars:
+                raise HelmTemplateError(f"undefined variable {name}")
+            return _walk(base, rest)
+        if path == ".":
+            return self.dot
+        return _walk(self.dot, path[1:])
+
+
+def _walk(obj: Any, dotted: str) -> Any:
+    if not dotted:
+        return obj
+    for part in dotted.split("."):
+        if obj is None:
+            return None
+        if isinstance(obj, dict):
+            obj = obj.get(part)
+        else:
+            raise HelmTemplateError(
+                f"cannot access field {part!r} on {type(obj).__name__}"
+            )
+    return obj
+
+
+def _eval(node, env: _Env) -> Any:
+    kind = node[0]
+    if kind == "lit":
+        return node[1]
+    if kind == "path":
+        return env.lookup_path(node[1])
+    if kind == "name":
+        # bare function with no args, e.g. part of a pipeline
+        return _call(node[1], [], env)
+    if kind == "call":
+        fn = node[1]
+        if fn[0] == "name":
+            args = [_eval(a, env) for a in node[2]]
+            return _call(fn[1], args, env)
+        if not node[2]:
+            return _eval(fn, env)
+        raise HelmTemplateError(f"cannot call non-function {fn!r}")
+    raise HelmTemplateError(f"bad AST node {node!r}")
+
+
+def _call(name: str, args: List[Any], env: _Env) -> Any:
+    if name == "default":
+        return args[1] if len(args) > 1 and _truthy(args[1]) else args[0]
+    if name == "quote":
+        v = args[0]
+        if isinstance(v, bool):
+            return '"true"' if v else '"false"'
+        return json.dumps("" if v is None else str(v))
+    if name == "toYaml":
+        return _to_yaml(args[0])
+    if name == "indent":
+        n, text = args[0], str(args[1])
+        pad = " " * int(n)
+        return "\n".join(pad + line for line in text.splitlines())
+    if name == "nindent":
+        n, text = args[0], str(args[1])
+        return "\n" + _call("indent", [n, text], env)
+    if name == "required":
+        msg, v = args[0], args[1]
+        if v is None or v == "":
+            raise HelmTemplateError(f"required value missing: {msg}")
+        return v
+    if name == "eq":
+        return args[0] == args[1]
+    if name == "ne":
+        return args[0] != args[1]
+    if name == "not":
+        return not _truthy(args[0])
+    if name == "and":
+        result = True
+        for a in args:
+            result = a
+            if not _truthy(a):
+                return a
+        return result
+    if name == "or":
+        for a in args:
+            if _truthy(a):
+                return a
+        return args[-1] if args else None
+    if name == "kindIs":
+        kind, v = args[0], args[1]
+        kinds = {
+            "string": str, "map": dict, "slice": list,
+            "bool": bool, "int": int, "float64": float,
+        }
+        if kind not in kinds:
+            # Fail loud: a typo like kindIs "str" must not silently match.
+            raise HelmTemplateError(f"unsupported kindIs kind {kind!r}")
+        if kind == "int" and isinstance(v, bool):
+            return False
+        return isinstance(v, kinds[kind])
+    if name == "hasKey":
+        return isinstance(args[0], dict) and args[1] in args[0]
+    if name == "print":
+        return "".join(str(a) for a in args)
+    raise HelmTemplateError(f"unsupported template function {name!r}")
+
+
+# -- block structure -------------------------------------------------------
+
+
+def _parse_blocks(tokens: List[tuple]) -> List[tuple]:
+    """Group the flat token stream into a tree of text/action/if/range."""
+    def parse(i: int, terminators) -> Tuple[List[tuple], int, Optional[str]]:
+        nodes: List[tuple] = []
+        while i < len(tokens):
+            tok = tokens[i]
+            if tok[0] == "text":
+                nodes.append(tok)
+                i += 1
+                continue
+            body = tok[1]
+            word = body.split(None, 1)[0] if body.strip() else ""
+            if word in terminators:
+                return nodes, i, word
+            if word == "if":
+                cond = body[2:].strip()
+                then, i, term = parse(i + 1, {"else", "end"})
+                otherwise: List[tuple] = []
+                if term == "else":
+                    else_body = tokens[i][1].split(None, 1)
+                    if len(else_body) > 1 and else_body[1].startswith("if"):
+                        # else if -> nested if inside the else branch
+                        nested_cond = else_body[1][2:].strip()
+                        inner, i, term2 = parse(i + 1, {"else", "end"})
+                        sub_else: List[tuple] = []
+                        if term2 == "else":
+                            sub_else, i, _ = parse(i + 1, {"end"})
+                        otherwise = [("if", nested_cond, inner, sub_else,
+                                      tok[2], tok[3])]
+                    else:
+                        otherwise, i, _ = parse(i + 1, {"end"})
+                nodes.append(("if", cond, then, otherwise, tok[2], tok[3]))
+                i += 1
+                continue
+            if word == "range":
+                spec = body[5:].strip()
+                inner, i, _ = parse(i + 1, {"end"})
+                nodes.append(("range", spec, inner, tok[2], tok[3]))
+                i += 1
+                continue
+            nodes.append(("action", body, tok[2], tok[3]))
+            i += 1
+        return nodes, i, None
+
+    nodes, i, _ = parse(0, set())
+    if i != len(tokens):
+        raise HelmTemplateError("unbalanced if/range/end")
+    return nodes
+
+
+def _exec_nodes(nodes: List[tuple], env: _Env, out: List[str]) -> None:
+    for node in nodes:
+        if node[0] == "text":
+            out.append(node[1])
+        elif node[0] == "action":
+            value = _eval(_parse_expr(node[1]), env)
+            out.append("" if value is None else str(value))
+        elif node[0] == "if":
+            _, cond, then, otherwise, _, _ = node
+            branch = then if _truthy(_eval(_parse_expr(cond), env)) else otherwise
+            _exec_nodes(branch, env, out)
+        elif node[0] == "range":
+            _, spec, inner, _, _ = node
+            m = re.match(r"(\$\w+)\s*:=\s*(.+)", spec)
+            if not m:
+                raise HelmTemplateError(
+                    f"only 'range $var := expr' is supported, got {spec!r}"
+                )
+            var, expr = m.group(1), m.group(2)
+            seq = _eval(_parse_expr(expr), env) or []
+            for item in seq:
+                child = env.child()
+                child.vars[var] = item
+                child.dot = item
+                _exec_nodes(inner, child, out)
+        else:
+            raise HelmTemplateError(f"bad block node {node[0]}")
+
+
+def _apply_trim(tokens: List[tuple]) -> List[tuple]:
+    """Apply {{- and -}} whitespace trimming to adjacent text tokens."""
+    out = list(tokens)
+    for idx, tok in enumerate(out):
+        if tok[0] != "action":
+            continue
+        _, body, tl, tr = tok
+        if tl and idx > 0 and out[idx - 1][0] == "text":
+            out[idx - 1] = ("text", out[idx - 1][1].rstrip(" \t").rstrip("\n"))
+        if tr and idx + 1 < len(out) and out[idx + 1][0] == "text":
+            out[idx + 1] = ("text", out[idx + 1][1].lstrip(" \t\n"))
+    return out
+
+
+def render_template(source: str, context: Dict[str, Any]) -> str:
+    tokens = _apply_trim(list(_tokenize(source)))
+    nodes = _parse_blocks(tokens)
+    env = _Env(context)
+    out: List[str] = []
+    _exec_nodes(nodes, env, out)
+    return "".join(out)
+
+
+# -- chart-level API -------------------------------------------------------
+
+
+def _deep_merge(base: Any, override: Any) -> Any:
+    if isinstance(base, dict) and isinstance(override, dict):
+        merged = dict(base)
+        for key, value in override.items():
+            merged[key] = _deep_merge(base.get(key), value)
+        return merged
+    return override
+
+
+def render_chart(
+    chart_dir: str,
+    overrides: Optional[Dict[str, Any]] = None,
+    release_name: str = "release",
+    namespace: str = "default",
+) -> Dict[str, str]:
+    """Render every template; returns {template filename: rendered text}."""
+    import os
+
+    import yaml
+
+    with open(os.path.join(chart_dir, "values.yaml")) as f:
+        values = yaml.safe_load(f) or {}
+    if overrides:
+        values = _deep_merge(values, overrides)
+    with open(os.path.join(chart_dir, "Chart.yaml")) as f:
+        chart_meta = yaml.safe_load(f)
+    context = {
+        "Values": values,
+        "Release": {"Name": release_name, "Namespace": namespace,
+                    "Service": "Helm"},
+        "Chart": chart_meta,
+    }
+    rendered = {}
+    tpl_dir = os.path.join(chart_dir, "templates")
+    for name in sorted(os.listdir(tpl_dir)):
+        if not name.endswith((".yaml", ".yml")):
+            continue
+        with open(os.path.join(tpl_dir, name)) as f:
+            rendered[name] = render_template(f.read(), context)
+    return rendered
+
+
+def main(argv=None) -> None:
+    import argparse
+
+    import yaml
+
+    parser = argparse.ArgumentParser(
+        description="Clusterless `helm template` stand-in"
+    )
+    parser.add_argument("chart_dir")
+    parser.add_argument("-f", "--values", action="append", default=[])
+    parser.add_argument("--set-name", default="release")
+    parser.add_argument("--namespace", default="default")
+    args = parser.parse_args(argv)
+
+    overrides: Dict[str, Any] = {}
+    for path in args.values:
+        with open(path) as f:
+            overrides = _deep_merge(overrides, yaml.safe_load(f) or {})
+    rendered = render_chart(
+        args.chart_dir, overrides, args.set_name, args.namespace
+    )
+    for name, text in rendered.items():
+        print(f"---\n# Source: {name}")
+        print(text)
+
+
+if __name__ == "__main__":
+    main()
