@@ -1,0 +1,25 @@
+#!/bin/bash
+# Install the observability stack for the TPU production stack.
+# Reference counterpart: observability/install.sh (kube-prom-stack +
+# prometheus-adapter).
+set -euo pipefail
+cd "$(dirname "$0")"
+
+NAMESPACE="${MONITORING_NAMESPACE:-monitoring}"
+
+helm repo add prometheus-community https://prometheus-community.github.io/helm-charts
+helm repo update
+
+helm upgrade --install kube-prom prometheus-community/kube-prometheus-stack \
+  -f kube-prom-stack.yaml -n "$NAMESPACE" --create-namespace
+
+helm upgrade --install prom-adapter prometheus-community/prometheus-adapter \
+  -f prom-adapter.yaml -n "$NAMESPACE"
+
+# Load the dashboard via the grafana sidecar (label-selected ConfigMap).
+kubectl -n "$NAMESPACE" create configmap tpu-dashboard \
+  --from-file=tpu-dashboard.json --dry-run=client -o yaml |
+  kubectl label -f - --local grafana_dashboard=1 -o yaml |
+  kubectl -n "$NAMESPACE" apply -f -
+
+echo "Done. Grafana: kubectl -n $NAMESPACE port-forward svc/kube-prom-grafana 3000:80"

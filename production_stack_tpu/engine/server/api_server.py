@@ -114,12 +114,7 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
             ("tpu:total_finished_requests", s["total_finished"]),
             ("tpu:num_preemptions", s["num_preemptions"]),
         ]
-        lines = []
-        for name, value in pairs:
-            kind = "counter" if name.startswith("tpu:total") else "gauge"
-            lines.append(f"# TYPE {name} {kind}")
-            lines.append(f"{name} {float(value)}")
-        return web.Response(text="\n".join(lines) + "\n")
+        return web.Response(text=vocab.render_prometheus(pairs))
 
     async def chat_completions(request: web.Request) -> web.StreamResponse:
         return await _serve_completion(request, chat=True)

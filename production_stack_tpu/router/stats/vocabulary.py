@@ -59,3 +59,23 @@ TPU_DUTY_CYCLE = "tpu:duty_cycle"
 # The custom metric the prometheus-adapter exposes for HPA (reference:
 # observability/prom-adapter.yaml:8-20 exposes vllm:num_requests_waiting).
 HPA_QUEUE_METRIC = TPU_NUM_REQUESTS_WAITING
+
+# Engine counters (monotonic; everything else above is a gauge).
+TPU_COUNTERS = frozenset({
+    "tpu:total_prompt_tokens",
+    "tpu:total_generated_tokens",
+    "tpu:total_finished_requests",
+    "tpu:num_preemptions",
+})
+
+
+def render_prometheus(pairs) -> str:
+    """Serialize (name, value) pairs in Prometheus text format with TYPE
+    lines.  Shared by the real engine server and the fake engine so the
+    observability contract cannot silently diverge between them."""
+    lines = []
+    for name, value in pairs:
+        kind = "counter" if name in TPU_COUNTERS else "gauge"
+        lines.append(f"# TYPE {name} {kind}")
+        lines.append(f"{name} {float(value)}")
+    return "\n".join(lines) + "\n"

@@ -43,6 +43,9 @@ class FakeEngineState:
         self.num_waiting = 0
         self.total_requests = 0
         self.total_model_probes = 0  # GETs of /v1/models (discovery probes)
+        self.total_prompt_tokens = 0
+        self.total_generated_tokens = 0  # bumped per emitted token
+        self.total_finished = 0  # bumped at completion (real-engine semantics)
         self.prefix_hits = 0
         self.prefix_queries = 0
         self._rng = random.Random(seed)
@@ -103,18 +106,22 @@ def build_fake_engine_app(state: FakeEngineState | None = None) -> web.Applicati
         return web.json_response({"status": "ok"})
 
     async def metrics(_request: web.Request) -> web.Response:
-        lines = []
-        for name, value in [
+        # Same serializer + same names as the real engine server
+        # (engine/server/api_server.py) so the observability contract is
+        # identical against fake and real engines.
+        text = vocab.render_prometheus([
             (vocab.TPU_NUM_REQUESTS_RUNNING, state.num_running),
             (vocab.TPU_NUM_REQUESTS_WAITING, state.num_waiting),
             (vocab.TPU_HBM_KV_USAGE_PERC, state.kv_usage),
             (vocab.TPU_PREFIX_CACHE_HIT_RATE, state.prefix_hit_rate),
             (vocab.TPU_HOST_KV_USAGE_PERC, 0.0),
             (vocab.TPU_DUTY_CYCLE, min(1.0, state.num_running * 0.1)),
-        ]:
-            lines.append(f"# TYPE {name} gauge")
-            lines.append(f"{name} {float(value)}")
-        return web.Response(text="\n".join(lines) + "\n")
+            ("tpu:total_prompt_tokens", state.total_prompt_tokens),
+            ("tpu:total_generated_tokens", state.total_generated_tokens),
+            ("tpu:total_finished_requests", state.total_finished),
+            ("tpu:num_preemptions", 0),
+        ])
+        return web.Response(text=text)
 
     async def chat_completions(request: web.Request) -> web.StreamResponse:
         return await _completion_common(request, chat=True)
@@ -139,6 +146,7 @@ def build_fake_engine_app(state: FakeEngineState | None = None) -> web.Applicati
         created = int(time.time())
         state.total_requests += 1
         state.num_running += 1
+        state.total_prompt_tokens += max(1, len(prompt_text) // 4)
         try:
             await asyncio.sleep(state.ttft)
             interval = 1.0 / state.tokens_per_sec
@@ -171,7 +179,9 @@ def build_fake_engine_app(state: FakeEngineState | None = None) -> web.Applicati
                             }
                         )
                     )
+                    state.total_generated_tokens += 1
                     await asyncio.sleep(interval)
+                state.total_finished += 1
                 final_choice = (
                     {"index": 0, "delta": {}, "finish_reason": "length"}
                     if chat
@@ -198,6 +208,8 @@ def build_fake_engine_app(state: FakeEngineState | None = None) -> web.Applicati
                 return response
             await asyncio.sleep(max_tokens * interval)
             text = " ".join(_word(state._rng) for _ in range(max_tokens))
+            state.total_generated_tokens += max_tokens
+            state.total_finished += 1
             if chat:
                 choice = {
                     "index": 0,
