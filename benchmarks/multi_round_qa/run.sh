@@ -1,0 +1,45 @@
+#!/bin/bash
+# Canonical multi-round QA sweep (reference run.sh:14-85: warmup then QPS
+# sweep 0.1 -> 4.1 with 320 users x 10 rounds, 1000-tok system prompt,
+# 20000-tok history, 100-tok answers).
+#
+# usage: ./run.sh <model> <base-url> [output-prefix]
+set -euo pipefail
+cd "$(dirname "$0")"
+
+MODEL="${1:?usage: run.sh <model> <base-url> [output-prefix]}"
+BASE_URL="${2:?usage: run.sh <model> <base-url> [output-prefix]}"
+PREFIX="${3:-sweep}"
+
+NUM_USERS=320
+NUM_ROUNDS=10
+SYSTEM_PROMPT=1000
+CHAT_HISTORY=20000
+ANSWER_LEN=100
+DURATION=100
+
+# Warmup: seed every user's history through the stack at high QPS
+# (reference warmup_single.sh).
+python3 multi_round_qa.py \
+  --base-url "$BASE_URL" --model "$MODEL" \
+  --num-users "$NUM_USERS" --num-rounds 2 \
+  --qps 2.0 \
+  --shared-system-prompt "$SYSTEM_PROMPT" \
+  --user-history-prompt "$CHAT_HISTORY" \
+  --answer-len "$ANSWER_LEN" \
+  --duration 60 \
+  --output /dev/null
+
+for QPS in 0.1 0.5 0.9 1.3 1.7 2.1 2.5 2.9 3.3 3.7 4.1; do
+  echo "===== QPS $QPS ====="
+  python3 multi_round_qa.py \
+    --base-url "$BASE_URL" --model "$MODEL" \
+    --num-users "$NUM_USERS" --num-rounds "$NUM_ROUNDS" \
+    --qps "$QPS" \
+    --shared-system-prompt "$SYSTEM_PROMPT" \
+    --user-history-prompt "$CHAT_HISTORY" \
+    --answer-len "$ANSWER_LEN" \
+    --seed-history-rounds 3 \
+    --duration "$DURATION" \
+    --output "${PREFIX}_qps${QPS}.csv"
+done

@@ -109,10 +109,10 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
             (vocab.TPU_PREFIX_CACHE_HIT_RATE, s["prefix_cache_hit_rate"]),
             (vocab.TPU_HOST_KV_USAGE_PERC, s["host_kv_usage_perc"]),
             (vocab.TPU_DUTY_CYCLE, s["duty_cycle"]),
-            ("tpu:total_prompt_tokens", s["total_prompt_tokens"]),
-            ("tpu:total_generated_tokens", s["total_generated_tokens"]),
-            ("tpu:total_finished_requests", s["total_finished"]),
-            ("tpu:num_preemptions", s["num_preemptions"]),
+            (vocab.TPU_TOTAL_PROMPT_TOKENS, s["total_prompt_tokens"]),
+            (vocab.TPU_TOTAL_GENERATED_TOKENS, s["total_generated_tokens"]),
+            (vocab.TPU_TOTAL_FINISHED_REQUESTS, s["total_finished"]),
+            (vocab.TPU_NUM_PREEMPTIONS, s["num_preemptions"]),
         ]
         return web.Response(text=vocab.render_prometheus(pairs))
 

@@ -61,11 +61,15 @@ TPU_DUTY_CYCLE = "tpu:duty_cycle"
 HPA_QUEUE_METRIC = TPU_NUM_REQUESTS_WAITING
 
 # Engine counters (monotonic; everything else above is a gauge).
+TPU_TOTAL_PROMPT_TOKENS = "tpu:total_prompt_tokens"
+TPU_TOTAL_GENERATED_TOKENS = "tpu:total_generated_tokens"
+TPU_TOTAL_FINISHED_REQUESTS = "tpu:total_finished_requests"
+TPU_NUM_PREEMPTIONS = "tpu:num_preemptions"
 TPU_COUNTERS = frozenset({
-    "tpu:total_prompt_tokens",
-    "tpu:total_generated_tokens",
-    "tpu:total_finished_requests",
-    "tpu:num_preemptions",
+    TPU_TOTAL_PROMPT_TOKENS,
+    TPU_TOTAL_GENERATED_TOKENS,
+    TPU_TOTAL_FINISHED_REQUESTS,
+    TPU_NUM_PREEMPTIONS,
 })
 
 

@@ -116,10 +116,10 @@ def build_fake_engine_app(state: FakeEngineState | None = None) -> web.Applicati
             (vocab.TPU_PREFIX_CACHE_HIT_RATE, state.prefix_hit_rate),
             (vocab.TPU_HOST_KV_USAGE_PERC, 0.0),
             (vocab.TPU_DUTY_CYCLE, min(1.0, state.num_running * 0.1)),
-            ("tpu:total_prompt_tokens", state.total_prompt_tokens),
-            ("tpu:total_generated_tokens", state.total_generated_tokens),
-            ("tpu:total_finished_requests", state.total_finished),
-            ("tpu:num_preemptions", 0),
+            (vocab.TPU_TOTAL_PROMPT_TOKENS, state.total_prompt_tokens),
+            (vocab.TPU_TOTAL_GENERATED_TOKENS, state.total_generated_tokens),
+            (vocab.TPU_TOTAL_FINISHED_REQUESTS, state.total_finished),
+            (vocab.TPU_NUM_PREEMPTIONS, 0),
         ])
         return web.Response(text=text)
 

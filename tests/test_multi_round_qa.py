@@ -1,0 +1,117 @@
+"""Multi-round-QA harness driven against the in-process router + fake
+engines — the clusterless CI variant of the canonical workload
+(SURVEY.md section 7 minimum slice; reference router-e2e-test.yml:63-87).
+"""
+
+import os
+import sys
+
+sys.path.insert(
+    0,
+    os.path.join(os.path.dirname(__file__), "..", "benchmarks", "multi_round_qa"),
+)
+
+from multi_round_qa import (  # noqa: E402
+    RequestRecord,
+    WorkloadConfig,
+    run_benchmark,
+    summarize,
+    write_csv,
+)
+
+from tests.test_router_e2e import start_fake_engine, start_router  # noqa: E402
+
+
+async def test_harness_end_to_end(tmp_path):
+    s1, e1 = await start_fake_engine(tokens_per_sec=3000.0, ttft=0.002)
+    s2, e2 = await start_fake_engine(tokens_per_sec=3000.0, ttft=0.002)
+    try:
+        app, server, client = await start_router(
+            [str(e1.make_url("")).rstrip("/"), str(e2.make_url("")).rstrip("/")],
+            ["fake/llama-3-8b", "fake/llama-3-8b"],
+            extra_args=["--routing-logic", "session", "--session-key", "x-user-id"],
+        )
+        try:
+            config = WorkloadConfig(
+                base_url=str(server.make_url("")).rstrip("/"),
+                model="fake/llama-3-8b",
+                num_users=4,
+                num_rounds=3,
+                qps=50.0,  # effectively unpaced: the test should be fast
+                system_prompt_len=50,
+                user_info_len=20,
+                answer_len=5,
+            )
+            result = await run_benchmark(config)
+            summary = result["summary"]
+            records = result["records"]
+
+            assert summary["requests_finished"] == 4 * 3
+            assert summary["requests_failed"] == 0
+            assert summary["ttft_p50_s"] > 0
+            assert summary["ttft_p99_s"] >= summary["ttft_p50_s"]
+            assert summary["output_tokens_per_s"] > 0
+            # KV hit rate scraped from the live router mirror.
+            assert "kv_hit_rate" in summary
+
+            # Session affinity: each user stuck to one engine, and the
+            # multi-round history grew (round 3 prompt > round 1 prompt).
+            assert s1.total_requests + s2.total_requests == 12
+            per_user = {}
+            for r in records:
+                per_user.setdefault(r.user_id, []).append(r)
+            for user_records in per_user.values():
+                by_round = sorted(user_records, key=lambda r: r.round_id)
+                assert by_round[-1].prompt_tokens > by_round[0].prompt_tokens
+
+            csv_path = str(tmp_path / "out.csv")
+            write_csv(records, csv_path)
+            with open(csv_path) as f:
+                lines = f.read().splitlines()
+            assert len(lines) == 1 + 12
+            assert lines[0].startswith("user_id,round_id")
+        finally:
+            await client.close()
+    finally:
+        await e1.close()
+        await e2.close()
+
+
+async def test_harness_survives_backend_errors():
+    """Failed rounds are recorded as errors, retract the user turn, and
+    don't poison the summary."""
+    app, server, client = await start_router(
+        ["http://127.0.0.1:1"], ["fake/llama-3-8b"]
+    )
+    try:
+        config = WorkloadConfig(
+            base_url=str(server.make_url("")).rstrip("/"),
+            model="fake/llama-3-8b",
+            num_users=2, num_rounds=2, qps=100.0,
+            system_prompt_len=5, user_info_len=5, answer_len=2,
+            request_timeout=5.0,
+        )
+        result = await run_benchmark(config)
+        summary = result["summary"]
+        assert summary["requests_finished"] == 0
+        assert summary["requests_failed"] == 4
+        assert all(r.error for r in result["records"])
+    finally:
+        await client.close()
+
+
+def test_summarize_percentiles():
+    records = [
+        RequestRecord(
+            user_id=1, round_id=i, launch_time=0, finish_time=1,
+            ttft=0.1 * i, generation_time=1.0,
+            prompt_tokens=100, generation_tokens=10,
+        )
+        for i in range(1, 11)
+    ]
+    summary = summarize(records, wall_time=10.0, kv_hit_rate=0.5)
+    assert summary["ttft_p50_s"] == 0.5
+    assert summary["ttft_p99_s"] == 1.0
+    assert summary["finished_qps"] == 1.0
+    assert summary["output_tokens_per_s"] == 10.0
+    assert summary["kv_hit_rate"] == 0.5
