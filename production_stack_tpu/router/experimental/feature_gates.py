@@ -1,0 +1,127 @@
+"""K8s-style feature gates for the experimental tier.
+
+Reference counterpart: src/vllm_router/experimental/feature_gates.py:50-142
+(gate names :14-15, env parsing :114-142).  Differences: an explicit
+FeatureGates object carried in the service registry instead of a singleton
+metaclass (SURVEY.md section 7 "Hot-reconfig correctness"), and strict
+parsing — a malformed gate string fails startup instead of being silently
+dropped.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import logging
+import os
+from typing import Dict, Optional, Set
+
+logger = logging.getLogger(__name__)
+
+FEATURE_GATES = "feature_gates"
+
+SEMANTIC_CACHE = "SemanticCache"
+PII_DETECTION = "PIIDetection"
+
+ENV_VAR = "PSTPU_FEATURE_GATES"
+
+
+class FeatureStage(enum.Enum):
+    ALPHA = "Alpha"
+    BETA = "Beta"
+    GA = "GA"
+
+
+@dataclasses.dataclass(frozen=True)
+class Feature:
+    name: str
+    description: str
+    stage: FeatureStage
+    default_enabled: bool = False
+
+
+KNOWN_FEATURES: Dict[str, Feature] = {
+    feature.name: feature
+    for feature in [
+        Feature(
+            SEMANTIC_CACHE,
+            "Similarity cache serving repeated chat completions without "
+            "touching a backend",
+            FeatureStage.ALPHA,
+        ),
+        Feature(
+            PII_DETECTION,
+            "Scan request bodies for PII and reject matches",
+            FeatureStage.ALPHA,
+        ),
+    ]
+}
+
+
+class FeatureGates:
+    def __init__(self):
+        self._enabled: Set[str] = {
+            f.name for f in KNOWN_FEATURES.values() if f.default_enabled
+        }
+
+    def enable(self, name: str) -> None:
+        self._enabled.add(name)
+
+    def disable(self, name: str) -> None:
+        self._enabled.discard(name)
+
+    def is_enabled(self, name: str) -> bool:
+        return name in self._enabled
+
+    def enabled_features(self) -> Set[str]:
+        return set(self._enabled)
+
+    def configure(self, gates: Dict[str, bool]) -> None:
+        for name, on in gates.items():
+            if on:
+                self.enable(name)
+            else:
+                self.disable(name)
+
+
+def parse_gates(spec: str) -> Dict[str, bool]:
+    """Parse ``Feature=true,Other=false``; unknown names or malformed
+    entries raise (the reference logs-and-continues, which hides typos)."""
+    gates: Dict[str, bool] = {}
+    for item in spec.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        if "=" not in item:
+            raise ValueError(
+                f"Malformed feature gate {item!r} (expected Name=true|false)"
+            )
+        name, _, value = item.partition("=")
+        name = name.strip()
+        value = value.strip().lower()
+        if name not in KNOWN_FEATURES:
+            raise ValueError(
+                f"Unknown feature gate {name!r}; known: {sorted(KNOWN_FEATURES)}"
+            )
+        if value not in ("true", "false"):
+            raise ValueError(
+                f"Feature gate {name} has non-boolean value {value!r}"
+            )
+        gates[name] = value == "true"
+    return gates
+
+
+def initialize_feature_gates(spec: Optional[str] = None) -> FeatureGates:
+    """Build gates from the env var then the CLI spec (CLI wins)."""
+    gates = FeatureGates()
+    env_spec = os.environ.get(ENV_VAR)
+    if env_spec:
+        gates.configure(parse_gates(env_spec))
+    if spec:
+        gates.configure(parse_gates(spec))
+    enabled = sorted(gates.enabled_features())
+    if enabled:
+        logger.info("Enabled experimental features: %s", ", ".join(enabled))
+    else:
+        logger.info("No experimental features enabled")
+    return gates

@@ -1,0 +1,177 @@
+"""Semantic response cache for chat completions.
+
+Reference counterpart: src/vllm_router/experimental/semantic_cache*/ —
+SentenceTransformer embeddings (semantic_cache.py:60-75) + FAISS
+IndexFlatIP with pickle persistence (db_adapters/faiss_adapter.py:38-69)
+behind optional extras.  Neither sentence-transformers nor faiss ships on
+TPU images, and model downloads need egress the cluster may not have — so
+the default embedding here is a dependency-free hashed bag-of-ngrams
+(cosine over a fixed-dimension float vector), with the same cache
+semantics: threshold similarity search over (model, last-user-message)
+keys, exact-match fast path, JSON-lines persistence.
+
+numpy is the only dependency (always present: jax depends on it).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import re
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+SEMANTIC_CACHE_SERVICE = "semantic_cache"
+
+_WORD_RE = re.compile(r"[a-z0-9']+")
+
+
+def _embed_hashed_ngrams(text: str, dim: int = 512) -> np.ndarray:
+    """Deterministic embedding: hashed unigrams + bigrams, L2-normalized.
+    Not a neural embedding — but monotone in lexical overlap, which is the
+    property the cache needs (near-duplicate questions hit, novel ones
+    miss)."""
+    vec = np.zeros(dim, np.float32)
+    words = _WORD_RE.findall(text.lower())
+    grams = words + [f"{a}_{b}" for a, b in zip(words, words[1:])]
+    for gram in grams:
+        digest = hashlib.blake2b(gram.encode(), digest_size=8).digest()
+        idx = int.from_bytes(digest[:4], "little") % dim
+        sign = 1.0 if digest[4] & 1 else -1.0
+        vec[idx] += sign
+    norm = float(np.linalg.norm(vec))
+    if norm > 0:
+        vec /= norm
+    return vec
+
+
+class SemanticCache:
+    """Threshold-similarity cache of non-streaming chat completions."""
+
+    def __init__(
+        self,
+        threshold: float = 0.95,
+        max_entries: int = 2048,
+        cache_dir: Optional[str] = None,
+        dim: int = 512,
+    ):
+        self.threshold = threshold
+        self.max_entries = max_entries
+        self.cache_dir = cache_dir
+        self.dim = dim
+        self.hits = 0
+        self.misses = 0
+        self._lock = threading.Lock()
+        # Per-model stores: list of (vector, key_text, response_bytes).
+        self._entries: Dict[str, List[Tuple[np.ndarray, str, bytes]]] = {}
+        self._exact: Dict[Tuple[str, str], bytes] = {}
+        if cache_dir:
+            os.makedirs(cache_dir, exist_ok=True)
+            self._load()
+
+    # -- persistence (JSON lines; the reference pickles a FAISS index) -----
+
+    def _store_path(self) -> str:
+        return os.path.join(self.cache_dir, "semantic_cache.jsonl")
+
+    def _load(self) -> None:
+        path = self._store_path()
+        if not os.path.exists(path):
+            return
+        count = 0
+        with open(path) as f:
+            for line in f:
+                try:
+                    row = json.loads(line)
+                    self._insert(
+                        row["model"], row["key"],
+                        row["response"].encode(), persist=False,
+                    )
+                    count += 1
+                except (KeyError, ValueError):
+                    continue
+        logger.info("Semantic cache: loaded %d entries from %s", count, path)
+
+    def _persist(self, model: str, key: str, response: bytes) -> None:
+        if not self.cache_dir:
+            return
+        with open(self._store_path(), "a") as f:
+            f.write(json.dumps({
+                "model": model, "key": key,
+                "response": response.decode("utf-8", "replace"),
+            }) + "\n")
+
+    # -- core --------------------------------------------------------------
+
+    @staticmethod
+    def request_key(body: Dict[str, Any]) -> Optional[str]:
+        """Cache key: the conversation's user messages (the reference keys
+        on the last user message only, semantic_cache.py:142-156; including
+        the full user history avoids cross-conversation false hits)."""
+        messages = body.get("messages")
+        if not isinstance(messages, list) or not messages:
+            return None
+        user_parts = [
+            str(m.get("content", ""))
+            for m in messages
+            if isinstance(m, dict) and m.get("role") == "user"
+        ]
+        if not user_parts:
+            return None
+        return "\n".join(user_parts)
+
+    def lookup(self, model: str, key: str) -> Optional[bytes]:
+        with self._lock:
+            exact = self._exact.get((model, key))
+            if exact is not None:
+                self.hits += 1
+                return exact
+            entries = self._entries.get(model)
+            if entries:
+                query = _embed_hashed_ngrams(key, self.dim)
+                vectors = np.stack([e[0] for e in entries])
+                sims = vectors @ query
+                best = int(np.argmax(sims))
+                if float(sims[best]) >= self.threshold:
+                    self.hits += 1
+                    return entries[best][2]
+            self.misses += 1
+            return None
+
+    def _insert(self, model: str, key: str, response: bytes,
+                persist: bool = True) -> None:
+        vec = _embed_hashed_ngrams(key, self.dim)
+        entries = self._entries.setdefault(model, [])
+        entries.append((vec, key, response))
+        self._exact[(model, key)] = response
+        if len(entries) > self.max_entries:
+            _, old_key, _ = entries.pop(0)
+            self._exact.pop((model, old_key), None)
+        if persist:
+            self._persist(model, key, response)
+
+    def store(self, model: str, key: str, response: bytes) -> None:
+        with self._lock:
+            if (model, key) in self._exact:
+                return
+            self._insert(model, key, response)
+
+    @property
+    def size(self) -> int:
+        return sum(len(v) for v in self._entries.values())
+
+    def stats(self) -> Dict[str, float]:
+        total = self.hits + self.misses
+        return {
+            "hits": self.hits,
+            "misses": self.misses,
+            "hit_ratio": self.hits / total if total else 0.0,
+            "size": self.size,
+        }
