@@ -1,0 +1,196 @@
+"""Experimental tier: feature gates wiring semantic cache + PII detection
+into the proxy path.
+
+Reference counterparts: src/vllm_router/experimental/feature_gates.py:114-142
+(gate init from flag+env), routers/main_router.py:44-51 (cache check
+pre-route), services/request_service/request.py:113-117 (cache store
+post-stream), experimental/pii/middleware.py:101-154 (PII scan-and-block).
+
+The integration point is the ``proxy_hooks`` seam in
+production_stack_tpu/router/routers/main_router.py: ``pre_route`` may
+short-circuit with a response (cache hit, PII block) and
+``post_response_hook`` supplies the background store callable the data path
+invokes after a completed proxy.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Any, Dict, Optional
+
+from aiohttp import web
+from prometheus_client import Counter, Gauge
+
+from production_stack_tpu.router.services.request_service.request import (
+    _error_response,
+)
+
+from production_stack_tpu.router.experimental.feature_gates import (
+    FEATURE_GATES,
+    PII_DETECTION,
+    SEMANTIC_CACHE,
+    FeatureGates,
+    initialize_feature_gates,
+)
+from production_stack_tpu.router.experimental.pii import (
+    create_analyzer,
+    format_types,
+    pii_requests_blocked,
+    scan_request_body,
+)
+from production_stack_tpu.router.experimental.semantic_cache import (
+    SEMANTIC_CACHE_SERVICE,
+    SemanticCache,
+)
+
+logger = logging.getLogger(__name__)
+
+# Prometheus surface (reference semantic_cache_integration.py:25-44).
+semantic_cache_hits = Counter(
+    "tpu_router:semantic_cache_hits", "Semantic cache hits served"
+)
+semantic_cache_misses = Counter(
+    "tpu_router:semantic_cache_misses", "Semantic cache lookups that missed"
+)
+semantic_cache_size = Gauge(
+    "tpu_router:semantic_cache_size", "Entries resident in the semantic cache"
+)
+
+_CHAT_PATH = "/v1/chat/completions"
+_CACHE_KEY = "semantic_cache_store_key"
+
+
+class ExperimentalProxyHooks:
+    """pre/post hooks installed as ``app['proxy_hooks']``."""
+
+    def __init__(
+        self,
+        gates: FeatureGates,
+        cache: Optional[SemanticCache],
+        pii_analyzer=None,
+    ):
+        self.gates = gates
+        self.cache = cache
+        self.pii_analyzer = pii_analyzer
+
+    async def _read_json(self, request: web.Request) -> Optional[Dict[str, Any]]:
+        # aiohttp caches the raw body, so the data path's later read() is free.
+        raw = await request.read()
+        if not raw:
+            return None
+        try:
+            body = json.loads(raw)
+        except json.JSONDecodeError:
+            return None
+        return body if isinstance(body, dict) else None
+
+    async def pre_route(
+        self, request: web.Request, path: str
+    ) -> Optional[web.StreamResponse]:
+        body = await self._read_json(request)
+
+        if self.pii_analyzer is not None:
+            # Block-on-error policy (reference middleware.py:97-98): a scan
+            # failure must fail closed, not wave the request through.
+            try:
+                detected = scan_request_body(self.pii_analyzer, body or {})
+            except Exception:
+                logger.exception("PII scan failed; blocking request")
+                pii_requests_blocked.inc()
+                return _error_response(
+                    400, "PII scan failed; request blocked by policy"
+                )
+            if detected:
+                pii_requests_blocked.inc()
+                types = format_types(detected)
+                logger.warning("Blocked request containing PII: %s", types)
+                return _error_response(
+                    400,
+                    "Request blocked: detected PII in request content "
+                    f"({', '.join(types)})",
+                )
+
+        if self.cache is not None and path == _CHAT_PATH and body is not None:
+            if not body.get("stream"):
+                model = body.get("model")
+                key = SemanticCache.request_key(body)
+                if model and key:
+                    cached = self.cache.lookup(model, key)
+                    semantic_cache_size.set(self.cache.size)
+                    if cached is not None:
+                        semantic_cache_hits.inc()
+                        return web.Response(
+                            body=cached,
+                            content_type="application/json",
+                            headers={"x-semantic-cache": "hit"},
+                        )
+                    semantic_cache_misses.inc()
+                    # Stash the key so post_response_hook stores the answer.
+                    request[_CACHE_KEY] = (model, key)
+        return None
+
+    def post_response_hook(self, request: web.Request, path: str):
+        """Return the background store callable for this request, or None
+        (reference request.py:113-117)."""
+        if self.cache is None:
+            return None
+        store_key = request.get(_CACHE_KEY)
+        if store_key is None:
+            return None
+        model, key = store_key
+        cache = self.cache
+
+        async def store(body_json: Dict[str, Any], response_bytes: bytes) -> None:
+            # Only cache well-formed completed JSON completions; SSE bodies
+            # and backend error payloads must not poison the cache.
+            try:
+                payload = json.loads(response_bytes)
+            except (ValueError, UnicodeDecodeError):
+                return
+            # Belt-and-braces on top of the status==200 gate in
+            # process_request: both OpenAI ({"error": ...}) and vLLM
+            # ({"object": "error"}) error envelopes are uncacheable.
+            if (
+                not isinstance(payload, dict)
+                or "error" in payload
+                or payload.get("object") == "error"
+            ):
+                return
+            cache.store(model, key, response_bytes)
+            semantic_cache_size.set(cache.size)
+
+        return store
+
+
+def initialize_experimental(app: web.Application, registry, args) -> None:
+    """Parse gates and install whatever they enable
+    (reference app.py:140-194)."""
+    gates = initialize_feature_gates(args.feature_gates)
+    registry.set(FEATURE_GATES, gates)
+
+    cache = None
+    if gates.is_enabled(SEMANTIC_CACHE):
+        if args.semantic_cache_model != "hash":
+            raise ValueError(
+                f"Unknown --semantic-cache-model {args.semantic_cache_model!r}; "
+                "this build ships the dependency-free 'hash' embedding"
+            )
+        cache = SemanticCache(
+            threshold=args.semantic_cache_threshold,
+            cache_dir=args.semantic_cache_dir,
+        )
+        registry.set(SEMANTIC_CACHE_SERVICE, cache)
+        logger.info(
+            "Semantic cache enabled (threshold=%.3f, dir=%s)",
+            args.semantic_cache_threshold,
+            args.semantic_cache_dir,
+        )
+
+    analyzer = None
+    if gates.is_enabled(PII_DETECTION):
+        analyzer = create_analyzer(args.pii_analyzer)
+        logger.info("PII detection enabled (analyzer=%s)", args.pii_analyzer)
+
+    if cache is not None or analyzer is not None:
+        app["proxy_hooks"] = ExperimentalProxyHooks(gates, cache, analyzer)

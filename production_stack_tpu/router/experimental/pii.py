@@ -1,0 +1,147 @@
+"""PII detection for request bodies.
+
+Reference counterpart: src/vllm_router/experimental/pii/ — PIIType taxonomy
+(types.py:22-53), regex analyzer with five pattern families
+(analyzers/regex.py:13-19), scan-and-block middleware with a block-on-error
+policy (middleware.py:97-154) and its own Prometheus counters
+(middleware.py:20-40).
+
+Differences: the reference's second analyzer (Presidio NLP) needs model
+downloads the TPU image cannot assume, so the pluggable seam keeps only the
+dependency-free regex analyzer; credit-card matches are Luhn-validated to cut
+the false-positive rate of a bare digit regex.
+"""
+
+from __future__ import annotations
+
+import enum
+import logging
+import re
+from typing import Any, Dict, Iterable, List, Set
+
+from prometheus_client import Counter
+
+logger = logging.getLogger(__name__)
+
+pii_requests_scanned = Counter(
+    "tpu_router:pii_requests_scanned",
+    "Requests scanned by the PII middleware",
+)
+pii_requests_blocked = Counter(
+    "tpu_router:pii_requests_blocked",
+    "Requests blocked because PII was detected (or scanning failed)",
+)
+pii_detections = Counter(
+    "tpu_router:pii_detections",
+    "PII entities detected in request bodies",
+    ["pii_type"],
+)
+
+
+class PIIType(enum.Enum):
+    EMAIL = "email"
+    PHONE_NUMBER = "phone_number"
+    SSN = "ssn"
+    CREDIT_CARD = "credit_card"
+    IP_ADDRESS = "ip_address"
+
+
+class RegexAnalyzer:
+    """Pattern-based analyzer (reference analyzers/regex.py:13-19)."""
+
+    name = "regex"
+
+    _PATTERNS: Dict[PIIType, re.Pattern] = {
+        PIIType.EMAIL: re.compile(
+            r"\b[a-zA-Z0-9._%+-]+@[a-zA-Z0-9.-]+\.[a-zA-Z]{2,}\b"
+        ),
+        # Separator-delimited US numbers; a bare 10-digit run is too noisy.
+        PIIType.PHONE_NUMBER: re.compile(
+            r"(?<!\d)(?:\+?1[-.\s])?\(?\d{3}\)?[-.\s]\d{3}[-.\s]\d{4}(?!\d)"
+        ),
+        PIIType.SSN: re.compile(r"(?<!\d)\d{3}-\d{2}-\d{4}(?!\d)"),
+        PIIType.CREDIT_CARD: re.compile(r"(?<!\d)(?:\d[ -]?){12,18}\d(?!\d)"),
+        PIIType.IP_ADDRESS: re.compile(
+            r"(?<!\d)(?:(?:25[0-5]|2[0-4]\d|1?\d?\d)\.){3}"
+            r"(?:25[0-5]|2[0-4]\d|1?\d?\d)(?!\d)"
+        ),
+    }
+
+    def analyze(self, text: str) -> Set[PIIType]:
+        found: Set[PIIType] = set()
+        for pii_type, pattern in self._PATTERNS.items():
+            for match in pattern.finditer(text):
+                if pii_type is PIIType.CREDIT_CARD and not _luhn_ok(match.group()):
+                    continue
+                found.add(pii_type)
+                break
+        return found
+
+
+def _luhn_ok(candidate: str) -> bool:
+    digits = [int(c) for c in candidate if c.isdigit()]
+    if not 13 <= len(digits) <= 19:
+        return False
+    checksum = 0
+    for i, d in enumerate(reversed(digits)):
+        if i % 2 == 1:
+            d *= 2
+            if d > 9:
+                d -= 9
+        checksum += d
+    return checksum % 10 == 0
+
+
+_ANALYZERS = {RegexAnalyzer.name: RegexAnalyzer}
+
+
+def create_analyzer(name: str):
+    """Factory seam (reference analyzers/factory.py:20-55)."""
+    try:
+        return _ANALYZERS[name]()
+    except KeyError:
+        raise ValueError(
+            f"Unknown PII analyzer {name!r}; available: {sorted(_ANALYZERS)}"
+        ) from None
+
+
+def extract_scannable_text(body: Dict[str, Any]) -> str:
+    """Pull user-supplied text out of an OpenAI-style request body:
+    chat ``messages[].content`` (string or content-part list), completion
+    ``prompt``, and embeddings ``input`` (reference middleware.py:101-120)."""
+    parts: List[str] = []
+
+    def _add(value: Any) -> None:
+        if isinstance(value, str):
+            parts.append(value)
+        elif isinstance(value, list):
+            for item in value:
+                if isinstance(item, str):
+                    parts.append(item)
+                elif isinstance(item, dict) and isinstance(item.get("text"), str):
+                    parts.append(item["text"])
+
+    messages = body.get("messages")
+    if isinstance(messages, list):
+        for message in messages:
+            if isinstance(message, dict):
+                _add(message.get("content"))
+    _add(body.get("prompt"))
+    _add(body.get("input"))
+    return "\n".join(parts)
+
+
+def scan_request_body(analyzer, body: Dict[str, Any]) -> Set[PIIType]:
+    """Scan one request body; counts every scan and detection."""
+    pii_requests_scanned.inc()
+    text = extract_scannable_text(body)
+    if not text:
+        return set()
+    detected = analyzer.analyze(text)
+    for pii_type in detected:
+        pii_detections.labels(pii_type=pii_type.value).inc()
+    return detected
+
+
+def format_types(detected: Iterable[PIIType]) -> List[str]:
+    return sorted(t.value for t in detected)

@@ -255,7 +255,16 @@ async def process_request(
                 "bad_gateway",
             )
 
-        if want_store and collected and body_json is not None:
+        # Only feed the store hook successful responses: backend error
+        # bodies (429/503, or vLLM's {"object": "error"} shape) must never
+        # be cached and replayed as hits.
+        if (
+            want_store
+            and collected
+            and body_json is not None
+            and response is not None
+            and response.status == 200
+        ):
             try:
                 await background(body_json, b"".join(collected))
             except Exception:
