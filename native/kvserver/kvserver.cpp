@@ -1,0 +1,409 @@
+// Shared KV cache server — single-threaded epoll event loop.
+//
+// Native counterpart of production_stack_tpu/kvserver/server.py (same wire
+// protocol, production_stack_tpu/kvserver/protocol.py; the Python server
+// stays as the CI/test fallback).  Fills the reference's standalone
+// cache-server role (helm/templates/deployment-cache-server.yaml:19-42) for
+// TPU hosts: engines offload KV snapshots HBM -> host DRAM -> this store.
+//
+// Design: one thread, level-triggered epoll, non-blocking sockets,
+// per-connection input/output buffers so partial reads/writes of multi-MB
+// KV snapshots never block the loop.  The store is an LRU map bounded by
+// --capacity-gb, evicting least-recently-used entries on overflow (same
+// semantics as the Python KVStore: GET refreshes recency, PUT of an
+// existing key replaces it).
+//
+// Wire protocol (little-endian):
+//   request:  magic u32 (0x54505543) | op u8 | key_len u16 | key
+//             [PUT only: val_len u64 | value]
+//   response: magic u32 | status u8 | val_len u64 | value
+//   ops:    1=PUT 2=GET 3=DEL 4=STAT 5=PING
+//   status: 0=OK 1=NOT_FOUND 2=ERROR
+
+#include <arpa/inet.h>
+#include <cerrno>
+#include <csignal>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fcntl.h>
+#include <list>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <string>
+#include <sys/epoll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x54505543;  // "TPUC"
+enum Op : uint8_t { OP_PUT = 1, OP_GET = 2, OP_DEL = 3, OP_STAT = 4, OP_PING = 5 };
+enum Status : uint8_t { ST_OK = 0, ST_NOT_FOUND = 1, ST_ERROR = 2 };
+
+// ---------------------------------------------------------------------------
+// LRU store
+// ---------------------------------------------------------------------------
+
+class KVStore {
+ public:
+  explicit KVStore(size_t capacity_bytes) : capacity_(capacity_bytes) {}
+
+  void Put(const std::string& key, std::string value) {
+    auto it = map_.find(key);
+    if (it != map_.end()) {
+      used_ -= it->second.value.size();
+      lru_.erase(it->second.lru_it);
+      map_.erase(it);
+    }
+    while (used_ + value.size() > capacity_ && !lru_.empty()) {
+      const std::string& victim = lru_.back();
+      auto vit = map_.find(victim);
+      used_ -= vit->second.value.size();
+      map_.erase(vit);
+      lru_.pop_back();
+    }
+    lru_.push_front(key);
+    used_ += value.size();
+    map_.emplace(key, Entry{std::move(value), lru_.begin()});
+  }
+
+  const std::string* Get(const std::string& key) {
+    auto it = map_.find(key);
+    if (it == map_.end()) {
+      ++misses_;
+      return nullptr;
+    }
+    ++hits_;
+    lru_.splice(lru_.begin(), lru_, it->second.lru_it);  // recency touch
+    return &it->second.value;
+  }
+
+  void Del(const std::string& key) {
+    auto it = map_.find(key);
+    if (it == map_.end()) return;
+    used_ -= it->second.value.size();
+    lru_.erase(it->second.lru_it);
+    map_.erase(it);
+  }
+
+  std::string StatsJson() const {
+    char buf[256];
+    snprintf(buf, sizeof(buf),
+             "{\"keys\": %zu, \"used_bytes\": %zu, \"capacity_bytes\": %zu, "
+             "\"hits\": %llu, \"misses\": %llu}",
+             map_.size(), used_, capacity_,
+             static_cast<unsigned long long>(hits_),
+             static_cast<unsigned long long>(misses_));
+    return buf;
+  }
+
+ private:
+  struct Entry {
+    std::string value;
+    std::list<std::string>::iterator lru_it;
+  };
+  size_t capacity_;
+  size_t used_ = 0;
+  uint64_t hits_ = 0, misses_ = 0;
+  std::list<std::string> lru_;  // front = most recently used
+  std::unordered_map<std::string, Entry> map_;
+};
+
+// ---------------------------------------------------------------------------
+// Connection state machine
+// ---------------------------------------------------------------------------
+
+struct Conn {
+  int fd;
+  std::vector<uint8_t> in;    // unparsed request bytes
+  std::string out;            // pending response bytes
+  size_t out_pos = 0;
+  bool closing = false;       // close once `out` drains (protocol error)
+};
+
+uint16_t ReadU16(const uint8_t* p) {
+  uint16_t v;
+  memcpy(&v, p, 2);
+  return v;
+}
+uint32_t ReadU32(const uint8_t* p) {
+  uint32_t v;
+  memcpy(&v, p, 4);
+  return v;
+}
+uint64_t ReadU64(const uint8_t* p) {
+  uint64_t v;
+  memcpy(&v, p, 8);
+  return v;
+}
+
+void AppendResponse(Conn& c, uint8_t status, const std::string* value = nullptr) {
+  uint32_t magic = kMagic;
+  uint64_t len = value ? value->size() : 0;
+  char head[13];
+  memcpy(head, &magic, 4);
+  head[4] = static_cast<char>(status);
+  memcpy(head + 5, &len, 8);
+  c.out.append(head, 13);
+  if (value) c.out.append(*value);
+}
+
+// Parse every complete frame in c.in; returns false on protocol error
+// (an ERROR response is queued and the connection marked closing).
+bool ParseFrames(Conn& c, KVStore& store) {
+  size_t pos = 0;
+  const size_t n = c.in.size();
+  while (true) {
+    if (n - pos < 7) break;
+    const uint8_t* p = c.in.data() + pos;
+    if (ReadU32(p) != kMagic) {
+      AppendResponse(c, ST_ERROR);
+      c.closing = true;
+      return false;
+    }
+    uint8_t op = p[4];
+    uint16_t key_len = ReadU16(p + 5);
+    size_t need = 7 + key_len;
+    if (op == OP_PUT) {
+      if (n - pos < need + 8) break;
+      uint64_t val_len = ReadU64(p + need);
+      // A val_len near 2^64 would wrap `need` and defeat the completeness
+      // check below (then crash on the std::string construction).  1 TiB is
+      // far beyond any KV snapshot; treat larger as a protocol error.
+      if (val_len > (1ull << 40)) {
+        AppendResponse(c, ST_ERROR);
+        c.closing = true;
+        return false;
+      }
+      need += 8 + val_len;
+    }
+    if (n - pos < need) break;
+    std::string key(reinterpret_cast<const char*>(p + 7), key_len);
+    switch (op) {
+      case OP_PUT: {
+        uint64_t val_len = ReadU64(p + 7 + key_len);
+        std::string value(reinterpret_cast<const char*>(p + 7 + key_len + 8),
+                          val_len);
+        store.Put(key, std::move(value));
+        AppendResponse(c, ST_OK);
+        break;
+      }
+      case OP_GET: {
+        const std::string* value = store.Get(key);
+        if (value == nullptr) {
+          AppendResponse(c, ST_NOT_FOUND);
+        } else {
+          AppendResponse(c, ST_OK, value);
+        }
+        break;
+      }
+      case OP_DEL:
+        store.Del(key);
+        AppendResponse(c, ST_OK);
+        break;
+      case OP_STAT: {
+        std::string stats = store.StatsJson();
+        AppendResponse(c, ST_OK, &stats);
+        break;
+      }
+      case OP_PING:
+        AppendResponse(c, ST_OK);
+        break;
+      default:
+        AppendResponse(c, ST_ERROR);
+        break;
+    }
+    pos += need;
+  }
+  if (pos > 0) c.in.erase(c.in.begin(), c.in.begin() + pos);
+  return true;
+}
+
+// ---------------------------------------------------------------------------
+// Event loop
+// ---------------------------------------------------------------------------
+
+volatile sig_atomic_t g_stop = 0;
+void OnSignal(int) { g_stop = 1; }
+
+bool SetNonBlocking(int fd) {
+  int flags = fcntl(fd, F_GETFL, 0);
+  return flags >= 0 && fcntl(fd, F_SETFL, flags | O_NONBLOCK) == 0;
+}
+
+void UpdateEpollOut(int epfd, Conn& c) {
+  epoll_event ev{};
+  ev.data.fd = c.fd;
+  ev.events =
+      EPOLLIN | (c.out.size() > c.out_pos ? static_cast<uint32_t>(EPOLLOUT) : 0u);
+  epoll_ctl(epfd, EPOLL_CTL_MOD, c.fd, &ev);
+}
+
+int RunServer(const char* host, int port, size_t capacity_bytes) {
+  signal(SIGINT, OnSignal);
+  signal(SIGTERM, OnSignal);
+  signal(SIGPIPE, SIG_IGN);
+
+  int listen_fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (listen_fd < 0) {
+    perror("socket");
+    return 1;
+  }
+  int one = 1;
+  setsockopt(listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    fprintf(stderr, "bad --host %s\n", host);
+    return 1;
+  }
+  if (bind(listen_fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0) {
+    perror("bind");
+    return 1;
+  }
+  if (listen(listen_fd, 128) < 0) {
+    perror("listen");
+    return 1;
+  }
+  SetNonBlocking(listen_fd);
+
+  socklen_t alen = sizeof(addr);
+  getsockname(listen_fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  // Machine-readable startup line: tests bind port 0 and parse this.
+  printf("LISTENING %d\n", ntohs(addr.sin_port));
+  fflush(stdout);
+
+  int epfd = epoll_create1(0);
+  epoll_event ev{};
+  ev.data.fd = listen_fd;
+  ev.events = EPOLLIN;
+  epoll_ctl(epfd, EPOLL_CTL_ADD, listen_fd, &ev);
+
+  KVStore store(capacity_bytes);
+  std::unordered_map<int, Conn> conns;
+  std::vector<epoll_event> events(256);
+  std::vector<uint8_t> rbuf(1 << 20);
+
+  while (!g_stop) {
+    int nready = epoll_wait(epfd, events.data(), events.size(), 500);
+    if (nready < 0) {
+      if (errno == EINTR) continue;
+      perror("epoll_wait");
+      break;
+    }
+    for (int i = 0; i < nready; ++i) {
+      int fd = events[i].data.fd;
+      if (fd == listen_fd) {
+        while (true) {
+          int cfd = accept(listen_fd, nullptr, nullptr);
+          if (cfd < 0) break;
+          SetNonBlocking(cfd);
+          setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+          epoll_event cev{};
+          cev.data.fd = cfd;
+          cev.events = EPOLLIN;
+          epoll_ctl(epfd, EPOLL_CTL_ADD, cfd, &cev);
+          conns[cfd].fd = cfd;
+        }
+        continue;
+      }
+      auto it = conns.find(fd);
+      if (it == conns.end()) continue;
+      Conn& c = it->second;
+      bool dead = false;
+
+      if (events[i].events & (EPOLLHUP | EPOLLERR)) dead = true;
+
+      if (!dead && (events[i].events & EPOLLIN) && !c.closing) {
+        while (true) {
+          ssize_t got = read(fd, rbuf.data(), rbuf.size());
+          if (got > 0) {
+            c.in.insert(c.in.end(), rbuf.data(), rbuf.data() + got);
+            continue;
+          }
+          if (got == 0) {
+            // Half-close: parse what we have, answer it, then close once
+            // the output drains (matches the Python server, which serves
+            // every complete frame before noticing EOF).
+            c.closing = true;
+            break;
+          }
+          if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+          dead = true;
+          break;
+        }
+        if (!dead) ParseFrames(c, store);
+      }
+
+      if (!dead && c.out.size() > c.out_pos) {
+        while (c.out.size() > c.out_pos) {
+          ssize_t sent = write(fd, c.out.data() + c.out_pos,
+                               c.out.size() - c.out_pos);
+          if (sent > 0) {
+            c.out_pos += sent;
+            continue;
+          }
+          if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+          dead = true;
+          break;
+        }
+        if (c.out_pos == c.out.size()) {
+          c.out.clear();
+          c.out_pos = 0;
+          if (c.closing) dead = true;
+        }
+      } else if (!dead && c.closing) {
+        dead = true;
+      }
+
+      if (dead) {
+        epoll_ctl(epfd, EPOLL_CTL_DEL, fd, nullptr);
+        close(fd);
+        conns.erase(it);
+      } else {
+        UpdateEpollOut(epfd, c);
+      }
+    }
+  }
+
+  for (auto& [fd, c] : conns) close(fd);
+  close(listen_fd);
+  close(epfd);
+  return 0;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  const char* host = "0.0.0.0";
+  int port = 9400;
+  double capacity_gb = 4.0;
+  for (int i = 1; i < argc; ++i) {
+    std::string arg = argv[i];
+    auto next = [&]() -> const char* {
+      if (i + 1 >= argc) {
+        fprintf(stderr, "%s needs a value\n", arg.c_str());
+        exit(2);
+      }
+      return argv[++i];
+    };
+    if (arg == "--host") {
+      host = next();
+    } else if (arg == "--port") {
+      port = atoi(next());
+    } else if (arg == "--capacity-gb") {
+      capacity_gb = atof(next());
+    } else if (arg == "--help" || arg == "-h") {
+      printf("usage: kvserver [--host H] [--port P] [--capacity-gb G]\n");
+      return 0;
+    } else {
+      fprintf(stderr, "unknown flag %s\n", arg.c_str());
+      return 2;
+    }
+  }
+  return RunServer(host, port, static_cast<size_t>(capacity_gb * (1ull << 30)));
+}

@@ -1,0 +1,184 @@
+"""Conformance tests for the native C++ epoll KV server
+(native/kvserver/kvserver.cpp) against the Python client — the same surface
+tests/test_kvserver.py drives against the Python asyncio server.
+
+The binary is built once per session via make; tests skip if no C++
+toolchain is available (e.g. a stripped CI image).
+"""
+
+import json
+import shutil
+import socket
+import struct
+import subprocess
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from production_stack_tpu.kvserver import protocol as proto
+from production_stack_tpu.kvserver.client import RemoteKVClient
+
+NATIVE_DIR = Path(__file__).resolve().parent.parent / "native" / "kvserver"
+
+
+def make_layers(num_layers=2, nb=3, bs=4, K=2, D=8, dtype=np.float32):
+    rng = np.random.default_rng(0)
+    return [
+        (
+            rng.standard_normal((nb, bs, K, D)).astype(dtype),
+            rng.standard_normal((nb, bs, K, D)).astype(dtype),
+        )
+        for _ in range(num_layers)
+    ]
+
+
+@pytest.fixture(scope="module")
+def kvserver_binary():
+    if shutil.which("g++") is None or shutil.which("make") is None:
+        pytest.skip("no C++ toolchain")
+    build = subprocess.run(
+        ["make", "-C", str(NATIVE_DIR)], capture_output=True, text=True
+    )
+    if build.returncode != 0:
+        pytest.fail(f"native kvserver build failed:\n{build.stderr}")
+    return NATIVE_DIR / "kvserver"
+
+
+@pytest.fixture()
+def native_server(kvserver_binary):
+    """Start the binary on an ephemeral port; parse the LISTENING line."""
+    proc = subprocess.Popen(
+        [str(kvserver_binary), "--host", "127.0.0.1", "--port", "0",
+         "--capacity-gb", str(1 / 1024)],  # 1 MiB, to exercise LRU eviction
+        stdout=subprocess.PIPE,
+        text=True,
+    )
+    try:
+        line = proc.stdout.readline().strip()
+        assert line.startswith("LISTENING "), f"unexpected startup line: {line!r}"
+        port = int(line.split()[1])
+        yield port
+    finally:
+        proc.terminate()
+        proc.wait(timeout=5)
+
+
+def test_native_put_get_delete_stat_ping(native_server):
+    client = RemoteKVClient(f"kv://127.0.0.1:{native_server}")
+    assert client.ping()
+
+    layers = make_layers()
+    client.put_blocks("seq-1", layers, num_tokens=9)
+    fetched = client.get_blocks("seq-1")
+    assert fetched is not None
+    got_layers, num_tokens = fetched
+    assert num_tokens == 9
+    for (k, v), (gk, gv) in zip(layers, got_layers):
+        np.testing.assert_array_equal(k, gk)
+        np.testing.assert_array_equal(v, gv)
+
+    stats = client.stat()
+    assert stats["keys"] == 1 and stats["hits"] == 1
+    assert stats["capacity_bytes"] == 1 << 20
+
+    client.delete("seq-1")
+    assert client.get_blocks("seq-1") is None
+    assert client.get_blocks("never-put") is None
+    client.close()
+
+
+def test_native_lru_eviction(native_server):
+    client = RemoteKVClient(f"kv://127.0.0.1:{native_server}")
+    big = make_layers(num_layers=4, nb=20, bs=8, K=4, D=32)  # ~640 KB encoded
+    client.put_blocks("old", big, num_tokens=1)
+    client.put_blocks("new", big, num_tokens=2)
+    assert client.get_blocks("old") is None
+    assert client.get_blocks("new") is not None
+    client.close()
+
+
+def test_native_get_refreshes_recency(native_server):
+    client = RemoteKVClient(f"kv://127.0.0.1:{native_server}")
+    mid = make_layers(num_layers=2, nb=10, bs=8, K=4, D=32)  # ~160 KB encoded
+    client.put_blocks("a", mid, num_tokens=1)
+    client.put_blocks("b", mid, num_tokens=2)
+    client.put_blocks("c", mid, num_tokens=3)
+    assert client.get_blocks("a") is not None  # touch "a": now MRU
+    big = make_layers(num_layers=4, nb=20, bs=8, K=4, D=32)
+    client.put_blocks("d", big, num_tokens=4)  # forces eviction of b then c
+    assert client.get_blocks("b") is None
+    assert client.get_blocks("a") is not None
+    client.close()
+
+
+def test_native_put_replaces_existing_key(native_server):
+    client = RemoteKVClient(f"kv://127.0.0.1:{native_server}")
+    layers = make_layers()
+    client.put_blocks("k", layers, num_tokens=5)
+    client.put_blocks("k", layers, num_tokens=7)
+    fetched = client.get_blocks("k")
+    assert fetched is not None and fetched[1] == 7
+    assert client.stat()["keys"] == 1
+    client.close()
+
+
+def test_native_pipelined_requests_one_socket(native_server):
+    """The frame parser must handle multiple requests arriving in one read
+    and requests split across reads."""
+    sock = socket.create_connection(("127.0.0.1", native_server), timeout=5)
+    try:
+        # Two PINGs + a PUT + a GET, sent as one blob.
+        value = b"x" * 1000
+        blob = (
+            proto.pack_request(proto.OP_PING, b"")
+            + proto.pack_request(proto.OP_PING, b"")
+            + proto.pack_request(proto.OP_PUT, b"pipeline", value)
+            + proto.pack_request(proto.OP_GET, b"pipeline")
+        )
+        # Dribble it in two arbitrary chunks to force a partial-frame parse.
+        sock.sendall(blob[:20])
+        sock.sendall(blob[20:])
+
+        def read_exact(n):
+            out = b""
+            while len(out) < n:
+                chunk = sock.recv(n - len(out))
+                assert chunk, "server closed early"
+                out += chunk
+            return out
+
+        for expected_status, expected_len in [
+            (proto.ST_OK, 0),
+            (proto.ST_OK, 0),
+            (proto.ST_OK, 0),
+            (proto.ST_OK, len(value)),
+        ]:
+            magic, status, val_len = struct.unpack("<IBQ", read_exact(13))
+            assert magic == proto.MAGIC
+            assert status == expected_status
+            assert val_len == expected_len
+            if val_len:
+                assert read_exact(val_len) == value
+    finally:
+        sock.close()
+
+
+def test_native_bad_magic_errors_and_closes(native_server):
+    sock = socket.create_connection(("127.0.0.1", native_server), timeout=5)
+    try:
+        sock.sendall(struct.pack("<IBH", 0xDEADBEEF, proto.OP_PING, 0))
+        head = sock.recv(13)
+        magic, status, _ = struct.unpack("<IBQ", head)
+        assert magic == proto.MAGIC and status == proto.ST_ERROR
+        assert sock.recv(1) == b""  # connection closed after protocol error
+    finally:
+        sock.close()
+
+
+def test_native_stat_json_shape(native_server):
+    client = RemoteKVClient(f"kv://127.0.0.1:{native_server}")
+    stats = client.stat()
+    assert set(stats) == {"keys", "used_bytes", "capacity_bytes", "hits", "misses"}
+    assert json.dumps(stats)  # serializable round-trip
+    client.close()
