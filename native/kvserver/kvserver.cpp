@@ -153,7 +153,7 @@ void AppendResponse(Conn& c, uint8_t status, const std::string* value = nullptr)
 
 // Parse every complete frame in c.in; returns false on protocol error
 // (an ERROR response is queued and the connection marked closing).
-bool ParseFrames(Conn& c, KVStore& store) {
+bool ParseFrames(Conn& c, KVStore& store, size_t max_value_bytes) {
   size_t pos = 0;
   const size_t n = c.in.size();
   while (true) {
@@ -170,10 +170,11 @@ bool ParseFrames(Conn& c, KVStore& store) {
     if (op == OP_PUT) {
       if (n - pos < need + 8) break;
       uint64_t val_len = ReadU64(p + need);
-      // A val_len near 2^64 would wrap `need` and defeat the completeness
-      // check below (then crash on the std::string construction).  1 TiB is
-      // far beyond any KV snapshot; treat larger as a protocol error.
-      if (val_len > (1ull << 40)) {
+      // Reject values the store could never hold: otherwise a single
+      // connection buffers the claimed length in DRAM before parsing (and
+      // a val_len near 2^64 would wrap `need`, defeating the completeness
+      // check below and crashing on the std::string construction).
+      if (val_len > max_value_bytes) {
         AppendResponse(c, ST_ERROR);
         c.closing = true;
         return false;
@@ -237,8 +238,11 @@ bool SetNonBlocking(int fd) {
 void UpdateEpollOut(int epfd, Conn& c) {
   epoll_event ev{};
   ev.data.fd = c.fd;
-  ev.events =
-      EPOLLIN | (c.out.size() > c.out_pos ? static_cast<uint32_t>(EPOLLOUT) : 0u);
+  // After a half-close the EOF keeps the fd EPOLLIN-ready forever under
+  // level triggering while the read path is skipped — keeping EPOLLIN
+  // armed would busy-spin the loop until the output drains.
+  ev.events = (c.closing ? 0u : static_cast<uint32_t>(EPOLLIN)) |
+              (c.out.size() > c.out_pos ? static_cast<uint32_t>(EPOLLOUT) : 0u);
   epoll_ctl(epfd, EPOLL_CTL_MOD, c.fd, &ev);
 }
 
@@ -336,7 +340,7 @@ int RunServer(const char* host, int port, size_t capacity_bytes) {
           dead = true;
           break;
         }
-        if (!dead) ParseFrames(c, store);
+        if (!dead) ParseFrames(c, store, capacity_bytes);
       }
 
       if (!dead && c.out.size() > c.out_pos) {

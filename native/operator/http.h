@@ -1,0 +1,191 @@
+// Thin HTTP client over libcurl's stable C ABI.
+//
+// The TPU image ships libcurl.so.4 (with OpenSSL) but not the dev headers,
+// so the handful of symbols and option codes the operator needs are declared
+// here directly; the Makefile links against the runtime .so.  Option values
+// are fixed by libcurl's ABI contract (base + offset encoding, curl.h).
+
+#pragma once
+
+#include <cstdint>
+#include <functional>
+#include <stdexcept>
+#include <string>
+
+extern "C" {
+typedef void CURL;
+struct curl_slist {
+  char* data;
+  curl_slist* next;
+};
+CURL* curl_easy_init(void);
+void curl_easy_cleanup(CURL*);
+int curl_easy_setopt(CURL*, int option, ...);
+int curl_easy_perform(CURL*);
+int curl_easy_getinfo(CURL*, int info, ...);
+const char* curl_easy_strerror(int);
+curl_slist* curl_slist_append(curl_slist*, const char*);
+void curl_slist_free_all(curl_slist*);
+}
+
+namespace http {
+
+// CURLoption encoding: long = 0+n, objectpoint = 10000+n, function = 20000+n.
+enum : int {
+  CURLOPT_WRITEDATA = 10001,
+  CURLOPT_URL = 10002,
+  CURLOPT_POSTFIELDS = 10015,
+  CURLOPT_HTTPHEADER = 10023,
+  CURLOPT_WRITEFUNCTION = 20011,
+  CURLOPT_CUSTOMREQUEST = 10036,
+  CURLOPT_POSTFIELDSIZE = 60,
+  CURLOPT_SSL_VERIFYPEER = 64,
+  CURLOPT_CAINFO = 10065,
+  CURLOPT_SSL_VERIFYHOST = 81,
+  CURLOPT_NOSIGNAL = 99,
+  CURLOPT_TIMEOUT_MS = 155,
+  CURLOPT_CONNECTTIMEOUT_MS = 156,
+  CURLOPT_NOPROGRESS = 43,
+  CURLOPT_XFERINFODATA = 10057,
+  CURLOPT_XFERINFOFUNCTION = 20219,
+  CURLINFO_RESPONSE_CODE = 0x200000 + 2,
+};
+constexpr int CURLE_OK_ = 0;
+constexpr int CURLE_WRITE_ERROR_ = 23;
+
+struct Response {
+  long status = 0;
+  std::string body;
+  bool ok() const { return status >= 200 && status < 300; }
+};
+
+// Streaming sink: return false to abort the transfer (used to tear down
+// watch streams on shutdown).
+using ChunkSink = std::function<bool(const char* data, size_t len)>;
+
+class Client {
+ public:
+  // ca_file empty => verify with system roots; "insecure" flag for tests.
+  Client(std::string token, std::string ca_file, bool insecure)
+      : token_(std::move(token)),
+        ca_file_(std::move(ca_file)),
+        insecure_(insecure) {}
+
+  Response Request(const std::string& method, const std::string& url,
+                   const std::string& body = "",
+                   const std::string& content_type = "application/json",
+                   long timeout_ms = 15000) const {
+    Response resp;
+    CURL* h = curl_easy_init();
+    if (!h) throw std::runtime_error("curl_easy_init failed");
+    curl_slist* headers = BuildHeaders(content_type);
+    curl_easy_setopt(h, CURLOPT_URL, url.c_str());
+    curl_easy_setopt(h, CURLOPT_NOSIGNAL, 1L);
+    curl_easy_setopt(h, CURLOPT_TIMEOUT_MS, timeout_ms);
+    curl_easy_setopt(h, CURLOPT_CONNECTTIMEOUT_MS, 5000L);
+    curl_easy_setopt(h, CURLOPT_HTTPHEADER, headers);
+    ApplyTls(h);
+    if (method != "GET") {
+      curl_easy_setopt(h, CURLOPT_CUSTOMREQUEST, method.c_str());
+    }
+    if (!body.empty() || method == "POST" || method == "PUT" ||
+        method == "PATCH") {
+      curl_easy_setopt(h, CURLOPT_POSTFIELDS, body.c_str());
+      curl_easy_setopt(h, CURLOPT_POSTFIELDSIZE, static_cast<long>(body.size()));
+    }
+    curl_easy_setopt(h, CURLOPT_WRITEFUNCTION, &Client::Collect);
+    curl_easy_setopt(h, CURLOPT_WRITEDATA, &resp.body);
+    int rc = curl_easy_perform(h);
+    if (rc != CURLE_OK_) {
+      curl_slist_free_all(headers);
+      curl_easy_cleanup(h);
+      throw std::runtime_error(std::string("curl: ") + curl_easy_strerror(rc));
+    }
+    curl_easy_getinfo(h, CURLINFO_RESPONSE_CODE, &resp.status);
+    curl_slist_free_all(headers);
+    curl_easy_cleanup(h);
+    return resp;
+  }
+
+  // Long-lived GET streaming chunks into `sink`; returns the HTTP status
+  // (0 if the connection failed before headers).  Returns normally when the
+  // server ends the stream, the sink aborts, or `abort_check` (polled by
+  // curl ~once per second even when no data flows) returns true — the
+  // latter is what makes shutdown prompt on an idle watch stream.
+  long Stream(const std::string& url, const ChunkSink& sink,
+              const std::function<bool()>& abort_check) const {
+    CURL* h = curl_easy_init();
+    if (!h) throw std::runtime_error("curl_easy_init failed");
+    curl_slist* headers = BuildHeaders("");
+    curl_easy_setopt(h, CURLOPT_URL, url.c_str());
+    curl_easy_setopt(h, CURLOPT_NOSIGNAL, 1L);
+    curl_easy_setopt(h, CURLOPT_CONNECTTIMEOUT_MS, 5000L);
+    curl_easy_setopt(h, CURLOPT_HTTPHEADER, headers);
+    ApplyTls(h);
+    StreamCtx ctx{&sink, &abort_check};
+    curl_easy_setopt(h, CURLOPT_WRITEFUNCTION, &Client::StreamChunk);
+    curl_easy_setopt(h, CURLOPT_WRITEDATA, &ctx);
+    curl_easy_setopt(h, CURLOPT_NOPROGRESS, 0L);
+    curl_easy_setopt(h, CURLOPT_XFERINFOFUNCTION, &Client::Progress);
+    curl_easy_setopt(h, CURLOPT_XFERINFODATA, &ctx);
+    curl_easy_perform(h);  // abort surfaces as WRITE_ERROR/ABORTED
+    long status = 0;
+    curl_easy_getinfo(h, CURLINFO_RESPONSE_CODE, &status);
+    curl_slist_free_all(headers);
+    curl_easy_cleanup(h);
+    return status;
+  }
+
+ private:
+  struct StreamCtx {
+    const ChunkSink* sink;
+    const std::function<bool()>* abort_check;
+  };
+
+  curl_slist* BuildHeaders(const std::string& content_type) const {
+    curl_slist* headers = nullptr;
+    if (!token_.empty()) {
+      headers = curl_slist_append(
+          headers, ("Authorization: Bearer " + token_).c_str());
+    }
+    if (!content_type.empty()) {
+      headers = curl_slist_append(
+          headers, ("Content-Type: " + content_type).c_str());
+    }
+    headers = curl_slist_append(headers, "Accept: application/json");
+    return headers;
+  }
+
+  void ApplyTls(CURL* h) const {
+    if (insecure_) {
+      curl_easy_setopt(h, CURLOPT_SSL_VERIFYPEER, 0L);
+      curl_easy_setopt(h, CURLOPT_SSL_VERIFYHOST, 0L);
+    } else if (!ca_file_.empty()) {
+      curl_easy_setopt(h, CURLOPT_CAINFO, ca_file_.c_str());
+    }
+  }
+
+  static size_t Collect(char* data, size_t size, size_t nmemb, void* userp) {
+    auto* out = static_cast<std::string*>(userp);
+    out->append(data, size * nmemb);
+    return size * nmemb;
+  }
+
+  static size_t StreamChunk(char* data, size_t size, size_t nmemb,
+                            void* userp) {
+    auto* ctx = static_cast<StreamCtx*>(userp);
+    if (!(*ctx->sink)(data, size * nmemb)) return 0;  // abort transfer
+    return size * nmemb;
+  }
+
+  static int Progress(void* userp, int64_t, int64_t, int64_t, int64_t) {
+    auto* ctx = static_cast<StreamCtx*>(userp);
+    return (*ctx->abort_check)() ? 1 : 0;  // nonzero aborts the transfer
+  }
+
+  std::string token_;
+  std::string ca_file_;
+  bool insecure_;
+};
+
+}  // namespace http

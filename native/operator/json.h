@@ -1,0 +1,373 @@
+// Minimal JSON value + recursive-descent parser + serializer.
+//
+// Just enough for the operator's K8s API traffic (objects, arrays, strings,
+// numbers, bools, null; UTF-8 passthrough with \uXXXX decode).  Kept
+// dependency-free: the TPU image ships no C++ JSON dev package.
+
+#pragma once
+
+#include <cctype>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <cstdio>
+#include <map>
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace minijson {
+
+class Value;
+using Object = std::map<std::string, Value>;
+using Array = std::vector<Value>;
+
+class Value {
+ public:
+  enum class Type { Null, Bool, Number, String, Array, Object };
+
+  Value() : type_(Type::Null) {}
+  Value(std::nullptr_t) : type_(Type::Null) {}
+  Value(bool b) : type_(Type::Bool), bool_(b) {}
+  Value(double d) : type_(Type::Number), num_(d) {}
+  Value(int i) : type_(Type::Number), num_(i) {}
+  Value(int64_t i) : type_(Type::Number), num_(static_cast<double>(i)) {}
+  Value(const char* s) : type_(Type::String), str_(s) {}
+  Value(std::string s) : type_(Type::String), str_(std::move(s)) {}
+  Value(Array a) : type_(Type::Array), arr_(std::move(a)) {}
+  Value(Object o) : type_(Type::Object), obj_(std::move(o)) {}
+
+  Type type() const { return type_; }
+  bool is_null() const { return type_ == Type::Null; }
+  bool is_object() const { return type_ == Type::Object; }
+  bool is_array() const { return type_ == Type::Array; }
+  bool is_string() const { return type_ == Type::String; }
+  bool is_number() const { return type_ == Type::Number; }
+  bool is_bool() const { return type_ == Type::Bool; }
+
+  bool as_bool(bool fallback = false) const {
+    return type_ == Type::Bool ? bool_ : fallback;
+  }
+  double as_number(double fallback = 0) const {
+    return type_ == Type::Number ? num_ : fallback;
+  }
+  int64_t as_int(int64_t fallback = 0) const {
+    return type_ == Type::Number ? static_cast<int64_t>(num_) : fallback;
+  }
+  const std::string& as_string() const {
+    static const std::string kEmpty;
+    return type_ == Type::String ? str_ : kEmpty;
+  }
+  const Array& as_array() const {
+    static const Array kEmpty;
+    return type_ == Type::Array ? arr_ : kEmpty;
+  }
+  const Object& as_object() const {
+    static const Object kEmpty;
+    return type_ == Type::Object ? obj_ : kEmpty;
+  }
+
+  // Mutable accessors (create-on-demand for objects).
+  Object& obj() {
+    if (type_ != Type::Object) {
+      type_ = Type::Object;
+      obj_.clear();
+    }
+    return obj_;
+  }
+  Array& arr() {
+    if (type_ != Type::Array) {
+      type_ = Type::Array;
+      arr_.clear();
+    }
+    return arr_;
+  }
+
+  // Path lookup: returns Null value when absent (never throws).
+  const Value& get(const std::string& key) const {
+    static const Value kNull;
+    if (type_ != Type::Object) return kNull;
+    auto it = obj_.find(key);
+    return it == obj_.end() ? kNull : it->second;
+  }
+  Value& set(const std::string& key, Value v) {
+    return obj()[key] = std::move(v);
+  }
+
+  bool operator==(const Value& o) const {
+    if (type_ != o.type_) return false;
+    switch (type_) {
+      case Type::Null: return true;
+      case Type::Bool: return bool_ == o.bool_;
+      case Type::Number: return num_ == o.num_;
+      case Type::String: return str_ == o.str_;
+      case Type::Array: return arr_ == o.arr_;
+      case Type::Object: return obj_ == o.obj_;
+    }
+    return false;
+  }
+  bool operator!=(const Value& o) const { return !(*this == o); }
+
+  std::string dump() const {
+    std::string out;
+    serialize(out);
+    return out;
+  }
+
+ private:
+  void serialize(std::string& out) const {
+    switch (type_) {
+      case Type::Null:
+        out += "null";
+        break;
+      case Type::Bool:
+        out += bool_ ? "true" : "false";
+        break;
+      case Type::Number: {
+        char buf[32];
+        if (std::floor(num_) == num_ && std::fabs(num_) < 1e15) {
+          snprintf(buf, sizeof(buf), "%lld", static_cast<long long>(num_));
+        } else {
+          snprintf(buf, sizeof(buf), "%.17g", num_);
+        }
+        out += buf;
+        break;
+      }
+      case Type::String:
+        escape(str_, out);
+        break;
+      case Type::Array: {
+        out += '[';
+        bool first = true;
+        for (const auto& v : arr_) {
+          if (!first) out += ',';
+          first = false;
+          v.serialize(out);
+        }
+        out += ']';
+        break;
+      }
+      case Type::Object: {
+        out += '{';
+        bool first = true;
+        for (const auto& [k, v] : obj_) {
+          if (!first) out += ',';
+          first = false;
+          escape(k, out);
+          out += ':';
+          v.serialize(out);
+        }
+        out += '}';
+        break;
+      }
+    }
+  }
+
+  static void escape(const std::string& s, std::string& out) {
+    out += '"';
+    for (unsigned char ch : s) {
+      switch (ch) {
+        case '"': out += "\\\""; break;
+        case '\\': out += "\\\\"; break;
+        case '\b': out += "\\b"; break;
+        case '\f': out += "\\f"; break;
+        case '\n': out += "\\n"; break;
+        case '\r': out += "\\r"; break;
+        case '\t': out += "\\t"; break;
+        default:
+          if (ch < 0x20) {
+            char buf[8];
+            snprintf(buf, sizeof(buf), "\\u%04x", ch);
+            out += buf;
+          } else {
+            out += static_cast<char>(ch);
+          }
+      }
+    }
+    out += '"';
+  }
+
+  Type type_;
+  bool bool_ = false;
+  double num_ = 0;
+  std::string str_;
+  Array arr_;
+  Object obj_;
+};
+
+class ParseError : public std::runtime_error {
+ public:
+  using std::runtime_error::runtime_error;
+};
+
+class Parser {
+ public:
+  explicit Parser(const std::string& text) : s_(text) {}
+
+  Value parse() {
+    Value v = value();
+    skip_ws();
+    if (pos_ != s_.size()) throw ParseError("trailing characters");
+    return v;
+  }
+
+ private:
+  void skip_ws() {
+    while (pos_ < s_.size() &&
+           (s_[pos_] == ' ' || s_[pos_] == '\t' || s_[pos_] == '\n' ||
+            s_[pos_] == '\r'))
+      ++pos_;
+  }
+  char peek() {
+    skip_ws();
+    if (pos_ >= s_.size()) throw ParseError("unexpected end of input");
+    return s_[pos_];
+  }
+  void expect(char c) {
+    if (peek() != c) throw ParseError(std::string("expected '") + c + "'");
+    ++pos_;
+  }
+
+  Value value() {
+    switch (peek()) {
+      case '{': return object();
+      case '[': return array();
+      case '"': return Value(string());
+      case 't': return literal("true", Value(true));
+      case 'f': return literal("false", Value(false));
+      case 'n': return literal("null", Value());
+      default: return number();
+    }
+  }
+
+  Value literal(const char* word, Value v) {
+    size_t len = strlen(word);
+    if (s_.compare(pos_, len, word) != 0) throw ParseError("bad literal");
+    pos_ += len;
+    return v;
+  }
+
+  Value object() {
+    expect('{');
+    Object o;
+    if (peek() == '}') {
+      ++pos_;
+      return Value(std::move(o));
+    }
+    while (true) {
+      std::string key = (peek(), string());
+      expect(':');
+      o[std::move(key)] = value();
+      char c = peek();
+      ++pos_;
+      if (c == '}') break;
+      if (c != ',') throw ParseError("expected ',' or '}'");
+    }
+    return Value(std::move(o));
+  }
+
+  Value array() {
+    expect('[');
+    Array a;
+    if (peek() == ']') {
+      ++pos_;
+      return Value(std::move(a));
+    }
+    while (true) {
+      a.push_back(value());
+      char c = peek();
+      ++pos_;
+      if (c == ']') break;
+      if (c != ',') throw ParseError("expected ',' or ']'");
+    }
+    return Value(std::move(a));
+  }
+
+  std::string string() {
+    if (s_[pos_] != '"') throw ParseError("expected string");
+    ++pos_;
+    std::string out;
+    while (true) {
+      if (pos_ >= s_.size()) throw ParseError("unterminated string");
+      char c = s_[pos_++];
+      if (c == '"') break;
+      if (c == '\\') {
+        if (pos_ >= s_.size()) throw ParseError("bad escape");
+        char e = s_[pos_++];
+        switch (e) {
+          case '"': out += '"'; break;
+          case '\\': out += '\\'; break;
+          case '/': out += '/'; break;
+          case 'b': out += '\b'; break;
+          case 'f': out += '\f'; break;
+          case 'n': out += '\n'; break;
+          case 'r': out += '\r'; break;
+          case 't': out += '\t'; break;
+          case 'u': {
+            if (pos_ + 4 > s_.size()) throw ParseError("bad \\u escape");
+            unsigned cp = static_cast<unsigned>(
+                strtoul(s_.substr(pos_, 4).c_str(), nullptr, 16));
+            pos_ += 4;
+            // Surrogate pair.
+            if (cp >= 0xD800 && cp <= 0xDBFF && pos_ + 6 <= s_.size() &&
+                s_[pos_] == '\\' && s_[pos_ + 1] == 'u') {
+              unsigned lo = static_cast<unsigned>(
+                  strtoul(s_.substr(pos_ + 2, 4).c_str(), nullptr, 16));
+              if (lo >= 0xDC00 && lo <= 0xDFFF) {
+                cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+                pos_ += 6;
+              }
+            }
+            append_utf8(cp, out);
+            break;
+          }
+          default: throw ParseError("bad escape");
+        }
+      } else {
+        out += c;
+      }
+    }
+    return out;
+  }
+
+  static void append_utf8(unsigned cp, std::string& out) {
+    if (cp < 0x80) {
+      out += static_cast<char>(cp);
+    } else if (cp < 0x800) {
+      out += static_cast<char>(0xC0 | (cp >> 6));
+      out += static_cast<char>(0x80 | (cp & 0x3F));
+    } else if (cp < 0x10000) {
+      out += static_cast<char>(0xE0 | (cp >> 12));
+      out += static_cast<char>(0x80 | ((cp >> 6) & 0x3F));
+      out += static_cast<char>(0x80 | (cp & 0x3F));
+    } else {
+      out += static_cast<char>(0xF0 | (cp >> 18));
+      out += static_cast<char>(0x80 | ((cp >> 12) & 0x3F));
+      out += static_cast<char>(0x80 | ((cp >> 6) & 0x3F));
+      out += static_cast<char>(0x80 | (cp & 0x3F));
+    }
+  }
+
+  const std::string& s_;
+  size_t pos_ = 0;
+
+  Value number() {
+    size_t start = pos_;
+    if (pos_ < s_.size() && (s_[pos_] == '-' || s_[pos_] == '+')) ++pos_;
+    bool any = false;
+    while (pos_ < s_.size() &&
+           (isdigit(static_cast<unsigned char>(s_[pos_])) || s_[pos_] == '.' ||
+            s_[pos_] == 'e' || s_[pos_] == 'E' || s_[pos_] == '-' ||
+            s_[pos_] == '+')) {
+      ++pos_;
+      any = true;
+    }
+    if (!any) throw ParseError("bad number");
+    return Value(strtod(s_.substr(start, pos_ - start).c_str(), nullptr));
+  }
+};
+
+inline Value parse(const std::string& text) { return Parser(text).parse(); }
+
+}  // namespace minijson

@@ -84,6 +84,11 @@ async def handle_client(
             key = await _recv_exact(reader, key_len) if key_len else b""
             if op == proto.OP_PUT:
                 (val_len,) = struct.unpack("<Q", await _recv_exact(reader, 8))
+                # Reject values the store could never hold before buffering
+                # them in DRAM (same guard as the C++ server).
+                if val_len > store.capacity_bytes:
+                    writer.write(proto.pack_response(proto.ST_ERROR))
+                    break
                 value = await _recv_exact(reader, val_len)
                 store.put(key, value)
                 writer.write(proto.pack_response(proto.ST_OK))

@@ -1,0 +1,229 @@
+"""Fake K8s control plane for operator tests (envtest analogue).
+
+Serves just enough of the K8s REST API for the native StaticRoute operator
+(native/operator/operator.cpp):
+
+* ``GET /apis/production-stack.tpu.dev/v1alpha1/staticroutes`` — list, plus
+  ``?watch=1`` chunked event stream.
+* ``GET/POST/PUT /api/v1/namespaces/{ns}/configmaps[/{name}]``.
+* ``PATCH .../staticroutes/{name}/status`` (merge-patch subresource).
+
+Reference counterpart: the Go controller is tested with envtest (real API
+server binaries, suite_test.go:32-61); those binaries don't exist here, so
+this plays the same role — real HTTP semantics, in-memory state.
+
+``projection_dir`` imitates the kubelet: every ConfigMap write also lands as
+files under ``{projection_dir}/{ns}/{name}/{key}`` so a router started with
+``--dynamic-config-json`` on that path sees updates the way a real pod sees
+a projected ConfigMap.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import copy
+import json
+import os
+import uuid
+from typing import Dict, List, Optional, Tuple
+
+from aiohttp import web
+
+GROUP = "production-stack.tpu.dev"
+VERSION = "v1alpha1"
+PLURAL = "staticroutes"
+
+
+class FakeK8sControlPlane:
+    def __init__(self, projection_dir: Optional[str] = None):
+        self.staticroutes: Dict[Tuple[str, str], dict] = {}
+        self.configmaps: Dict[Tuple[str, str], dict] = {}
+        self.status_patches: List[dict] = []
+        self.projection_dir = projection_dir
+        self.watch_queues: List[asyncio.Queue] = []
+        self._rv = 0
+
+    # -- state manipulation (the "kubectl" side) ---------------------------
+
+    def next_rv(self) -> str:
+        self._rv += 1
+        return str(self._rv)
+
+    async def create_staticroute(self, ns: str, name: str, spec: dict) -> dict:
+        obj = {
+            "apiVersion": f"{GROUP}/{VERSION}",
+            "kind": "StaticRoute",
+            "metadata": {
+                "name": name,
+                "namespace": ns,
+                "uid": str(uuid.uuid4()),
+                "generation": 1,
+                "resourceVersion": self.next_rv(),
+            },
+            "spec": spec,
+        }
+        self.staticroutes[(ns, name)] = obj
+        await self._emit("ADDED", obj)
+        return obj
+
+    async def update_staticroute_spec(self, ns: str, name: str, spec: dict) -> dict:
+        obj = self.staticroutes[(ns, name)]
+        obj["spec"] = spec
+        obj["metadata"]["generation"] += 1
+        obj["metadata"]["resourceVersion"] = self.next_rv()
+        await self._emit("MODIFIED", obj)
+        return obj
+
+    async def delete_staticroute(self, ns: str, name: str) -> None:
+        obj = self.staticroutes.pop((ns, name), None)
+        if obj is not None:
+            await self._emit("DELETED", obj)
+
+    async def _emit(self, etype: str, obj: dict) -> None:
+        for queue in list(self.watch_queues):
+            await queue.put({"type": etype, "object": copy.deepcopy(obj)})
+
+    async def wait_for_watcher(self, timeout: float = 5.0) -> None:
+        deadline = asyncio.get_event_loop().time() + timeout
+        while asyncio.get_event_loop().time() < deadline:
+            if self.watch_queues:
+                return
+            await asyncio.sleep(0.02)
+        raise TimeoutError("operator watch stream never connected")
+
+    def get_status(self, ns: str, name: str) -> dict:
+        return self.staticroutes[(ns, name)].get("status", {})
+
+    def get_condition(self, ns: str, name: str, ctype: str) -> Optional[dict]:
+        for cond in self.get_status(ns, name).get("conditions", []):
+            if cond.get("type") == ctype:
+                return cond
+        return None
+
+    # -- kubelet projection -------------------------------------------------
+
+    def _project(self, ns: str, name: str, cm: dict) -> None:
+        if not self.projection_dir:
+            return
+        target = os.path.join(self.projection_dir, ns, name)
+        os.makedirs(target, exist_ok=True)
+        for key, content in (cm.get("data") or {}).items():
+            # Write-then-rename, like the kubelet's atomic symlink swap.
+            tmp = os.path.join(target, f".{key}.tmp")
+            with open(tmp, "w") as f:
+                f.write(content)
+            os.replace(tmp, os.path.join(target, key))
+
+    # -- HTTP handlers ------------------------------------------------------
+
+    def build_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_get(
+            f"/apis/{GROUP}/{VERSION}/{PLURAL}", self.handle_list_or_watch
+        )
+        app.router.add_get(
+            f"/apis/{GROUP}/{VERSION}/namespaces/{{ns}}/{PLURAL}",
+            self.handle_list_or_watch,
+        )
+        app.router.add_patch(
+            f"/apis/{GROUP}/{VERSION}/namespaces/{{ns}}/{PLURAL}/{{name}}/status",
+            self.handle_status_patch,
+        )
+        app.router.add_get(
+            "/api/v1/namespaces/{ns}/configmaps/{name}", self.handle_cm_get
+        )
+        app.router.add_post(
+            "/api/v1/namespaces/{ns}/configmaps", self.handle_cm_create
+        )
+        app.router.add_put(
+            "/api/v1/namespaces/{ns}/configmaps/{name}", self.handle_cm_update
+        )
+        return app
+
+    async def handle_list_or_watch(self, request: web.Request):
+        ns = request.match_info.get("ns")
+        items = [
+            copy.deepcopy(obj)
+            for (obj_ns, _), obj in sorted(self.staticroutes.items())
+            if ns is None or obj_ns == ns
+        ]
+        if not request.query.get("watch"):
+            return web.json_response(
+                {
+                    "apiVersion": f"{GROUP}/{VERSION}",
+                    "kind": "StaticRouteList",
+                    "metadata": {"resourceVersion": str(self._rv)},
+                    "items": items,
+                }
+            )
+        response = web.StreamResponse(
+            status=200, headers={"Content-Type": "application/json"}
+        )
+        await response.prepare(request)
+        queue: asyncio.Queue = asyncio.Queue()
+        for obj in items:
+            await queue.put({"type": "ADDED", "object": obj})
+        self.watch_queues.append(queue)
+        try:
+            while True:
+                event = await queue.get()
+                await response.write(json.dumps(event).encode() + b"\n")
+        except (ConnectionResetError, asyncio.CancelledError):
+            pass
+        finally:
+            self.watch_queues.remove(queue)
+        return response
+
+    async def handle_status_patch(self, request: web.Request):
+        ns, name = request.match_info["ns"], request.match_info["name"]
+        obj = self.staticroutes.get((ns, name))
+        if obj is None:
+            return web.json_response({"reason": "NotFound"}, status=404)
+        patch = await request.json()
+        self.status_patches.append(
+            {"namespace": ns, "name": name, "patch": copy.deepcopy(patch)}
+        )
+        # merge-patch semantics on the status subresource.
+        status = obj.setdefault("status", {})
+        for key, value in patch.get("status", {}).items():
+            if value is None:
+                status.pop(key, None)
+            else:
+                status[key] = value
+        obj["metadata"]["resourceVersion"] = self.next_rv()
+        # A real API server emits MODIFIED for status writes too — the
+        # operator must not reconcile-loop on its own status patches.
+        await self._emit("MODIFIED", obj)
+        return web.json_response(obj)
+
+    async def handle_cm_get(self, request: web.Request):
+        ns, name = request.match_info["ns"], request.match_info["name"]
+        cm = self.configmaps.get((ns, name))
+        if cm is None:
+            return web.json_response(
+                {"kind": "Status", "reason": "NotFound", "code": 404}, status=404
+            )
+        return web.json_response(cm)
+
+    async def handle_cm_create(self, request: web.Request):
+        ns = request.match_info["ns"]
+        cm = await request.json()
+        name = cm.get("metadata", {}).get("name")
+        if not name:
+            return web.json_response({"reason": "Invalid"}, status=422)
+        if (ns, name) in self.configmaps:
+            return web.json_response({"reason": "AlreadyExists"}, status=409)
+        cm.setdefault("metadata", {})["resourceVersion"] = self.next_rv()
+        self.configmaps[(ns, name)] = cm
+        self._project(ns, name, cm)
+        return web.json_response(cm, status=201)
+
+    async def handle_cm_update(self, request: web.Request):
+        ns, name = request.match_info["ns"], request.match_info["name"]
+        if (ns, name) not in self.configmaps:
+            return web.json_response({"reason": "NotFound"}, status=404)
+        cm = await request.json()
+        cm.setdefault("metadata", {})["resourceVersion"] = self.next_rv()
+        self.configmaps[(ns, name)] = cm
+        self._project(ns, name, cm)
+        return web.json_response(cm)

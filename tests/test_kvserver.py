@@ -124,6 +124,25 @@ def test_server_lru_eviction(kv_server):
     client.close()
 
 
+def test_server_oversize_put_rejected(kv_server):
+    """Same DRAM-protection guard as the native server: a PUT claiming more
+    than capacity is refused before its bytes are read."""
+    import socket
+    import struct as _struct
+
+    store, port = kv_server
+    sock = socket.create_connection(("127.0.0.1", port), timeout=5)
+    try:
+        sock.sendall(
+            _struct.pack("<IBH", proto.MAGIC, proto.OP_PUT, 3) + b"key"
+            + _struct.pack("<Q", 1 << 41)
+        )
+        magic, status, _ = _struct.unpack("<IBQ", sock.recv(13))
+        assert magic == proto.MAGIC and status == proto.ST_ERROR
+    finally:
+        sock.close()
+
+
 # -- offload manager remote tier -------------------------------------------
 
 

@@ -176,6 +176,22 @@ def test_native_bad_magic_errors_and_closes(native_server):
         sock.close()
 
 
+def test_native_oversize_put_rejected_without_buffering(native_server):
+    """A PUT header claiming more than the store capacity must be rejected
+    immediately — not buffered in DRAM while the server waits for bytes."""
+    sock = socket.create_connection(("127.0.0.1", native_server), timeout=5)
+    try:
+        sock.sendall(
+            struct.pack("<IBH", proto.MAGIC, proto.OP_PUT, 3) + b"key"
+            + struct.pack("<Q", 1 << 41)  # 2 TiB claim, 1 MiB capacity
+        )
+        magic, status, _ = struct.unpack("<IBQ", sock.recv(13))
+        assert magic == proto.MAGIC and status == proto.ST_ERROR
+        assert sock.recv(1) == b""  # connection closed
+    finally:
+        sock.close()
+
+
 def test_native_stat_json_shape(native_server):
     client = RemoteKVClient(f"kv://127.0.0.1:{native_server}")
     stats = client.stat()
