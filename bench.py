@@ -97,6 +97,37 @@ def init_backend_or_fallback(timeout_s: float = 150.0, attempts: int = 2) -> str
         raise  # unreachable (execve does not return)
 
 
+class stage_watchdog:
+    """Re-exec this script with ``extra_env`` if the enclosed stage doesn't
+    finish within ``timeout_s`` (a hung TPU compile/execute can't be
+    interrupted in-process; the driver's own timeout would record nothing).
+    Same re-exec strategy as init_backend_or_fallback."""
+
+    def __init__(self, stage: str, timeout_s: float, extra_env: dict):
+        self.stage = stage
+        self.timeout_s = timeout_s
+        self.extra_env = extra_env
+
+    def __enter__(self):
+        import threading
+
+        self._done = threading.Event()
+
+        def watch():
+            if self._done.wait(self.timeout_s):
+                return
+            log(f"{self.stage}: stalled >{self.timeout_s:.0f}s; "
+                f"re-exec with {self.extra_env}")
+            _reexec(self.extra_env)
+
+        threading.Thread(target=watch, daemon=True).start()
+        return self
+
+    def __exit__(self, *exc):
+        self._done.set()
+        return False
+
+
 def timed(fn, *args, repeats=3):
     """Wall time of fn(*args) fully synced via scalar host readback."""
     float(np.asarray(fn(*args)))  # warmup + compile
@@ -180,13 +211,19 @@ def bench_prefill(jax, jnp, cfg, params, kv_caches, bucket, block_size):
         @jax.jit
         def f(params, tokens, kv_caches):
             def body(i, carry):
-                kv, acc = carry
+                kv, toks, acc = carry
                 logits, kv = llama.prefill(
-                    params, cfg, tokens, jnp.int32(0), prefix_ids, new_ids,
+                    params, cfg, toks, jnp.int32(0), prefix_ids, new_ids,
                     jnp.int32(bucket), kv,
                 )
-                return kv, acc + logits[0]
-            _, acc = jax.lax.fori_loop(0, n, body, (kv_caches, 0.0))
+                # Serial dependency: next iteration's tokens derive from
+                # these logits, and the sum consumes every logit — XLA can
+                # neither hoist the invariant first layer nor dead-code the
+                # lm_head columns (round-3 audit: consuming only logits[0]
+                # let the measurement beat its own roofline).
+                toks = (toks + jnp.argmax(logits).astype(jnp.int32)) % 101
+                return kv, toks, acc + logits.sum()
+            _, _, acc = jax.lax.fori_loop(0, n, body, (kv_caches, tokens, 0.0))
             return acc
 
         return f
@@ -218,13 +255,19 @@ def bench_decode(jax, jnp, cfg, params, kv_caches, S, ctx_len, bmax, block_size)
         @jax.jit
         def f(params, kv_caches):
             def body(i, carry):
-                kv, acc = carry
+                kv, toks, acc = carry
                 logits, kv = llama.decode(
-                    params, cfg, tokens, positions, block_tables, ctx_lens,
+                    params, cfg, toks, positions, block_tables, ctx_lens,
                     slot_blocks, slot_offsets, kv,
                 )
-                return kv, acc + logits[0, 0]
-            _, acc = jax.lax.fori_loop(0, n, body, (kv_caches, 0.0))
+                # Greedy-decode feedback: every sequence's next token
+                # depends on its full logits row, so no per-sequence slice
+                # of the batch is dead code (round-3 audit: consuming only
+                # logits[0, 0] made sequences 1..S-1 eligible for DCE and
+                # the measurement beat its own roofline).
+                toks = jnp.argmax(logits, axis=-1).astype(jnp.int32) % 101
+                return kv, toks, acc + logits.sum()
+            _, _, acc = jax.lax.fori_loop(0, n, body, (kv_caches, tokens, 0.0))
             return acc
 
         return f
@@ -309,10 +352,22 @@ def main() -> None:
 
     # Prefill (TTFT component): one 2048-token prompt.
     bucket = min(2048, cfg.max_model_len)
-    t_prefill = bench_prefill(jax, jnp, cfg, params, kv, bucket, bs)
+    if os.environ.get("PSTPU_DISABLE_FLASH_PREFILL"):
+        detail["flash_prefill_disabled"] = True
+    with stage_watchdog("prefill", 300.0, {"PSTPU_DISABLE_FLASH_PREFILL": "1"}):
+        t_prefill = bench_prefill(jax, jnp, cfg, params, kv, bucket, bs)
     prefill_tps = bucket / t_prefill
-    prefill_flops = 2 * n_params * bucket + 2 * 2 * cfg.num_layers * (
-        cfg.num_heads * cfg.head_dim * bucket * bucket / 2
+    # Matmul flops only: the embedding is a gather (no flops) and the model
+    # applies lm_head to the last token, not the whole bucket
+    # (llama.py:184-186) — counting either inflates MFU.
+    embed_params = cfg.vocab_size * cfg.hidden_size * (
+        1 if cfg.tie_word_embeddings else 2
+    )
+    prefill_flops = (
+        2 * (n_params - embed_params) * bucket
+        + 2 * cfg.vocab_size * cfg.hidden_size  # lm_head, last token only
+        + 2 * 2 * cfg.num_layers
+        * (cfg.num_heads * cfg.head_dim * bucket * bucket / 2)
     )
     detail["prefill_tokens_per_s"] = round(prefill_tps)
     detail["ttft_ms_2k_prompt"] = round(t_prefill * 1e3, 2)
@@ -332,12 +387,53 @@ def main() -> None:
     # Roofline: per step, read all params once + each sequence's live KV.
     vs_baseline = 0.0
     if peak_gbs:
-        param_bytes = n_params * 2
+        # Weights streamed per step: every matmul weight + lm_head.  With
+        # tied embeddings lm_head IS the embedding matrix (read once); with
+        # untied, the embedding table is only gathered (S rows, ~0 bytes).
+        streamed_params = n_params - (
+            0 if cfg.tie_word_embeddings
+            else cfg.vocab_size * cfg.hidden_size
+        )
+        param_bytes = streamed_params * 2
         kv_bytes = S * (-(-ctx // bs)) * bs * cfg.num_kv_heads * cfg.head_dim \
             * 2 * 2 * cfg.num_layers
         roofline_step = (param_bytes + kv_bytes) / (peak_gbs * 1e9)
         vs_baseline = round(decode_tps * roofline_step / S, 3)
         detail["decode_roofline_tokens_per_s"] = round(S / roofline_step)
+
+    if not args.quick:
+        # North-star serving metrics (BASELINE.md): multi-round QA through
+        # the REAL stack — engine -> OpenAI server -> session router — on
+        # localhost.  Small scale (the chip is shared with the kernel
+        # benches above), but the data path is the production one.
+        try:
+            import importlib.util
+            import os as _os
+
+            spec = importlib.util.spec_from_file_location(
+                "serving_bench",
+                _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
+                              "benchmarks", "serving_bench.py"),
+            )
+            serving_bench = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(serving_bench)
+            log("serving bench: booting engine + router in-process ...")
+            serving = serving_bench.run_serving_bench_sync(
+                preset=preset,
+                num_users=6, num_rounds=3, qps=2.0,
+                system_prompt_len=600, user_info_len=600, answer_len=48,
+                max_num_seqs=args.batch,
+                max_model_len=min(cfg.max_model_len, 4096),
+            )
+            detail["serving"] = serving
+            log(f"serving: ttft_p50={serving.get('ttft_p50_s')}s "
+                f"out_tok/s={serving.get('output_tokens_per_s')} "
+                f"kv_hit={serving.get('kv_hit_rate')} "
+                f"failed={serving.get('requests_failed')}")
+        except Exception as e:
+            # The kernel benches above are still valid; record the failure.
+            log(f"serving bench failed: {e}")
+            detail["serving"] = {"error": str(e)[:200]}
 
     if not args.quick and on_tpu:
         # A/B the full decode step with the gather attention path (the KV

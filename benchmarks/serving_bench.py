@@ -1,0 +1,109 @@
+"""North-star serving benchmark: multi-round QA against the REAL engine.
+
+BASELINE.md's target metrics are stack-level — multi-round-QA TTFT p50,
+aggregate output tokens/s, and KV hit rate measured through the router in
+front of a real serving engine (reference workload: run.sh:43-85,
+tutorials/07-...:32-67).  Kernel microbenches can't evidence those; this
+module boots the full serving stack in-process (JAX engine -> OpenAI
+server -> router with session routing) on localhost and drives the
+canonical workload at a configurable scale.
+
+Used two ways:
+* ``bench.py`` (the driver entry) calls :func:`run_serving_bench` on the
+  real TPU chip and folds the summary into the BENCH JSON line.
+* ``tests/test_serving_bench.py`` runs it on CPU with the tiny preset as a
+  wiring test.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import sys
+from typing import Dict, Optional
+
+from aiohttp import web
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "multi_round_qa")
+)
+
+
+async def _start_app(app: web.Application) -> tuple:
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    return runner, f"http://127.0.0.1:{port}"
+
+
+async def run_serving_bench(
+    preset: str = "tiny-llama",
+    *,
+    num_users: int = 4,
+    num_rounds: int = 3,
+    qps: float = 2.0,
+    system_prompt_len: int = 200,
+    user_info_len: int = 200,
+    answer_len: int = 32,
+    max_num_seqs: int = 8,
+    max_model_len: int = 2048,
+    num_blocks: Optional[int] = None,
+    duration: Optional[float] = None,
+) -> Dict:
+    """Boot engine + router on localhost, run the workload, return summary.
+
+    Returns the harness summary dict (benchmarks/multi_round_qa):
+    ttft_p50/p90/p99, output_tokens_per_s, kv_hit_rate, error counts, ...
+    """
+    from multi_round_qa import WorkloadConfig, run_benchmark
+    from production_stack_tpu.engine.config import config_from_preset
+    from production_stack_tpu.engine.server.api_server import (
+        build_engine_app,
+    )
+    from production_stack_tpu.engine.server.async_engine import AsyncEngine
+    from production_stack_tpu.router.app import build_app as build_router_app
+    from production_stack_tpu.router.parser import parse_args
+
+    overrides = {
+        "scheduler.max_num_seqs": max_num_seqs,
+        "scheduler.max_model_len": max_model_len,
+    }
+    if num_blocks is not None:
+        overrides["cache.num_blocks"] = num_blocks
+    config = config_from_preset(preset, **overrides)
+    engine = AsyncEngine(config)
+    engine_app = build_engine_app(engine, served_model=preset)
+    engine_runner, engine_url = await _start_app(engine_app)
+
+    router_app = build_router_app(parse_args([
+        "--static-backends", engine_url,
+        "--static-models", preset,
+        "--routing-logic", "session",
+        "--session-key", "x-user-id",
+        "--engine-stats-interval", "1",
+    ]))
+    router_runner, router_url = await _start_app(router_app)
+
+    try:
+        result = await run_benchmark(WorkloadConfig(
+            base_url=router_url,
+            model=preset,
+            num_users=num_users,
+            num_rounds=num_rounds,
+            qps=qps,
+            system_prompt_len=system_prompt_len,
+            user_info_len=user_info_len,
+            answer_len=answer_len,
+            duration=duration,
+        ))
+        return result["summary"]
+    finally:
+        await router_runner.cleanup()
+        await engine_runner.cleanup()
+
+
+def run_serving_bench_sync(**kwargs) -> Dict:
+    """Entry for bench.py (which is synchronous)."""
+    return asyncio.run(run_serving_bench(**kwargs))

@@ -165,7 +165,7 @@ def prefill(
         else:
             out = attn_ops.prefill_attention(
                 q, k, v, k_prefix, v_prefix, cached_len, valid_len,
-                scale=scale, sliding_window=cfg.sliding_window,
+                scale=scale, sliding_window=cfg.sliding_window, mesh=mesh,
             )
         k_cache, v_cache = attn_ops.write_prefill_kv(
             k_cache, v_cache, k, v, new_block_ids

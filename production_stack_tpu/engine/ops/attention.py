@@ -103,6 +103,24 @@ def decode_attention(
     )(q, k_cache, v_cache, block_tables, ctx_lens)
 
 
+def use_pallas_prefill(num_heads: int, num_kv_heads: int, head_dim: int,
+                       num_tokens: int) -> bool:
+    """Trace-time dispatch check for the flash prefill kernel: real TPU,
+    128-lane-aligned head_dim, GQA-divisible heads, and a power-of-two-ish
+    token bucket the q tiling divides (engine buckets are powers of two)."""
+    if os.environ.get("PSTPU_DISABLE_PALLAS") or os.environ.get(
+        "PSTPU_DISABLE_FLASH_PREFILL"
+    ):
+        # The second gate exists so bench.py's stage watchdog can re-exec
+        # with only the prefill kernel disabled if it ever stalls a chip.
+        return False
+    if head_dim % 128 or num_heads % max(num_kv_heads, 1):
+        return False
+    if num_tokens % min(256, num_tokens):
+        return False
+    return jax.default_backend() == "tpu"
+
+
 def prefill_attention(
     q: jax.Array,  # [T, H, D]
     k_new: jax.Array,  # [T, K, D]
@@ -114,10 +132,29 @@ def prefill_attention(
     *,
     scale: float,
     sliding_window: Optional[int] = None,
+    mesh: Optional[Mesh] = None,
 ) -> jax.Array:
     """Causal attention for one sequence's prefill, attending to an optional
-    cached prefix (prefix-cache hit) plus the new tokens themselves."""
+    cached prefix (prefix-cache hit) plus the new tokens themselves.
+
+    Dispatches to the Pallas flash kernel on single-device TPU (the dense
+    path below materializes [K, G, T, C+T] fp32 scores, which spills to
+    HBM for long prompts — see pallas/flash_prefill.py).  Under a
+    multi-device mesh the dense path stays: GSPMD partitions its einsums
+    across tp automatically, while a bare pallas_call cannot be
+    auto-partitioned (the sp>1 case never reaches here — llama.prefill
+    routes it to ring attention)."""
     T, H, D = q.shape
+    single_device = mesh is None or mesh.size == 1
+    if single_device and use_pallas_prefill(H, k_new.shape[1], D, T):
+        from production_stack_tpu.engine.ops.pallas.flash_prefill import (
+            flash_prefill_attention,
+        )
+
+        return flash_prefill_attention(
+            q, k_new, v_new, k_prefix, v_prefix, cached_len, valid_len,
+            scale=scale, sliding_window=sliding_window,
+        )
     C_max = k_prefix.shape[0]
     K = k_new.shape[1]
     G = H // K

@@ -1,0 +1,200 @@
+"""Pallas TPU flash-attention kernel for prefill (causal + cached prefix).
+
+Why the dense path stalls at ~0.44 MFU: ops/attention.py:prefill_attention
+materializes the full fp32 score/prob tensors — [K, G, T, C+T] is ~430 MB
+for a 2k-token llama-3.2-3b prefill, far beyond VMEM, so XLA spills them
+to HBM and the MXU waits on bandwidth.  This kernel never materializes
+scores in HBM: each program owns one Tq-row query tile (all heads), keeps
+the full key/value rows resident in VMEM (a few MB at serving lengths),
+streams them in Tk-column slices with an online softmax, and stops at the
+causal frontier so upper-triangle waste is bounded by one Tk slice per
+tile.
+
+Layout notes (Mosaic): blocks keep the (head, lane) dims whole — q tiles
+are [Tq, H, D], keys [S_k, K, D] — because Mosaic requires the last two
+block dims divisible by (8, 128) or equal to the array's.  GQA regrouping
+happens in-register via the same swapaxes/reshape moves the decode kernel
+uses (paged_attention.py:114-115); both matmuls are K-batched dot_generals
+contracting the lane dim, so no transposes are materialized.
+
+Position/validity semantics match the dense path exactly
+(ops/attention.py:128-143): key j < C is prefix slot j (valid while
+j < cached_len), key j >= C is new token j-C at position cached_len+(j-C)
+(valid while j-C < valid_len); query row t sits at cached_len + t.
+
+Replaces the role of FlashAttention prefill kernels inside the reference's
+external vLLM engine (the reference ships no kernels — SURVEY.md preamble).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _flash_prefill_kernel(
+    # scalar prefetch (SMEM)
+    cached_len_ref,  # [1] int32
+    valid_len_ref,  # [1] int32
+    # inputs (VMEM blocks)
+    q_ref,  # [Tq, H, D] this tile's queries, all heads
+    k_ref,  # [S_k, K, D] the full (padded) key row
+    v_ref,  # [S_k, K, D]
+    # outputs
+    o_ref,  # [Tq, H, D]
+    *,
+    Tq: int,
+    Tk: int,
+    C: int,
+    S_k: int,
+    K: int,
+    G: int,
+    D: int,
+    scale: float,
+    sliding_window: Optional[int],
+):
+    i = pl.program_id(0)
+    cached = cached_len_ref[0]
+    valid = valid_len_ref[0]
+    R = Tq * G  # query rows per kv head after GQA regrouping
+
+    # [Tq, H, D] -> [K, Tq*G, D]: head h = k*G + g attends kv head k.
+    q = q_ref[...].astype(jnp.float32) * scale
+    q = q.reshape(Tq, K, G, D).swapaxes(0, 1).reshape(K, R, D)
+
+    # Query positions per GQA-regrouped row r = t*G + g: row r's query
+    # token is t = r // G.  Masks are built 2-D [R, Tk] and broadcast into
+    # the 3-D scores ([K, R, Tk] where mask[None] — the exact pattern the
+    # decode kernel lowers with); 4-D mask ops and bool-valued selects both
+    # stall Mosaic.
+    row_t = jax.lax.broadcasted_iota(jnp.int32, (R, 1), 0) // G
+    q_pos = cached + i * Tq + row_t  # [R, 1]
+
+    # Causal frontier: the tile's last query sits at cached + (i+1)*Tq - 1
+    # and can see prefix keys (flat index < C) plus new keys with flat
+    # index < C + (i+1)*Tq.  Slices wholly past that are skipped.
+    frontier = C + (i + 1) * Tq
+    nk = jax.lax.min((frontier + Tk - 1) // Tk, S_k // Tk)
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[pl.dslice(j * Tk, Tk)].astype(jnp.float32)  # [Tk, K, D]
+        v = v_ref[pl.dslice(j * Tk, Tk)].astype(jnp.float32)
+        k = k.swapaxes(0, 1)  # [K, Tk, D]
+        v = v.swapaxes(0, 1)
+
+        # [K, R, D] x [K, Tk, D] -> [K, R, Tk] (batch over kv heads).
+        s = jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+
+        flat = j * Tk + jax.lax.broadcasted_iota(jnp.int32, (1, Tk), 1)
+        is_prefix = flat < C
+        key_pos = jnp.where(is_prefix, flat, cached + flat - C)  # int select
+        key_valid = (is_prefix & (flat < cached)) | (
+            ~is_prefix & (flat - C < valid)
+        )
+        mask = key_valid & (key_pos <= q_pos)  # [R, Tk]
+        if sliding_window is not None:
+            mask &= key_pos > q_pos - sliding_window
+        s = jnp.where(mask[None], s, NEG_INF)
+
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        # [K, R, Tk] x [K, Tk, D] -> [K, R, D]
+        pv = jax.lax.dot_general(
+            p, v, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc * alpha + pv
+
+    m0 = jnp.full((K, R, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((K, R, 1), jnp.float32)
+    acc0 = jnp.zeros((K, R, D), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, nk, body, (m0, l0, acc0))
+
+    # Rows past valid_len (padding) have every key masked -> l == 0; emit
+    # zeros, not NaNs (the caller slices them off).
+    l = jnp.where(l == 0.0, 1.0, l)
+    out = (acc / l).reshape(K, Tq, G, D).swapaxes(0, 1)  # [Tq, K, G, D]
+    o_ref[...] = out.reshape(Tq, K * G, D).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("scale", "sliding_window", "q_tile", "kv_tile", "interpret"),
+)
+def flash_prefill_attention(
+    q: jax.Array,  # [T, H, D]
+    k_new: jax.Array,  # [T, K, D]
+    v_new: jax.Array,  # [T, K, D]
+    k_prefix: jax.Array,  # [C, K, D] gathered cached prefix (may be C=0)
+    v_prefix: jax.Array,  # [C, K, D]
+    cached_len: jax.Array,  # scalar int32
+    valid_len: jax.Array,  # scalar int32
+    *,
+    scale: float,
+    sliding_window: Optional[int] = None,
+    q_tile: int = 256,
+    kv_tile: int = 512,
+    interpret: bool = False,
+) -> jax.Array:
+    """Flash causal prefill attention with prefix (Pallas TPU)."""
+    T, H, D = q.shape
+    K = k_new.shape[1]
+    C = k_prefix.shape[0]
+    if H % K:
+        raise ValueError(f"H={H} not divisible by num_kv_heads={K}")
+    G = H // K
+    if D % 128 and not interpret:
+        raise ValueError(f"flash prefill requires head_dim%128==0, got {D}")
+
+    Tq = min(q_tile, T)
+    if T % Tq:
+        raise ValueError(f"T={T} not a multiple of q_tile={Tq}")
+
+    keys = jnp.concatenate([k_prefix, k_new], axis=0)  # [C+T, K, D]
+    values = jnp.concatenate([v_prefix, v_new], axis=0)
+    S_raw = C + T
+    Tk = min(kv_tile, S_raw)
+    S_k = -(-S_raw // Tk) * Tk
+    if S_k != S_raw:
+        pad = [(0, S_k - S_raw), (0, 0), (0, 0)]
+        keys = jnp.pad(keys, pad)  # padded keys are masked (j-C >= valid)
+        values = jnp.pad(values, pad)
+
+    kernel = functools.partial(
+        _flash_prefill_kernel,
+        Tq=Tq, Tk=Tk, C=C, S_k=S_k, K=K, G=G, D=D,
+        scale=scale, sliding_window=sliding_window,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(T // Tq,),
+        in_specs=[
+            pl.BlockSpec((Tq, H, D), lambda i, *_: (i, 0, 0)),
+            pl.BlockSpec((S_k, K, D), lambda i, *_: (0, 0, 0)),
+            pl.BlockSpec((S_k, K, D), lambda i, *_: (0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((Tq, H, D), lambda i, *_: (i, 0, 0)),
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((T, H, D), q.dtype),
+        interpret=interpret,
+    )(
+        jnp.asarray(cached_len, jnp.int32).reshape(1),
+        jnp.asarray(valid_len, jnp.int32).reshape(1),
+        q, keys, values,
+    )

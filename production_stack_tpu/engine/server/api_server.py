@@ -147,6 +147,27 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
         checker = StopChecker(tokenizer, params.stop)
         prompt_token_ids = tokenizer.encode(prompt)
 
+        # Reject over-long prompts BEFORE the stream starts: once the SSE
+        # response is prepared, a scheduler-side ValueError can only
+        # truncate the chunked body (clients see ClientPayloadError, not a
+        # clean 400).
+        max_len = engine.engine.config.scheduler.max_model_len
+        if len(prompt_token_ids) >= max_len:
+            return web.json_response(
+                {
+                    "error": {
+                        "message": (
+                            f"This model's maximum context length is "
+                            f"{max_len} tokens, but the prompt is "
+                            f"{len(prompt_token_ids)} tokens long"
+                        ),
+                        "type": "invalid_request_error",
+                        "code": "context_length_exceeded",
+                    }
+                },
+                status=400,
+            )
+
         gen = engine.generate(
             prompt_token_ids=prompt_token_ids,
             sampling_params=params,
