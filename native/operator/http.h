@@ -18,6 +18,7 @@ struct curl_slist {
   char* data;
   curl_slist* next;
 };
+int curl_global_init(long flags);
 CURL* curl_easy_init(void);
 void curl_easy_cleanup(CURL*);
 int curl_easy_setopt(CURL*, int option, ...);
@@ -52,6 +53,7 @@ enum : int {
 };
 constexpr int CURLE_OK_ = 0;
 constexpr int CURLE_WRITE_ERROR_ = 23;
+constexpr long CURL_GLOBAL_DEFAULT_ = 3;  // SSL | WIN32
 
 struct Response {
   long status = 0;

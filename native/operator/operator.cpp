@@ -174,6 +174,7 @@ class Reconciler {
     // Drop per-CR state for deleted routes so a recreated CR of the same
     // name starts with a clean failure count and condition history.
     Prune(failures_, live);
+    Prune(last_probe_, live);
     Prune(last_condition_, live);
     Prune(last_transition_, live);
     return count;
@@ -230,9 +231,23 @@ class Reconciler {
           health = "True";
           health_msg = "router /health returned 200";
         } else {
+          // Probe spacing: our own status PATCH fires a MODIFIED watch
+          // event, which re-runs reconcile immediately — without spacing,
+          // back-to-back probes would consume the whole failure threshold
+          // within one blip, defeating the debounce.  Only count a failure
+          // if at least half a resync period passed since the last counted
+          // probe for this CR.
+          double now = std::chrono::duration<double>(
+                           std::chrono::steady_clock::now().time_since_epoch())
+                           .count();
+          auto lp = last_probe_.find(key);
+          bool counted = failures_[key] == 0 || lp == last_probe_.end() ||
+                         now - lp->second >= opts_.resync_seconds * 0.5;
+          if (counted) last_probe_[key] = now;
           // Cap at the threshold: a growing count would change the status
           // message every pass, and each status write wakes our own watch.
-          int fails = std::min(threshold, failures_[key] + 1);
+          int fails = counted ? std::min(threshold, failures_[key] + 1)
+                              : failures_[key];
           failures_[key] = fails;
           if (fails >= threshold) {
             health = "False";
@@ -432,6 +447,7 @@ class Reconciler {
   const Options& opts_;
   http::Client& client_;
   std::map<std::string, int> failures_;
+  std::map<std::string, double> last_probe_;
   std::map<std::string, std::string> last_condition_;
   std::map<std::string, std::string> last_transition_;
 };
@@ -515,6 +531,10 @@ int main(int argc, char** argv) {
   signal(SIGINT, OnSignal);
   signal(SIGTERM, OnSignal);
   signal(SIGPIPE, SIG_IGN);
+
+  // One-time libcurl/OpenSSL global init BEFORE the watcher thread exists:
+  // the lazy init inside curl_easy_init is documented non-thread-safe.
+  curl_global_init(http::CURL_GLOBAL_DEFAULT_);
 
   std::string token = ReadFileOrEmpty(opts.token_file);
   std::string ca =
