@@ -1,0 +1,9 @@
+#!/bin/bash
+# Tear down everything entry_point.sh created (reference clean_up.sh).
+set -euo pipefail
+
+CLUSTER_NAME="${CLUSTER_NAME:-production-stack-tpu}"
+ZONE="${ZONE:-us-central2-b}"
+
+helm uninstall tpu-stack 2>/dev/null || true
+gcloud container clusters delete "$CLUSTER_NAME" --zone "$ZONE" --quiet

@@ -1,0 +1,74 @@
+#!/bin/bash
+# GKE bootstrap for the TPU production stack.
+#
+# TPU-native analogue of the reference's GPU cluster bootstrap
+# (deployment_on_cloud/gcp/entry_point.sh:23-63): instead of GPU node pools
+# + the NVIDIA device plugin, this creates a CPU pool for the control plane
+# (router, operator, cache server, observability) and a TPU slice node pool
+# (google.com/tpu resources are exposed by GKE's built-in TPU support — no
+# driver daemonset needed).
+#
+# Usage:
+#   ./entry_point.sh <VALUES_YAML>          # create cluster + install stack
+#
+# Tunables (env):
+#   CLUSTER_NAME   (default production-stack-tpu)
+#   ZONE           (default us-central2-b — has v5e capacity)
+#   TPU_ACCEL      (default tpu-v5-lite-podslice: v5e)
+#   TPU_TOPOLOGY   (default 2x4: one v5e-8 slice per node)
+#   TPU_NODES      (default 1)
+set -euo pipefail
+
+CLUSTER_NAME="${CLUSTER_NAME:-production-stack-tpu}"
+ZONE="${ZONE:-us-central2-b}"
+TPU_ACCEL="${TPU_ACCEL:-tpu-v5-lite-podslice}"
+TPU_TOPOLOGY="${TPU_TOPOLOGY:-2x4}"
+TPU_NODES="${TPU_NODES:-1}"
+
+GCP_PROJECT=$(gcloud config get-value project 2>/dev/null)
+if [ -z "$GCP_PROJECT" ]; then
+  echo "Error: no GCP project set. Run: gcloud config set project <PROJECT_ID>" >&2
+  exit 1
+fi
+if [ "$#" -ne 1 ]; then
+  echo "Usage: $0 <VALUES_YAML>" >&2
+  exit 1
+fi
+VALUES_YAML=$1
+
+echo "== Creating GKE cluster $CLUSTER_NAME in $ZONE (project $GCP_PROJECT)"
+gcloud container clusters create "$CLUSTER_NAME" \
+  --project "$GCP_PROJECT" \
+  --zone "$ZONE" \
+  --release-channel regular \
+  --machine-type n2-standard-8 \
+  --num-nodes 2 \
+  --enable-ip-alias
+
+echo "== Adding TPU node pool ($TPU_ACCEL topology $TPU_TOPOLOGY x $TPU_NODES)"
+# GKE TPU node pools: the machine type is determined by the accelerator;
+# the topology selector is what the chart's engine deployment matches on
+# (helm/templates/deployment-engine.yaml nodeSelector
+# cloud.google.com/gke-tpu-accelerator / gke-tpu-topology).
+gcloud container node-pools create tpu-pool \
+  --project "$GCP_PROJECT" \
+  --zone "$ZONE" \
+  --cluster "$CLUSTER_NAME" \
+  --machine-type ct5lp-hightpu-8t \
+  --tpu-topology "$TPU_TOPOLOGY" \
+  --num-nodes "$TPU_NODES" \
+  --enable-autoscaling --min-nodes 0 --max-nodes "$TPU_NODES"
+
+gcloud container clusters get-credentials "$CLUSTER_NAME" --zone "$ZONE"
+
+echo "== Installing the StaticRoute CRD + operator"
+kubectl apply -f "$(dirname "$0")/../../native/operator/config/crd.yaml"
+kubectl create namespace production-stack --dry-run=client -o yaml | kubectl apply -f -
+kubectl apply -f "$(dirname "$0")/../../native/operator/config/rbac.yaml"
+kubectl apply -f "$(dirname "$0")/../../native/operator/config/deployment.yaml"
+
+echo "== Installing the stack chart with $VALUES_YAML"
+helm install tpu-stack "$(dirname "$0")/../../helm" -f "$VALUES_YAML"
+
+echo "== Done. Router endpoint:"
+kubectl get svc -l app.kubernetes.io/component=router

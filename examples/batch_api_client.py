@@ -1,0 +1,111 @@
+"""OpenAI Batch API client example against the TPU router.
+
+Uploads a JSONL batch input file, creates a batch, polls until it
+completes, and downloads the per-line results.  (Reference counterpart:
+examples/openai_api_client_batch.py — that one only creates the batch; the
+reference's processor is a simulation stub, while this stack executes every
+line through the real routing path.)
+
+Run (router started with --enable-batch-api):
+
+    python examples/batch_api_client.py --base-url http://localhost:8001 \
+        --model fake/llama-3-8b
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+
+import aiohttp
+
+
+def build_batch_input(model: str, questions) -> bytes:
+    """One OpenAI batch line per question (custom_id, method, url, body)."""
+    lines = []
+    for i, question in enumerate(questions):
+        lines.append(json.dumps({
+            "custom_id": f"req-{i}",
+            "method": "POST",
+            "url": "/v1/chat/completions",
+            "body": {
+                "model": model,
+                "messages": [{"role": "user", "content": question}],
+                "max_tokens": 64,
+            },
+        }))
+    return ("\n".join(lines) + "\n").encode()
+
+
+async def run_batch(base_url: str, model: str, questions,
+                    poll_interval: float = 0.5, timeout: float = 120.0):
+    async with aiohttp.ClientSession() as session:
+        # 1. Upload the input file (multipart, purpose=batch).
+        form = aiohttp.FormData()
+        form.add_field("purpose", "batch")
+        form.add_field("file", build_batch_input(model, questions),
+                       filename="batch_input.jsonl",
+                       content_type="application/jsonl")
+        async with session.post(f"{base_url}/v1/files", data=form) as resp:
+            resp.raise_for_status()
+            input_file = await resp.json()
+        print(f"uploaded input file: {input_file['id']}")
+
+        # 2. Create the batch.
+        async with session.post(f"{base_url}/v1/batches", json={
+            "input_file_id": input_file["id"],
+            "endpoint": "/v1/chat/completions",
+            "completion_window": "24h",
+        }) as resp:
+            resp.raise_for_status()
+            batch = await resp.json()
+        print(f"created batch: {batch['id']} (status {batch['status']})")
+
+        # 3. Poll until done.
+        deadline = asyncio.get_event_loop().time() + timeout
+        while batch["status"] not in ("completed", "failed", "expired",
+                                      "cancelled"):
+            if asyncio.get_event_loop().time() > deadline:
+                raise TimeoutError(f"batch stuck in {batch['status']}")
+            await asyncio.sleep(poll_interval)
+            async with session.get(f"{base_url}/v1/batches/{batch['id']}") as resp:
+                batch = await resp.json()
+        print(f"batch finished: {batch['status']} "
+              f"(completed={batch['request_counts']['completed']} "
+              f"failed={batch['request_counts']['failed']})")
+
+        # 4. Download results.
+        results = []
+        if batch.get("output_file_id"):
+            async with session.get(
+                f"{base_url}/v1/files/{batch['output_file_id']}/content"
+            ) as resp:
+                text = await resp.text()
+            for line in text.splitlines():
+                results.append(json.loads(line))
+        return batch, results
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--base-url", default="http://localhost:8001")
+    parser.add_argument("--model", required=True)
+    args = parser.parse_args(argv)
+
+    questions = [
+        "What is a TPU systolic array?",
+        "Explain paged attention in one sentence.",
+        "Why is decode bandwidth-bound?",
+    ]
+    batch, results = asyncio.run(run_batch(args.base_url, args.model, questions))
+    for row in results:
+        body = row.get("response", {}).get("body", {})
+        content = (body.get("choices") or [{}])[0].get("message", {}).get("content")
+        print(f"{row['custom_id']}: {content!r}")
+    return 0 if batch["status"] == "completed" else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

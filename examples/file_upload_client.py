@@ -1,0 +1,64 @@
+"""OpenAI Files API client example against the TPU router.
+
+Upload, inspect, list, download, and delete a file.  (Reference
+counterpart: src/examples/example_file_upload.py.)
+
+Run (router started with --enable-batch-api):
+
+    python examples/file_upload_client.py --base-url http://localhost:8001
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import sys
+
+import aiohttp
+
+
+async def file_roundtrip(base_url: str, content: bytes,
+                         filename: str = "example.jsonl") -> dict:
+    async with aiohttp.ClientSession() as session:
+        form = aiohttp.FormData()
+        form.add_field("purpose", "batch")
+        form.add_field("file", content, filename=filename,
+                       content_type="application/jsonl")
+        async with session.post(f"{base_url}/v1/files", data=form) as resp:
+            resp.raise_for_status()
+            created = await resp.json()
+        print(f"uploaded: {created['id']} ({created['bytes']} bytes)")
+
+        async with session.get(f"{base_url}/v1/files/{created['id']}") as resp:
+            meta = await resp.json()
+        print(f"metadata: filename={meta['filename']} purpose={meta['purpose']}")
+
+        async with session.get(f"{base_url}/v1/files") as resp:
+            listing = await resp.json()
+        print(f"listed {len(listing['data'])} file(s)")
+
+        async with session.get(
+            f"{base_url}/v1/files/{created['id']}/content"
+        ) as resp:
+            downloaded = await resp.read()
+        assert downloaded == content, "round-trip mismatch"
+        print("content round-trips byte-exact")
+
+        async with session.delete(f"{base_url}/v1/files/{created['id']}") as resp:
+            deleted = await resp.json()
+        print(f"deleted: {deleted['deleted']}")
+        return created
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--base-url", default="http://localhost:8001")
+    args = parser.parse_args(argv)
+    asyncio.run(file_roundtrip(
+        args.base_url, b'{"example": 1}\n{"example": 2}\n'
+    ))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
