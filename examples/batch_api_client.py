@@ -71,6 +71,7 @@ async def run_batch(base_url: str, model: str, questions,
                 raise TimeoutError(f"batch stuck in {batch['status']}")
             await asyncio.sleep(poll_interval)
             async with session.get(f"{base_url}/v1/batches/{batch['id']}") as resp:
+                resp.raise_for_status()
                 batch = await resp.json()
         print(f"batch finished: {batch['status']} "
               f"(completed={batch['request_counts']['completed']} "
@@ -82,6 +83,7 @@ async def run_batch(base_url: str, model: str, questions,
             async with session.get(
                 f"{base_url}/v1/files/{batch['output_file_id']}/content"
             ) as resp:
+                resp.raise_for_status()
                 text = await resp.text()
             for line in text.splitlines():
                 results.append(json.loads(line))

@@ -100,6 +100,36 @@ PRESETS = {
         rope_theta=10000.0,
         sliding_window=4096,
     ),
+    # Qwen2/2.5 family: QKV biases (attention_bias), high rope theta.
+    "qwen2.5-0.5b": ModelConfig(
+        name="qwen2.5-0.5b",
+        vocab_size=151936,
+        hidden_size=896,
+        intermediate_size=4864,
+        num_layers=24,
+        num_heads=14,
+        num_kv_heads=2,
+        head_dim=64,
+        max_model_len=8192,
+        rope_theta=1000000.0,
+        rms_norm_eps=1e-6,
+        attention_bias=True,
+        tie_word_embeddings=True,
+    ),
+    "qwen2.5-7b": ModelConfig(
+        name="qwen2.5-7b",
+        vocab_size=152064,
+        hidden_size=3584,
+        intermediate_size=18944,
+        num_layers=28,
+        num_heads=28,
+        num_kv_heads=4,
+        head_dim=128,
+        max_model_len=8192,
+        rope_theta=1000000.0,
+        rms_norm_eps=1e-6,
+        attention_bias=True,
+    ),
 }
 
 

@@ -67,31 +67,39 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
         params["lm_head"] = dense(keys[1], (h, cfg.vocab_size))
     for i in range(cfg.num_layers):
         lk = jax.random.split(keys[i + 3], 7)
-        params["layers"].append(
-            {
-                "input_layernorm": jnp.ones((h,), dtype),
-                "post_attention_layernorm": jnp.ones((h,), dtype),
-                "q_proj": dense(lk[0], (h, H * hd)),
-                "k_proj": dense(lk[1], (h, K * hd)),
-                "v_proj": dense(lk[2], (h, K * hd)),
-                "o_proj": dense(lk[3], (H * hd, h)),
-                "gate_proj": dense(lk[4], (h, I)),
-                "up_proj": dense(lk[5], (h, I)),
-                "down_proj": dense(lk[6], (I, h)),
-            }
-        )
+        layer = {
+            "input_layernorm": jnp.ones((h,), dtype),
+            "post_attention_layernorm": jnp.ones((h,), dtype),
+            "q_proj": dense(lk[0], (h, H * hd)),
+            "k_proj": dense(lk[1], (h, K * hd)),
+            "v_proj": dense(lk[2], (h, K * hd)),
+            "o_proj": dense(lk[3], (H * hd, h)),
+            "gate_proj": dense(lk[4], (h, I)),
+            "up_proj": dense(lk[5], (h, I)),
+            "down_proj": dense(lk[6], (I, h)),
+        }
+        if cfg.attention_bias:
+            # Qwen2-style QKV biases (o_proj stays bias-free there).
+            layer["q_bias"] = jnp.zeros((H * hd,), dtype)
+            layer["k_bias"] = jnp.zeros((K * hd,), dtype)
+            layer["v_bias"] = jnp.zeros((K * hd,), dtype)
+        params["layers"].append(layer)
     return params
 
 
 def _project_qkv(layer: Params, x: jax.Array, cfg: ModelConfig):
     """x: [T, h] -> q [T, H, D], k/v [T, K, D]."""
     T = x.shape[0]
-    q = jnp.dot(x, layer["q_proj"], preferred_element_type=jnp.float32).astype(x.dtype)
-    k = jnp.dot(x, layer["k_proj"], preferred_element_type=jnp.float32).astype(x.dtype)
-    v = jnp.dot(x, layer["v_proj"], preferred_element_type=jnp.float32).astype(x.dtype)
-    q = q.reshape(T, cfg.num_heads, cfg.head_dim)
-    k = k.reshape(T, cfg.num_kv_heads, cfg.head_dim)
-    v = v.reshape(T, cfg.num_kv_heads, cfg.head_dim)
+    q = jnp.dot(x, layer["q_proj"], preferred_element_type=jnp.float32)
+    k = jnp.dot(x, layer["k_proj"], preferred_element_type=jnp.float32)
+    v = jnp.dot(x, layer["v_proj"], preferred_element_type=jnp.float32)
+    if cfg.attention_bias:
+        q = q + layer["q_bias"].astype(jnp.float32)
+        k = k + layer["k_bias"].astype(jnp.float32)
+        v = v + layer["v_bias"].astype(jnp.float32)
+    q = q.astype(x.dtype).reshape(T, cfg.num_heads, cfg.head_dim)
+    k = k.astype(x.dtype).reshape(T, cfg.num_kv_heads, cfg.head_dim)
+    v = v.astype(x.dtype).reshape(T, cfg.num_kv_heads, cfg.head_dim)
     return q, k, v
 
 

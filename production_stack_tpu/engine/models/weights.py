@@ -86,20 +86,25 @@ def load_hf_safetensors(cfg: ModelConfig, weights_path: str):
         params["lm_head"] = take("lm_head.weight", transpose=True)
     for i in range(cfg.num_layers):
         p = f"model.layers.{i}."
-        params["layers"].append(
-            {
-                "input_layernorm": take(p + "input_layernorm.weight"),
-                "post_attention_layernorm": take(
-                    p + "post_attention_layernorm.weight"
-                ),
-                "q_proj": take(p + "self_attn.q_proj.weight", transpose=True),
-                "k_proj": take(p + "self_attn.k_proj.weight", transpose=True),
-                "v_proj": take(p + "self_attn.v_proj.weight", transpose=True),
-                "o_proj": take(p + "self_attn.o_proj.weight", transpose=True),
-                "gate_proj": take(p + "mlp.gate_proj.weight", transpose=True),
-                "up_proj": take(p + "mlp.up_proj.weight", transpose=True),
-                "down_proj": take(p + "mlp.down_proj.weight", transpose=True),
-            }
-        )
+        layer = {
+            "input_layernorm": take(p + "input_layernorm.weight"),
+            "post_attention_layernorm": take(
+                p + "post_attention_layernorm.weight"
+            ),
+            "q_proj": take(p + "self_attn.q_proj.weight", transpose=True),
+            "k_proj": take(p + "self_attn.k_proj.weight", transpose=True),
+            "v_proj": take(p + "self_attn.v_proj.weight", transpose=True),
+            "o_proj": take(p + "self_attn.o_proj.weight", transpose=True),
+            "gate_proj": take(p + "mlp.gate_proj.weight", transpose=True),
+            "up_proj": take(p + "mlp.up_proj.weight", transpose=True),
+            "down_proj": take(p + "mlp.down_proj.weight", transpose=True),
+        }
+        if cfg.attention_bias:
+            # Qwen2-style QKV biases (HF Qwen2Attention has bias=True on
+            # q/k/v projections only).
+            layer["q_bias"] = take(p + "self_attn.q_proj.bias")
+            layer["k_bias"] = take(p + "self_attn.k_proj.bias")
+            layer["v_bias"] = take(p + "self_attn.v_proj.bias")
+        params["layers"].append(layer)
     logger.info("Loaded %d tensors from %s", len(sd), weights_path)
     return params

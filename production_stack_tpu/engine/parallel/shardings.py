@@ -41,8 +41,8 @@ def validate_tp(cfg: ModelConfig, tp_size: int) -> None:
         )
 
 
-def _layer_specs() -> Dict[str, P]:
-    return {
+def _layer_specs(cfg) -> Dict[str, P]:
+    specs = {
         "input_layernorm": P(),
         "post_attention_layernorm": P(),
         "q_proj": P(None, TP),
@@ -53,6 +53,12 @@ def _layer_specs() -> Dict[str, P]:
         "up_proj": P(None, TP),
         "down_proj": P(TP, None),
     }
+    if cfg.attention_bias:
+        # Biases follow their projection's output (head) dim.
+        specs["q_bias"] = P(TP)
+        specs["k_bias"] = P(TP)
+        specs["v_bias"] = P(TP)
+    return specs
 
 
 def param_specs(cfg: ModelConfig) -> Dict:
@@ -60,7 +66,7 @@ def param_specs(cfg: ModelConfig) -> Dict:
     specs: Dict = {
         "embed_tokens": P(TP, None),
         "norm": P(),
-        "layers": [_layer_specs() for _ in range(cfg.num_layers)],
+        "layers": [_layer_specs(cfg) for _ in range(cfg.num_layers)],
     }
     if not cfg.tie_word_embeddings:
         specs["lm_head"] = P(None, TP)

@@ -59,21 +59,24 @@ def hf_to_params(model, cfg: ModelConfig):
         params["lm_head"] = t("lm_head.weight")
     for i in range(cfg.num_layers):
         p = f"model.layers.{i}."
-        params["layers"].append(
-            {
-                "input_layernorm": jnp.asarray(sd[p + "input_layernorm.weight"]),
-                "post_attention_layernorm": jnp.asarray(
-                    sd[p + "post_attention_layernorm.weight"]
-                ),
-                "q_proj": t(p + "self_attn.q_proj.weight"),
-                "k_proj": t(p + "self_attn.k_proj.weight"),
-                "v_proj": t(p + "self_attn.v_proj.weight"),
-                "o_proj": t(p + "self_attn.o_proj.weight"),
-                "gate_proj": t(p + "mlp.gate_proj.weight"),
-                "up_proj": t(p + "mlp.up_proj.weight"),
-                "down_proj": t(p + "mlp.down_proj.weight"),
-            }
-        )
+        layer = {
+            "input_layernorm": jnp.asarray(sd[p + "input_layernorm.weight"]),
+            "post_attention_layernorm": jnp.asarray(
+                sd[p + "post_attention_layernorm.weight"]
+            ),
+            "q_proj": t(p + "self_attn.q_proj.weight"),
+            "k_proj": t(p + "self_attn.k_proj.weight"),
+            "v_proj": t(p + "self_attn.v_proj.weight"),
+            "o_proj": t(p + "self_attn.o_proj.weight"),
+            "gate_proj": t(p + "mlp.gate_proj.weight"),
+            "up_proj": t(p + "mlp.up_proj.weight"),
+            "down_proj": t(p + "mlp.down_proj.weight"),
+        }
+        if cfg.attention_bias:
+            layer["q_bias"] = jnp.asarray(sd[p + "self_attn.q_proj.bias"])
+            layer["k_bias"] = jnp.asarray(sd[p + "self_attn.k_proj.bias"])
+            layer["v_bias"] = jnp.asarray(sd[p + "self_attn.v_proj.bias"])
+        params["layers"].append(layer)
     return params
 
 
@@ -246,4 +249,87 @@ def test_sliding_window_masks_old_tokens():
     )
     np.testing.assert_allclose(
         np.asarray(logits_w), np.asarray(logits_p), rtol=1e-5, atol=1e-5
+    )
+
+
+# -- Qwen2 family (QKV biases) ----------------------------------------------
+
+
+def make_hf_qwen2(cfg: ModelConfig):
+    hf_cfg = transformers.Qwen2Config(
+        vocab_size=cfg.vocab_size,
+        hidden_size=cfg.hidden_size,
+        intermediate_size=cfg.intermediate_size,
+        num_hidden_layers=cfg.num_layers,
+        num_attention_heads=cfg.num_heads,
+        num_key_value_heads=cfg.num_kv_heads,
+        rms_norm_eps=cfg.rms_norm_eps,
+        rope_theta=cfg.rope_theta,
+        max_position_embeddings=cfg.max_model_len,
+        tie_word_embeddings=cfg.tie_word_embeddings,
+        # Qwen2's HF impl enables sliding window only past a layer index;
+        # keep it off for the parity config.
+        use_sliding_window=False,
+    )
+    torch.manual_seed(1)
+    model = transformers.Qwen2ForCausalLM(hf_cfg)
+    model.eval()
+    return model
+
+
+def test_qwen2_prefill_and_decode_match_hf():
+    """Qwen2 = llama topology + QKV biases; the biases must flow through
+    both prefill and paged decode (round-4: attention_bias was previously
+    parsed but never applied)."""
+    cfg = tiny_cfg(attention_bias=True, tie_word_embeddings=True)
+    model = make_hf_qwen2(cfg)
+    params = hf_to_params(model, cfg)
+    # HF zero-inits Linear biases, which would make a dropped bias add pass
+    # vacuously: perturb q/k/v biases on BOTH sides so any of the three
+    # being dropped or zero-mapped fails loudly.
+    for i, hf_layer in enumerate(model.model.layers):
+        for name, hf_linear in [
+            ("q_bias", hf_layer.self_attn.q_proj),
+            ("k_bias", hf_layer.self_attn.k_proj),
+            ("v_bias", hf_layer.self_attn.v_proj),
+        ]:
+            bump = 0.1 + 0.05 * i
+            params["layers"][i][name] = params["layers"][i][name] + bump
+            with torch.no_grad():
+                hf_linear.bias += bump
+
+    prompt = [9, 3, 77, 21, 60]
+    T_bucket = 8
+    tokens = jnp.asarray(prompt + [0] * (T_bucket - len(prompt)), jnp.int32)
+    logits, caches = llama.prefill(
+        params,
+        cfg,
+        tokens,
+        cached_len=jnp.int32(0),
+        prefix_block_ids=jnp.zeros((1,), jnp.int32),
+        new_block_ids=jnp.asarray([1, 2], jnp.int32),
+        valid_len=jnp.int32(len(prompt)),
+        kv_caches=fresh_caches(cfg),
+    )
+    expected = hf_all_logits(model, prompt)[-1]
+    np.testing.assert_allclose(np.asarray(logits), expected, rtol=2e-4, atol=2e-4)
+
+    # One decode step must match the dense forward too.
+    block_table = [1, 2, 0, 0]
+    pos = len(prompt)
+    next_tok = 33
+    step_logits, _ = llama.decode(
+        params,
+        cfg,
+        tokens=jnp.asarray([next_tok], jnp.int32),
+        positions=jnp.asarray([pos], jnp.int32),
+        block_tables=jnp.asarray([block_table], jnp.int32),
+        ctx_lens=jnp.asarray([pos + 1], jnp.int32),
+        slot_block_ids=jnp.asarray([block_table[pos // BLOCK_SIZE]], jnp.int32),
+        slot_offsets=jnp.asarray([pos % BLOCK_SIZE], jnp.int32),
+        kv_caches=caches,
+    )
+    expected_step = hf_all_logits(model, prompt + [next_tok])[-1]
+    np.testing.assert_allclose(
+        np.asarray(step_logits)[0], expected_step, rtol=2e-4, atol=2e-4
     )
