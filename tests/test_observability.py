@@ -64,7 +64,11 @@ async def scrape_router_metrics():
     state, engine = await start_fake_engine()
     try:
         app, server, client = await start_router(
-            [str(engine.make_url("")).rstrip("/")], ["fake/llama-3-8b"]
+            [str(engine.make_url("")).rstrip("/")], ["fake/llama-3-8b"],
+            # The dashboard's experimental-tier panels (semantic cache, PII)
+            # must be backed by real metrics too, so scrape with both gates
+            # live rather than relying on module-import side effects.
+            extra_args=["--feature-gates", "SemanticCache=true,PIIDetection=true"],
         )
         try:
             # One proxied request so request-plane gauges materialize.
@@ -72,6 +76,18 @@ async def scrape_router_metrics():
                 "/v1/completions",
                 json={"model": "fake/llama-3-8b", "prompt": "x", "max_tokens": 1},
             )
+            # Repeat chat question -> cache miss then hit; SSN -> PII block.
+            chat = {
+                "model": "fake/llama-3-8b",
+                "messages": [{"role": "user", "content": "metrics probe"}],
+                "max_tokens": 4,
+            }
+            await client.post("/v1/chat/completions", json=chat)
+            await client.post("/v1/chat/completions", json=chat)
+            await client.post("/v1/chat/completions", json={
+                **chat,
+                "messages": [{"role": "user", "content": "ssn 123-45-6789"}],
+            })
             resp = await client.get("/metrics")
             return await resp.text()
         finally:
