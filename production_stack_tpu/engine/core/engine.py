@@ -42,6 +42,7 @@ from production_stack_tpu.engine.models import get_model
 from production_stack_tpu.engine.models.weights import load_params
 from production_stack_tpu.engine.parallel import shardings as shardings_lib
 from production_stack_tpu.engine.parallel.mesh import AXES, build_mesh
+from production_stack_tpu.engine import sampling as sampling_lib
 from production_stack_tpu.engine.sampling import sample_tokens
 from production_stack_tpu.engine.tokenizer import get_tokenizer
 
@@ -146,6 +147,10 @@ class LLMEngine:
             donate_argnames=("kv_caches",),
         )
         self._sample_fn = jax.jit(sample_tokens)
+        self._penalties_fn = jax.jit(sampling_lib.apply_penalties)
+        self._logprobs_fn = jax.jit(
+            sampling_lib.top_logprobs_of, static_argnames=("k",)
+        )
 
         self._step_counter = 0
         self._seqs: Dict[str, Sequence] = {}
@@ -326,8 +331,10 @@ class LLMEngine:
             # Non-final chunk of a long prompt: KV is written, but the
             # logits are mid-prompt — nothing to sample yet.
             return []
-        token_id = self._sample_batch(logits[None, :], [seq])[0]
-        return self._append_and_check([seq], [token_id], first_token=True)
+        token_ids, logprob_info = self._sample_batch(logits[None, :], [seq])
+        return self._append_and_check(
+            [seq], token_ids, first_token=True, logprob_info=logprob_info
+        )
 
     def _run_decode(self, plan: DecodePlan) -> List[StepOutput]:
         seqs = plan.seqs
@@ -361,28 +368,67 @@ class LLMEngine:
             slot_offsets=self._put(slot_offsets, batch_spec),
             kv_caches=self.kv_caches,
         )
-        token_ids = self._sample_batch(logits[: len(seqs)], seqs)
-        return self._append_and_check(seqs, token_ids, first_token=False)
+        token_ids, logprob_info = self._sample_batch(logits[: len(seqs)], seqs)
+        return self._append_and_check(
+            seqs, token_ids, first_token=False, logprob_info=logprob_info
+        )
 
-    def _sample_batch(self, logits: jax.Array, seqs: List[Sequence]) -> List[int]:
+    def _sample_batch(self, logits: jax.Array, seqs: List[Sequence]):
+        """Returns (token_ids, logprob_info) where logprob_info is a list of
+        None or (chosen_logprob, [(token_id, logprob), ...]) per sequence."""
         S = logits.shape[0]
+        pad = S - len(seqs)
+
+        # Presence/frequency penalties (OpenAI surface): only pay the
+        # scatter-add when some live sequence uses them AND has output.
+        if any(
+            (s.sampling_params.presence_penalty
+             or s.sampling_params.frequency_penalty)
+            and s.output_token_ids
+            for s in seqs
+        ):
+            max_len = max(len(s.output_token_ids) for s in seqs)
+            # Bucket L so XLA compiles O(log) penalty variants, not one per
+            # generated length.
+            L = 64
+            while L < max_len:
+                L *= 2
+            out_tokens = np.full((S, L), -1, np.int32)
+            for i, s in enumerate(seqs):
+                ids = s.output_token_ids[-L:]
+                out_tokens[i, : len(ids)] = ids
+            presence = np.array(
+                [s.sampling_params.presence_penalty for s in seqs] + [0.0] * pad,
+                np.float32,
+            )
+            frequency = np.array(
+                [s.sampling_params.frequency_penalty for s in seqs] + [0.0] * pad,
+                np.float32,
+            )
+            logits = self._penalties_fn(
+                logits,
+                jnp.asarray(out_tokens),
+                jnp.asarray(presence),
+                jnp.asarray(frequency),
+            )
+
         temps = np.array(
-            [s.sampling_params.temperature for s in seqs] + [0.0] * (S - len(seqs)),
+            [s.sampling_params.temperature for s in seqs] + [0.0] * pad,
             np.float32,
         )
         top_ps = np.array(
-            [s.sampling_params.top_p for s in seqs] + [1.0] * (S - len(seqs)),
+            [s.sampling_params.top_p for s in seqs] + [1.0] * pad,
             np.float32,
         )
         top_ks = np.array(
-            [s.sampling_params.top_k for s in seqs] + [0] * (S - len(seqs)), np.int32
+            [s.sampling_params.top_k for s in seqs] + [0] * pad, np.int32
         )
         seeds = np.array(
             [
                 (s.sampling_params.seed if s.sampling_params.seed is not None else idx)
                 for idx, s in enumerate(seqs)
             ]
-            + [0] * (S - len(seqs)),
+            + [0] * pad,
             np.int32,
         )
         step_key = jax.random.PRNGKey(self.config.seed + self._step_counter)
@@ -394,14 +440,44 @@ class LLMEngine:
             step_key,
             jnp.asarray(seeds),
         )
-        return [int(t) for t in np.asarray(out[: len(seqs)])]
+        token_ids = [int(t) for t in np.asarray(out[: len(seqs)])]
+
+        logprob_info: List = [None] * len(seqs)
+        if any(s.sampling_params.logprobs for s in seqs):
+            # Fixed k = the API clamp (20): a per-batch k would compile a
+            # fresh XLA variant inside the step thread for every new value,
+            # stalling all in-flight sequences; per-sequence counts are
+            # sliced on the host below.
+            chosen, top_ids, top_logps = self._logprobs_fn(
+                logits, out, k=20
+            )
+            chosen = np.asarray(chosen)
+            top_ids = np.asarray(top_ids)
+            top_logps = np.asarray(top_logps)
+            for i, s in enumerate(seqs):
+                if s.sampling_params.logprobs:
+                    n = s.sampling_params.top_logprobs
+                    logprob_info[i] = (
+                        float(chosen[i]),
+                        [
+                            (int(top_ids[i, j]), float(top_logps[i, j]))
+                            for j in range(n)
+                        ],
+                    )
+        return token_ids, logprob_info
 
     def _append_and_check(
-        self, seqs: List[Sequence], token_ids: List[int], first_token: bool
+        self,
+        seqs: List[Sequence],
+        token_ids: List[int],
+        first_token: bool,
+        logprob_info: Optional[List] = None,
     ) -> List[StepOutput]:
         outputs: List[StepOutput] = []
         now = time.time()
-        for seq, token_id in zip(seqs, token_ids):
+        if logprob_info is None:
+            logprob_info = [None] * len(seqs)
+        for seq, token_id, lp in zip(seqs, token_ids, logprob_info):
             seq.output_token_ids.append(token_id)
             self.total_generated_tokens += 1
             if seq.first_token_time is None:
@@ -421,6 +497,8 @@ class LLMEngine:
                     finish_reason=finish,
                     num_prompt_tokens=seq.num_prompt_tokens,
                     num_output_tokens=seq.num_generated,
+                    logprob=lp[0] if lp else None,
+                    top_logprobs=lp[1] if lp else None,
                 )
             )
         return outputs

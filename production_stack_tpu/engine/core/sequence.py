@@ -31,6 +31,9 @@ class SamplingParams:
     ignore_eos: bool = False
     seed: Optional[int] = None
     logprobs: bool = False
+    top_logprobs: int = 0  # alternatives returned per token when logprobs
+    presence_penalty: float = 0.0
+    frequency_penalty: float = 0.0
 
 
 @dataclasses.dataclass
@@ -93,3 +96,7 @@ class StepOutput:
     finish_reason: Optional[FinishReason]
     num_prompt_tokens: int
     num_output_tokens: int
+    # Set when the request asked for logprobs: log P(chosen) and the top-k
+    # alternatives as (token_id, logprob) pairs.
+    logprob: Optional[float] = None
+    top_logprobs: Optional[List] = None

@@ -67,3 +67,40 @@ def compute_logprobs(logits: jax.Array, token_ids: jax.Array) -> jax.Array:
     """Log-prob of the chosen tokens: [S, V], [S] -> [S]."""
     logp = jax.nn.log_softmax(logits, axis=-1)
     return jnp.take_along_axis(logp, token_ids[:, None], axis=-1)[:, 0]
+
+
+def apply_penalties(
+    logits: jax.Array,  # [S, V] fp32
+    out_tokens: jax.Array,  # [S, L] int32 generated-so-far, -1 padded
+    presence: jax.Array,  # [S]
+    frequency: jax.Array,  # [S]
+) -> jax.Array:
+    """OpenAI presence/frequency penalties over the GENERATED tokens (vLLM
+    semantics: the prompt is not penalized).  Per sequence:
+    ``logit[t] -= presence*[count(t)>0] + frequency*count(t)``.
+
+    The [S, V] count matrix is built on-device by scatter-add from the
+    small [S, L] id array — no dense host->device transfer per step."""
+    S, V = logits.shape
+    valid = out_tokens >= 0
+    ids = jnp.where(valid, out_tokens, 0)
+    counts = jax.vmap(
+        lambda i, v: jnp.zeros((V,), jnp.float32).at[i].add(
+            v.astype(jnp.float32)
+        )
+    )(ids, valid)
+    penalty = presence[:, None] * (counts > 0) + frequency[:, None] * counts
+    return logits - penalty
+
+
+def top_logprobs_of(
+    logits: jax.Array,  # [S, V] fp32
+    token_ids: jax.Array,  # [S] chosen tokens
+    k: int,
+):
+    """Chosen-token logprob + top-k alternatives (OpenAI ``logprobs``).
+    Returns (chosen [S], top_ids [S, k], top_logps [S, k])."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    chosen = jnp.take_along_axis(logp, token_ids[:, None], axis=-1)[:, 0]
+    top_logps, top_ids = jax.lax.top_k(logp, k)
+    return chosen, top_ids.astype(jnp.int32), top_logps

@@ -29,10 +29,19 @@ from production_stack_tpu.utils.log import init_logger
 logger = logging.getLogger(__name__)
 
 
-def _sampling_from_body(body: dict) -> SamplingParams:
+def _sampling_from_body(body: dict, chat: bool) -> SamplingParams:
     stop = body.get("stop")
     if isinstance(stop, str):
         stop = [stop]
+    # logprobs: chat uses bool `logprobs` + int `top_logprobs`; the legacy
+    # completions API uses int-or-null `logprobs` as the top-k count.
+    if chat:
+        want_logprobs = bool(body.get("logprobs", False))
+        top_logprobs = int(body.get("top_logprobs") or 0)
+    else:
+        raw = body.get("logprobs")
+        want_logprobs = raw is not None and raw is not False
+        top_logprobs = int(raw or 0) if not isinstance(raw, bool) else 0
     return SamplingParams(
         max_tokens=int(
             body.get("max_tokens") or body.get("max_completion_tokens") or 128
@@ -43,6 +52,10 @@ def _sampling_from_body(body: dict) -> SamplingParams:
         stop=stop,
         ignore_eos=bool(body.get("ignore_eos", False)),
         seed=body.get("seed"),
+        logprobs=want_logprobs,
+        top_logprobs=max(0, min(top_logprobs, 20)),
+        presence_penalty=float(body.get("presence_penalty") or 0.0),
+        frequency_penalty=float(body.get("frequency_penalty") or 0.0),
     )
 
 
@@ -76,6 +89,15 @@ class StopChecker:
         if delta:
             self.emitted_text = safe
         return delta, False
+
+    def flush(self) -> str:
+        """Remaining held-back text when generation ends WITHOUT a stop
+        match (e.g. max_tokens with output ending in a partial stop
+        prefix); without this the tail characters are silently dropped."""
+        text = self.tokenizer.decode(self.token_ids)
+        delta = text[len(self.emitted_text):]
+        self.emitted_text = text
+        return delta
 
 
 def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
@@ -138,7 +160,7 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
             prompt = body.get("prompt") or ""
             if isinstance(prompt, list):
                 prompt = "\n".join(str(p) for p in prompt)
-        params = _sampling_from_body(body)
+        params = _sampling_from_body(body, chat)
         stream = bool(body.get("stream", False))
         request_id = request.headers.get("x-request-id") or f"cmpl-{uuid.uuid4().hex[:16]}"
         created = int(time.time())
@@ -174,7 +196,23 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
             request_id=request_id,
         )
 
-        def chunk_payload(delta_text: str, finish_reason, first: bool):
+        # Running character offset for the legacy completions logprobs
+        # text_offset array (consumed by e.g. lm-evaluation-harness).
+        stream_state = {"offset": 0}
+
+        def _logprob_entry(event) -> dict:
+            """One token's OpenAI chat-style logprobs entry."""
+            return {
+                "token": tokenizer.decode([event.token_id]),
+                "logprob": event.logprob,
+                "top_logprobs": [
+                    {"token": tokenizer.decode([tid]), "logprob": lp}
+                    for tid, lp in (event.top_logprobs or [])
+                ],
+            }
+
+        def chunk_payload(delta_text: str, finish_reason, first: bool,
+                          event=None):
             if chat:
                 delta = {}
                 if first:
@@ -182,8 +220,24 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
                 if delta_text:
                     delta["content"] = delta_text
                 choice = {"index": 0, "delta": delta, "finish_reason": finish_reason}
+                if params.logprobs and event is not None:
+                    choice["logprobs"] = {"content": [_logprob_entry(event)]}
             else:
                 choice = {"index": 0, "text": delta_text, "finish_reason": finish_reason}
+                if params.logprobs and event is not None:
+                    tok_text = tokenizer.decode([event.token_id])
+                    choice["logprobs"] = {
+                        "tokens": [tok_text],
+                        "token_logprobs": [event.logprob],
+                        "top_logprobs": [
+                            {
+                                tokenizer.decode([tid]): lp
+                                for tid, lp in (event.top_logprobs or [])
+                            }
+                        ],
+                        "text_offset": [stream_state["offset"]],
+                    }
+                    stream_state["offset"] += len(tok_text)
             return {
                 "id": request_id,
                 "object": object_name,
@@ -203,8 +257,18 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
                 async for event in gen:
                     delta, stopped = checker.push(event.token_id)
                     n_out = event.num_output_tokens
-                    if delta or first:
-                        payload = chunk_payload(delta, None, first)
+                    if event.finished and not stopped:
+                        # Flush any partial-stop-suffix holdback so the
+                        # client gets the full tail.
+                        delta += checker.flush()
+                    if delta or first or params.logprobs:
+                        # A stop-triggering token is trimmed from the text,
+                        # so it must not contribute a logprobs entry either
+                        # (OpenAI: logprobs.content aligns with content).
+                        payload = chunk_payload(
+                            delta, None, first,
+                            event=None if stopped else event,
+                        )
                         await response.write(
                             f"data: {json.dumps(payload)}\n\n".encode()
                         )
@@ -234,11 +298,16 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
 
         # Non-streaming: accumulate.
         text_parts = []
+        logprob_entries = []
         finish_reason = "length"
         n_out = 0
         async for event in gen:
             delta, stopped = checker.push(event.token_id)
             text_parts.append(delta)
+            if params.logprobs and not stopped:
+                # The stop-trigger token is trimmed from the text; keep
+                # logprobs aligned with the returned content.
+                logprob_entries.append(event)
             n_out = event.num_output_tokens
             if stopped:
                 finish_reason = "stop"
@@ -246,6 +315,7 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
                     await engine.abort(request_id)
                 break
             if event.finished:
+                text_parts.append(checker.flush())
                 finish_reason = (
                     "stop" if event.finish_reason == FinishReason.STOP else "length"
                 )
@@ -257,9 +327,33 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
                 "message": {"role": "assistant", "content": text},
                 "finish_reason": finish_reason,
             }
+            if params.logprobs:
+                choice["logprobs"] = {
+                    "content": [_logprob_entry(e) for e in logprob_entries]
+                }
             obj = "chat.completion"
         else:
             choice = {"index": 0, "text": text, "finish_reason": finish_reason}
+            if params.logprobs:
+                token_texts = [
+                    tokenizer.decode([e.token_id]) for e in logprob_entries
+                ]
+                offsets, pos = [], 0
+                for t in token_texts:
+                    offsets.append(pos)
+                    pos += len(t)
+                choice["logprobs"] = {
+                    "tokens": token_texts,
+                    "token_logprobs": [e.logprob for e in logprob_entries],
+                    "top_logprobs": [
+                        {
+                            tokenizer.decode([tid]): lp
+                            for tid, lp in (e.top_logprobs or [])
+                        }
+                        for e in logprob_entries
+                    ],
+                    "text_offset": offsets,
+                }
             obj = "text_completion"
         return web.json_response(
             {

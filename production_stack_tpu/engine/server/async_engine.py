@@ -32,6 +32,8 @@ class TokenEvent:
     finish_reason: Optional[FinishReason]
     num_prompt_tokens: int
     num_output_tokens: int
+    logprob: Optional[float] = None
+    top_logprobs: Optional[list] = None  # [(token_id, logprob), ...]
 
 
 class AsyncEngine:
@@ -140,6 +142,8 @@ class AsyncEngine:
                             finish_reason=out.finish_reason,
                             num_prompt_tokens=out.num_prompt_tokens,
                             num_output_tokens=out.num_output_tokens,
+                            logprob=out.logprob,
+                            top_logprobs=out.top_logprobs,
                         ),
                     )
         logger.info("engine step loop exited")

@@ -234,3 +234,28 @@ def test_tp_validation_rejects_indivisible_heads():
 def test_dp_validation_rejects_indivisible_batch():
     with pytest.raises(ValueError):
         mesh_engine(dp=2, max_num_seqs=3)
+
+
+@requires_8_devices
+def test_engine_generation_parity_with_attention_bias_tp():
+    """Qwen2-style QKV biases under tensor parallelism: the P(TP) bias
+    shardings (parallel/shardings.py _layer_specs) must compile and keep
+    greedy parity with the single-device engine."""
+    def biased_engine(dp=1, tp=1, sp=1):
+        cfg = EngineConfig(
+            model=ModelConfig(dtype="float32", attention_bias=True),
+            cache=CacheConfig(block_size=4, num_blocks=128),
+            parallel=ParallelConfig(
+                data_parallel=dp, tensor_parallel=tp, sequence_parallel=sp
+            ),
+            scheduler=SchedulerConfig(
+                max_num_seqs=4,
+                prefill_buckets=(16, 32, 64, 128),
+                max_model_len=256,
+            ),
+        )
+        return LLMEngine(cfg)
+
+    want = generate_all(biased_engine(), PROMPTS[:2])
+    got = generate_all(biased_engine(tp=2, sp=2), PROMPTS[:2])
+    assert got == want

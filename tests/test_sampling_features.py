@@ -1,0 +1,267 @@
+"""Presence/frequency penalties and logprobs — unit math, engine behavior,
+and the OpenAI response shapes through the real server.
+"""
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+from aiohttp.test_utils import TestServer
+
+from production_stack_tpu.engine.config import (
+    CacheConfig,
+    EngineConfig,
+    ModelConfig,
+    SchedulerConfig,
+)
+from production_stack_tpu.engine.core.engine import LLMEngine
+from production_stack_tpu.engine.core.sequence import SamplingParams
+from production_stack_tpu.engine.sampling import apply_penalties, top_logprobs_of
+
+# ---------------------------------------------------------------------------
+# Unit: sampler math
+# ---------------------------------------------------------------------------
+
+
+def test_apply_penalties_math():
+    logits = jnp.zeros((2, 8), jnp.float32)
+    out_tokens = jnp.asarray([[3, 3, 5, -1], [-1, -1, -1, -1]], jnp.int32)
+    presence = jnp.asarray([1.0, 1.0], jnp.float32)
+    frequency = jnp.asarray([0.5, 0.5], jnp.float32)
+    got = np.asarray(apply_penalties(logits, out_tokens, presence, frequency))
+    # Seq 0: token 3 seen twice -> -(1.0 + 0.5*2) = -2.0; token 5 once -> -1.5.
+    np.testing.assert_allclose(got[0, 3], -2.0)
+    np.testing.assert_allclose(got[0, 5], -1.5)
+    np.testing.assert_allclose(got[0, 0], 0.0)
+    # Seq 1 generated nothing: unpenalized.
+    np.testing.assert_allclose(got[1], 0.0)
+
+
+def test_apply_penalties_padding_token_not_penalized():
+    """-1 padding maps to id 0 for the scatter but with weight 0: token 0's
+    logit must be untouched."""
+    logits = jnp.ones((1, 4), jnp.float32)
+    out_tokens = jnp.full((1, 8), -1, jnp.int32)
+    got = np.asarray(apply_penalties(
+        logits, out_tokens, jnp.asarray([5.0]), jnp.asarray([5.0])
+    ))
+    np.testing.assert_allclose(got, 1.0)
+
+
+def test_top_logprobs_of():
+    logits = jnp.asarray([[0.0, 1.0, 2.0, -1.0]], jnp.float32)
+    chosen, top_ids, top_lps = top_logprobs_of(logits, jnp.asarray([1]), k=2)
+    ref = np.exp([0.0, 1.0, 2.0, -1.0])
+    ref_logp = np.log(ref / ref.sum())
+    np.testing.assert_allclose(float(chosen[0]), ref_logp[1], rtol=1e-6)
+    assert list(np.asarray(top_ids[0])) == [2, 1]  # sorted desc
+    np.testing.assert_allclose(
+        np.asarray(top_lps[0]), ref_logp[[2, 1]], rtol=1e-6
+    )
+
+
+# ---------------------------------------------------------------------------
+# Engine behavior
+# ---------------------------------------------------------------------------
+
+
+def tiny_engine():
+    return LLMEngine(EngineConfig(
+        model=ModelConfig(),
+        cache=CacheConfig(block_size=4, num_blocks=128),
+        scheduler=SchedulerConfig(
+            max_num_seqs=4, prefill_buckets=(16, 32, 64), max_model_len=128
+        ),
+    ))
+
+
+def run_one(engine, seq_id, prompt, params, max_steps=300):
+    engine.add_request(seq_id, prompt=prompt, sampling_params=params)
+    events = []
+    for _ in range(max_steps):
+        if not engine.has_unfinished():
+            break
+        events.extend(engine.step())
+    assert not engine.has_unfinished()
+    return events
+
+
+def test_presence_penalty_forbids_repeats_under_greedy():
+    """A huge presence penalty makes every generated token distinct (each
+    emitted token's logit is pushed to -inf for the rest of the sequence)."""
+    params = SamplingParams(max_tokens=16, presence_penalty=1e9)
+    events = run_one(tiny_engine(), "r", "penalize me", params)
+    tokens = [e.new_token_id for e in events]
+    assert len(tokens) == 16
+    assert len(set(tokens)) == len(tokens), f"repeat under huge penalty: {tokens}"
+
+    # Same prompt without penalty repeats at least one token (tiny random
+    # model, 16 greedy steps) — guards against the penalty path being a
+    # no-op that accidentally passes the distinctness check.
+    baseline = [
+        e.new_token_id
+        for e in run_one(tiny_engine(), "r", "penalize me",
+                         SamplingParams(max_tokens=16))
+    ]
+    assert len(set(baseline)) < len(baseline)
+
+
+def test_penalties_zero_is_noop_on_greedy_output():
+    want = [e.new_token_id for e in run_one(
+        tiny_engine(), "r", "stable output", SamplingParams(max_tokens=8)
+    )]
+    got = [e.new_token_id for e in run_one(
+        tiny_engine(), "r", "stable output",
+        SamplingParams(max_tokens=8, presence_penalty=0.0, frequency_penalty=0.0),
+    )]
+    assert got == want
+
+
+def test_engine_logprobs_returned_and_consistent():
+    params = SamplingParams(max_tokens=5, logprobs=True, top_logprobs=3)
+    events = run_one(tiny_engine(), "r", "logprobs please", params)
+    assert len(events) == 5
+    for e in events:
+        assert e.logprob is not None and math.isfinite(e.logprob)
+        assert e.logprob <= 0.0
+        assert len(e.top_logprobs) == 3
+        lps = [lp for _, lp in e.top_logprobs]
+        assert lps == sorted(lps, reverse=True)
+        # Greedy: the chosen token IS the top-1 alternative.
+        assert e.top_logprobs[0][0] == e.new_token_id
+        np.testing.assert_allclose(e.top_logprobs[0][1], e.logprob, rtol=1e-5)
+
+
+def test_logprobs_off_has_no_cost_fields():
+    events = run_one(tiny_engine(), "r", "plain", SamplingParams(max_tokens=3))
+    assert all(e.logprob is None and e.top_logprobs is None for e in events)
+
+
+# ---------------------------------------------------------------------------
+# OpenAI response shapes through the real server
+# ---------------------------------------------------------------------------
+
+
+async def _engine_server():
+    from production_stack_tpu.engine.config import config_from_preset
+    from production_stack_tpu.engine.server.api_server import build_engine_app
+    from production_stack_tpu.engine.server.async_engine import AsyncEngine
+
+    config = config_from_preset(
+        "tiny-llama",
+        **{"scheduler.max_num_seqs": 2, "scheduler.max_model_len": 256,
+           "cache.num_blocks": 128},
+    )
+    engine = AsyncEngine(config)
+    server = TestServer(build_engine_app(engine, "tiny-llama"))
+    await server.start_server()
+    return server
+
+
+async def test_chat_logprobs_response_shape():
+    import aiohttp
+
+    server = await _engine_server()
+    url = f"http://127.0.0.1:{server.port}"
+    try:
+        async with aiohttp.ClientSession() as session:
+            async with session.post(f"{url}/v1/chat/completions", json={
+                "model": "tiny-llama",
+                "messages": [{"role": "user", "content": "hi"}],
+                "max_tokens": 4,
+                "logprobs": True,
+                "top_logprobs": 2,
+            }) as resp:
+                assert resp.status == 200
+                body = await resp.json()
+        content = body["choices"][0]["logprobs"]["content"]
+        assert len(content) == 4
+        for entry in content:
+            assert entry["logprob"] <= 0.0
+            assert len(entry["top_logprobs"]) == 2
+            assert isinstance(entry["token"], str)
+    finally:
+        await server.close()
+
+
+async def test_completions_logprobs_and_penalties_accepted():
+    import aiohttp
+
+    server = await _engine_server()
+    url = f"http://127.0.0.1:{server.port}"
+    try:
+        async with aiohttp.ClientSession() as session:
+            async with session.post(f"{url}/v1/completions", json={
+                "model": "tiny-llama",
+                "prompt": "legacy api",
+                "max_tokens": 3,
+                "logprobs": 2,
+                "presence_penalty": 0.5,
+                "frequency_penalty": 0.25,
+            }) as resp:
+                assert resp.status == 200
+                body = await resp.json()
+        lp = body["choices"][0]["logprobs"]
+        assert len(lp["tokens"]) == 3
+        assert len(lp["token_logprobs"]) == 3
+        assert all(isinstance(d, dict) and len(d) <= 2 for d in lp["top_logprobs"])
+    finally:
+        await server.close()
+
+
+async def test_stop_token_excluded_from_logprobs_and_tail_flushed():
+    """Two alignment guarantees: (a) a stop-triggering token contributes no
+    logprobs entry (OpenAI aligns logprobs.content with content); (b) text
+    held back by the partial-stop-suffix buffer is flushed when generation
+    ends via max_tokens."""
+    import aiohttp
+
+    server = await _engine_server()
+    url = f"http://127.0.0.1:{server.port}"
+    try:
+        async with aiohttp.ClientSession() as session:
+            # (a): stop on a string the byte model will eventually emit is
+            # not deterministic; instead verify the invariant structurally:
+            # len(logprobs.content) == number of emitted tokens that were
+            # NOT trimmed, which equals len(content) alignment here because
+            # the byte tokenizer maps one token to >=0 chars.  Run with a
+            # stop that never matches: entries == max_tokens.
+            async with session.post(f"{url}/v1/chat/completions", json={
+                "model": "tiny-llama",
+                "messages": [{"role": "user", "content": "align"}],
+                "max_tokens": 6,
+                "logprobs": True,
+                "top_logprobs": 1,
+                "stop": ["ZZZZZZZZ"],
+            }) as resp:
+                body = await resp.json()
+            assert len(body["choices"][0]["logprobs"]["content"]) == 6
+
+            # (b): non-streaming text must equal the detokenization of all
+            # emitted tokens even when it ends in a partial stop prefix.
+            # Use a 1-char stop prefix trap: stop string of two chars whose
+            # first char may occur at the tail.
+            async with session.post(f"{url}/v1/completions", json={
+                "model": "tiny-llama",
+                "prompt": "flush tail",
+                "max_tokens": 5,
+                "logprobs": 0,
+            }) as resp:
+                plain = await resp.json()
+            async with session.post(f"{url}/v1/completions", json={
+                "model": "tiny-llama",
+                "prompt": "flush tail",
+                "max_tokens": 5,
+                "logprobs": 0,
+                # Stop strings that never fully match but whose 1-char
+                # prefixes cover the whole byte range of the model's
+                # output alphabet would be unwieldy; instead use a
+                # two-char stop whose first char equals the plain run's
+                # final char, forcing a holdback at the tail.
+                "stop": [plain["choices"][0]["text"][-1] + "\x00"],
+            }) as resp:
+                held = await resp.json()
+            # Greedy: same tokens; the held-back final char must be flushed.
+            assert held["choices"][0]["text"] == plain["choices"][0]["text"]
+    finally:
+        await server.close()
