@@ -190,11 +190,29 @@ class SchedulerConfig:
 
 
 @dataclasses.dataclass
+class LoraServingConfig:
+    """Multi-LoRA slots (engine/lora.py); max_loras=0 disables the path."""
+
+    max_loras: int = 0
+    max_rank: int = 16
+
+    @property
+    def enabled(self) -> bool:
+        return self.max_loras > 0
+
+    @property
+    def num_slots(self) -> int:
+        # +1 for the identity slot 0 (base model).
+        return self.max_loras + 1
+
+
+@dataclasses.dataclass
 class EngineConfig:
     model: ModelConfig = dataclasses.field(default_factory=ModelConfig)
     cache: CacheConfig = dataclasses.field(default_factory=CacheConfig)
     parallel: ParallelConfig = dataclasses.field(default_factory=ParallelConfig)
     scheduler: SchedulerConfig = dataclasses.field(default_factory=SchedulerConfig)
+    lora: LoraServingConfig = dataclasses.field(default_factory=LoraServingConfig)
     seed: int = 0
     tokenizer: Optional[str] = None  # HF tokenizer path; None -> byte fallback
     weights_path: Optional[str] = None  # safetensors dir; None -> random init

@@ -152,6 +152,16 @@ class LLMEngine:
             sampling_lib.top_logprobs_of, static_argnames=("k",)
         )
 
+        # Multi-LoRA slot arrays (engine/lora.py); None keeps the model's
+        # lora-free code path (zero overhead, separate compiled programs).
+        self.lora_registry = None
+        if config.lora.enabled:
+            from production_stack_tpu.engine.lora import AdapterRegistry
+
+            self.lora_registry = AdapterRegistry(
+                cfg, config.lora, jnp.dtype(cfg.dtype)
+            )
+
         self._step_counter = 0
         self._seqs: Dict[str, Sequence] = {}
         # Cumulative counters for /metrics.
@@ -225,6 +235,7 @@ class LLMEngine:
         prompt: Optional[str] = None,
         prompt_token_ids: Optional[List[int]] = None,
         sampling_params: Optional[SamplingParams] = None,
+        adapter: Optional[str] = None,
     ) -> None:
         if prompt_token_ids is None:
             if prompt is None:
@@ -232,10 +243,23 @@ class LLMEngine:
             prompt_token_ids = self.tokenizer.encode(prompt)
         if not prompt_token_ids:
             prompt_token_ids = [self.tokenizer.bos_token_id or 0]
+        adapter_idx = 0
+        cache_ns = 0
+        if adapter:
+            if self.lora_registry is None:
+                raise ValueError(
+                    "LoRA adapter requested but the engine was started with "
+                    "max_loras=0"
+                )
+            adapter_idx = self.lora_registry.slot_of(adapter)  # raises if unknown
+            cache_ns = self.lora_registry.namespace_of(adapter)
         seq = Sequence(
             seq_id=request_id,
             prompt_token_ids=list(prompt_token_ids),
             sampling_params=sampling_params or SamplingParams(),
+            adapter=adapter,
+            adapter_idx=adapter_idx,
+            cache_ns=cache_ns,
         )
         self._seqs[request_id] = seq
         self.scheduler.add_seq(seq)
@@ -318,6 +342,12 @@ class LLMEngine:
         prefix_ids = np.zeros((pmax,), np.int32)
         prefix_ids[: len(plan.prefix_block_ids)] = plan.prefix_block_ids
 
+        lora_kwargs = {}
+        if self.lora_registry is not None:
+            lora_kwargs = {
+                "lora": self.lora_registry.params,
+                "adapter_idx": jnp.int32(seq.adapter_idx),
+            }
         logits, self.kv_caches = self._prefill_fn(
             self.params,
             tokens=self._put(tokens, P(AXES.SP)),
@@ -326,6 +356,7 @@ class LLMEngine:
             new_block_ids=self._put(new_block_ids, P(AXES.SP)),
             valid_len=jnp.int32(plan.num_new_tokens),
             kv_caches=self.kv_caches,
+            **lora_kwargs,
         )
         if not plan.is_final:
             # Non-final chunk of a long prompt: KV is written, but the
@@ -358,6 +389,15 @@ class LLMEngine:
             slot_offsets[i] = pos % bs
 
         batch_spec = shardings_lib.decode_batch_spec()
+        lora_kwargs = {}
+        if self.lora_registry is not None:
+            adapter_idx = np.zeros((S,), np.int32)
+            for i, seq in enumerate(seqs):
+                adapter_idx[i] = seq.adapter_idx
+            lora_kwargs = {
+                "lora": self.lora_registry.params,
+                "adapter_idx": self._put(adapter_idx, batch_spec),
+            }
         logits, self.kv_caches = self._decode_fn(
             self.params,
             tokens=self._put(tokens, batch_spec),
@@ -367,6 +407,7 @@ class LLMEngine:
             slot_block_ids=self._put(slot_blocks, batch_spec),
             slot_offsets=self._put(slot_offsets, batch_spec),
             kv_caches=self.kv_caches,
+            **lora_kwargs,
         )
         token_ids, logprob_info = self._sample_batch(logits[: len(seqs)], seqs)
         return self._append_and_check(
@@ -537,6 +578,32 @@ class LLMEngine:
             if t > cutoff
         )
         return min(1.0, busy / self._busy_window_s)
+
+    # -- multi-LoRA admin (engine/lora.py) ---------------------------------
+
+    def _require_lora(self):
+        if self.lora_registry is None:
+            raise ValueError("engine started with max_loras=0")
+        return self.lora_registry
+
+    def load_lora(self, name: str, layer_factors, rank: int,
+                  alpha: float = 16.0) -> int:
+        return self._require_lora().load(name, layer_factors, rank, alpha)
+
+    def load_lora_from_path(self, name: str, path: str,
+                            alpha: float = 16.0) -> int:
+        from production_stack_tpu.engine.lora import load_peft_safetensors
+
+        factors, rank = load_peft_safetensors(
+            path, self.config.model.num_layers
+        )
+        return self.load_lora(name, factors, rank, alpha)
+
+    def unload_lora(self, name: str) -> None:
+        self._require_lora().unload(name)
+
+    def loaded_adapters(self) -> List[str]:
+        return [] if self.lora_registry is None else self.lora_registry.loaded()
 
     def stats(self) -> Dict[str, float]:
         return {

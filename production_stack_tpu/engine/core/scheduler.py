@@ -198,7 +198,7 @@ class Scheduler:
             cached_len = seq.num_cached_tokens
         else:
             prefix_blocks, cached_len = self.block_pool.match_prefix(
-                seq.prompt_token_ids
+                seq.prompt_token_ids, namespace=seq.cache_ns
             )
         num_new = seq.num_prompt_tokens - cached_len
         bucket = self._bucket_for(num_new)
@@ -290,6 +290,8 @@ class Scheduler:
             self.running.remove(seq)
         # Register the sequence's full blocks for prefix reuse BEFORE
         # freeing, so the freed blocks enter the reclaimable LRU tier.
-        self.block_pool.register_prefix(seq.all_token_ids, seq.block_table)
+        self.block_pool.register_prefix(
+            seq.all_token_ids, seq.block_table, namespace=seq.cache_ns
+        )
         self._release(seq)
         seq.status = SequenceStatus.FINISHED

@@ -44,6 +44,13 @@ class Sequence:
     arrival_time: float = dataclasses.field(default_factory=time.time)
 
     status: SequenceStatus = SequenceStatus.WAITING
+    # Multi-LoRA: adapter name + resolved slot (0 = base model, engine/lora.py).
+    adapter: Optional[str] = None
+    adapter_idx: int = 0
+    # Prefix-cache namespace: a per-load-event id (NOT the slot index), so
+    # KV cached by a slot's previous tenant can never be served after a
+    # slot is reused or an adapter reloaded.
+    cache_ns: int = 0
     output_token_ids: List[int] = dataclasses.field(default_factory=list)
     block_table: List[int] = dataclasses.field(default_factory=list)
     num_cached_tokens: int = 0  # prefix-cache hit length at admission

@@ -107,7 +107,16 @@ class BlockPool:
 
     # -- prefix caching ----------------------------------------------------
 
-    def match_prefix(self, token_ids: Sequence[int]) -> Tuple[List[int], int]:
+    @staticmethod
+    def _namespace_seed(namespace: int) -> Optional[bytes]:
+        """Seed the hash chain per namespace (e.g. LoRA adapter slot): KV
+        computed under one adapter must never be served to another.
+        Namespace 0 keeps the legacy unseeded chain."""
+        return _chain_hash(None, [namespace]) if namespace else None
+
+    def match_prefix(
+        self, token_ids: Sequence[int], namespace: int = 0
+    ) -> Tuple[List[int], int]:
         """Longest cached full-block prefix of token_ids.
 
         Returns (block_ids, num_cached_tokens); increments the matched
@@ -120,7 +129,7 @@ class BlockPool:
         bs = self.block_size
         usable = len(token_ids) - 1  # leave >=1 token for prefill
         blocks: List[int] = []
-        prev: Optional[bytes] = None
+        prev: Optional[bytes] = self._namespace_seed(namespace)
         for start in range(0, usable - usable % bs, bs):
             digest = _chain_hash(prev, token_ids[start : start + bs])
             block = self._hash_to_block.get(digest)
@@ -139,14 +148,17 @@ class BlockPool:
         return blocks, cached
 
     def register_prefix(
-        self, token_ids: Sequence[int], block_table: Sequence[int]
+        self,
+        token_ids: Sequence[int],
+        block_table: Sequence[int],
+        namespace: int = 0,
     ) -> None:
         """Record hash chain for every *full* block of this sequence so later
         requests with the same prefix hit the cache."""
         if not self.enable_prefix_caching:
             return
         bs = self.block_size
-        prev: Optional[bytes] = None
+        prev: Optional[bytes] = self._namespace_seed(namespace)
         for i in range(len(token_ids) // bs):
             digest = _chain_hash(prev, token_ids[i * bs : (i + 1) * bs])
             block = block_table[i]

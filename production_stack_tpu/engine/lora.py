@@ -1,0 +1,258 @@
+"""Multi-LoRA serving: static adapter slots, batched application.
+
+Implements proposals/lora-tpu-support.md's engine half.  XLA compiles one
+program, so adapter swaps must not change shapes: the engine reserves
+``max_loras`` slots of rank-``max_rank`` A/B factors per target projection
+at startup.  Loading an adapter is a device-array slice update (no
+recompile); slot 0 is the identity (all-zero B) and is what base-model
+requests run with, so a LoRA-enabled engine pays one small gather+matmul
+pair per projection and nothing else.
+
+Per-sequence selection: decode carries ``adapter_idx [S]`` (each row
+gathers its own A/B — MXU-friendly batched einsum); prefill is
+single-sequence and uses a scalar index.
+
+HF/peft checkpoint mapping (load_peft_safetensors): peft stores
+``lora_A.weight [r, in]`` and ``lora_B.weight [out, r]`` per target; we
+store transposed ([in, r], [r, out]) so application is ``(x @ A) @ B``,
+scaled by alpha/r.
+
+Reference counterpart: the reference stack's LoRA story is a design doc
+(proposals/lora-k8s-support.md); execution would happen inside vLLM's CUDA
+LoRA machinery.  Here the TPU engine owns it.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+# Projections that can carry LoRA factors (HF peft target_modules names).
+TARGETS = ("q_proj", "k_proj", "v_proj", "o_proj",
+           "gate_proj", "up_proj", "down_proj")
+
+
+# The slot-count/rank knobs live in config.LoraServingConfig (referenced
+# here as ``lora_cfg``); this module owns the arrays and the math.
+
+
+def _proj_dims(cfg) -> Dict[str, Tuple[int, int]]:
+    h, hd = cfg.hidden_size, cfg.head_dim
+    H, K, I = cfg.num_heads, cfg.num_kv_heads, cfg.intermediate_size
+    return {
+        "q_proj": (h, H * hd),
+        "k_proj": (h, K * hd),
+        "v_proj": (h, K * hd),
+        "o_proj": (H * hd, h),
+        "gate_proj": (h, I),
+        "up_proj": (h, I),
+        "down_proj": (I, h),
+    }
+
+
+def init_lora_params(model_cfg, lora_cfg, dtype) -> Dict:
+    """Zero-initialized slot arrays: {"layers": [{proj: (A, B)}...],
+    "scale": [num_slots]}.  Zero B => identity for every slot until loaded."""
+    L = lora_cfg.num_slots
+    r = lora_cfg.max_rank
+    layers = []
+    for _ in range(model_cfg.num_layers):
+        layer = {}
+        for proj, (d_in, d_out) in _proj_dims(model_cfg).items():
+            layer[proj] = (
+                jnp.zeros((L, d_in, r), dtype),
+                jnp.zeros((L, r, d_out), dtype),
+            )
+        layers.append(layer)
+    return {"layers": layers, "scale": jnp.zeros((L,), jnp.float32)}
+
+
+def lora_delta(
+    x: jax.Array,  # [T, d_in] (prefill) or [S, d_in] (decode)
+    A: jax.Array,  # [L, d_in, r]
+    B: jax.Array,  # [L, r, d_out]
+    idx: jax.Array,  # scalar (prefill) or [S] (decode, row-aligned)
+    scale: jax.Array,  # [L] per-slot alpha/r
+) -> jax.Array:
+    """fp32 delta ``scale[idx] * (x @ A[idx]) @ B[idx]``."""
+    xf = x.astype(jnp.float32)
+    if idx.ndim == 0:
+        a = A[idx].astype(jnp.float32)  # [d_in, r]
+        b = B[idx].astype(jnp.float32)
+        return (xf @ a) @ b * scale[idx]
+    a = A[idx].astype(jnp.float32)  # [S, d_in, r] row gather
+    b = B[idx].astype(jnp.float32)
+    t = jnp.einsum("sd,sdr->sr", xf, a)
+    return jnp.einsum("sr,sro->so", t, b) * scale[idx][:, None]
+
+
+class AdapterRegistry:
+    """Host-side name -> slot bookkeeping + device array updates.
+
+    Concurrency contract: ``params`` is replaced by a SINGLE attribute
+    assignment after the full new tree is built (build-then-swap), so the
+    engine step thread — which reads ``registry.params`` once per step —
+    always sees a complete old or complete new tree, never a torn mix.
+    A failed load raises before the swap and leaves state untouched.
+    """
+
+    def __init__(self, model_cfg, lora_cfg, dtype):
+        self.model_cfg = model_cfg
+        self.lora_cfg = lora_cfg
+        self.dtype = dtype
+        self.params = init_lora_params(model_cfg, lora_cfg, dtype)
+        self._slots: Dict[str, int] = {}  # name -> slot (1..max_loras)
+        # Prefix-cache namespaces: a fresh id per LOAD event (not the slot
+        # index) — reusing a freed slot, or reloading changed weights under
+        # the same name, must never hit KV cached by the previous tenant.
+        self._namespaces: Dict[str, int] = {}
+        self._next_namespace = 1
+
+    def slot_of(self, name: Optional[str]) -> int:
+        if not name:
+            return 0
+        try:
+            return self._slots[name]
+        except KeyError:
+            raise ValueError(
+                f"Unknown LoRA adapter {name!r}; loaded: {sorted(self._slots)}"
+            ) from None
+
+    def namespace_of(self, name: Optional[str]) -> int:
+        """Prefix-cache namespace for this adapter (0 = base model)."""
+        if not name:
+            return 0
+        self.slot_of(name)  # raises for unknown
+        return self._namespaces[name]
+
+    def loaded(self) -> List[str]:
+        return sorted(self._slots)
+
+    def load(
+        self,
+        name: str,
+        layer_factors: List[Dict[str, Tuple[np.ndarray, np.ndarray]]],
+        rank: int,
+        alpha: float,
+    ) -> int:
+        """Install adapter ``name``; factors are per-layer {proj: (A [in,r],
+        B [r,out])} — missing projections stay zero (identity)."""
+        if rank > self.lora_cfg.max_rank:
+            raise ValueError(
+                f"adapter rank {rank} exceeds max_rank {self.lora_cfg.max_rank}"
+            )
+        if len(layer_factors) != self.model_cfg.num_layers:
+            raise ValueError(
+                f"adapter has {len(layer_factors)} layers; model has "
+                f"{self.model_cfg.num_layers}"
+            )
+        dims = _proj_dims(self.model_cfg)
+        # Validate EVERY shape before the first device write: a mid-loop
+        # failure must not leave a half-written adapter serving traffic.
+        for li, factors in enumerate(layer_factors):
+            for proj, (A_np, B_np) in factors.items():
+                if proj not in dims:
+                    raise ValueError(f"layer {li}: unknown projection {proj!r}")
+                d_in, d_out = dims[proj]
+                if A_np.shape != (d_in, rank) or B_np.shape != (rank, d_out):
+                    raise ValueError(
+                        f"layer {li} {proj}: got A{A_np.shape} B{B_np.shape}, "
+                        f"want A({d_in},{rank}) B({rank},{d_out})"
+                    )
+
+        slot = self._slots.get(name)
+        if slot is None:
+            used = set(self._slots.values())
+            free = [
+                s for s in range(1, self.lora_cfg.num_slots) if s not in used
+            ]
+            if not free:
+                raise ValueError(
+                    f"all {self.lora_cfg.max_loras} LoRA slots in use; "
+                    f"unload one of {sorted(self._slots)}"
+                )
+            slot = free[0]
+
+        new_layers = []
+        for li, factors in enumerate(layer_factors):
+            old_layer = self.params["layers"][li]
+            new_layer = {}
+            for proj in TARGETS:
+                A_dev, B_dev = old_layer[proj]
+                d_in, d_out = dims[proj]
+                A_full = np.zeros((d_in, self.lora_cfg.max_rank), np.float32)
+                B_full = np.zeros((self.lora_cfg.max_rank, d_out), np.float32)
+                if proj in factors:
+                    A_np, B_np = factors[proj]
+                    A_full[:, :rank] = A_np
+                    B_full[:rank, :] = B_np
+                new_layer[proj] = (
+                    A_dev.at[slot].set(jnp.asarray(A_full, self.dtype)),
+                    B_dev.at[slot].set(jnp.asarray(B_full, self.dtype)),
+                )
+            new_layers.append(new_layer)
+        new_scale = self.params["scale"].at[slot].set(alpha / rank)
+        # Single-assignment swap (see class docstring).
+        self.params = {"layers": new_layers, "scale": new_scale}
+        self._slots[name] = slot
+        self._namespaces[name] = self._next_namespace
+        self._next_namespace += 1
+        logger.info("LoRA adapter %r loaded into slot %d (rank %d)", name, slot, rank)
+        return slot
+
+    def unload(self, name: str) -> None:
+        slot = self._slots.pop(name, None)
+        if slot is None:
+            return
+        self._namespaces.pop(name, None)
+        # Zeroing B alone makes the slot an identity again; A can stay.
+        new_layers = [
+            {
+                proj: (A_dev, B_dev.at[slot].set(0.0))
+                for proj, (A_dev, B_dev) in layer.items()
+            }
+            for layer in self.params["layers"]
+        ]
+        new_scale = self.params["scale"].at[slot].set(0.0)
+        self.params = {"layers": new_layers, "scale": new_scale}
+        logger.info("LoRA adapter %r unloaded from slot %d", name, slot)
+
+
+def load_peft_safetensors(path: str, num_layers: int):
+    """Read an HF/peft adapter_model.safetensors into per-layer factors.
+    Returns (layer_factors, rank).  peft names:
+    ``base_model.model.model.layers.{i}.self_attn.q_proj.lora_A.weight``."""
+    from safetensors import safe_open
+
+    with safe_open(path, framework="np") as f:
+        tensors = {k: f.get_tensor(k) for k in f.keys()}
+    layer_factors: List[Dict] = [{} for _ in range(num_layers)]
+    rank = None
+    for key, value in tensors.items():
+        if ".layers." not in key or ".lora_" not in key:
+            continue
+        li = int(key.split(".layers.")[1].split(".")[0])
+        proj = next((p for p in TARGETS if f".{p}." in key), None)
+        if proj is None or li >= num_layers:
+            continue
+        a_part = ".lora_A." in key
+        A, B = layer_factors[li].get(proj, (None, None))
+        if a_part:
+            A = value.T  # [r, in] -> [in, r]
+            rank = value.shape[0]
+        else:
+            B = value.T  # [out, r] -> [r, out]
+        layer_factors[li][proj] = (A, B)
+    if rank is None:
+        raise ValueError(f"no lora_A tensors found in {path}")
+    for li, factors in enumerate(layer_factors):
+        for proj, (A, B) in list(factors.items()):
+            if A is None or B is None:
+                raise ValueError(f"layer {li} {proj}: incomplete A/B pair")
+    return layer_factors, rank

@@ -87,12 +87,26 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
     return params
 
 
-def _project_qkv(layer: Params, x: jax.Array, cfg: ModelConfig):
+def _maybe_lora(y, x, lora_layer, proj, adapter_idx, lora_scale):
+    """Add the LoRA delta for ``proj`` when adapters are live (lora.py)."""
+    if lora_layer is None:
+        return y
+    from production_stack_tpu.engine.lora import lora_delta
+
+    A, B = lora_layer[proj]
+    return y + lora_delta(x, A, B, adapter_idx, lora_scale)
+
+
+def _project_qkv(layer: Params, x: jax.Array, cfg: ModelConfig,
+                 lora_layer=None, adapter_idx=None, lora_scale=None):
     """x: [T, h] -> q [T, H, D], k/v [T, K, D]."""
     T = x.shape[0]
     q = jnp.dot(x, layer["q_proj"], preferred_element_type=jnp.float32)
     k = jnp.dot(x, layer["k_proj"], preferred_element_type=jnp.float32)
     v = jnp.dot(x, layer["v_proj"], preferred_element_type=jnp.float32)
+    q = _maybe_lora(q, x, lora_layer, "q_proj", adapter_idx, lora_scale)
+    k = _maybe_lora(k, x, lora_layer, "k_proj", adapter_idx, lora_scale)
+    v = _maybe_lora(v, x, lora_layer, "v_proj", adapter_idx, lora_scale)
     if cfg.attention_bias:
         q = q + layer["q_bias"].astype(jnp.float32)
         k = k + layer["k_bias"].astype(jnp.float32)
@@ -101,6 +115,32 @@ def _project_qkv(layer: Params, x: jax.Array, cfg: ModelConfig):
     k = k.astype(x.dtype).reshape(T, cfg.num_kv_heads, cfg.head_dim)
     v = v.astype(x.dtype).reshape(T, cfg.num_kv_heads, cfg.head_dim)
     return q, k, v
+
+
+def _o_proj(layer: Params, out: jax.Array, lora_layer, adapter_idx, lora_scale):
+    y = jnp.dot(out, layer["o_proj"], preferred_element_type=jnp.float32)
+    return _maybe_lora(y, out, lora_layer, "o_proj", adapter_idx, lora_scale)
+
+
+def _mlp(layer: Params, x: jax.Array, lora_layer, adapter_idx, lora_scale):
+    """swiglu with optional LoRA on gate/up/down (matches ops/layers.py
+    swiglu exactly when lora_layer is None)."""
+    if lora_layer is None:
+        return swiglu(
+            x, layer["gate_proj"], layer["up_proj"], layer["down_proj"]
+        )
+    gate = jnp.dot(x, layer["gate_proj"], preferred_element_type=jnp.float32)
+    up = jnp.dot(x, layer["up_proj"], preferred_element_type=jnp.float32)
+    gate = _maybe_lora(gate, x, lora_layer, "gate_proj", adapter_idx, lora_scale)
+    up = _maybe_lora(up, x, lora_layer, "up_proj", adapter_idx, lora_scale)
+    activated = (jax.nn.silu(gate) * up).astype(x.dtype)
+    down = jnp.dot(
+        activated, layer["down_proj"], preferred_element_type=jnp.float32
+    )
+    down = _maybe_lora(
+        down, activated, lora_layer, "down_proj", adapter_idx, lora_scale
+    )
+    return down.astype(x.dtype)
 
 
 def _lm_head(params: Params, cfg: ModelConfig, hidden: jax.Array) -> jax.Array:
@@ -122,6 +162,8 @@ def prefill(
     valid_len: jax.Array,  # scalar int32: true number of new tokens
     kv_caches: KVCaches,
     mesh: Optional[Mesh] = None,  # SPMD mesh; sp>1 -> ring attention
+    lora: Optional[Dict] = None,  # LoRA slot arrays (lora.py); None = off
+    adapter_idx: Optional[jax.Array] = None,  # scalar slot for this seq
 ) -> Tuple[jax.Array, KVCaches]:
     """One sequence's prefill.  Returns (last-token logits [V], new caches).
 
@@ -138,11 +180,17 @@ def prefill(
 
     x = params["embed_tokens"][tokens]  # [T, h]
     x = _constrain(x, mesh, P(AXES.SP, None))
+    lora_scale = lora["scale"] if lora is not None else None
     new_caches: KVCaches = []
-    for layer, (k_cache, v_cache) in zip(params["layers"], kv_caches):
+    for li, (layer, (k_cache, v_cache)) in enumerate(
+        zip(params["layers"], kv_caches)
+    ):
+        lora_layer = lora["layers"][li] if lora is not None else None
         residual = x
         x_n = rms_norm(x, layer["input_layernorm"], cfg.rms_norm_eps)
-        q, k, v = _project_qkv(layer, x_n, cfg)
+        q, k, v = _project_qkv(
+            layer, x_n, cfg, lora_layer, adapter_idx, lora_scale
+        )
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
         k_prefix, v_prefix = attn_ops.gather_prefix_kv(
@@ -180,14 +228,12 @@ def prefill(
         )
         new_caches.append((k_cache, v_cache))
         out = out.reshape(T, cfg.num_heads * cfg.head_dim)
-        x = residual + jnp.dot(
-            out, layer["o_proj"], preferred_element_type=jnp.float32
+        x = residual + _o_proj(
+            layer, out, lora_layer, adapter_idx, lora_scale
         ).astype(x.dtype)
         residual = x
         x_n = rms_norm(x, layer["post_attention_layernorm"], cfg.rms_norm_eps)
-        x = residual + swiglu(
-            x_n, layer["gate_proj"], layer["up_proj"], layer["down_proj"]
-        )
+        x = residual + _mlp(layer, x_n, lora_layer, adapter_idx, lora_scale)
 
     x = rms_norm(x, params["norm"], cfg.rms_norm_eps)
     last = x[jnp.maximum(valid_len - 1, 0)]  # [h]
@@ -205,6 +251,8 @@ def decode(
     slot_offsets: jax.Array,  # [S] int32 offset within that block
     kv_caches: KVCaches,
     mesh: Optional[Mesh] = None,  # SPMD mesh; batch sharded over dp
+    lora: Optional[Dict] = None,  # LoRA slot arrays (lora.py); None = off
+    adapter_idx: Optional[jax.Array] = None,  # [S] slot per sequence
 ) -> Tuple[jax.Array, KVCaches]:
     """Batched single-token decode.  Returns (logits [S, V], new caches).
 
@@ -217,11 +265,17 @@ def decode(
 
     x = params["embed_tokens"][tokens]  # [S, h]
     x = _constrain(x, mesh, P(AXES.DP, None))
+    lora_scale = lora["scale"] if lora is not None else None
     new_caches: KVCaches = []
-    for layer, (k_cache, v_cache) in zip(params["layers"], kv_caches):
+    for li, (layer, (k_cache, v_cache)) in enumerate(
+        zip(params["layers"], kv_caches)
+    ):
+        lora_layer = lora["layers"][li] if lora is not None else None
         residual = x
         x_n = rms_norm(x, layer["input_layernorm"], cfg.rms_norm_eps)
-        q, k, v = _project_qkv(layer, x_n, cfg)
+        q, k, v = _project_qkv(
+            layer, x_n, cfg, lora_layer, adapter_idx, lora_scale
+        )
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
         # The new token's KV must be visible to its own attention: write
@@ -235,14 +289,12 @@ def decode(
         )
         new_caches.append((k_cache, v_cache))
         out = out.reshape(S, cfg.num_heads * cfg.head_dim)
-        x = residual + jnp.dot(
-            out, layer["o_proj"], preferred_element_type=jnp.float32
+        x = residual + _o_proj(
+            layer, out, lora_layer, adapter_idx, lora_scale
         ).astype(x.dtype)
         residual = x
         x_n = rms_norm(x, layer["post_attention_layernorm"], cfg.rms_norm_eps)
-        x = residual + swiglu(
-            x_n, layer["gate_proj"], layer["up_proj"], layer["down_proj"]
-        )
+        x = residual + _mlp(layer, x_n, lora_layer, adapter_idx, lora_scale)
 
     x = rms_norm(x, params["norm"], cfg.rms_norm_eps)
     return _lm_head(params, cfg, x), new_caches

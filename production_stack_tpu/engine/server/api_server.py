@@ -11,6 +11,7 @@ This is the process the helm chart runs per engine pod — the TPU analogue of
 from __future__ import annotations
 
 import argparse
+import asyncio
 import json
 import logging
 import os
@@ -99,25 +100,37 @@ class StopChecker:
         self.emitted_text = text
         return delta
 
+    def aligned_token_count(self) -> int:
+        """Largest k such that the first k tokens detokenize within the
+        emitted (post-stop-trim) text — i.e. how many tokens' logprobs
+        entries align with the returned content.  Tokens consumed by a
+        multi-token stop string fall outside."""
+        emitted = len(self.emitted_text)
+        for k in range(len(self.token_ids), -1, -1):
+            if len(self.tokenizer.decode(self.token_ids[:k])) <= emitted:
+                return k
+        return 0
+
 
 def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
     app = web.Application()
     app["engine"] = engine
 
     async def models(_req: web.Request) -> web.Response:
-        return web.json_response(
-            {
-                "object": "list",
-                "data": [
-                    {
-                        "id": served_model,
-                        "object": "model",
-                        "created": int(time.time()),
-                        "owned_by": "production-stack-tpu",
-                    }
-                ],
+        def card(model_id: str) -> dict:
+            return {
+                "id": model_id,
+                "object": "model",
+                "created": int(time.time()),
+                "owned_by": "production-stack-tpu",
             }
-        )
+
+        # Loaded LoRA adapters are addressable as "<base>:<adapter>".
+        data = [card(served_model)] + [
+            card(f"{served_model}:{name}")
+            for name in engine.engine.loaded_adapters()
+        ]
+        return web.json_response({"object": "list", "data": data})
 
     async def health(_req: web.Request) -> web.Response:
         return web.json_response({"status": "ok"})
@@ -165,6 +178,21 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
         request_id = request.headers.get("x-request-id") or f"cmpl-{uuid.uuid4().hex[:16]}"
         created = int(time.time())
         model_name = body.get("model", served_model)
+        # "<base>:<adapter>" selects a loaded LoRA adapter; validate BEFORE
+        # any stream starts so unknown adapters 400 cleanly.  Only active
+        # on LoRA-enabled engines: otherwise ':' stays an opaque character
+        # in the model id (e.g. ollama-style names) as before.
+        adapter = None
+        if ":" in model_name and engine.engine.lora_registry is not None:
+            _, adapter = model_name.split(":", 1)
+            try:
+                engine.engine.lora_registry.slot_of(adapter)
+            except ValueError as e:
+                return web.json_response(
+                    {"error": {"message": str(e),
+                               "type": "invalid_request_error", "code": 404}},
+                    status=400,
+                )
         object_name = "chat.completion.chunk" if chat else "text_completion"
         checker = StopChecker(tokenizer, params.stop)
         prompt_token_ids = tokenizer.encode(prompt)
@@ -194,6 +222,7 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
             prompt_token_ids=prompt_token_ids,
             sampling_params=params,
             request_id=request_id,
+            adapter=adapter,
         )
 
         # Running character offset for the legacy completions logprobs
@@ -304,9 +333,7 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
         async for event in gen:
             delta, stopped = checker.push(event.token_id)
             text_parts.append(delta)
-            if params.logprobs and not stopped:
-                # The stop-trigger token is trimmed from the text; keep
-                # logprobs aligned with the returned content.
+            if params.logprobs:
                 logprob_entries.append(event)
             n_out = event.num_output_tokens
             if stopped:
@@ -321,6 +348,12 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
                 )
                 break
         text = "".join(text_parts)
+        if params.logprobs:
+            # Align with the post-stop-trim content: tokens consumed by a
+            # (possibly multi-token) stop string contribute no entries.
+            # (Streaming can't retract already-sent entries; this exact
+            # alignment is the non-streaming guarantee.)
+            logprob_entries = logprob_entries[: checker.aligned_token_count()]
         if chat:
             choice = {
                 "index": 0,
@@ -370,11 +403,50 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
             }
         )
 
+    # -- multi-LoRA admin (proposals/lora-tpu-support.md control plane) ----
+
+    async def lora_list(_req: web.Request) -> web.Response:
+        return web.json_response({"adapters": engine.engine.loaded_adapters()})
+
+    async def lora_load(request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+            name = body["name"]
+            path = body["path"]
+        except (json.JSONDecodeError, KeyError):
+            return web.json_response(
+                {"error": {"message": "need JSON body with 'name' and 'path'"}},
+                status=400,
+            )
+        try:
+            # Off-loop: file I/O + hundreds of host->device transfers would
+            # otherwise stall every in-flight SSE stream.  Catch broadly:
+            # a corrupt file raises safetensors' own error type.
+            slot = await asyncio.to_thread(
+                engine.engine.load_lora_from_path,
+                name, path, float(body.get("alpha", 16.0)),
+            )
+        except Exception as e:
+            return web.json_response(
+                {"error": {"message": f"{type(e).__name__}: {e}"}}, status=400
+            )
+        return web.json_response({"name": name, "slot": slot})
+
+    async def lora_unload(request: web.Request) -> web.Response:
+        try:
+            engine.engine.unload_lora(request.match_info["name"])
+        except ValueError as e:
+            return web.json_response({"error": {"message": str(e)}}, status=400)
+        return web.json_response({"ok": True})
+
     app.router.add_get("/v1/models", models)
     app.router.add_get("/health", health)
     app.router.add_get("/metrics", metrics)
     app.router.add_post("/v1/chat/completions", chat_completions)
     app.router.add_post("/v1/completions", completions)
+    app.router.add_get("/admin/lora", lora_list)
+    app.router.add_post("/admin/lora", lora_load)
+    app.router.add_delete("/admin/lora/{name}", lora_unload)
 
     async def lifecycle(app):
         await engine.start()
@@ -431,6 +503,9 @@ def main(argv=None) -> None:
     parser.add_argument("--data-parallel", type=int, default=1)
     parser.add_argument("--tensor-parallel", type=int, default=1)
     parser.add_argument("--sequence-parallel", type=int, default=1)
+    # Multi-LoRA slots (engine/lora.py); adapters load via POST /admin/lora.
+    parser.add_argument("--max-loras", type=int, default=0)
+    parser.add_argument("--max-lora-rank", type=int, default=16)
     parser.add_argument("--log-level", default="info")
     args = parser.parse_args(argv)
 
@@ -463,6 +538,8 @@ def main(argv=None) -> None:
             "parallel.data_parallel": args.data_parallel,
             "parallel.tensor_parallel": args.tensor_parallel,
             "parallel.sequence_parallel": args.sequence_parallel,
+            "lora.max_loras": args.max_loras,
+            "lora.max_rank": args.max_lora_rank,
         },
     )
     engine = AsyncEngine(config)

@@ -71,6 +71,7 @@ class AsyncEngine:
         prompt_token_ids: Optional[List[int]] = None,
         sampling_params: Optional[SamplingParams] = None,
         request_id: Optional[str] = None,
+        adapter: Optional[str] = None,
     ) -> AsyncIterator[TokenEvent]:
         request_id = request_id or f"req-{uuid.uuid4().hex[:12]}"
         queue: asyncio.Queue = asyncio.Queue()
@@ -79,7 +80,8 @@ class AsyncEngine:
             prompt_token_ids = self.engine.tokenizer.encode(prompt or "")
         with self._lock:
             self._pending.append(
-                (request_id, prompt_token_ids, sampling_params or SamplingParams())
+                (request_id, prompt_token_ids,
+                 sampling_params or SamplingParams(), adapter)
             )
         self._wakeup.set()
         try:
@@ -112,12 +114,13 @@ class AsyncEngine:
                 aborts, self._aborts = self._aborts, []
             for request_id in aborts:
                 self.engine.abort_request(request_id)
-            for request_id, token_ids, params in pending:
+            for request_id, token_ids, params, adapter in pending:
                 try:
                     self.engine.add_request(
                         request_id,
                         prompt_token_ids=token_ids,
                         sampling_params=params,
+                        adapter=adapter,
                     )
                 except Exception as e:
                     self._emit(request_id, e)
