@@ -617,4 +617,5 @@ class LLMEngine:
             "total_generated_tokens": self.total_generated_tokens,
             "total_finished": self.total_finished,
             "num_preemptions": self.scheduler.num_preemptions,
+            "loaded_loras": len(self.loaded_adapters()),
         }

@@ -144,6 +144,7 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
             (vocab.TPU_PREFIX_CACHE_HIT_RATE, s["prefix_cache_hit_rate"]),
             (vocab.TPU_HOST_KV_USAGE_PERC, s["host_kv_usage_perc"]),
             (vocab.TPU_DUTY_CYCLE, s["duty_cycle"]),
+            (vocab.TPU_LOADED_LORAS, s["loaded_loras"]),
             (vocab.TPU_TOTAL_PROMPT_TOKENS, s["total_prompt_tokens"]),
             (vocab.TPU_TOTAL_GENERATED_TOKENS, s["total_generated_tokens"]),
             (vocab.TPU_TOTAL_FINISHED_REQUESTS, s["total_finished"]),

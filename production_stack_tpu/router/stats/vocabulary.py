@@ -55,6 +55,7 @@ TPU_HBM_KV_USAGE_PERC = "tpu:hbm_kv_usage_perc"
 TPU_PREFIX_CACHE_HIT_RATE = "tpu:prefix_cache_hit_rate"
 TPU_HOST_KV_USAGE_PERC = "tpu:host_kv_usage_perc"
 TPU_DUTY_CYCLE = "tpu:duty_cycle"
+TPU_LOADED_LORAS = "tpu:loaded_loras"
 
 # The custom metric the prometheus-adapter exposes for HPA (reference:
 # observability/prom-adapter.yaml:8-20 exposes vllm:num_requests_waiting).
