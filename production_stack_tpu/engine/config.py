@@ -159,7 +159,11 @@ class ParallelConfig:
 
     data_parallel: int = 1
     tensor_parallel: int = 1
-    sequence_parallel: int = 1  # ring-attention axis for long context
+    sequence_parallel: int = 1  # sequence-parallel axis for long context
+    # "ring" (ppermute KV rotation, ring_attention.py) or "ulysses"
+    # (all-to-all head redistribution, ulysses.py — needs
+    # (num_kv_heads/tp) % sp == 0).
+    sequence_parallel_mode: str = "ring"
     expert_parallel: int = 1  # reserved for MoE models
 
     @property

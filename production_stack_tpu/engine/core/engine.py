@@ -71,6 +71,7 @@ class LLMEngine:
         # is identical on one chip and on a slice.
         par = config.parallel
         shardings_lib.validate_tp(cfg, par.tensor_parallel)
+        shardings_lib.validate_sp_mode(cfg, par)
         if config.scheduler.max_num_seqs % par.data_parallel:
             raise ValueError(
                 f"max_num_seqs={config.scheduler.max_num_seqs} must be "
@@ -139,7 +140,10 @@ class LLMEngine:
         # Jitted step functions.  KV caches are donated so updates alias the
         # same HBM; cfg and mesh are closed over (static).
         self._prefill_fn = jax.jit(
-            partial(self.model.prefill, cfg=cfg, mesh=self.mesh),
+            partial(
+                self.model.prefill, cfg=cfg, mesh=self.mesh,
+                sp_mode=par.sequence_parallel_mode,
+            ),
             donate_argnames=("kv_caches",),
         )
         self._decode_fn = jax.jit(

@@ -161,9 +161,10 @@ def prefill(
     new_block_ids: jax.Array,  # [T // block_size] int32 (null-padded)
     valid_len: jax.Array,  # scalar int32: true number of new tokens
     kv_caches: KVCaches,
-    mesh: Optional[Mesh] = None,  # SPMD mesh; sp>1 -> ring attention
+    mesh: Optional[Mesh] = None,  # SPMD mesh; sp>1 -> ring/ulysses attention
     lora: Optional[Dict] = None,  # LoRA slot arrays (lora.py); None = off
     adapter_idx: Optional[jax.Array] = None,  # scalar slot for this seq
+    sp_mode: str = "ring",  # sequence-parallel strategy when sp>1
 ) -> Tuple[jax.Array, KVCaches]:
     """One sequence's prefill.  Returns (last-token logits [V], new caches).
 
@@ -197,13 +198,26 @@ def prefill(
             k_cache, v_cache, prefix_block_ids
         )
         if use_ring:
-            from production_stack_tpu.engine.parallel.ring_attention import (
-                ring_prefill_with_prefix,
-            )
+            if sp_mode == "ulysses":
+                from production_stack_tpu.engine.parallel.ulysses import (
+                    ulysses_prefill_with_prefix,
+                )
+
+                sp_attention = partial(
+                    ulysses_prefill_with_prefix,
+                    sliding_window=cfg.sliding_window,
+                )
+            else:
+                # The ring does not implement sliding windows;
+                # validate_sp_mode rejects windowed models under ring sp>1
+                # rather than silently widening the receptive field.
+                from production_stack_tpu.engine.parallel.ring_attention import (
+                    ring_prefill_with_prefix as sp_attention,
+                )
 
             out = shard_map(
                 partial(
-                    ring_prefill_with_prefix, axis_name=AXES.SP, scale=scale
+                    sp_attention, axis_name=AXES.SP, scale=scale
                 ),
                 mesh=mesh,
                 in_specs=(

@@ -41,6 +41,36 @@ def validate_tp(cfg: ModelConfig, tp_size: int) -> None:
         )
 
 
+def validate_sp_mode(cfg: ModelConfig, par) -> None:
+    """Ulysses redistributes heads across sp: every device's local kv-head
+    count (after tp) must split evenly (parallel/ulysses.py)."""
+    if par.sequence_parallel_mode not in ("ring", "ulysses"):
+        raise ValueError(
+            f"Unknown sequence_parallel_mode {par.sequence_parallel_mode!r} "
+            "(ring|ulysses)"
+        )
+    sp, tp = par.sequence_parallel, par.tensor_parallel
+    if par.sequence_parallel_mode == "ulysses" and sp > 1:
+        local_kv = cfg.num_kv_heads // tp
+        if local_kv % sp:
+            raise ValueError(
+                f"ulysses needs (num_kv_heads/tp)={local_kv} divisible by "
+                f"sp={sp}; use sequence_parallel_mode='ring' instead"
+            )
+    if (
+        par.sequence_parallel_mode == "ring"
+        and sp > 1
+        and cfg.sliding_window is not None
+    ):
+        # The ring rotation has no window support; silently computing full
+        # attention would be wrong for windowed models (e.g. mistral).
+        raise ValueError(
+            f"sliding_window={cfg.sliding_window} is not supported with "
+            "sequence_parallel_mode='ring'; use 'ulysses' (requires "
+            "(num_kv_heads/tp) % sp == 0) or sp=1"
+        )
+
+
 def _layer_specs(cfg) -> Dict[str, P]:
     specs = {
         "input_layernorm": P(),

@@ -504,6 +504,9 @@ def main(argv=None) -> None:
     parser.add_argument("--data-parallel", type=int, default=1)
     parser.add_argument("--tensor-parallel", type=int, default=1)
     parser.add_argument("--sequence-parallel", type=int, default=1)
+    parser.add_argument(
+        "--sequence-parallel-mode", choices=["ring", "ulysses"], default="ring"
+    )
     # Multi-LoRA slots (engine/lora.py); adapters load via POST /admin/lora.
     parser.add_argument("--max-loras", type=int, default=0)
     parser.add_argument("--max-lora-rank", type=int, default=16)
@@ -539,6 +542,7 @@ def main(argv=None) -> None:
             "parallel.data_parallel": args.data_parallel,
             "parallel.tensor_parallel": args.tensor_parallel,
             "parallel.sequence_parallel": args.sequence_parallel,
+            "parallel.sequence_parallel_mode": args.sequence_parallel_mode,
             "lora.max_loras": args.max_loras,
             "lora.max_rank": args.max_lora_rank,
         },

@@ -259,3 +259,135 @@ def test_engine_generation_parity_with_attention_bias_tp():
     want = generate_all(biased_engine(), PROMPTS[:2])
     got = generate_all(biased_engine(tp=2, sp=2), PROMPTS[:2])
     assert got == want
+
+
+# -- Ulysses (all-to-all) sequence parallelism ------------------------------
+
+
+@requires_8_devices
+@pytest.mark.parametrize("cached_len,valid_len", [(0, 32), (8, 24), (12, 17)])
+def test_ulysses_prefill_with_prefix_matches_gather_path(cached_len, valid_len):
+    """The all-to-all SP strategy must agree with the single-device path
+    for every (prefix, padding) combination — same contract as the ring."""
+    from production_stack_tpu.engine.parallel.ulysses import (
+        ulysses_prefill_with_prefix,
+    )
+
+    T, H, K, D, C_max = 32, 8, 2, 8, 16
+    key = jax.random.PRNGKey(5)
+    ks = jax.random.split(key, 5)
+    q = jax.random.normal(ks[0], (T, H, D), jnp.float32)
+    k = jax.random.normal(ks[1], (T, K, D), jnp.float32)
+    v = jax.random.normal(ks[2], (T, K, D), jnp.float32)
+    k_pre = jax.random.normal(ks[3], (C_max, K, D), jnp.float32)
+    v_pre = jax.random.normal(ks[4], (C_max, K, D), jnp.float32)
+    scale = D**-0.5
+    cl = jnp.int32(cached_len)
+    vl = jnp.int32(valid_len)
+
+    mesh = sp_mesh(2)  # K=2 kv heads: sp=2 is the divisibility limit
+    ulysses = shard_map(
+        partial(ulysses_prefill_with_prefix, axis_name=AXES.SP, scale=scale),
+        mesh=mesh,
+        in_specs=(
+            P(AXES.SP), P(AXES.SP), P(AXES.SP),
+            P(AXES.SP), P(AXES.SP),
+            P(), P(),
+        ),
+        out_specs=P(AXES.SP),
+        check_vma=False,
+    )
+    got = np.asarray(jax.jit(ulysses)(q, k, v, k_pre, v_pre, cl, vl))
+    want = np.asarray(
+        attn_ops.prefill_attention(q, k, v, k_pre, v_pre, cl, vl, scale=scale)
+    )
+    np.testing.assert_allclose(
+        got[:valid_len], want[:valid_len], rtol=2e-5, atol=2e-5
+    )
+
+
+@requires_8_devices
+def test_engine_generation_parity_ulysses_mode():
+    """Full-engine greedy parity with sequence_parallel_mode='ulysses'
+    (dp=2 x sp=2 needs (K/tp)=2 % sp==0)."""
+    def ulysses_engine(dp=1, tp=1, sp=1):
+        cfg = EngineConfig(
+            model=ModelConfig(dtype="float32"),
+            cache=CacheConfig(block_size=4, num_blocks=128),
+            parallel=ParallelConfig(
+                data_parallel=dp, tensor_parallel=tp, sequence_parallel=sp,
+                sequence_parallel_mode="ulysses",
+            ),
+            scheduler=SchedulerConfig(
+                max_num_seqs=4, prefill_buckets=(16, 32, 64, 128),
+                max_model_len=256,
+            ),
+        )
+        return LLMEngine(cfg)
+
+    want = generate_all(mesh_engine(), PROMPTS)
+    got = generate_all(ulysses_engine(dp=2, sp=2), PROMPTS)
+    assert got == want
+
+
+def test_ulysses_mode_validation():
+    """kv-heads indivisible by sp must fail loudly at engine construction."""
+    from production_stack_tpu.engine.parallel.shardings import validate_sp_mode
+
+    cfg = ModelConfig()  # K=2
+    with pytest.raises(ValueError, match="divisible by sp"):
+        validate_sp_mode(cfg, ParallelConfig(
+            sequence_parallel=4, sequence_parallel_mode="ulysses"
+        ))
+    with pytest.raises(ValueError, match="Unknown sequence_parallel_mode"):
+        validate_sp_mode(cfg, ParallelConfig(sequence_parallel_mode="bogus"))
+    # ring never restricts kv heads.
+    validate_sp_mode(cfg, ParallelConfig(sequence_parallel=8))
+
+
+def test_ring_rejects_sliding_window():
+    """Windowed models must not silently widen under ring sp>1."""
+    from production_stack_tpu.engine.parallel.shardings import validate_sp_mode
+
+    cfg = ModelConfig(sliding_window=64)
+    with pytest.raises(ValueError, match="sliding_window"):
+        validate_sp_mode(cfg, ParallelConfig(sequence_parallel=2))
+    # Ulysses carries the window through; sp=1 ring is fine too.
+    validate_sp_mode(cfg, ParallelConfig(
+        sequence_parallel=2, sequence_parallel_mode="ulysses"
+    ))
+    validate_sp_mode(cfg, ParallelConfig(sequence_parallel=1))
+
+
+@requires_8_devices
+def test_ulysses_sliding_window_matches_dense():
+    from production_stack_tpu.engine.parallel.ulysses import (
+        ulysses_prefill_with_prefix,
+    )
+
+    T, H, K, D = 32, 4, 2, 8
+    key = jax.random.PRNGKey(9)
+    ks = jax.random.split(key, 3)
+    q = jax.random.normal(ks[0], (T, H, D), jnp.float32)
+    k = jax.random.normal(ks[1], (T, K, D), jnp.float32)
+    v = jax.random.normal(ks[2], (T, K, D), jnp.float32)
+    k_pre = jnp.zeros((4, K, D), jnp.float32)
+    v_pre = jnp.zeros((4, K, D), jnp.float32)
+    scale = D**-0.5
+    window = 12
+
+    mesh = sp_mesh(2)
+    fn = shard_map(
+        partial(ulysses_prefill_with_prefix, axis_name=AXES.SP, scale=scale,
+                sliding_window=window),
+        mesh=mesh,
+        in_specs=(P(AXES.SP),) * 5 + (P(), P()),
+        out_specs=P(AXES.SP),
+        check_vma=False,
+    )
+    got = np.asarray(jax.jit(fn)(q, k, v, k_pre, v_pre, jnp.int32(0), jnp.int32(T)))
+    want = np.asarray(attn_ops.prefill_attention(
+        q, k, v, k_pre, v_pre, jnp.int32(0), jnp.int32(T),
+        scale=scale, sliding_window=window,
+    ))
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
