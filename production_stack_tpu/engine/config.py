@@ -33,6 +33,10 @@ class ModelConfig:
     attention_bias: bool = False
     mlp_bias: bool = False
     sliding_window: Optional[int] = None  # mistral-style local attention
+    # Sparse MoE (mixtral-style): 0 = dense MLP.  Experts shard over the
+    # tp mesh axis (models/llama.py _moe_mlp).
+    num_experts: int = 0
+    num_experts_per_tok: int = 2
 
     def __post_init__(self):
         if self.head_dim is None:
@@ -99,6 +103,20 @@ PRESETS = {
         max_model_len=8192,
         rope_theta=10000.0,
         sliding_window=4096,
+    ),
+    "mixtral-8x7b": ModelConfig(
+        name="mixtral-8x7b",
+        vocab_size=32000,
+        hidden_size=4096,
+        intermediate_size=14336,
+        num_layers=32,
+        num_heads=32,
+        num_kv_heads=8,
+        head_dim=128,
+        max_model_len=8192,
+        rope_theta=1000000.0,
+        num_experts=8,
+        num_experts_per_tok=2,
     ),
     # Qwen2/2.5 family: QKV biases (attention_bias), high rope theta.
     "qwen2.5-0.5b": ModelConfig(

@@ -45,15 +45,24 @@ TARGETS = ("q_proj", "k_proj", "v_proj", "o_proj",
 def _proj_dims(cfg) -> Dict[str, Tuple[int, int]]:
     h, hd = cfg.hidden_size, cfg.head_dim
     H, K, I = cfg.num_heads, cfg.num_kv_heads, cfg.intermediate_size
-    return {
+    dims = {
         "q_proj": (h, H * hd),
         "k_proj": (h, K * hd),
         "v_proj": (h, K * hd),
         "o_proj": (H * hd, h),
-        "gate_proj": (h, I),
-        "up_proj": (h, I),
-        "down_proj": (I, h),
     }
+    if not getattr(cfg, "num_experts", 0):
+        # MoE models (mixtral) have stacked expert MLPs with no flat
+        # gate/up/down projections: LoRA there is attention-only, and an
+        # adapter shipping MLP factors must fail the load loudly (the
+        # validation below rejects unknown projections) rather than load
+        # "successfully" with its MLP deltas silently dropped.
+        dims.update({
+            "gate_proj": (h, I),
+            "up_proj": (h, I),
+            "down_proj": (I, h),
+        })
+    return dims
 
 
 def init_lora_params(model_cfg, lora_cfg, dtype) -> Dict:
@@ -62,9 +71,10 @@ def init_lora_params(model_cfg, lora_cfg, dtype) -> Dict:
     L = lora_cfg.num_slots
     r = lora_cfg.max_rank
     layers = []
+    dims = _proj_dims(model_cfg)
     for _ in range(model_cfg.num_layers):
         layer = {}
-        for proj, (d_in, d_out) in _proj_dims(model_cfg).items():
+        for proj, (d_in, d_out) in dims.items():
             layer[proj] = (
                 jnp.zeros((L, d_in, r), dtype),
                 jnp.zeros((L, r, d_out), dtype),
@@ -183,7 +193,7 @@ class AdapterRegistry:
         for li, factors in enumerate(layer_factors):
             old_layer = self.params["layers"][li]
             new_layer = {}
-            for proj in TARGETS:
+            for proj in dims:
                 A_dev, B_dev = old_layer[proj]
                 d_in, d_out = dims[proj]
                 A_full = np.zeros((d_in, self.lora_cfg.max_rank), np.float32)

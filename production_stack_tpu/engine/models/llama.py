@@ -66,7 +66,7 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
     if not cfg.tie_word_embeddings:
         params["lm_head"] = dense(keys[1], (h, cfg.vocab_size))
     for i in range(cfg.num_layers):
-        lk = jax.random.split(keys[i + 3], 7)
+        lk = jax.random.split(keys[i + 3], 8)
         layer = {
             "input_layernorm": jnp.ones((h,), dtype),
             "post_attention_layernorm": jnp.ones((h,), dtype),
@@ -74,10 +74,17 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
             "k_proj": dense(lk[1], (h, K * hd)),
             "v_proj": dense(lk[2], (h, K * hd)),
             "o_proj": dense(lk[3], (H * hd, h)),
-            "gate_proj": dense(lk[4], (h, I)),
-            "up_proj": dense(lk[5], (h, I)),
-            "down_proj": dense(lk[6], (I, h)),
         }
+        if cfg.num_experts:
+            E = cfg.num_experts
+            layer["gate"] = dense(lk[7], (h, E))
+            layer["experts_gate"] = dense(lk[4], (E, h, I))
+            layer["experts_up"] = dense(lk[5], (E, h, I))
+            layer["experts_down"] = dense(lk[6], (E, I, h))
+        else:
+            layer["gate_proj"] = dense(lk[4], (h, I))
+            layer["up_proj"] = dense(lk[5], (h, I))
+            layer["down_proj"] = dense(lk[6], (I, h))
         if cfg.attention_bias:
             # Qwen2-style QKV biases (o_proj stays bias-free there).
             layer["q_bias"] = jnp.zeros((H * hd,), dtype)
@@ -122,9 +129,57 @@ def _o_proj(layer: Params, out: jax.Array, lora_layer, adapter_idx, lora_scale):
     return _maybe_lora(y, out, lora_layer, "o_proj", adapter_idx, lora_scale)
 
 
-def _mlp(layer: Params, x: jax.Array, lora_layer, adapter_idx, lora_scale):
+def _moe_mlp(layer: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
+    """Mixtral-style sparse MoE block: full-softmax router, top-k
+    renormalized weights, SwiGLU experts.
+
+    TPU-first layout: expert weights are STACKED ``[E, ...]`` arrays
+    sharded over the tp mesh axis (parallel/shardings.py) — each device
+    runs its E/tp experts over all tokens and GSPMD reduces the weighted
+    sum.  Every token mathematically visits every (local) expert with its
+    routing weight (zero outside the top-k): static shapes, no
+    capacity-overflow token dropping, no host-side sorting.  The
+    megablocks-style block-sparse dispatch kernel is the optimization
+    path once profiling justifies it; this formulation is the correctness
+    and sharding reference.
+    """
+    E, k = cfg.num_experts, cfg.num_experts_per_tok
+    router_logits = jnp.dot(
+        x, layer["gate"], preferred_element_type=jnp.float32
+    )  # [T, E]
+    probs = jax.nn.softmax(router_logits, axis=-1)
+    top_vals, top_idx = jax.lax.top_k(probs, k)  # [T, k]
+    top_vals = top_vals / jnp.sum(top_vals, axis=-1, keepdims=True)
+    # Dense routing-weight matrix [T, E]: top-k weights, zero elsewhere.
+    weights = jnp.sum(
+        jax.nn.one_hot(top_idx, E, dtype=jnp.float32) * top_vals[..., None],
+        axis=1,
+    )
+
+    gate = jnp.einsum(
+        "th,ehi->tei", x, layer["experts_gate"],
+        preferred_element_type=jnp.float32,
+    )
+    up = jnp.einsum(
+        "th,ehi->tei", x, layer["experts_up"],
+        preferred_element_type=jnp.float32,
+    )
+    activated = (jax.nn.silu(gate) * up).astype(x.dtype)
+    down = jnp.einsum(
+        "tei,eih->teh", activated, layer["experts_down"],
+        preferred_element_type=jnp.float32,
+    )  # [T, E, h]
+    out = jnp.einsum("te,teh->th", weights, down)
+    return out.astype(x.dtype)
+
+
+def _mlp(layer: Params, x: jax.Array, lora_layer, adapter_idx, lora_scale,
+         cfg: Optional[ModelConfig] = None):
     """swiglu with optional LoRA on gate/up/down (matches ops/layers.py
-    swiglu exactly when lora_layer is None)."""
+    swiglu exactly when lora_layer is None); dispatches to the sparse MoE
+    block for mixtral-style configs (LoRA then applies to attention only)."""
+    if cfg is not None and cfg.num_experts:
+        return _moe_mlp(layer, x, cfg)
     if lora_layer is None:
         return swiglu(
             x, layer["gate_proj"], layer["up_proj"], layer["down_proj"]
@@ -247,7 +302,7 @@ def prefill(
         ).astype(x.dtype)
         residual = x
         x_n = rms_norm(x, layer["post_attention_layernorm"], cfg.rms_norm_eps)
-        x = residual + _mlp(layer, x_n, lora_layer, adapter_idx, lora_scale)
+        x = residual + _mlp(layer, x_n, lora_layer, adapter_idx, lora_scale, cfg)
 
     x = rms_norm(x, params["norm"], cfg.rms_norm_eps)
     last = x[jnp.maximum(valid_len - 1, 0)]  # [h]
@@ -308,7 +363,7 @@ def decode(
         ).astype(x.dtype)
         residual = x
         x_n = rms_norm(x, layer["post_attention_layernorm"], cfg.rms_norm_eps)
-        x = residual + _mlp(layer, x_n, lora_layer, adapter_idx, lora_scale)
+        x = residual + _mlp(layer, x_n, lora_layer, adapter_idx, lora_scale, cfg)
 
     x = rms_norm(x, params["norm"], cfg.rms_norm_eps)
     return _lm_head(params, cfg, x), new_caches

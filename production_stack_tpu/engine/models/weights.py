@@ -95,10 +95,28 @@ def load_hf_safetensors(cfg: ModelConfig, weights_path: str):
             "k_proj": take(p + "self_attn.k_proj.weight", transpose=True),
             "v_proj": take(p + "self_attn.v_proj.weight", transpose=True),
             "o_proj": take(p + "self_attn.o_proj.weight", transpose=True),
-            "gate_proj": take(p + "mlp.gate_proj.weight", transpose=True),
-            "up_proj": take(p + "mlp.up_proj.weight", transpose=True),
-            "down_proj": take(p + "mlp.down_proj.weight", transpose=True),
         }
+        if cfg.num_experts:
+            # Mixtral: block_sparse_moe.gate + per-expert w1/w3/w2
+            # (gate/up/down), stacked into [E, ...] arrays.
+            moe = p + "block_sparse_moe."
+            layer["gate"] = take(moe + "gate.weight", transpose=True)
+            layer["experts_gate"] = jnp.stack([
+                take(moe + f"experts.{e}.w1.weight", transpose=True)
+                for e in range(cfg.num_experts)
+            ])
+            layer["experts_up"] = jnp.stack([
+                take(moe + f"experts.{e}.w3.weight", transpose=True)
+                for e in range(cfg.num_experts)
+            ])
+            layer["experts_down"] = jnp.stack([
+                take(moe + f"experts.{e}.w2.weight", transpose=True)
+                for e in range(cfg.num_experts)
+            ])
+        else:
+            layer["gate_proj"] = take(p + "mlp.gate_proj.weight", transpose=True)
+            layer["up_proj"] = take(p + "mlp.up_proj.weight", transpose=True)
+            layer["down_proj"] = take(p + "mlp.down_proj.weight", transpose=True)
         if cfg.attention_bias:
             # Qwen2-style QKV biases (HF Qwen2Attention has bias=True on
             # q/k/v projections only).

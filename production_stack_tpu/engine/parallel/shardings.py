@@ -35,7 +35,13 @@ def validate_tp(cfg: ModelConfig, tp_size: int) -> None:
         raise ValueError(
             f"num_kv_heads={cfg.num_kv_heads} not divisible by tp={tp_size}"
         )
-    if cfg.intermediate_size % tp_size:
+    if cfg.num_experts:
+        if cfg.num_experts % tp_size:
+            raise ValueError(
+                f"num_experts={cfg.num_experts} not divisible by tp={tp_size} "
+                "(MoE experts shard over the tp axis)"
+            )
+    elif cfg.intermediate_size % tp_size:
         raise ValueError(
             f"intermediate_size={cfg.intermediate_size} not divisible by tp={tp_size}"
         )
@@ -79,10 +85,19 @@ def _layer_specs(cfg) -> Dict[str, P]:
         "k_proj": P(None, TP),
         "v_proj": P(None, TP),
         "o_proj": P(TP, None),
-        "gate_proj": P(None, TP),
-        "up_proj": P(None, TP),
-        "down_proj": P(TP, None),
     }
+    if cfg.num_experts:
+        # MoE: experts shard over the tp axis (expert parallelism); the
+        # router gate is replicated.  GSPMD reduces the weighted expert
+        # sum across tp (models/llama.py _moe_mlp).
+        specs["gate"] = P()
+        specs["experts_gate"] = P(TP, None, None)
+        specs["experts_up"] = P(TP, None, None)
+        specs["experts_down"] = P(TP, None, None)
+    else:
+        specs["gate_proj"] = P(None, TP)
+        specs["up_proj"] = P(None, TP)
+        specs["down_proj"] = P(TP, None)
     if cfg.attention_bias:
         # Biases follow their projection's output (head) dim.
         specs["q_bias"] = P(TP)

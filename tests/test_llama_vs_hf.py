@@ -333,3 +333,109 @@ def test_qwen2_prefill_and_decode_match_hf():
     np.testing.assert_allclose(
         np.asarray(step_logits)[0], expected_step, rtol=2e-4, atol=2e-4
     )
+
+
+# -- Mixtral family (sparse MoE) --------------------------------------------
+
+
+def make_hf_mixtral(cfg: ModelConfig):
+    hf_cfg = transformers.MixtralConfig(
+        vocab_size=cfg.vocab_size,
+        hidden_size=cfg.hidden_size,
+        intermediate_size=cfg.intermediate_size,
+        num_hidden_layers=cfg.num_layers,
+        num_attention_heads=cfg.num_heads,
+        num_key_value_heads=cfg.num_kv_heads,
+        rms_norm_eps=cfg.rms_norm_eps,
+        rope_theta=cfg.rope_theta,
+        max_position_embeddings=cfg.max_model_len,
+        num_local_experts=cfg.num_experts,
+        num_experts_per_tok=cfg.num_experts_per_tok,
+        tie_word_embeddings=cfg.tie_word_embeddings,
+        sliding_window=None,
+        attention_dropout=0.0,
+    )
+    torch.manual_seed(2)
+    model = transformers.MixtralForCausalLM(hf_cfg)
+    model.eval()
+    return model
+
+
+def mixtral_to_params(model, cfg: ModelConfig):
+    sd = {k: v.detach().numpy() for k, v in model.state_dict().items()}
+
+    def t(name):
+        return jnp.asarray(sd[name].T)
+
+    params = {
+        "embed_tokens": jnp.asarray(sd["model.embed_tokens.weight"]),
+        "norm": jnp.asarray(sd["model.norm.weight"]),
+        "lm_head": t("lm_head.weight"),
+        "layers": [],
+    }
+    for i in range(cfg.num_layers):
+        p = f"model.layers.{i}."
+        moe = p + "block_sparse_moe."
+        params["layers"].append({
+            "input_layernorm": jnp.asarray(sd[p + "input_layernorm.weight"]),
+            "post_attention_layernorm": jnp.asarray(
+                sd[p + "post_attention_layernorm.weight"]
+            ),
+            "q_proj": t(p + "self_attn.q_proj.weight"),
+            "k_proj": t(p + "self_attn.k_proj.weight"),
+            "v_proj": t(p + "self_attn.v_proj.weight"),
+            "o_proj": t(p + "self_attn.o_proj.weight"),
+            "gate": t(moe + "gate.weight"),
+            "experts_gate": jnp.stack([
+                t(moe + f"experts.{e}.w1.weight") for e in range(cfg.num_experts)
+            ]),
+            "experts_up": jnp.stack([
+                t(moe + f"experts.{e}.w3.weight") for e in range(cfg.num_experts)
+            ]),
+            "experts_down": jnp.stack([
+                t(moe + f"experts.{e}.w2.weight") for e in range(cfg.num_experts)
+            ]),
+        })
+    return params
+
+
+def test_mixtral_moe_prefill_and_decode_match_hf():
+    """Sparse-MoE parity: router top-k selection, renormalized weights,
+    and stacked-expert einsums must reproduce HF MixtralForCausalLM."""
+    cfg = tiny_cfg(num_experts=4, num_experts_per_tok=2)
+    model = make_hf_mixtral(cfg)
+    params = mixtral_to_params(model, cfg)
+
+    prompt = [7, 42, 19, 88, 3]
+    T_bucket = 8
+    tokens = jnp.asarray(prompt + [0] * (T_bucket - len(prompt)), jnp.int32)
+    logits, caches = llama.prefill(
+        params,
+        cfg,
+        tokens,
+        cached_len=jnp.int32(0),
+        prefix_block_ids=jnp.zeros((1,), jnp.int32),
+        new_block_ids=jnp.asarray([1, 2], jnp.int32),
+        valid_len=jnp.int32(len(prompt)),
+        kv_caches=fresh_caches(cfg),
+    )
+    expected = hf_all_logits(model, prompt)[-1]
+    np.testing.assert_allclose(np.asarray(logits), expected, rtol=3e-4, atol=3e-4)
+
+    block_table = [1, 2, 0, 0]
+    pos = len(prompt)
+    step_logits, _ = llama.decode(
+        params,
+        cfg,
+        tokens=jnp.asarray([55], jnp.int32),
+        positions=jnp.asarray([pos], jnp.int32),
+        block_tables=jnp.asarray([block_table], jnp.int32),
+        ctx_lens=jnp.asarray([pos + 1], jnp.int32),
+        slot_block_ids=jnp.asarray([block_table[pos // BLOCK_SIZE]], jnp.int32),
+        slot_offsets=jnp.asarray([pos % BLOCK_SIZE], jnp.int32),
+        kv_caches=caches,
+    )
+    expected_step = hf_all_logits(model, prompt + [55])[-1]
+    np.testing.assert_allclose(
+        np.asarray(step_logits)[0], expected_step, rtol=3e-4, atol=3e-4
+    )

@@ -290,3 +290,35 @@ def test_reload_same_name_invalidates_cache_and_failed_load_is_atomic():
     fb = random_factors(engine.config.model, 4, seed=15)
     engine.load_lora("x", fb, rank=4)
     assert engine.lora_registry.namespace_of("x") != ns1
+
+
+def test_moe_engine_rejects_mlp_lora_targets():
+    """MoE models have no flat MLP projections: an adapter shipping
+    gate/up/down factors must fail the load loudly, never load
+    'successfully' with its MLP deltas silently dropped."""
+    moe_cfg = EngineConfig(
+        model=ModelConfig(dtype="float32", num_experts=4,
+                          num_experts_per_tok=2, intermediate_size=64),
+        cache=CacheConfig(block_size=4, num_blocks=64),
+        scheduler=SchedulerConfig(
+            max_num_seqs=2, prefill_buckets=(16, 32), max_model_len=64
+        ),
+        lora=LoraServingConfig(max_loras=1, max_rank=4),
+    )
+    engine = LLMEngine(moe_cfg)
+    # Build MLP-bearing factors against a dense twin config (the MoE
+    # _proj_dims deliberately has no flat MLP projections to size against).
+    dense_twin = ModelConfig(dtype="float32", intermediate_size=64)
+    with pytest.raises(ValueError, match="unknown projection"):
+        engine.load_lora(
+            "bad", random_factors(dense_twin, 4, seed=20), rank=4
+        )
+    # Attention-only adapters load and apply.
+    attn_only = random_factors(
+        moe_cfg.model, 4, seed=21,
+        targets=("q_proj", "k_proj", "v_proj", "o_proj"),
+    )
+    engine.load_lora("ok", attn_only, rank=4)
+    with_lora = generate(engine, "moe lora", adapter="ok", max_tokens=4)
+    base = generate(engine, "moe lora", max_tokens=4, seq_id="r2")
+    assert with_lora != base
