@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import dataclasses
 import json
 import logging
 import os
@@ -195,7 +196,6 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
                     status=400,
                 )
         object_name = "chat.completion.chunk" if chat else "text_completion"
-        checker = StopChecker(tokenizer, params.stop)
         prompt_token_ids = tokenizer.encode(prompt)
 
         # Reject over-long prompts BEFORE the stream starts: once the SSE
@@ -219,16 +219,42 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
                 status=400,
             )
 
-        gen = engine.generate(
-            prompt_token_ids=prompt_token_ids,
-            sampling_params=params,
-            request_id=request_id,
-            adapter=adapter,
-        )
+        # n > 1: fan out one engine request per choice (OpenAI `n`).  Each
+        # choice gets a distinct seed when one was supplied; without one
+        # the engine's per-slot seeding already diversifies sampled runs.
+        n_choices = int(body.get("n") or 1)
+        if not 1 <= n_choices <= 16:
+            return web.json_response(
+                {"error": {"message": f"n must be in [1, 16], got {n_choices}",
+                           "type": "invalid_request_error"}},
+                status=400,
+            )
 
-        # Running character offset for the legacy completions logprobs
-        # text_offset array (consumed by e.g. lm-evaluation-harness).
-        stream_state = {"offset": 0}
+        def choice_params(i: int) -> SamplingParams:
+            if params.seed is None or i == 0:
+                return params if i == 0 else dataclasses.replace(params)
+            return dataclasses.replace(params, seed=params.seed + i)
+
+        choice_ids = [
+            request_id if i == 0 else f"{request_id}-c{i}"
+            for i in range(n_choices)
+        ]
+        gens = [
+            engine.generate(
+                prompt_token_ids=prompt_token_ids,
+                sampling_params=choice_params(i),
+                request_id=choice_ids[i],
+                adapter=adapter,
+            )
+            for i in range(n_choices)
+        ]
+        checkers = [
+            StopChecker(tokenizer, params.stop) for _ in range(n_choices)
+        ]
+
+        # Running character offset per choice for the legacy completions
+        # logprobs text_offset array (consumed by e.g. lm-evaluation-harness).
+        stream_offsets = [0] * n_choices
 
         def _logprob_entry(event) -> dict:
             """One token's OpenAI chat-style logprobs entry."""
@@ -242,18 +268,20 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
             }
 
         def chunk_payload(delta_text: str, finish_reason, first: bool,
-                          event=None):
+                          event=None, index: int = 0):
             if chat:
                 delta = {}
                 if first:
                     delta["role"] = "assistant"
                 if delta_text:
                     delta["content"] = delta_text
-                choice = {"index": 0, "delta": delta, "finish_reason": finish_reason}
+                choice = {"index": index, "delta": delta,
+                          "finish_reason": finish_reason}
                 if params.logprobs and event is not None:
                     choice["logprobs"] = {"content": [_logprob_entry(event)]}
             else:
-                choice = {"index": 0, "text": delta_text, "finish_reason": finish_reason}
+                choice = {"index": index, "text": delta_text,
+                          "finish_reason": finish_reason}
                 if params.logprobs and event is not None:
                     tok_text = tokenizer.decode([event.token_id])
                     choice["logprobs"] = {
@@ -265,9 +293,9 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
                                 for tid, lp in (event.top_logprobs or [])
                             }
                         ],
-                        "text_offset": [stream_state["offset"]],
+                        "text_offset": [stream_offsets[index]],
                     }
-                    stream_state["offset"] += len(tok_text)
+                    stream_offsets[index] += len(tok_text)
             return {
                 "id": request_id,
                 "object": object_name,
@@ -281,28 +309,55 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
                 headers={"Content-Type": "text/event-stream", "Cache-Control": "no-cache"}
             )
             await response.prepare(request)
-            first = True
-            n_out = 0
+
+            # Merge the n per-choice event streams through one queue so
+            # chunks interleave as tokens arrive (each chunk carries its
+            # choice index).
+            queue: asyncio.Queue = asyncio.Queue()
+
+            async def pump(i: int, g):
+                try:
+                    async for ev in g:
+                        await queue.put((i, ev, None))
+                    await queue.put((i, None, None))
+                except Exception as e:  # surfaced on the write loop
+                    await queue.put((i, None, e))
+
+            pumps = [
+                asyncio.create_task(pump(i, g)) for i, g in enumerate(gens)
+            ]
+            first = [True] * n_choices
+            live = [True] * n_choices
+            total_out = 0
             try:
-                async for event in gen:
+                remaining = n_choices
+                while remaining:
+                    i, event, error = await queue.get()
+                    if error is not None:
+                        raise error
+                    if event is None:
+                        remaining -= 1
+                        continue
+                    if not live[i]:
+                        continue  # post-stop events of an aborting choice
+                    checker = checkers[i]
                     delta, stopped = checker.push(event.token_id)
-                    n_out = event.num_output_tokens
                     if event.finished and not stopped:
                         # Flush any partial-stop-suffix holdback so the
                         # client gets the full tail.
                         delta += checker.flush()
-                    if delta or first or params.logprobs:
+                    if delta or first[i] or params.logprobs:
                         # A stop-triggering token is trimmed from the text,
                         # so it must not contribute a logprobs entry either
                         # (OpenAI: logprobs.content aligns with content).
                         payload = chunk_payload(
-                            delta, None, first,
-                            event=None if stopped else event,
+                            delta, None, first[i],
+                            event=None if stopped else event, index=i,
                         )
                         await response.write(
                             f"data: {json.dumps(payload)}\n\n".encode()
                         )
-                        first = False
+                        first[i] = False
                     if stopped or event.finished:
                         reason = (
                             "stop"
@@ -311,91 +366,129 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
                             else "length"
                         )
                         if stopped and not event.finished:
-                            await engine.abort(request_id)
-                        final = chunk_payload("", reason, first)
-                        final["usage"] = {
-                            "prompt_tokens": len(prompt_token_ids),
-                            "completion_tokens": n_out,
-                            "total_tokens": len(prompt_token_ids) + n_out,
-                        }
-                        await response.write(f"data: {json.dumps(final)}\n\n".encode())
-                        break
+                            # Abort emits no further events, so this pump
+                            # will never send its sentinel: retire the
+                            # choice here (cancelling the pump runs the
+                            # generator's finally, which aborts in-engine).
+                            pumps[i].cancel()
+                            remaining -= 1
+                        live[i] = False
+                        total_out += event.num_output_tokens
+                        final = chunk_payload("", reason, first[i], index=i)
+                        if sum(live) == 0:
+                            final["usage"] = {
+                                "prompt_tokens": len(prompt_token_ids),
+                                "completion_tokens": total_out,
+                                "total_tokens": len(prompt_token_ids) + total_out,
+                            }
+                        await response.write(
+                            f"data: {json.dumps(final)}\n\n".encode()
+                        )
                 await response.write(b"data: [DONE]\n\n")
                 await response.write_eof()
             except ConnectionResetError:
-                await engine.abort(request_id)
+                pass  # cleanup below aborts every live choice
+            finally:
+                # Cancelling a pump closes its generator, whose finally
+                # aborts the engine request if it hasn't finished — so a
+                # disconnect or a mid-stream error on one choice never
+                # leaves sibling choices decoding for nobody.
+                for task in pumps:
+                    task.cancel()
             return response
 
-        # Non-streaming: accumulate.
-        text_parts = []
-        logprob_entries = []
-        finish_reason = "length"
-        n_out = 0
-        async for event in gen:
-            delta, stopped = checker.push(event.token_id)
-            text_parts.append(delta)
+        # Non-streaming: drain all choices CONCURRENTLY (async generators
+        # are lazy — a sequential for-loop would only submit choice i+1's
+        # engine request after choice i finished, serializing what the
+        # engine would otherwise batch).
+        async def drain(i: int, gen):
+            checker = checkers[i]
+            text_parts = []
+            logprob_entries = []
+            finish_reason = "length"
+            out_tokens = 0
+            async for event in gen:
+                delta, stopped = checker.push(event.token_id)
+                text_parts.append(delta)
+                if params.logprobs:
+                    logprob_entries.append(event)
+                if stopped:
+                    finish_reason = "stop"
+                    out_tokens = event.num_output_tokens
+                    if not event.finished:
+                        await engine.abort(choice_ids[i])
+                    break
+                if event.finished:
+                    text_parts.append(checker.flush())
+                    out_tokens = event.num_output_tokens
+                    finish_reason = (
+                        "stop" if event.finish_reason == FinishReason.STOP
+                        else "length"
+                    )
+                    break
+            return "".join(text_parts), logprob_entries, finish_reason, out_tokens
+
+        drained = await asyncio.gather(
+            *[drain(i, g) for i, g in enumerate(gens)]
+        )
+        choices = []
+        total_out = 0
+        for i, (text, logprob_entries, finish_reason, out_tokens) in enumerate(
+            drained
+        ):
+            checker = checkers[i]
+            total_out += out_tokens
             if params.logprobs:
-                logprob_entries.append(event)
-            n_out = event.num_output_tokens
-            if stopped:
-                finish_reason = "stop"
-                if not event.finished:
-                    await engine.abort(request_id)
-                break
-            if event.finished:
-                text_parts.append(checker.flush())
-                finish_reason = (
-                    "stop" if event.finish_reason == FinishReason.STOP else "length"
-                )
-                break
-        text = "".join(text_parts)
-        if params.logprobs:
-            # Align with the post-stop-trim content: tokens consumed by a
-            # (possibly multi-token) stop string contribute no entries.
-            # (Streaming can't retract already-sent entries; this exact
-            # alignment is the non-streaming guarantee.)
-            logprob_entries = logprob_entries[: checker.aligned_token_count()]
-        if chat:
-            choice = {
-                "index": 0,
-                "message": {"role": "assistant", "content": text},
-                "finish_reason": finish_reason,
-            }
-            if params.logprobs:
-                choice["logprobs"] = {
-                    "content": [_logprob_entry(e) for e in logprob_entries]
-                }
-            obj = "chat.completion"
-        else:
-            choice = {"index": 0, "text": text, "finish_reason": finish_reason}
-            if params.logprobs:
-                token_texts = [
-                    tokenizer.decode([e.token_id]) for e in logprob_entries
+                # Align with the post-stop-trim content: tokens consumed by
+                # a (possibly multi-token) stop string contribute no
+                # entries.  (Streaming can't retract already-sent entries;
+                # this exact alignment is the non-streaming guarantee.)
+                logprob_entries = logprob_entries[
+                    : checker.aligned_token_count()
                 ]
-                offsets, pos = [], 0
-                for t in token_texts:
-                    offsets.append(pos)
-                    pos += len(t)
-                choice["logprobs"] = {
-                    "tokens": token_texts,
-                    "token_logprobs": [e.logprob for e in logprob_entries],
-                    "top_logprobs": [
-                        {
-                            tokenizer.decode([tid]): lp
-                            for tid, lp in (e.top_logprobs or [])
-                        }
-                        for e in logprob_entries
-                    ],
-                    "text_offset": offsets,
+            if chat:
+                choice = {
+                    "index": i,
+                    "message": {"role": "assistant", "content": text},
+                    "finish_reason": finish_reason,
                 }
-            obj = "text_completion"
+                if params.logprobs:
+                    choice["logprobs"] = {
+                        "content": [_logprob_entry(e) for e in logprob_entries]
+                    }
+            else:
+                choice = {"index": i, "text": text,
+                          "finish_reason": finish_reason}
+                if params.logprobs:
+                    token_texts = [
+                        tokenizer.decode([e.token_id]) for e in logprob_entries
+                    ]
+                    offsets, pos = [], 0
+                    for t in token_texts:
+                        offsets.append(pos)
+                        pos += len(t)
+                    choice["logprobs"] = {
+                        "tokens": token_texts,
+                        "token_logprobs": [e.logprob for e in logprob_entries],
+                        "top_logprobs": [
+                            {
+                                tokenizer.decode([tid]): lp
+                                for tid, lp in (e.top_logprobs or [])
+                            }
+                            for e in logprob_entries
+                        ],
+                        "text_offset": offsets,
+                    }
+            choices.append(choice)
+        obj = "chat.completion" if chat else "text_completion"
+        n_out = total_out
         return web.json_response(
             {
                 "id": request_id,
                 "object": obj,
                 "created": created,
                 "model": model_name,
-                "choices": [choice],
+                "choices": choices,
                 "usage": {
                     "prompt_tokens": len(prompt_token_ids),
                     "completion_tokens": n_out,

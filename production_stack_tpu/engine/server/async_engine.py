@@ -84,6 +84,7 @@ class AsyncEngine:
                  sampling_params or SamplingParams(), adapter)
             )
         self._wakeup.set()
+        finished = False
         try:
             while True:
                 event = await queue.get()
@@ -91,10 +92,19 @@ class AsyncEngine:
                     raise event
                 yield event
                 if event.finished:
+                    finished = True
                     return
         finally:
             self._queues.pop(request_id, None)
-            # If the client disconnected mid-generation, abort in-engine.
+            if not finished:
+                # Consumer stopped early (client disconnect, pump cancel,
+                # error on a sibling choice): abort in-engine so the
+                # scheduler doesn't keep decoding for nobody.  Inline sync
+                # append — `await` in an async-generator finally runs
+                # during aclose and must not block.
+                with self._lock:
+                    self._aborts.append(request_id)
+                self._wakeup.set()
 
     async def abort(self, request_id: str) -> None:
         with self._lock:

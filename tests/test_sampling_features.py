@@ -2,6 +2,7 @@
 and the OpenAI response shapes through the real server.
 """
 
+import json
 import math
 
 import jax.numpy as jnp
@@ -263,5 +264,116 @@ async def test_stop_token_excluded_from_logprobs_and_tail_flushed():
                 held = await resp.json()
             # Greedy: same tokens; the held-back final char must be flushed.
             assert held["choices"][0]["text"] == plain["choices"][0]["text"]
+    finally:
+        await server.close()
+
+
+async def test_n_choices_non_streaming():
+    """n>1 returns n independent choices with correct indices; greedy makes
+    them identical, which also proves each ran the full pipeline."""
+    import aiohttp
+
+    server = await _engine_server()
+    url = f"http://127.0.0.1:{server.port}"
+    try:
+        async with aiohttp.ClientSession() as session:
+            async with session.post(f"{url}/v1/chat/completions", json={
+                "model": "tiny-llama",
+                "messages": [{"role": "user", "content": "n choices"}],
+                "max_tokens": 4,
+                "n": 3,
+            }) as resp:
+                assert resp.status == 200
+                body = await resp.json()
+        assert [c["index"] for c in body["choices"]] == [0, 1, 2]
+        texts = [c["message"]["content"] for c in body["choices"]]
+        assert texts[0] == texts[1] == texts[2]  # greedy
+        assert body["usage"]["completion_tokens"] == 12  # 3 x 4
+
+        # Validation: n out of range -> 400.
+        async with aiohttp.ClientSession() as session:
+            async with session.post(f"{url}/v1/chat/completions", json={
+                "model": "tiny-llama",
+                "messages": [{"role": "user", "content": "x"}],
+                "n": 99,
+            }) as resp:
+                assert resp.status == 400
+    finally:
+        await server.close()
+
+
+async def test_n_choices_streaming_interleaved():
+    import aiohttp
+
+    server = await _engine_server()
+    url = f"http://127.0.0.1:{server.port}"
+    try:
+        async with aiohttp.ClientSession() as session:
+            async with session.post(f"{url}/v1/chat/completions", json={
+                "model": "tiny-llama",
+                "messages": [{"role": "user", "content": "stream n"}],
+                "max_tokens": 3,
+                "n": 2,
+                "stream": True,
+            }) as resp:
+                assert resp.status == 200
+                raw = await resp.text()
+        chunks = [
+            json.loads(line[len("data: "):])
+            for line in raw.splitlines()
+            if line.startswith("data: ") and line != "data: [DONE]"
+        ]
+        per_index = {0: "", 1: ""}
+        finishes = {}
+        usage = None
+        for chunk in chunks:
+            (choice,) = chunk["choices"]
+            idx = choice["index"]
+            per_index[idx] += choice["delta"].get("content", "")
+            if choice["finish_reason"]:
+                finishes[idx] = choice["finish_reason"]
+            if "usage" in chunk:
+                usage = chunk["usage"]
+        assert set(finishes) == {0, 1}
+        assert per_index[0] == per_index[1]  # greedy
+        assert usage is not None and usage["completion_tokens"] == 6
+    finally:
+        await server.close()
+
+
+async def test_streaming_stop_string_terminates_cleanly():
+    """Regression: a stop string matching mid-stream must end the SSE
+    stream with [DONE] — the abort path emits no further events, so the
+    server has to retire the choice itself rather than wait for one."""
+    import aiohttp
+
+    server = await _engine_server()
+    url = f"http://127.0.0.1:{server.port}"
+    try:
+        async with aiohttp.ClientSession() as session:
+            # Greedy reference run to learn the deterministic output.
+            async with session.post(f"{url}/v1/completions", json={
+                "model": "tiny-llama", "prompt": "stop stream", "max_tokens": 8,
+            }) as resp:
+                full = (await resp.json())["choices"][0]["text"]
+            assert len(full) >= 3
+            stop = full[1:3]  # matches mid-generation
+
+            async with session.post(f"{url}/v1/completions", json={
+                "model": "tiny-llama", "prompt": "stop stream",
+                "max_tokens": 8, "stop": [stop], "stream": True,
+            }, timeout=aiohttp.ClientTimeout(total=20)) as resp:
+                raw = await resp.text()
+        assert raw.rstrip().endswith("data: [DONE]")
+        finals = [
+            json.loads(line[len("data: "):])
+            for line in raw.splitlines()
+            if line.startswith("data: ") and line != "data: [DONE]"
+        ]
+        assert finals[-1]["choices"][0]["finish_reason"] == "stop"
+        streamed = "".join(
+            c["choices"][0].get("text", "") for c in finals
+        )
+        assert stop not in streamed
     finally:
         await server.close()
