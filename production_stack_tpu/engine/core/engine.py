@@ -167,6 +167,7 @@ class LLMEngine:
             )
 
         self._step_counter = 0
+        self._encode_fn = None  # lazily jitted /v1/embeddings path
         self._seqs: Dict[str, Sequence] = {}
         # Cumulative counters for /metrics.
         self.total_prompt_tokens = 0
@@ -582,6 +583,42 @@ class LLMEngine:
             if t > cutoff
         )
         return min(1.0, busy / self._busy_window_s)
+
+    def embed(self, prompt_token_ids: List[int]) -> np.ndarray:
+        """Normalized mean-pooled embedding of a prompt (llama.encode).
+        Pads to the nearest prefill bucket so repeat calls reuse one XLA
+        program per bucket."""
+        if not hasattr(self.model, "encode"):
+            raise ValueError(
+                f"model {self.config.model.name!r} has no encode path"
+            )
+        n = max(1, len(prompt_token_ids))
+        max_len = min(
+            self.config.scheduler.prefill_buckets[-1],
+            self.config.scheduler.max_model_len,
+        )
+        if n > max_len:
+            # Silent truncation would return an embedding of a prefix while
+            # reporting the full token count; fail like completions does.
+            raise ValueError(
+                f"input is {n} tokens; the embedding path supports up to "
+                f"{max_len}"
+            )
+        bucket = next(
+            b for b in self.config.scheduler.prefill_buckets if b >= n
+        )
+        ids = (list(prompt_token_ids) + [0] * bucket)[:bucket]
+        if self._encode_fn is None:
+            self._encode_fn = jax.jit(
+                partial(self.model.encode, cfg=self.config.model,
+                        mesh=self.mesh)
+            )
+        out = self._encode_fn(
+            self.params,
+            tokens=jnp.asarray(ids, jnp.int32),
+            valid_len=jnp.int32(n),
+        )
+        return np.asarray(out)
 
     # -- multi-LoRA admin (engine/lora.py) ---------------------------------
 

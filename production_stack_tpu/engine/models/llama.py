@@ -309,6 +309,48 @@ def prefill(
     return _lm_head(params, cfg, last), new_caches
 
 
+def encode(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jax.Array,  # [T] int32 (padded to a bucket)
+    valid_len: jax.Array,  # scalar int32
+    mesh: Optional[Mesh] = None,  # routes attention off Pallas under tp/sp
+) -> jax.Array:
+    """Embedding forward: causal self-attention over the prompt, returning
+    the mean of the final-layer hidden states over valid tokens,
+    L2-normalized — the /v1/embeddings path.  No KV bookkeeping: the
+    sequence is processed once and discarded, so attention runs with an
+    empty prefix and the per-layer K/V stay in registers/VMEM."""
+    T = tokens.shape[0]
+    scale = cfg.head_dim**-0.5
+    positions = jnp.arange(T)
+    cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
+    empty_k = jnp.zeros((0, cfg.num_kv_heads, cfg.head_dim), cfg.dtype)
+    empty_v = empty_k
+
+    x = params["embed_tokens"][tokens]  # [T, h]
+    for layer in params["layers"]:
+        residual = x
+        x_n = rms_norm(x, layer["input_layernorm"], cfg.rms_norm_eps)
+        q, k, v = _project_qkv(layer, x_n, cfg)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        out = attn_ops.prefill_attention(
+            q, k, v, empty_k, empty_v, jnp.int32(0), valid_len,
+            scale=scale, sliding_window=cfg.sliding_window, mesh=mesh,
+        )
+        out = out.reshape(T, cfg.num_heads * cfg.head_dim)
+        x = residual + _o_proj(layer, out, None, None, None).astype(x.dtype)
+        residual = x
+        x_n = rms_norm(x, layer["post_attention_layernorm"], cfg.rms_norm_eps)
+        x = residual + _mlp(layer, x_n, None, None, None, cfg)
+
+    x = rms_norm(x, params["norm"], cfg.rms_norm_eps).astype(jnp.float32)
+    mask = (jnp.arange(T) < valid_len)[:, None]
+    pooled = jnp.sum(x * mask, axis=0) / jnp.maximum(valid_len, 1)
+    return pooled / jnp.maximum(jnp.linalg.norm(pooled), 1e-9)
+
+
 def decode(
     params: Params,
     cfg: ModelConfig,

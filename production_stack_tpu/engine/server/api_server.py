@@ -222,7 +222,15 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
         # n > 1: fan out one engine request per choice (OpenAI `n`).  Each
         # choice gets a distinct seed when one was supplied; without one
         # the engine's per-slot seeding already diversifies sampled runs.
-        n_choices = int(body.get("n") or 1)
+        try:
+            n_choices = int(body["n"]) if body.get("n") is not None else 1
+        except (TypeError, ValueError):
+            return web.json_response(
+                {"error": {"message": f"n must be an integer, got "
+                           f"{body.get('n')!r}",
+                           "type": "invalid_request_error"}},
+                status=400,
+            )
         if not 1 <= n_choices <= 16:
             return web.json_response(
                 {"error": {"message": f"n must be in [1, 16], got {n_choices}",
@@ -328,6 +336,7 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
             ]
             first = [True] * n_choices
             live = [True] * n_choices
+            retired = [False] * n_choices  # manually removed from `remaining`
             total_out = 0
             try:
                 remaining = n_choices
@@ -336,7 +345,13 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
                     if error is not None:
                         raise error
                     if event is None:
-                        remaining -= 1
+                        # A choice retired on a stop match was already
+                        # deducted; its pump may still deliver a stale
+                        # sentinel (it can enqueue finished+sentinel before
+                        # the writer handles the stop token) — counting it
+                        # again would end the stream under live siblings.
+                        if not retired[i]:
+                            remaining -= 1
                         continue
                     if not live[i]:
                         continue  # post-stop events of an aborting choice
@@ -371,6 +386,7 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
                             # choice here (cancelling the pump runs the
                             # generator's finally, which aborts in-engine).
                             pumps[i].cancel()
+                            retired[i] = True
                             remaining -= 1
                         live[i] = False
                         total_out += event.num_output_tokens
@@ -497,6 +513,61 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
             }
         )
 
+    async def embeddings(request: web.Request) -> web.Response:
+        """OpenAI /v1/embeddings: normalized mean-pooled final hidden
+        states (llama.encode).  The engine the router proxies this path to
+        must actually serve it."""
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response(
+                {"error": {"message": "invalid JSON",
+                           "type": "invalid_request_error"}},
+                status=400,
+            )
+        raw_input = body.get("input")
+        if isinstance(raw_input, str):
+            inputs = [raw_input]
+        elif isinstance(raw_input, list) and all(
+            isinstance(x, str) for x in raw_input
+        ):
+            inputs = raw_input
+        else:
+            return web.json_response(
+                {"error": {"message": "'input' must be a string or list of "
+                           "strings", "type": "invalid_request_error"}},
+                status=400,
+            )
+        tokenizer = engine.engine.tokenizer
+        data = []
+        total_tokens = 0
+        for i, text in enumerate(inputs):
+            ids = tokenizer.encode(text)
+            total_tokens += len(ids)
+            try:
+                # Off-loop: the forward runs on the device alongside the
+                # step thread; XLA serializes, the event loop must not.
+                vector = await asyncio.to_thread(engine.engine.embed, ids)
+            except ValueError as e:
+                # Over-long input, or a model without an encode path.
+                return web.json_response(
+                    {"error": {"message": str(e),
+                               "type": "invalid_request_error"}},
+                    status=400,
+                )
+            data.append({
+                "object": "embedding",
+                "index": i,
+                "embedding": [float(v) for v in vector],
+            })
+        return web.json_response({
+            "object": "list",
+            "data": data,
+            "model": body.get("model", served_model),
+            "usage": {"prompt_tokens": total_tokens,
+                      "total_tokens": total_tokens},
+        })
+
     # -- multi-LoRA admin (proposals/lora-tpu-support.md control plane) ----
 
     async def lora_list(_req: web.Request) -> web.Response:
@@ -538,6 +609,7 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
     app.router.add_get("/metrics", metrics)
     app.router.add_post("/v1/chat/completions", chat_completions)
     app.router.add_post("/v1/completions", completions)
+    app.router.add_post("/v1/embeddings", embeddings)
     app.router.add_get("/admin/lora", lora_list)
     app.router.add_post("/admin/lora", lora_load)
     app.router.add_delete("/admin/lora/{name}", lora_unload)
