@@ -37,11 +37,22 @@ class ModelConfig:
     # tp mesh axis (models/llama.py _moe_mlp).
     num_experts: int = 0
     num_experts_per_tok: int = 2
+    # Gemma-family switches: zero-centered RMSNorm weights (output scaled
+    # by 1+w), tanh-approx GeGLU activation, sqrt(h) embedding scaling.
+    rms_norm_offset: float = 0.0
+    hidden_act: str = "silu"  # silu | gelu_tanh
+    scale_embeddings: bool = False
 
     def __post_init__(self):
         if self.head_dim is None:
             self.head_dim = self.hidden_size // self.num_heads
         assert self.num_heads % self.num_kv_heads == 0
+        if self.hidden_act not in ("silu", "gelu_tanh"):
+            # A typo (or HF's own string, "gelu_pytorch_tanh") silently
+            # falling back to silu would serve wrong logits forever.
+            raise ValueError(
+                f"Unknown hidden_act {self.hidden_act!r} (silu | gelu_tanh)"
+            )
 
     @property
     def q_per_kv(self) -> int:
@@ -103,6 +114,42 @@ PRESETS = {
         max_model_len=8192,
         rope_theta=10000.0,
         sliding_window=4096,
+    ),
+    # Gemma family: zero-centered norms (1+w), GeGLU, sqrt(h) embedding
+    # scale, head_dim decoupled from hidden/heads, always-tied embeddings.
+    "gemma-2b": ModelConfig(
+        name="gemma-2b",
+        vocab_size=256000,
+        hidden_size=2048,
+        intermediate_size=16384,
+        num_layers=18,
+        num_heads=8,
+        num_kv_heads=1,
+        head_dim=256,
+        max_model_len=8192,
+        rope_theta=10000.0,
+        rms_norm_eps=1e-6,
+        tie_word_embeddings=True,
+        rms_norm_offset=1.0,
+        hidden_act="gelu_tanh",
+        scale_embeddings=True,
+    ),
+    "gemma-7b": ModelConfig(
+        name="gemma-7b",
+        vocab_size=256000,
+        hidden_size=3072,
+        intermediate_size=24576,
+        num_layers=28,
+        num_heads=16,
+        num_kv_heads=16,
+        head_dim=256,
+        max_model_len=8192,
+        rope_theta=10000.0,
+        rms_norm_eps=1e-6,
+        tie_word_embeddings=True,
+        rms_norm_offset=1.0,
+        hidden_act="gelu_tanh",
+        scale_embeddings=True,
     ),
     "mixtral-8x7b": ModelConfig(
         name="mixtral-8x7b",

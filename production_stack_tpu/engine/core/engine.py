@@ -592,7 +592,10 @@ class LLMEngine:
             raise ValueError(
                 f"model {self.config.model.name!r} has no encode path"
             )
-        n = max(1, len(prompt_token_ids))
+        if not prompt_token_ids:
+            # An embedding of the pad token would be silent garbage.
+            raise ValueError("input produced no tokens")
+        n = len(prompt_token_ids)
         max_len = min(
             self.config.scheduler.prefill_buckets[-1],
             self.config.scheduler.max_model_len,

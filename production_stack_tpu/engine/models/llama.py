@@ -5,8 +5,12 @@ Weight layout matches HF ``LlamaForCausalLM`` modulo transposition (we store
 safetensors names directly.  Correctness is pinned against the HF torch
 implementation in tests/test_llama_vs_hf.py.
 
-Covers Mistral (sliding_window) and Llama 3.x (GQA, rope_theta, tied
-embeddings) via ModelConfig switches.
+Covers the whole RMSNorm+RoPE+gated-MLP decoder family via ModelConfig
+switches: Llama 3.x (GQA, rope_theta, tied embeddings), Mistral
+(sliding_window), Qwen2 (QKV biases), Mixtral (sparse MoE, _moe_mlp), and
+Gemma (zero-centered norms, tanh GeGLU, sqrt(h) embedding scale, decoupled
+head_dim/MQA) — each pinned against its HF torch implementation in
+tests/test_llama_vs_hf.py.
 """
 
 from __future__ import annotations
@@ -94,6 +98,23 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
     return params
 
 
+def _norm(x: jax.Array, weight: jax.Array, cfg: ModelConfig) -> jax.Array:
+    return rms_norm(x, weight, cfg.rms_norm_eps, cfg.rms_norm_offset)
+
+
+def _act(x: jax.Array, cfg: ModelConfig) -> jax.Array:
+    if cfg.hidden_act == "gelu_tanh":  # gemma
+        return jax.nn.gelu(x, approximate=True)
+    return jax.nn.silu(x)
+
+
+def _embed(params: Params, cfg: ModelConfig, tokens: jax.Array) -> jax.Array:
+    x = params["embed_tokens"][tokens]
+    if cfg.scale_embeddings:  # gemma: sqrt(h) in the input dtype
+        x = x * jnp.asarray(cfg.hidden_size**0.5, x.dtype)
+    return x
+
+
 def _maybe_lora(y, x, lora_layer, proj, adapter_idx, lora_scale):
     """Add the LoRA delta for ``proj`` when adapters are live (lora.py)."""
     if lora_layer is None:
@@ -164,7 +185,7 @@ def _moe_mlp(layer: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
         "th,ehi->tei", x, layer["experts_up"],
         preferred_element_type=jnp.float32,
     )
-    activated = (jax.nn.silu(gate) * up).astype(x.dtype)
+    activated = (_act(gate, cfg) * up).astype(x.dtype)
     down = jnp.einsum(
         "tei,eih->teh", activated, layer["experts_down"],
         preferred_element_type=jnp.float32,
@@ -174,21 +195,22 @@ def _moe_mlp(layer: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
 
 
 def _mlp(layer: Params, x: jax.Array, lora_layer, adapter_idx, lora_scale,
-         cfg: Optional[ModelConfig] = None):
-    """swiglu with optional LoRA on gate/up/down (matches ops/layers.py
+         cfg: ModelConfig):
+    """Gated MLP with optional LoRA on gate/up/down (matches ops/layers.py
     swiglu exactly when lora_layer is None); dispatches to the sparse MoE
     block for mixtral-style configs (LoRA then applies to attention only)."""
-    if cfg is not None and cfg.num_experts:
+    if cfg.num_experts:
         return _moe_mlp(layer, x, cfg)
     if lora_layer is None:
         return swiglu(
-            x, layer["gate_proj"], layer["up_proj"], layer["down_proj"]
+            x, layer["gate_proj"], layer["up_proj"], layer["down_proj"],
+            act=cfg.hidden_act,
         )
     gate = jnp.dot(x, layer["gate_proj"], preferred_element_type=jnp.float32)
     up = jnp.dot(x, layer["up_proj"], preferred_element_type=jnp.float32)
     gate = _maybe_lora(gate, x, lora_layer, "gate_proj", adapter_idx, lora_scale)
     up = _maybe_lora(up, x, lora_layer, "up_proj", adapter_idx, lora_scale)
-    activated = (jax.nn.silu(gate) * up).astype(x.dtype)
+    activated = (_act(gate, cfg) * up).astype(x.dtype)
     down = jnp.dot(
         activated, layer["down_proj"], preferred_element_type=jnp.float32
     )
@@ -234,7 +256,7 @@ def prefill(
     positions = cached_len + jnp.arange(T)
     cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
 
-    x = params["embed_tokens"][tokens]  # [T, h]
+    x = _embed(params, cfg, tokens)  # [T, h]
     x = _constrain(x, mesh, P(AXES.SP, None))
     lora_scale = lora["scale"] if lora is not None else None
     new_caches: KVCaches = []
@@ -243,7 +265,7 @@ def prefill(
     ):
         lora_layer = lora["layers"][li] if lora is not None else None
         residual = x
-        x_n = rms_norm(x, layer["input_layernorm"], cfg.rms_norm_eps)
+        x_n = _norm(x, layer["input_layernorm"], cfg)
         q, k, v = _project_qkv(
             layer, x_n, cfg, lora_layer, adapter_idx, lora_scale
         )
@@ -301,10 +323,10 @@ def prefill(
             layer, out, lora_layer, adapter_idx, lora_scale
         ).astype(x.dtype)
         residual = x
-        x_n = rms_norm(x, layer["post_attention_layernorm"], cfg.rms_norm_eps)
+        x_n = _norm(x, layer["post_attention_layernorm"], cfg)
         x = residual + _mlp(layer, x_n, lora_layer, adapter_idx, lora_scale, cfg)
 
-    x = rms_norm(x, params["norm"], cfg.rms_norm_eps)
+    x = _norm(x, params["norm"], cfg)
     last = x[jnp.maximum(valid_len - 1, 0)]  # [h]
     return _lm_head(params, cfg, last), new_caches
 
@@ -328,10 +350,10 @@ def encode(
     empty_k = jnp.zeros((0, cfg.num_kv_heads, cfg.head_dim), cfg.dtype)
     empty_v = empty_k
 
-    x = params["embed_tokens"][tokens]  # [T, h]
+    x = _embed(params, cfg, tokens)  # [T, h]
     for layer in params["layers"]:
         residual = x
-        x_n = rms_norm(x, layer["input_layernorm"], cfg.rms_norm_eps)
+        x_n = _norm(x, layer["input_layernorm"], cfg)
         q, k, v = _project_qkv(layer, x_n, cfg)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
@@ -342,10 +364,10 @@ def encode(
         out = out.reshape(T, cfg.num_heads * cfg.head_dim)
         x = residual + _o_proj(layer, out, None, None, None).astype(x.dtype)
         residual = x
-        x_n = rms_norm(x, layer["post_attention_layernorm"], cfg.rms_norm_eps)
+        x_n = _norm(x, layer["post_attention_layernorm"], cfg)
         x = residual + _mlp(layer, x_n, None, None, None, cfg)
 
-    x = rms_norm(x, params["norm"], cfg.rms_norm_eps).astype(jnp.float32)
+    x = _norm(x, params["norm"], cfg).astype(jnp.float32)
     mask = (jnp.arange(T) < valid_len)[:, None]
     pooled = jnp.sum(x * mask, axis=0) / jnp.maximum(valid_len, 1)
     return pooled / jnp.maximum(jnp.linalg.norm(pooled), 1e-9)
@@ -374,7 +396,7 @@ def decode(
     scale = cfg.head_dim**-0.5
     cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
 
-    x = params["embed_tokens"][tokens]  # [S, h]
+    x = _embed(params, cfg, tokens)  # [S, h]
     x = _constrain(x, mesh, P(AXES.DP, None))
     lora_scale = lora["scale"] if lora is not None else None
     new_caches: KVCaches = []
@@ -383,7 +405,7 @@ def decode(
     ):
         lora_layer = lora["layers"][li] if lora is not None else None
         residual = x
-        x_n = rms_norm(x, layer["input_layernorm"], cfg.rms_norm_eps)
+        x_n = _norm(x, layer["input_layernorm"], cfg)
         q, k, v = _project_qkv(
             layer, x_n, cfg, lora_layer, adapter_idx, lora_scale
         )
@@ -404,8 +426,8 @@ def decode(
             layer, out, lora_layer, adapter_idx, lora_scale
         ).astype(x.dtype)
         residual = x
-        x_n = rms_norm(x, layer["post_attention_layernorm"], cfg.rms_norm_eps)
+        x_n = _norm(x, layer["post_attention_layernorm"], cfg)
         x = residual + _mlp(layer, x_n, lora_layer, adapter_idx, lora_scale, cfg)
 
-    x = rms_norm(x, params["norm"], cfg.rms_norm_eps)
+    x = _norm(x, params["norm"], cfg)
     return _lm_head(params, cfg, x), new_caches

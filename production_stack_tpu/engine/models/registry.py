@@ -11,11 +11,15 @@ from types import ModuleType
 from production_stack_tpu.engine.models import llama
 
 MODEL_REGISTRY = {
-    # llama.py covers every RMSNorm+RoPE+GQA+SwiGLU family member; the
-    # config (not the code) differentiates them.
+    # llama.py covers every RMSNorm+RoPE+GQA+gated-MLP family member; the
+    # config (not the code) differentiates them — including QKV biases
+    # (qwen2), sparse MoE (mixtral), and gemma's norm-offset/GeGLU/
+    # embedding-scale switches.
     "llama": llama,
     "mistral": llama,
+    "mixtral": llama,
     "qwen2": llama,
+    "gemma": llama,
 }
 
 

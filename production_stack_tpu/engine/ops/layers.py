@@ -11,13 +11,18 @@ import jax
 import jax.numpy as jnp
 
 
-def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
-    """RMSNorm computed in fp32, cast back to input dtype."""
+def rms_norm(
+    x: jax.Array, weight: jax.Array, eps: float, offset: float = 0.0
+) -> jax.Array:
+    """RMSNorm computed in fp32, cast back to input dtype.
+
+    ``offset=1.0`` gives the gemma convention (zero-centered weights,
+    output scaled by ``1 + w``)."""
     orig_dtype = x.dtype
     x32 = x.astype(jnp.float32)
     variance = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
     normed = x32 * jax.lax.rsqrt(variance + eps)
-    return (normed * weight.astype(jnp.float32)).astype(orig_dtype)
+    return (normed * (offset + weight.astype(jnp.float32))).astype(orig_dtype)
 
 
 def rope_cos_sin(positions: jax.Array, head_dim: int, theta: float):
@@ -50,9 +55,20 @@ def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
     return out.astype(x.dtype)
 
 
-def swiglu(x: jax.Array, gate_w: jax.Array, up_w: jax.Array, down_w: jax.Array) -> jax.Array:
-    """SwiGLU MLP: down( silu(x @ gate) * (x @ up) ), bf16 matmuls on MXU."""
+def swiglu(
+    x: jax.Array,
+    gate_w: jax.Array,
+    up_w: jax.Array,
+    down_w: jax.Array,
+    act: str = "silu",
+) -> jax.Array:
+    """Gated MLP: down( act(x @ gate) * (x @ up) ), bf16 matmuls on MXU.
+    ``act``: "silu" (llama/mistral/qwen) or "gelu_tanh" (gemma GeGLU)."""
     gate = jnp.dot(x, gate_w, preferred_element_type=jnp.float32)
     up = jnp.dot(x, up_w, preferred_element_type=jnp.float32)
-    activated = (jax.nn.silu(gate) * up).astype(x.dtype)
+    if act == "gelu_tanh":
+        gated = jax.nn.gelu(gate, approximate=True)
+    else:
+        gated = jax.nn.silu(gate)
+    activated = (gated * up).astype(x.dtype)
     return jnp.dot(activated, down_w, preferred_element_type=jnp.float32).astype(x.dtype)

@@ -222,9 +222,11 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
         # n > 1: fan out one engine request per choice (OpenAI `n`).  Each
         # choice gets a distinct seed when one was supplied; without one
         # the engine's per-slot seeding already diversifies sampled runs.
-        try:
-            n_choices = int(body["n"]) if body.get("n") is not None else 1
-        except (TypeError, ValueError):
+        n_choices = body.get("n", 1)
+        if n_choices is None:
+            n_choices = 1
+        if not isinstance(n_choices, int) or isinstance(n_choices, bool):
+            # int() would silently truncate 2.9 and accept True.
             return web.json_response(
                 {"error": {"message": f"n must be an integer, got "
                            f"{body.get('n')!r}",
@@ -536,6 +538,15 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
             return web.json_response(
                 {"error": {"message": "'input' must be a string or list of "
                            "strings", "type": "invalid_request_error"}},
+                status=400,
+            )
+        if not 1 <= len(inputs) <= 128:
+            # Each item is a full device forward; an unbounded list would
+            # let one request starve completions traffic.
+            return web.json_response(
+                {"error": {"message": f"'input' must contain 1-128 items, "
+                           f"got {len(inputs)}",
+                           "type": "invalid_request_error"}},
                 status=400,
             )
         tokenizer = engine.engine.tokenizer

@@ -439,3 +439,74 @@ def test_mixtral_moe_prefill_and_decode_match_hf():
     np.testing.assert_allclose(
         np.asarray(step_logits)[0], expected_step, rtol=3e-4, atol=3e-4
     )
+
+
+# -- Gemma family (norm offset, GeGLU, embedding scale) ---------------------
+
+
+def make_hf_gemma(cfg: ModelConfig):
+    hf_cfg = transformers.GemmaConfig(
+        vocab_size=cfg.vocab_size,
+        hidden_size=cfg.hidden_size,
+        intermediate_size=cfg.intermediate_size,
+        num_hidden_layers=cfg.num_layers,
+        num_attention_heads=cfg.num_heads,
+        num_key_value_heads=cfg.num_kv_heads,
+        head_dim=cfg.head_dim,
+        rms_norm_eps=cfg.rms_norm_eps,
+        rope_theta=cfg.rope_theta,
+        max_position_embeddings=cfg.max_model_len,
+        hidden_act="gelu_pytorch_tanh",
+        tie_word_embeddings=True,
+        attention_bias=False,
+    )
+    torch.manual_seed(3)
+    model = transformers.GemmaForCausalLM(hf_cfg)
+    model.eval()
+    return model
+
+
+def test_gemma_prefill_and_decode_match_hf():
+    """Gemma = llama topology + zero-centered norms (1+w), tanh GeGLU,
+    sqrt(h) embedding scaling, decoupled head_dim, MQA."""
+    cfg = tiny_cfg(
+        num_heads=4, num_kv_heads=1, head_dim=16,  # MQA + decoupled head_dim
+        rms_norm_offset=1.0, hidden_act="gelu_tanh", scale_embeddings=True,
+        tie_word_embeddings=True, rms_norm_eps=1e-6,
+    )
+    model = make_hf_gemma(cfg)
+    params = hf_to_params(model, cfg)
+
+    prompt = [11, 87, 29, 54]
+    T_bucket = 8
+    tokens = jnp.asarray(prompt + [0] * (T_bucket - len(prompt)), jnp.int32)
+    logits, caches = llama.prefill(
+        params,
+        cfg,
+        tokens,
+        cached_len=jnp.int32(0),
+        prefix_block_ids=jnp.zeros((1,), jnp.int32),
+        new_block_ids=jnp.asarray([1, 2], jnp.int32),
+        valid_len=jnp.int32(len(prompt)),
+        kv_caches=fresh_caches(cfg),
+    )
+    expected = hf_all_logits(model, prompt)[-1]
+    np.testing.assert_allclose(np.asarray(logits), expected, rtol=3e-4, atol=3e-4)
+
+    block_table = [1, 2, 0, 0]
+    pos = len(prompt)
+    step_logits, _ = llama.decode(
+        params,
+        cfg,
+        tokens=jnp.asarray([70], jnp.int32),
+        positions=jnp.asarray([pos], jnp.int32),
+        block_tables=jnp.asarray([block_table], jnp.int32),
+        ctx_lens=jnp.asarray([pos + 1], jnp.int32),
+        slot_block_ids=jnp.asarray([block_table[pos // BLOCK_SIZE]], jnp.int32),
+        slot_offsets=jnp.asarray([pos % BLOCK_SIZE], jnp.int32),
+        kv_caches=caches,
+    )
+    expected_step = hf_all_logits(model, prompt + [70])[-1]
+    np.testing.assert_allclose(
+        np.asarray(step_logits)[0], expected_step, rtol=3e-4, atol=3e-4
+    )
