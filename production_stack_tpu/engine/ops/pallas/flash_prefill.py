@@ -4,18 +4,29 @@ Why the dense path stalls at ~0.44 MFU: ops/attention.py:prefill_attention
 materializes the full fp32 score/prob tensors — [K, G, T, C+T] is ~430 MB
 for a 2k-token llama-3.2-3b prefill, far beyond VMEM, so XLA spills them
 to HBM and the MXU waits on bandwidth.  This kernel never materializes
-scores in HBM: each program owns one Tq-row query tile (all heads), keeps
-the full key/value rows resident in VMEM (a few MB at serving lengths),
-streams them in Tk-column slices with an online softmax, and stops at the
-causal frontier so upper-triangle waste is bounded by one Tk slice per
-tile.
+scores in HBM: a 2-D grid (query tile x kv tile) streams keys/values
+through VMEM in [Tk, K, D] slices while the online-softmax state
+(running max, normalizer, and fp32 accumulator) lives in VMEM scratch
+that persists across the kv dimension of the grid.  Nothing resident
+scales with sequence length, so VMEM stays ~12 MB at any context
+(a previous revision kept the whole [S_k, K, D] KV row resident, which
+blew the 16 MB scoped-VMEM limit at 2k context on a 3B model).
+
+Causal skipping: kv tiles wholly above a query tile's frontier are
+skipped two ways — compute is fenced with ``pl.when``, and the kv
+index map clamps to the last visible tile so Mosaic's revisit-elision
+skips the DMA too (the block index doesn't change, so nothing is
+re-fetched).
 
 Layout notes (Mosaic): blocks keep the (head, lane) dims whole — q tiles
-are [Tq, H, D], keys [S_k, K, D] — because Mosaic requires the last two
-block dims divisible by (8, 128) or equal to the array's.  GQA regrouping
-happens in-register via the same swapaxes/reshape moves the decode kernel
-uses (paged_attention.py:114-115); both matmuls are K-batched dot_generals
-contracting the lane dim, so no transposes are materialized.
+are [Tq, H, D], kv tiles [Tk, K, D] — because Mosaic requires the last
+two block dims divisible by (8, 128) or equal to the array's.  GQA
+regrouping happens in-register via the same swapaxes/reshape moves the
+decode kernel uses (paged_attention.py:114-115); both matmuls are
+K-batched dot_generals contracting the lane dim, so no transposes are
+materialized.  The softmax running max/normalizer are stored broadcast
+across the 128-lane dim (scratch must be lane-tiled anyway) and read
+back with a lane-reduce.
 
 Position/validity semantics match the dense path exactly
 (ops/attention.py:128-143): key j < C is prefix slot j (valid while
@@ -37,6 +48,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
+LANES = 128  # scratch lane width: fp32 scratch must tile to (8, 128)
 
 
 def _flash_prefill_kernel(
@@ -44,16 +56,20 @@ def _flash_prefill_kernel(
     cached_len_ref,  # [1] int32
     valid_len_ref,  # [1] int32
     # inputs (VMEM blocks)
-    q_ref,  # [Tq, H, D] this tile's queries, all heads
-    k_ref,  # [S_k, K, D] the full (padded) key row
-    v_ref,  # [S_k, K, D]
+    q_ref,  # [Tq, H, D] this query tile, all heads
+    k_ref,  # [Tk, K, D] this kv tile
+    v_ref,  # [Tk, K, D]
     # outputs
     o_ref,  # [Tq, H, D]
+    # scratch (VMEM, persists across the kv grid dim)
+    m_ref,  # [K, R, LANES] fp32 running max (lane-broadcast)
+    l_ref,  # [K, R, LANES] fp32 running normalizer (lane-broadcast)
+    acc_ref,  # [K, R, D] fp32 output accumulator
     *,
     Tq: int,
     Tk: int,
     C: int,
-    S_k: int,
+    NKV: int,
     K: int,
     G: int,
     D: int,
@@ -61,34 +77,29 @@ def _flash_prefill_kernel(
     sliding_window: Optional[int],
 ):
     i = pl.program_id(0)
+    j = pl.program_id(1)
     cached = cached_len_ref[0]
     valid = valid_len_ref[0]
     R = Tq * G  # query rows per kv head after GQA regrouping
 
-    # [Tq, H, D] -> [K, Tq*G, D]: head h = k*G + g attends kv head k.
-    q = q_ref[...].astype(jnp.float32) * scale
-    q = q.reshape(Tq, K, G, D).swapaxes(0, 1).reshape(K, R, D)
+    # Last kv tile any query in this tile can see: the tile's last query
+    # sits at cached + (i+1)*Tq - 1 and sees prefix keys (flat < C) plus
+    # new keys with flat index < C + (i+1)*Tq.
+    last = (C + (i + 1) * Tq - 1) // Tk
 
-    # Query positions per GQA-regrouped row r = t*G + g: row r's query
-    # token is t = r // G.  Masks are built 2-D [R, Tk] and broadcast into
-    # the 3-D scores ([K, R, Tk] where mask[None] — the exact pattern the
-    # decode kernel lowers with); 4-D mask ops and bool-valued selects both
-    # stall Mosaic.
-    row_t = jax.lax.broadcasted_iota(jnp.int32, (R, 1), 0) // G
-    q_pos = cached + i * Tq + row_t  # [R, 1]
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full((K, R, LANES), NEG_INF, jnp.float32)
+        l_ref[...] = jnp.zeros((K, R, LANES), jnp.float32)
+        acc_ref[...] = jnp.zeros((K, R, D), jnp.float32)
 
-    # Causal frontier: the tile's last query sits at cached + (i+1)*Tq - 1
-    # and can see prefix keys (flat index < C) plus new keys with flat
-    # index < C + (i+1)*Tq.  Slices wholly past that are skipped.
-    frontier = C + (i + 1) * Tq
-    nk = jax.lax.min((frontier + Tk - 1) // Tk, S_k // Tk)
-
-    def body(j, carry):
-        m, l, acc = carry
-        k = k_ref[pl.dslice(j * Tk, Tk)].astype(jnp.float32)  # [Tk, K, D]
-        v = v_ref[pl.dslice(j * Tk, Tk)].astype(jnp.float32)
-        k = k.swapaxes(0, 1)  # [K, Tk, D]
-        v = v.swapaxes(0, 1)
+    @pl.when(j <= last)
+    def _compute():
+        # [Tq, H, D] -> [K, Tq*G, D]: head h = k*G + g attends kv head k.
+        q = q_ref[...].astype(jnp.float32) * scale
+        q = q.reshape(Tq, K, G, D).swapaxes(0, 1).reshape(K, R, D)
+        k = k_ref[...].astype(jnp.float32).swapaxes(0, 1)  # [K, Tk, D]
+        v = v_ref[...].astype(jnp.float32).swapaxes(0, 1)
 
         # [K, R, D] x [K, Tk, D] -> [K, R, Tk] (batch over kv heads).
         s = jax.lax.dot_general(
@@ -96,6 +107,12 @@ def _flash_prefill_kernel(
             preferred_element_type=jnp.float32,
         )
 
+        # Masks are built 2-D [R, Tk] and broadcast into the 3-D scores
+        # (mask[None] — the exact pattern the decode kernel lowers with);
+        # 4-D mask ops and bool-valued selects both stall Mosaic.  Query
+        # row r = t*G + g is query token t = r // G.
+        row_t = jax.lax.broadcasted_iota(jnp.int32, (R, 1), 0) // G
+        q_pos = cached + i * Tq + row_t  # [R, 1]
         flat = j * Tk + jax.lax.broadcasted_iota(jnp.int32, (1, Tk), 1)
         is_prefix = flat < C
         key_pos = jnp.where(is_prefix, flat, cached + flat - C)  # int select
@@ -107,27 +124,29 @@ def _flash_prefill_kernel(
             mask &= key_pos > q_pos - sliding_window
         s = jnp.where(mask[None], s, NEG_INF)
 
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        alpha = jnp.exp(m - m_new)
+        m_prev = jnp.max(m_ref[...], axis=-1, keepdims=True)  # [K, R, 1]
+        l_prev = jnp.max(l_ref[...], axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new)
-        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
         # [K, R, Tk] x [K, Tk, D] -> [K, R, D]
         pv = jax.lax.dot_general(
             p, v, (((2,), (1,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
         )
-        return m_new, l_new, acc * alpha + pv
+        acc_ref[...] = acc_ref[...] * alpha + pv
+        m_ref[...] = jnp.broadcast_to(m_new, (K, R, LANES))
+        l_ref[...] = jnp.broadcast_to(l_new, (K, R, LANES))
 
-    m0 = jnp.full((K, R, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((K, R, 1), jnp.float32)
-    acc0 = jnp.zeros((K, R, D), jnp.float32)
-    m, l, acc = jax.lax.fori_loop(0, nk, body, (m0, l0, acc0))
-
-    # Rows past valid_len (padding) have every key masked -> l == 0; emit
-    # zeros, not NaNs (the caller slices them off).
-    l = jnp.where(l == 0.0, 1.0, l)
-    out = (acc / l).reshape(K, Tq, G, D).swapaxes(0, 1)  # [Tq, K, G, D]
-    o_ref[...] = out.reshape(Tq, K * G, D).astype(o_ref.dtype)
+    @pl.when(j == NKV - 1)
+    def _final():
+        # Rows past valid_len (padding) have every key masked -> l == 0;
+        # emit zeros, not NaNs (the caller slices them off).
+        l = jnp.max(l_ref[...], axis=-1, keepdims=True)
+        l = jnp.where(l == 0.0, 1.0, l)
+        out = (acc_ref[...] / l).reshape(K, Tq, G, D).swapaxes(0, 1)
+        o_ref[...] = out.reshape(Tq, K * G, D).astype(o_ref.dtype)
 
 
 @functools.partial(
@@ -145,7 +164,7 @@ def flash_prefill_attention(
     *,
     scale: float,
     sliding_window: Optional[int] = None,
-    q_tile: int = 256,
+    q_tile: int = 128,
     kv_tile: int = 512,
     interpret: bool = False,
 ) -> jax.Array:
@@ -172,26 +191,45 @@ def flash_prefill_attention(
         pad = [(0, S_k - S_raw), (0, 0), (0, 0)]
         keys = jnp.pad(keys, pad)  # padded keys are masked (j-C >= valid)
         values = jnp.pad(values, pad)
+    NKV = S_k // Tk
 
     kernel = functools.partial(
         _flash_prefill_kernel,
-        Tq=Tq, Tk=Tk, C=C, S_k=S_k, K=K, G=G, D=D,
+        Tq=Tq, Tk=Tk, C=C, NKV=NKV, K=K, G=G, D=D,
         scale=scale, sliding_window=sliding_window,
     )
+
+    def kv_index(i, j, *_):
+        # Clamp to the tile's causal frontier: for skipped steps the block
+        # index repeats, so Mosaic's revisit-elision skips the DMA.
+        last = (C + (i + 1) * Tq - 1) // Tk
+        return (jnp.minimum(j, last), 0, 0)
+
+    R = Tq * G
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(T // Tq,),
+        grid=(T // Tq, NKV),
         in_specs=[
-            pl.BlockSpec((Tq, H, D), lambda i, *_: (i, 0, 0)),
-            pl.BlockSpec((S_k, K, D), lambda i, *_: (0, 0, 0)),
-            pl.BlockSpec((S_k, K, D), lambda i, *_: (0, 0, 0)),
+            pl.BlockSpec((Tq, H, D), lambda i, j, *_: (i, 0, 0)),
+            pl.BlockSpec((Tk, K, D), kv_index),
+            pl.BlockSpec((Tk, K, D), kv_index),
         ],
-        out_specs=pl.BlockSpec((Tq, H, D), lambda i, *_: (i, 0, 0)),
+        out_specs=pl.BlockSpec((Tq, H, D), lambda i, j, *_: (i, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((K, R, LANES), jnp.float32),
+            pltpu.VMEM((K, R, LANES), jnp.float32),
+            pltpu.VMEM((K, R, D), jnp.float32),
+        ],
     )
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((T, H, D), q.dtype),
+        # The fp32 score/prob intermediates ([K, R, Tk] each) plus the
+        # online-softmax scratch exceed the compiler's default 16 MB scoped
+        # VMEM at serving tile sizes; v5e/v6e have 128 MB, so raise the cap
+        # rather than shrink tiles below MXU-efficient shapes.
+        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=96 * 1024 * 1024),
         interpret=interpret,
     )(
         jnp.asarray(cached_len, jnp.int32).reshape(1),
