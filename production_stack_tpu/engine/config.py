@@ -256,6 +256,12 @@ class SchedulerConfig:
     max_model_len: int = 2048
     # "recompute" (drop + re-prefill) or "offload" (page out to host DRAM)
     preemption_mode: str = "offload"
+    # Decode iterations fused into ONE device dispatch (lax.scan over the
+    # decode step with on-device sampling).  vLLM's --num-scheduler-steps:
+    # amortizes host->device dispatch latency across N tokens at the cost
+    # of up to N-1 wasted tokens past a stop condition (truncated on the
+    # host, never surfaced).  1 = classic one-token steps.
+    num_scheduler_steps: int = 1
 
 
 @dataclasses.dataclass

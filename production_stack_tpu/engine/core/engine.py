@@ -151,6 +151,67 @@ class LLMEngine:
             donate_argnames=("kv_caches",),
         )
         self._sample_fn = jax.jit(sample_tokens)
+
+        # Multi-step decode (vLLM --num-scheduler-steps analogue): scan N
+        # decode+sample iterations on-device and return all N tokens in one
+        # host round-trip.  Slot targeting moves on-device (the block table
+        # lookup per iteration); rows past their per-seq budget park their
+        # KV write on null block 0.  Sequences using penalties/logprobs
+        # (which need host-side state per token) fall back to single-step.
+        self._decode_multi_fn = None
+        n_steps = config.scheduler.num_scheduler_steps
+        if n_steps > 1:
+            model_decode = partial(self.model.decode, cfg=cfg, mesh=self.mesh)
+            bs = config.cache.block_size
+
+            def multi_decode(
+                params, tokens, positions, block_tables, ctx_lens,
+                max_steps, kv_caches, temps, top_ps, top_ks,
+                step_key, seq_seeds, lora=None, adapter_idx=None,
+            ):
+                def body(carry, t):
+                    tokens, positions, ctx_lens, kv_caches = carry
+                    active = t < max_steps  # [S]
+                    blk = jnp.take_along_axis(
+                        block_tables, (positions // bs)[:, None], axis=1
+                    )[:, 0]
+                    extra = (
+                        {"lora": lora, "adapter_idx": adapter_idx}
+                        if lora is not None else {}
+                    )
+                    logits, kv_caches = model_decode(
+                        params,
+                        tokens=tokens,
+                        positions=positions,
+                        block_tables=block_tables,
+                        ctx_lens=ctx_lens,
+                        slot_block_ids=jnp.where(active, blk, 0),
+                        slot_offsets=positions % bs,
+                        kv_caches=kv_caches,
+                        **extra,
+                    )
+                    sampled = sample_tokens(
+                        logits, temps, top_ps, top_ks,
+                        jax.random.fold_in(step_key, t), seq_seeds,
+                    )
+                    step = active.astype(jnp.int32)
+                    return (
+                        jnp.where(active, sampled, tokens),
+                        positions + step,
+                        ctx_lens + step,
+                        kv_caches,
+                    ), sampled
+
+                carry, sampled = jax.lax.scan(
+                    body,
+                    (tokens, positions, ctx_lens, kv_caches),
+                    jnp.arange(n_steps),
+                )
+                return sampled, carry[3]  # [n, S] tokens, new caches
+
+            self._decode_multi_fn = jax.jit(
+                multi_decode, donate_argnames=("kv_caches",)
+            )
         self._penalties_fn = jax.jit(sampling_lib.apply_penalties)
         self._logprobs_fn = jax.jit(
             sampling_lib.top_logprobs_of, static_argnames=("k",)
@@ -403,6 +464,57 @@ class LLMEngine:
                 "lora": self.lora_registry.params,
                 "adapter_idx": self._put(adapter_idx, batch_spec),
             }
+
+        # Multi-step path: penalties/logprobs need host-visible per-token
+        # state, so any sequence using them drops the whole batch to
+        # single-step (they're rare; the common path stays fused).
+        use_multi = self._decode_multi_fn is not None and not any(
+            s.sampling_params.presence_penalty
+            or s.sampling_params.frequency_penalty
+            or s.sampling_params.logprobs
+            for s in seqs
+        )
+        if use_multi:
+            max_steps = np.zeros((S,), np.int32)
+            max_steps[: len(seqs)] = plan.steps
+            temps, top_ps, top_ks, seeds = self._sampling_arrays(seqs, S)
+            sampled, self.kv_caches = self._decode_multi_fn(
+                self.params,
+                tokens=self._put(tokens, batch_spec),
+                positions=self._put(positions, batch_spec),
+                block_tables=self._put(block_tables, P(AXES.DP, None)),
+                ctx_lens=self._put(ctx_lens, batch_spec),
+                max_steps=self._put(max_steps, batch_spec),
+                kv_caches=self.kv_caches,
+                temps=self._put(temps, batch_spec),
+                top_ps=self._put(top_ps, batch_spec),
+                top_ks=self._put(top_ks, batch_spec),
+                step_key=jax.random.PRNGKey(
+                    self.config.seed + self._step_counter
+                ),
+                seq_seeds=self._put(seeds, batch_spec),
+                **lora_kwargs,
+            )
+            arr = np.asarray(sampled)  # [n, S] — ONE device->host sync
+            outputs: List[StepOutput] = []
+            alive = list(enumerate(seqs))
+            for t in range(arr.shape[0]):
+                batch = [(i, s) for (i, s) in alive if t < plan.steps[i]]
+                if not batch:
+                    break
+                outs = self._append_and_check(
+                    [s for _, s in batch],
+                    [int(arr[t, i]) for i, _ in batch],
+                    first_token=False,
+                )
+                outputs.extend(outs)
+                # Tokens computed past a finish are discarded here, never
+                # appended (vLLM multi-step semantics).
+                alive = [
+                    (i, s) for (i, s), o in zip(batch, outs) if not o.finished
+                ]
+            return outputs
+
         logits, self.kv_caches = self._decode_fn(
             self.params,
             tokens=self._put(tokens, batch_spec),
@@ -418,6 +530,30 @@ class LLMEngine:
         return self._append_and_check(
             seqs, token_ids, first_token=False, logprob_info=logprob_info
         )
+
+    def _sampling_arrays(self, seqs: List[Sequence], S: int):
+        """Padded per-sequence sampling parameter arrays [S]."""
+        pad = S - len(seqs)
+        temps = np.array(
+            [s.sampling_params.temperature for s in seqs] + [0.0] * pad,
+            np.float32,
+        )
+        top_ps = np.array(
+            [s.sampling_params.top_p for s in seqs] + [1.0] * pad,
+            np.float32,
+        )
+        top_ks = np.array(
+            [s.sampling_params.top_k for s in seqs] + [0] * pad, np.int32
+        )
+        seeds = np.array(
+            [
+                (s.sampling_params.seed if s.sampling_params.seed is not None else idx)
+                for idx, s in enumerate(seqs)
+            ]
+            + [0] * pad,
+            np.int32,
+        )
+        return temps, top_ps, top_ks, seeds
 
     def _sample_batch(self, logits: jax.Array, seqs: List[Sequence]):
         """Returns (token_ids, logprob_info) where logprob_info is a list of
@@ -458,25 +594,7 @@ class LLMEngine:
                 jnp.asarray(frequency),
             )
 
-        temps = np.array(
-            [s.sampling_params.temperature for s in seqs] + [0.0] * pad,
-            np.float32,
-        )
-        top_ps = np.array(
-            [s.sampling_params.top_p for s in seqs] + [1.0] * pad,
-            np.float32,
-        )
-        top_ks = np.array(
-            [s.sampling_params.top_k for s in seqs] + [0] * pad, np.int32
-        )
-        seeds = np.array(
-            [
-                (s.sampling_params.seed if s.sampling_params.seed is not None else idx)
-                for idx, s in enumerate(seqs)
-            ]
-            + [0] * pad,
-            np.int32,
-        )
+        temps, top_ps, top_ks, seeds = self._sampling_arrays(seqs, S)
         step_key = jax.random.PRNGKey(self.config.seed + self._step_counter)
         out = self._sample_fn(
             logits,

@@ -44,6 +44,12 @@ class PrefillPlan:
 @dataclasses.dataclass
 class DecodePlan:
     seqs: List[Sequence]  # <= max_num_seqs running sequences
+    # Per-sequence decode-iteration budget for this plan (aligned with
+    # ``seqs``).  All 1s for classic stepping; with multi-step scheduling
+    # (SchedulerConfig.num_scheduler_steps > 1) each entry is capped by the
+    # sequence's remaining room (max_model_len, max_tokens) and its blocks
+    # are pre-allocated for the whole budget.
+    steps: List[int] = dataclasses.field(default_factory=list)
 
 
 @dataclasses.dataclass
@@ -236,29 +242,44 @@ class Scheduler:
             is_final=is_final,
         )
 
+    def _step_budget(self, seq: Sequence) -> int:
+        """Decode iterations this sequence can run in one multi-step plan:
+        bounded by max_model_len and the request's max_tokens (stop/EOS cut
+        shorter on the host — those tokens are computed and discarded)."""
+        n = self.config.num_scheduler_steps
+        room_len = self.config.max_model_len - seq.num_tokens
+        room_out = seq.sampling_params.max_tokens - seq.num_generated
+        return max(1, min(n, room_len, room_out))
+
     def _try_schedule_decode(self) -> Optional[DecodePlan]:
         if not self.running:
             return None
         bs = self.block_pool.block_size
 
-        def needs_block(seq: Sequence) -> bool:
-            # The incoming token sits at position num_tokens-1; the table
-            # must cover num_tokens slots.
-            return seq.num_tokens > len(seq.block_table) * bs
+        def blocks_needed(seq: Sequence) -> int:
+            # Iteration i consumes the token at position num_tokens-1+i, so
+            # a k-step budget writes KV through slot num_tokens+k-2 — the
+            # table must cover num_tokens+k-1 slots (k=1: num_tokens).
+            slots = seq.num_tokens + self._step_budget(seq) - 1
+            return max(0, -(-slots // bs) - len(seq.block_table))
 
-        # Ensure every running sequence has a block for its next token;
+        # Ensure every running sequence has blocks for its whole budget;
         # preempt the youngest until the step fits.
         while self.running:
-            need = sum(1 for seq in self.running if needs_block(seq))
+            need = sum(blocks_needed(seq) for seq in self.running)
             if self.block_pool.can_allocate(need):
                 break
             self._preempt_youngest()
         if not self.running:
             return None
         for seq in self.running:
-            if needs_block(seq):
-                seq.block_table.extend(self.block_pool.allocate(1))
-        return DecodePlan(seqs=list(self.running))
+            need = blocks_needed(seq)
+            if need:
+                seq.block_table.extend(self.block_pool.allocate(need))
+        return DecodePlan(
+            seqs=list(self.running),
+            steps=[self._step_budget(seq) for seq in self.running],
+        )
 
     # -- preemption / release ---------------------------------------------
 
