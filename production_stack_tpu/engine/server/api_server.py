@@ -20,6 +20,7 @@ import time
 import uuid
 from typing import Optional
 
+import numpy as np
 from aiohttp import web
 
 from production_stack_tpu.engine.config import EngineConfig, config_from_preset
@@ -549,34 +550,182 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
                            "type": "invalid_request_error"}},
                 status=400,
             )
-        tokenizer = engine.engine.tokenizer
-        data = []
-        total_tokens = 0
-        for i, text in enumerate(inputs):
-            ids = tokenizer.encode(text)
-            total_tokens += len(ids)
-            try:
-                # Off-loop: the forward runs on the device alongside the
-                # step thread; XLA serializes, the event loop must not.
-                vector = await asyncio.to_thread(engine.engine.embed, ids)
-            except ValueError as e:
-                # Over-long input, or a model without an encode path.
-                return web.json_response(
-                    {"error": {"message": str(e),
-                               "type": "invalid_request_error"}},
-                    status=400,
-                )
-            data.append({
+        try:
+            vectors, total_tokens = await _embed_texts(inputs)
+        except ValueError as e:
+            # Over-long input, or a model without an encode path.
+            return web.json_response(
+                {"error": {"message": str(e),
+                           "type": "invalid_request_error"}},
+                status=400,
+            )
+        data = [
+            {
                 "object": "embedding",
                 "index": i,
                 "embedding": [float(v) for v in vector],
-            })
+            }
+            for i, vector in enumerate(vectors)
+        ]
         return web.json_response({
             "object": "list",
             "data": data,
             "model": body.get("model", served_model),
             "usage": {"prompt_tokens": total_tokens,
                       "total_tokens": total_tokens},
+        })
+
+    async def _embed_texts(texts):
+        """Embed a list of strings via the encode path; returns unit vectors.
+
+        Raises ValueError for over-long inputs or models without an encode
+        path — callers map that to a 400.
+        """
+        tokenizer = engine.engine.tokenizer
+        vectors, total_tokens = [], 0
+        for text in texts:
+            ids = tokenizer.encode(text)
+            total_tokens += len(ids)
+            vectors.append(await asyncio.to_thread(engine.engine.embed, ids))
+        return vectors, total_tokens
+
+    def _dot(a, b) -> float:
+        return float(np.dot(a, b))
+
+    async def rerank(request: web.Request) -> web.Response:
+        """Jina/Cohere-style rerank (the contract the reference router
+        proxies at /v1/rerank and /rerank): cosine relevance of each
+        document to the query via the encode path, sorted descending."""
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response(
+                {"error": {"message": "invalid JSON",
+                           "type": "invalid_request_error"}},
+                status=400,
+            )
+        query = body.get("query")
+        documents = body.get("documents")
+        if not isinstance(query, str) or not isinstance(documents, list) or not all(
+            isinstance(d, str) for d in documents
+        ):
+            return web.json_response(
+                {"error": {"message": "'query' must be a string and "
+                           "'documents' a list of strings",
+                           "type": "invalid_request_error"}},
+                status=400,
+            )
+        if not 1 <= len(documents) <= 128:
+            return web.json_response(
+                {"error": {"message": f"'documents' must contain 1-128 items, "
+                           f"got {len(documents)}",
+                           "type": "invalid_request_error"}},
+                status=400,
+            )
+        top_n = body.get("top_n")
+        if top_n is not None and (
+            not isinstance(top_n, int) or isinstance(top_n, bool) or top_n < 1
+        ):
+            # Validate BEFORE the device forwards below, like every other
+            # parameter on this endpoint.
+            return web.json_response(
+                {"error": {"message": "'top_n' must be a positive integer",
+                           "type": "invalid_request_error"}},
+                status=400,
+            )
+        try:
+            vectors, total_tokens = await _embed_texts([query] + documents)
+        except ValueError as e:
+            return web.json_response(
+                {"error": {"message": str(e), "type": "invalid_request_error"}},
+                status=400,
+            )
+        qvec, dvecs = vectors[0], vectors[1:]
+        results = [
+            {"index": i, "document": {"text": documents[i]},
+             "relevance_score": _dot(qvec, dvec)}
+            for i, dvec in enumerate(dvecs)
+        ]
+        results.sort(key=lambda r: r["relevance_score"], reverse=True)
+        if top_n is not None:
+            results = results[:top_n]
+        if not body.get("return_documents", True):
+            for r in results:
+                r.pop("document")
+        return web.json_response({
+            "id": f"rerank-{uuid.uuid4().hex[:16]}",
+            "model": body.get("model", served_model),
+            "usage": {"prompt_tokens": total_tokens,
+                      "total_tokens": total_tokens},
+            "results": results,
+        })
+
+    async def score(request: web.Request) -> web.Response:
+        """vLLM-style /score: similarity of text_1 x text_2 pairs.  A single
+        text_1 broadcasts over the text_2 list; equal-length lists pair
+        elementwise."""
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response(
+                {"error": {"message": "invalid JSON",
+                           "type": "invalid_request_error"}},
+                status=400,
+            )
+
+        def as_list(v):
+            if isinstance(v, str):
+                return [v]
+            if isinstance(v, list) and all(isinstance(x, str) for x in v):
+                return v
+            return None
+
+        t1, t2 = as_list(body.get("text_1")), as_list(body.get("text_2"))
+        if t1 is None or t2 is None or not t1 or not t2:
+            return web.json_response(
+                {"error": {"message": "'text_1' and 'text_2' must be "
+                           "non-empty strings or lists of strings",
+                           "type": "invalid_request_error"}},
+                status=400,
+            )
+        if len(t1) == 1:
+            t1 = t1 * len(t2)
+        if len(t1) != len(t2):
+            return web.json_response(
+                {"error": {"message": f"'text_1' ({len(t1)}) and 'text_2' "
+                           f"({len(t2)}) must broadcast (1-to-N or equal "
+                           "length)", "type": "invalid_request_error"}},
+                status=400,
+            )
+        if len(t2) > 128:
+            return web.json_response(
+                {"error": {"message": f"at most 128 pairs, got {len(t2)}",
+                           "type": "invalid_request_error"}},
+                status=400,
+            )
+        try:
+            # Embed each distinct text once: a broadcast text_1 would
+            # otherwise re-run the device forward per pair.
+            distinct = list(dict.fromkeys(t1 + t2))
+            vectors, total_tokens = await _embed_texts(distinct)
+        except ValueError as e:
+            return web.json_response(
+                {"error": {"message": str(e), "type": "invalid_request_error"}},
+                status=400,
+            )
+        by_text = dict(zip(distinct, vectors))
+        data = [
+            {"object": "score", "index": i,
+             "score": _dot(by_text[a], by_text[b])}
+            for i, (a, b) in enumerate(zip(t1, t2))
+        ]
+        return web.json_response({
+            "id": f"score-{uuid.uuid4().hex[:16]}",
+            "object": "list",
+            "model": body.get("model", served_model),
+            "usage": {"prompt_tokens": total_tokens,
+                      "total_tokens": total_tokens},
+            "data": data,
         })
 
     # -- multi-LoRA admin (proposals/lora-tpu-support.md control plane) ----
@@ -621,6 +770,10 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
     app.router.add_post("/v1/chat/completions", chat_completions)
     app.router.add_post("/v1/completions", completions)
     app.router.add_post("/v1/embeddings", embeddings)
+    app.router.add_post("/v1/rerank", rerank)
+    app.router.add_post("/rerank", rerank)
+    app.router.add_post("/v1/score", score)
+    app.router.add_post("/score", score)
     app.router.add_get("/admin/lora", lora_list)
     app.router.add_post("/admin/lora", lora_load)
     app.router.add_delete("/admin/lora/{name}", lora_unload)
@@ -670,6 +823,14 @@ def main(argv=None) -> None:
         help="comma-separated prefill bucket lengths (prompts beyond the "
         "largest bucket run as chunked prefill)",
     )
+    parser.add_argument(
+        "--num-scheduler-steps",
+        type=int,
+        default=1,
+        help="decode iterations fused per device dispatch (vLLM "
+        "--num-scheduler-steps): amortizes dispatch latency, may compute "
+        "up to N-1 discarded tokens past a stop condition",
+    )
     parser.add_argument("--host-offload-gb", type=float, default=0.0)
     parser.add_argument("--remote-kv-url", default=None)
     parser.add_argument("--no-prefix-caching", action="store_true")
@@ -709,6 +870,7 @@ def main(argv=None) -> None:
                 if args.prefill_buckets
                 else {}
             ),
+            "scheduler.num_scheduler_steps": args.num_scheduler_steps,
             "cache.block_size": args.block_size,
             "cache.num_blocks": args.num_blocks,
             "cache.host_offload_gb": args.host_offload_gb,

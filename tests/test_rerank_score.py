@@ -1,0 +1,156 @@
+"""/v1/rerank + /score served by the real engine (and proxied by the router).
+
+The reference router proxies /v1/rerank, /rerank, /v1/score, /score
+(src/vllm_router/routers/main_router.py:42-91) to whatever engine backs
+them; our engine implements them over the encode path (cosine relevance),
+so the proxied paths have a real backend.
+"""
+
+import aiohttp
+from aiohttp.test_utils import TestClient, TestServer
+
+from production_stack_tpu.engine.config import config_from_preset
+from production_stack_tpu.engine.server.api_server import build_engine_app
+from production_stack_tpu.engine.server.async_engine import AsyncEngine
+
+
+async def _engine_server():
+    config = config_from_preset(
+        "tiny-llama",
+        **{"scheduler.max_num_seqs": 2, "scheduler.max_model_len": 256,
+           "cache.num_blocks": 128},
+    )
+    engine = AsyncEngine(config)
+    server = TestServer(build_engine_app(engine, "tiny-llama"))
+    await server.start_server()
+    return server
+
+
+async def test_rerank_orders_by_relevance():
+    server = await _engine_server()
+    url = f"http://127.0.0.1:{server.port}"
+    docs = [
+        "quarterly revenue grew by eight percent",
+        "the cat sat on the mat",
+        "a cat sat on a mat",
+    ]
+    try:
+        async with aiohttp.ClientSession() as session:
+            async with session.post(f"{url}/v1/rerank", json={
+                "model": "tiny-llama",
+                "query": "the cat sat on the mat",
+                "documents": docs,
+            }) as resp:
+                assert resp.status == 200
+                body = await resp.json()
+        results = body["results"]
+        assert len(results) == 3
+        scores = [r["relevance_score"] for r in results]
+        assert scores == sorted(scores, reverse=True)
+        # The identical document must win; documents echo back by index.
+        assert results[0]["index"] == 1
+        assert results[0]["document"]["text"] == docs[1]
+        assert body["usage"]["prompt_tokens"] > 0
+
+        # top_n truncation + return_documents=False on the alias path.
+        async with aiohttp.ClientSession() as session:
+            async with session.post(f"{url}/rerank", json={
+                "query": "the cat sat on the mat",
+                "documents": docs,
+                "top_n": 1,
+                "return_documents": False,
+            }) as resp:
+                assert resp.status == 200
+                body = await resp.json()
+        assert len(body["results"]) == 1
+        assert "document" not in body["results"][0]
+    finally:
+        await server.close()
+
+
+async def test_rerank_validation():
+    server = await _engine_server()
+    url = f"http://127.0.0.1:{server.port}"
+    try:
+        async with aiohttp.ClientSession() as session:
+            for bad in (
+                {"query": 5, "documents": ["a"]},
+                {"query": "q", "documents": "not a list"},
+                {"query": "q", "documents": []},
+            ):
+                async with session.post(f"{url}/v1/rerank", json=bad) as resp:
+                    assert resp.status == 400
+    finally:
+        await server.close()
+
+
+async def test_score_broadcast_and_pairwise():
+    server = await _engine_server()
+    url = f"http://127.0.0.1:{server.port}"
+    try:
+        # 1-to-N broadcast: identical pair scores highest.
+        async with aiohttp.ClientSession() as session:
+            async with session.post(f"{url}/score", json={
+                "text_1": "the cat sat on the mat",
+                "text_2": ["the cat sat on the mat", "revenue grew"],
+            }) as resp:
+                assert resp.status == 200
+                body = await resp.json()
+        assert body["object"] == "list"
+        assert [d["index"] for d in body["data"]] == [0, 1]
+        assert body["data"][0]["score"] > body["data"][1]["score"]
+        # Self-similarity of unit vectors is ~1.
+        assert abs(body["data"][0]["score"] - 1.0) < 1e-3
+
+        # Equal-length lists pair elementwise.
+        async with aiohttp.ClientSession() as session:
+            async with session.post(f"{url}/v1/score", json={
+                "text_1": ["alpha", "beta"],
+                "text_2": ["alpha", "gamma"],
+            }) as resp:
+                assert resp.status == 200
+                body = await resp.json()
+        assert len(body["data"]) == 2
+        assert body["data"][0]["score"] > body["data"][1]["score"]
+
+        # Mismatched lengths that don't broadcast are a 400.
+        async with aiohttp.ClientSession() as session:
+            async with session.post(f"{url}/v1/score", json={
+                "text_1": ["a", "b"], "text_2": ["x", "y", "z"],
+            }) as resp:
+                assert resp.status == 400
+    finally:
+        await server.close()
+
+
+async def test_rerank_proxied_through_router():
+    from production_stack_tpu.router.app import build_app
+    from production_stack_tpu.router.parser import parse_args
+
+    engine_server = await _engine_server()
+    engine_url = f"http://127.0.0.1:{engine_server.port}"
+    app = build_app(parse_args([
+        "--static-backends", engine_url,
+        "--static-models", "tiny-llama",
+        "--engine-stats-interval", "1",
+    ]))
+    router = TestServer(app)
+    await router.start_server()
+    client = TestClient(router)
+    try:
+        resp = await client.post("/v1/rerank", json={
+            "model": "tiny-llama",
+            "query": "q",
+            "documents": ["a", "b"],
+        })
+        assert resp.status == 200
+        body = await resp.json()
+        assert len(body["results"]) == 2
+        resp = await client.post("/score", json={
+            "model": "tiny-llama", "text_1": "q", "text_2": ["a"],
+        })
+        assert resp.status == 200
+    finally:
+        await client.close()
+        await router.close()
+        await engine_server.close()
