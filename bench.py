@@ -297,6 +297,12 @@ def main() -> None:
     ap.add_argument("--batch", type=int, default=8)
     ap.add_argument("--ctx", type=int, default=2048)
     ap.add_argument("--quick", action="store_true", help="skip secondary benches")
+    ap.add_argument(
+        "--serving-scheduler-steps", type=int, default=8,
+        help="num_scheduler_steps for the serving bench engine (8 amortizes "
+        "dispatch RTT when the TPU sits behind a network tunnel; set 1 for "
+        "classic per-token stepping on a directly-attached chip)",
+    )
     args = ap.parse_args()
 
     import os
@@ -424,6 +430,7 @@ def main() -> None:
                 system_prompt_len=600, user_info_len=600, answer_len=48,
                 max_num_seqs=args.batch,
                 max_model_len=min(cfg.max_model_len, 4096),
+                num_scheduler_steps=args.serving_scheduler_steps,
             )
             detail["serving"] = serving
             log(f"serving: ttft_p50={serving.get('ttft_p50_s')}s "

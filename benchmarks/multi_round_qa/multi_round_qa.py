@@ -58,6 +58,11 @@ class WorkloadConfig:
     init_user_id: int = 0
     seed_history_rounds: int = 0  # pre-grown history (ramp-up equivalent)
     request_timeout: float = 120.0
+    # Unrecorded sequential requests before the measurement clock starts
+    # (reference warmup_engine, multi-round-qa.py:534-543).  Essential for
+    # a JAX engine: the first hit on each prefill bucket / decode program
+    # compiles (~tens of seconds) and must not land in TTFT percentiles.
+    warmup_requests: int = 0
 
 
 @dataclasses.dataclass
@@ -261,11 +266,17 @@ def summarize(records: List[RequestRecord], wall_time: float,
         "ttft_mean_s": round(statistics.fmean(ttfts), 4) if ttfts else 0.0,
         "input_tokens_per_s": round(total_prompt / wall_time, 1) if wall_time else 0,
         "output_tokens_per_s": round(total_gen / wall_time, 1) if wall_time else 0,
+        # Per-request generation throughput is only meaningful when the
+        # answer streamed over a measurable interval; short answers can
+        # arrive in one SSE chunk (generation_time ~ 0), which would make
+        # the mean explode.  Those requests are excluded.
         "gen_throughput_per_request": round(
             statistics.fmean(
-                r.generation_tokens / r.generation_time for r in ok
+                r.generation_tokens / r.generation_time
+                for r in ok
+                if r.generation_time > 1e-3
             ), 2,
-        ) if ok else 0.0,
+        ) if any(r.generation_time > 1e-3 for r in ok) else 0.0,
     }
     if kv_hit_rate is not None:
         summary["kv_hit_rate"] = round(kv_hit_rate, 4)
@@ -290,6 +301,18 @@ async def run_benchmark(config: WorkloadConfig) -> Dict:
     stop = asyncio.Event()
     connector = aiohttp.TCPConnector(limit=0)
     async with aiohttp.ClientSession(connector=connector) as session:
+        if config.warmup_requests:
+            # A throwaway user (id far outside the measured range) runs its
+            # rounds back-to-back: round 1 prefills a workload-sized prompt
+            # (compiling the big bucket), later rounds hit the decode path
+            # again with grown history.  Records are discarded.
+            warm = UserSession(
+                config.init_user_id + 1_000_000,
+                dataclasses.replace(config, num_rounds=config.warmup_requests),
+            )
+            warm.gap = 0.0
+            await warm.run(session, asyncio.Event())
+
         sessions: List[UserSession] = []
         # Ramp-up: stagger user joins across one full request gap so load
         # rises smoothly; late joiners get seeded history so their KV
@@ -349,6 +372,9 @@ def main(argv=None) -> None:
                         help="measurement window seconds (default: run to drain)")
     parser.add_argument("--seed-history-rounds", type=int, default=0)
     parser.add_argument("--init-user-id", type=int, default=0)
+    parser.add_argument("--warmup-requests", type=int, default=0,
+                        help="unrecorded warmup requests before the clock "
+                        "starts (compiles JAX programs out-of-band)")
     parser.add_argument("--no-user-id-header", action="store_true")
     parser.add_argument("--output", default=None, help="per-request CSV path")
     parser.add_argument("--log-level", default="info")
@@ -369,6 +395,7 @@ def main(argv=None) -> None:
         enable_user_id=not args.no_user_id_header,
         init_user_id=args.init_user_id,
         seed_history_rounds=args.seed_history_rounds,
+        warmup_requests=args.warmup_requests,
     )
     result = asyncio.run(run_benchmark(config))
     summary = result["summary"]

@@ -51,6 +51,8 @@ async def run_serving_bench(
     max_model_len: int = 2048,
     num_blocks: Optional[int] = None,
     duration: Optional[float] = None,
+    num_scheduler_steps: int = 1,
+    warmup_requests: int = 2,
 ) -> Dict:
     """Boot engine + router on localhost, run the workload, return summary.
 
@@ -69,6 +71,7 @@ async def run_serving_bench(
     overrides = {
         "scheduler.max_num_seqs": max_num_seqs,
         "scheduler.max_model_len": max_model_len,
+        "scheduler.num_scheduler_steps": num_scheduler_steps,
     }
     if num_blocks is not None:
         overrides["cache.num_blocks"] = num_blocks
@@ -97,6 +100,7 @@ async def run_serving_bench(
             user_info_len=user_info_len,
             answer_len=answer_len,
             duration=duration,
+            warmup_requests=warmup_requests,
         ))
         return result["summary"]
     finally:
