@@ -6,10 +6,14 @@ Reference counterpart: src/vllm_router/experimental/pii/ — PIIType taxonomy
 policy (middleware.py:97-154) and its own Prometheus counters
 (middleware.py:20-40).
 
-Differences: the reference's second analyzer (Presidio NLP) needs model
-downloads the TPU image cannot assume, so the pluggable seam keeps only the
-dependency-free regex analyzer; credit-card matches are Luhn-validated to cut
-the false-positive rate of a bare digit regex.
+Differences: the reference's second analyzer (Presidio NLP,
+analyzers/presidio.py) needs model downloads the TPU image cannot assume, so
+the factory's second analyzer here is dependency-free instead: ``secrets``
+detects credential material (cloud API keys, tokens, private-key blocks,
+mod-97-validated IBANs) — the PII class that matters most for a proxy that
+logs and caches request bodies.  ``strict`` composes both.  Credit-card
+matches are Luhn-validated to cut the false-positive rate of a bare digit
+regex.
 """
 
 from __future__ import annotations
@@ -44,6 +48,9 @@ class PIIType(enum.Enum):
     SSN = "ssn"
     CREDIT_CARD = "credit_card"
     IP_ADDRESS = "ip_address"
+    IBAN = "iban"
+    API_KEY = "api_key"
+    PRIVATE_KEY = "private_key"
 
 
 class RegexAnalyzer:
@@ -92,7 +99,79 @@ def _luhn_ok(candidate: str) -> bool:
     return checksum % 10 == 0
 
 
-_ANALYZERS = {RegexAnalyzer.name: RegexAnalyzer}
+def _iban_ok(candidate: str) -> bool:
+    """ISO 13616 mod-97 check (rearrange, letters -> 10..35, mod 97 == 1)."""
+    s = candidate.replace(" ", "").upper()
+    if not 15 <= len(s) <= 34:
+        return False
+    rearranged = s[4:] + s[:4]
+    try:
+        value = int("".join(
+            str(int(c, 36)) for c in rearranged
+        ))
+    except ValueError:
+        return False
+    return value % 97 == 1
+
+
+class SecretsAnalyzer:
+    """Credential-material analyzer: the highest-stakes PII for a router
+    that logs bodies and stores them in caches/batch files.  All patterns
+    are structure-validated (prefix formats; IBAN mod-97) so prose never
+    trips them."""
+
+    name = "secrets"
+
+    _PATTERNS: Dict[PIIType, re.Pattern] = {
+        # Cloud/API credentials by issuer-fixed prefix: AWS access keys,
+        # Google API keys, GitHub tokens, Slack tokens, OpenAI-style keys.
+        PIIType.API_KEY: re.compile(
+            r"\b(?:AKIA[0-9A-Z]{16}"
+            r"|AIza[0-9A-Za-z_-]{35}"
+            r"|gh[pousr]_[A-Za-z0-9]{36,}"
+            r"|xox[baprs]-[A-Za-z0-9-]{10,}"
+            r"|sk-[A-Za-z0-9_-]{20,})\b"
+        ),
+        PIIType.PRIVATE_KEY: re.compile(
+            r"-----BEGIN (?:RSA |EC |DSA |OPENSSH |PGP )?PRIVATE KEY(?: BLOCK)?-----"
+        ),
+        PIIType.IBAN: re.compile(
+            r"\b[A-Z]{2}\d{2}(?:[ ]?[A-Z0-9]{2,4}){3,8}\b"
+        ),
+    }
+
+    def analyze(self, text: str) -> Set[PIIType]:
+        found: Set[PIIType] = set()
+        for pii_type, pattern in self._PATTERNS.items():
+            for match in pattern.finditer(text):
+                if pii_type is PIIType.IBAN and not _iban_ok(match.group()):
+                    continue
+                found.add(pii_type)
+                break
+        return found
+
+
+class StrictAnalyzer:
+    """Union of every registered leaf analyzer (reference factory's
+    multi-analyzer role, analyzers/factory.py:20-55)."""
+
+    name = "strict"
+
+    def __init__(self):
+        self._analyzers = [RegexAnalyzer(), SecretsAnalyzer()]
+
+    def analyze(self, text: str) -> Set[PIIType]:
+        found: Set[PIIType] = set()
+        for analyzer in self._analyzers:
+            found |= analyzer.analyze(text)
+        return found
+
+
+_ANALYZERS = {
+    RegexAnalyzer.name: RegexAnalyzer,
+    SecretsAnalyzer.name: SecretsAnalyzer,
+    StrictAnalyzer.name: StrictAnalyzer,
+}
 
 
 def create_analyzer(name: str):

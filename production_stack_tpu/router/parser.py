@@ -100,7 +100,13 @@ def parse_args(argv=None) -> argparse.Namespace:
     parser.add_argument("--semantic-cache-model", default="hash")
     parser.add_argument("--semantic-cache-dir", default=None)
     parser.add_argument("--semantic-cache-threshold", type=float, default=0.95)
-    parser.add_argument("--pii-analyzer", default="regex")
+    parser.add_argument(
+        "--pii-analyzer",
+        default="regex",
+        choices=["regex", "secrets", "strict"],
+        help="regex: classic PII patterns; secrets: credential material "
+        "(API keys, private keys, IBANs); strict: both",
+    )
 
     parser.add_argument("--request-rewriter", default="noop")
     parser.add_argument("--log-level", default="info")
