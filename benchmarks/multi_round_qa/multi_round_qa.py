@@ -63,6 +63,13 @@ class WorkloadConfig:
     # a JAX engine: the first hit on each prefill bucket / decode program
     # compiles (~tens of seconds) and must not land in TTFT percentiles.
     warmup_requests: int = 0
+    # Replay real conversations instead of the synthetic workload
+    # (reference ShareGPT mode, multi-round-qa.py:181-260,373-381): a JSON
+    # list of {"num_round": int, "conversations": [{"value": str,
+    # "num_tokens": int}, ...]} alternating human/assistant turns.  User
+    # prompts come from the human turns; each round's max_tokens from the
+    # matching assistant turn's num_tokens.
+    sharegpt_path: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -82,12 +89,38 @@ def _dummy_text(num_tokens: int) -> str:
     return " ".join(["hi"] * num_tokens)
 
 
+def load_sharegpt(path: str, num_rounds: int) -> List[Dict]:
+    """Conversations with enough rounds for the configured workload
+    (reference _load_sharegpt_data, multi-round-qa.py:373-381)."""
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    # Filter on the ACTUAL turn count — num_round metadata can disagree
+    # with the conversations list, and trusting it would crash mid-replay.
+    usable = [
+        d for d in data
+        if len(d.get("conversations", [])) >= 2 * num_rounds
+    ]
+    if not usable:
+        raise ValueError(
+            f"{path}: no conversation has >= {2 * num_rounds} turns "
+            f"({len(data)} total)"
+        )
+    logger.info("ShareGPT: %d/%d conversations usable", len(usable), len(data))
+    return usable
+
+
 class UserSession:
     """One user's multi-round conversation, self-paced."""
 
-    def __init__(self, user_id: int, config: WorkloadConfig):
+    def __init__(
+        self,
+        user_id: int,
+        config: WorkloadConfig,
+        dialogue: Optional[Dict] = None,  # one ShareGPT conversation
+    ):
         self.user_id = user_id
         self.config = config
+        self.dialogue = dialogue
         self.history: List[Dict[str, str]] = []
         self.records: List[RequestRecord] = []
         # Per-user pacing: num_users concurrent users at aggregate `qps`
@@ -109,19 +142,40 @@ class UserSession:
             "a new long story with a happy ending?"
         )
 
+    def _round_prompt(self, round_id: int) -> str:
+        """Round round_id's user turn: the ShareGPT human turn when
+        replaying, else synthetic (system prompt folded into round 1)."""
+        if self.dialogue is not None:
+            return self.dialogue["conversations"][2 * (round_id - 1)]["value"]
+        prompt = self._question(round_id)
+        if not self.history:
+            prompt = self._system_prompt() + prompt
+        return prompt
+
+    def _round_max_tokens(self, round_id: int) -> int:
+        """ShareGPT replay caps the answer at the real assistant turn's
+        length (reference :254-262); synthetic mode uses answer_len."""
+        if self.dialogue is not None:
+            turn = self.dialogue["conversations"][2 * (round_id - 1) + 1]
+            n = turn.get("num_tokens") or (len(turn.get("value", "")) // 4)
+            return max(1, min(int(n), 2048))
+        return self.config.answer_len
+
     def seed_history(self, rounds: int) -> None:
         """Pre-grow the chat history so mid-benchmark joins look like the
         steady state (the reference's ramp-up internal-state seeding,
         multi-round-qa.py:285-301)."""
         for round_id in range(1, rounds + 1):
-            prompt = self._question(round_id)
-            if not self.history:
-                prompt = self._system_prompt() + prompt
-            self.history.append({"role": "user", "content": prompt})
-            self.history.append({
-                "role": "assistant",
-                "content": _dummy_text(self.config.answer_len),
-            })
+            self.history.append(
+                {"role": "user", "content": self._round_prompt(round_id)}
+            )
+            if self.dialogue is not None:
+                answer = self.dialogue["conversations"][
+                    2 * (round_id - 1) + 1
+                ].get("value", "")
+            else:
+                answer = _dummy_text(self.config.answer_len)
+            self.history.append({"role": "assistant", "content": answer})
 
     async def run(self, session: aiohttp.ClientSession, stop: asyncio.Event):
         start_round = len(self.history) // 2 + 1
@@ -129,10 +183,9 @@ class UserSession:
             if stop.is_set():
                 return
             round_start = time.time()
-            prompt = self._question(round_id)
-            if not self.history:
-                prompt = self._system_prompt() + prompt
-            self.history.append({"role": "user", "content": prompt})
+            self.history.append(
+                {"role": "user", "content": self._round_prompt(round_id)}
+            )
             record = await self._request(session, round_id)
             self.records.append(record)
             if record.error is None:
@@ -161,7 +214,7 @@ class UserSession:
             "messages": self.history,
             "temperature": 0,
             "stream": True,
-            "max_tokens": self.config.answer_len,
+            "max_tokens": self._round_max_tokens(round_id),
             "stream_options": {"include_usage": True},
         }
         first_token_time = None
@@ -299,6 +352,9 @@ async def run_benchmark(config: WorkloadConfig) -> Dict:
     """Drive the workload; returns the summary dict (importable from tests
     and run scripts)."""
     stop = asyncio.Event()
+    dialogues: Optional[List[Dict]] = None
+    if config.sharegpt_path:
+        dialogues = load_sharegpt(config.sharegpt_path, config.num_rounds)
     connector = aiohttp.TCPConnector(limit=0)
     async with aiohttp.ClientSession(connector=connector) as session:
         if config.warmup_requests:
@@ -306,9 +362,18 @@ async def run_benchmark(config: WorkloadConfig) -> Dict:
             # rounds back-to-back: round 1 prefills a workload-sized prompt
             # (compiling the big bucket), later rounds hit the decode path
             # again with grown history.  Records are discarded.
+            warm_dialogue = dialogues[-1] if dialogues else None
+            warm_rounds = config.warmup_requests
+            if warm_dialogue is not None:
+                # The dataset only guarantees num_rounds rounds per
+                # conversation; don't index past the warmup dialogue.
+                warm_rounds = min(
+                    warm_rounds, len(warm_dialogue["conversations"]) // 2
+                )
             warm = UserSession(
                 config.init_user_id + 1_000_000,
-                dataclasses.replace(config, num_rounds=config.warmup_requests),
+                dataclasses.replace(config, num_rounds=warm_rounds),
+                dialogue=warm_dialogue,
             )
             warm.gap = 0.0
             await warm.run(session, asyncio.Event())
@@ -324,7 +389,11 @@ async def run_benchmark(config: WorkloadConfig) -> Dict:
         start = time.time()
 
         async def launch_user(idx: int) -> UserSession:
-            user = UserSession(config.init_user_id + idx + 1, config)
+            user = UserSession(
+                config.init_user_id + idx + 1,
+                config,
+                dialogue=dialogues[idx % len(dialogues)] if dialogues else None,
+            )
             if config.seed_history_rounds:
                 user.seed_history(
                     min(config.seed_history_rounds, config.num_rounds - 1)
@@ -375,6 +444,9 @@ def main(argv=None) -> None:
     parser.add_argument("--warmup-requests", type=int, default=0,
                         help="unrecorded warmup requests before the clock "
                         "starts (compiles JAX programs out-of-band)")
+    parser.add_argument("--sharegpt", default=None, metavar="PATH",
+                        help="replay conversations from a ShareGPT-format "
+                        "JSON instead of the synthetic workload")
     parser.add_argument("--no-user-id-header", action="store_true")
     parser.add_argument("--output", default=None, help="per-request CSV path")
     parser.add_argument("--log-level", default="info")
@@ -396,6 +468,7 @@ def main(argv=None) -> None:
         init_user_id=args.init_user_id,
         seed_history_rounds=args.seed_history_rounds,
         warmup_requests=args.warmup_requests,
+        sharegpt_path=args.sharegpt,
     )
     result = asyncio.run(run_benchmark(config))
     summary = result["summary"]

@@ -14,6 +14,7 @@ sys.path.insert(
 from multi_round_qa import (  # noqa: E402
     RequestRecord,
     WorkloadConfig,
+    load_sharegpt,
     run_benchmark,
     summarize,
     write_csv,
@@ -98,6 +99,61 @@ async def test_harness_survives_backend_errors():
         assert all(r.error for r in result["records"])
     finally:
         await client.close()
+
+
+def _sharegpt_file(tmp_path, num_convs=3, rounds=4):
+    import json
+
+    data = []
+    for c in range(num_convs):
+        turns = []
+        for r in range(rounds):
+            turns.append({"value": f"conv {c} question {r} about topic {c}?"})
+            turns.append({"value": "answer " * 6, "num_tokens": 6})
+        data.append({"num_round": 2 * rounds, "conversations": turns})
+    # One conversation too short to satisfy any workload: must be filtered.
+    data.append({"num_round": 2, "conversations": [
+        {"value": "short"}, {"value": "reply", "num_tokens": 2}]})
+    path = tmp_path / "sharegpt.json"
+    path.write_text(json.dumps(data))
+    return str(path)
+
+
+def test_load_sharegpt_filters_short_conversations(tmp_path):
+    path = _sharegpt_file(tmp_path, num_convs=2, rounds=3)
+    usable = load_sharegpt(path, num_rounds=3)
+    assert len(usable) == 2  # the 1-round conversation is dropped
+    import pytest
+
+    with pytest.raises(ValueError, match="no conversation"):
+        load_sharegpt(path, num_rounds=50)
+
+
+async def test_harness_sharegpt_replay(tmp_path):
+    """ShareGPT mode replays real turns: prompts come from the dataset and
+    answers are capped by the dataset's assistant turn lengths."""
+    s1, e1 = await start_fake_engine(tokens_per_sec=3000.0, ttft=0.002)
+    try:
+        app, server, client = await start_router(
+            [str(e1.make_url("")).rstrip("/")], ["fake/llama-3-8b"]
+        )
+        try:
+            config = WorkloadConfig(
+                base_url=str(server.make_url("")).rstrip("/"),
+                model="fake/llama-3-8b",
+                num_users=3, num_rounds=2, qps=50.0,
+                sharegpt_path=_sharegpt_file(tmp_path),
+            )
+            result = await run_benchmark(config)
+            summary = result["summary"]
+            assert summary["requests_finished"] == 3 * 2
+            assert summary["requests_failed"] == 0
+            # Dataset cap: every answer is at most the turn's num_tokens.
+            assert all(r.generation_tokens <= 6 for r in result["records"])
+        finally:
+            await client.close()
+    finally:
+        await e1.close()
 
 
 def test_summarize_percentiles():
