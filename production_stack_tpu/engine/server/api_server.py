@@ -813,6 +813,13 @@ def main(argv=None) -> None:
     parser.add_argument("--served-model-name", default=None)
     parser.add_argument("--weights-path", default=None)
     parser.add_argument("--tokenizer", default=None)
+    parser.add_argument(
+        "--chat-template",
+        default=None,
+        help="path to a Jinja chat-template file overriding the "
+        "tokenizer's (the chart mounts modelSpec.chatTemplate here; "
+        "reference deployment-vllm-multi.yaml:260-270)",
+    )
     parser.add_argument("--max-num-seqs", type=int, default=8)
     parser.add_argument("--max-model-len", type=int, default=2048)
     parser.add_argument("--block-size", type=int, default=16)
@@ -886,6 +893,22 @@ def main(argv=None) -> None:
         },
     )
     engine = AsyncEngine(config)
+    if args.chat_template:
+        with open(args.chat_template, "r", encoding="utf-8") as f:
+            engine.engine.tokenizer.chat_template = f.read()
+        try:
+            # Fail at boot, not per-request: render a probe conversation so
+            # template typos (undefined vars, syntax errors) surface now.
+            engine.engine.tokenizer.apply_chat_template(
+                [{"role": "system", "content": "probe"},
+                 {"role": "user", "content": "probe"}]
+            )
+        except Exception as e:
+            raise SystemExit(
+                f"--chat-template {args.chat_template} failed to render: "
+                f"{type(e).__name__}: {e}"
+            )
+        logger.info("Chat template override: %s", args.chat_template)
     served = args.served_model_name or args.model
     app = build_engine_app(engine, served)
     logger.info("Starting tpu-engine (%s) on %s:%d", served, args.host, args.port)

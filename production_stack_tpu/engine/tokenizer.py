@@ -8,6 +8,7 @@ vocab of 256 bytes + 4 specials.
 from __future__ import annotations
 
 import logging
+from functools import lru_cache
 from typing import List, Optional
 
 logger = logging.getLogger(__name__)
@@ -24,6 +25,7 @@ class ByteTokenizer:
         self.bos_token_id = self.BOS
         self.eos_token_id = self.EOS
         self.pad_token_id = self.PAD
+        self.chat_template: Optional[str] = None  # Jinja override
 
     def encode(self, text: str, add_bos: bool = True) -> List[int]:
         ids = [b + self.OFFSET for b in text.encode("utf-8")]
@@ -38,6 +40,8 @@ class ByteTokenizer:
         return data.decode("utf-8", errors="replace")
 
     def apply_chat_template(self, messages) -> str:
+        if self.chat_template is not None:
+            return _render_jinja(self.chat_template, messages, bos="", eos="")
         parts = [f"<|{m.get('role', 'user')}|>{m.get('content', '')}" for m in messages]
         return "\n".join(parts) + "\n<|assistant|>"
 
@@ -53,6 +57,7 @@ class HFTokenizer:
         self.bos_token_id = self._tok.bos_token_id
         self.eos_token_id = self._tok.eos_token_id
         self.pad_token_id = self._tok.pad_token_id or 0
+        self.chat_template: Optional[str] = None  # Jinja override
 
     def encode(self, text: str, add_bos: bool = True) -> List[int]:
         return self._tok.encode(text, add_special_tokens=add_bos)
@@ -61,6 +66,16 @@ class HFTokenizer:
         return self._tok.decode(ids, skip_special_tokens=True)
 
     def apply_chat_template(self, messages) -> str:
+        if self.chat_template is not None:
+            # An explicitly configured template must never be silently
+            # replaced by the degenerate fallback: the server validates it
+            # at startup, and any later failure should surface loudly.
+            return self._tok.apply_chat_template(
+                messages,
+                tokenize=False,
+                add_generation_prompt=True,
+                chat_template=self.chat_template,
+            )
         try:
             return self._tok.apply_chat_template(
                 messages, tokenize=False, add_generation_prompt=True
@@ -68,6 +83,31 @@ class HFTokenizer:
         except Exception:
             parts = [f"{m.get('role')}: {m.get('content', '')}" for m in messages]
             return "\n".join(parts) + "\nassistant:"
+
+
+@lru_cache(maxsize=8)
+def _compile_jinja(template: str):
+    """Compile once per template string: rendering sits on the request hot
+    path.  StrictUndefined so typos fail the startup validation render
+    instead of silently emitting empty strings."""
+    import jinja2
+
+    env = jinja2.Environment(undefined=jinja2.StrictUndefined, autoescape=False)
+    return env.from_string(template)
+
+
+def _render_jinja(template: str, messages, bos: str, eos: str) -> str:
+    """Render a custom chat template (the reference chart's chatTemplate
+    ConfigMap, deployment-vllm-multi.yaml:260-270, passed to vllm serve as
+    --chat-template).  jinja2 ships with transformers in this image.
+    ``bos_token``/``eos_token`` are provided because standard HF templates
+    reference them."""
+    return _compile_jinja(template).render(
+        messages=messages,
+        add_generation_prompt=True,
+        bos_token=bos,
+        eos_token=eos,
+    )
 
 
 def get_tokenizer(path: Optional[str]):

@@ -1,0 +1,59 @@
+"""Chat-template override: the chart's modelSpec.chatTemplate ConfigMap ->
+--chat-template -> tokenizer (reference deployment-vllm-multi.yaml:260-270).
+"""
+
+import aiohttp
+from aiohttp.test_utils import TestServer
+
+from production_stack_tpu.engine.config import config_from_preset
+from production_stack_tpu.engine.server.api_server import build_engine_app
+from production_stack_tpu.engine.server.async_engine import AsyncEngine
+from production_stack_tpu.engine.tokenizer import ByteTokenizer
+
+TEMPLATE = (
+    "{% for m in messages %}[{{ m.role }}]{{ m.content }}{% endfor %}"
+    "{% if add_generation_prompt %}[assistant]{% endif %}"
+)
+
+
+def test_byte_tokenizer_jinja_override():
+    tok = ByteTokenizer()
+    messages = [
+        {"role": "system", "content": "be kind"},
+        {"role": "user", "content": "hello"},
+    ]
+    default = tok.apply_chat_template(messages)
+    assert "<|assistant|>" in default
+
+    tok.chat_template = TEMPLATE
+    rendered = tok.apply_chat_template(messages)
+    assert rendered == "[system]be kind[user]hello[assistant]"
+
+
+async def test_engine_serves_with_custom_template():
+    config = config_from_preset(
+        "tiny-llama",
+        **{"scheduler.max_num_seqs": 2, "scheduler.max_model_len": 256,
+           "cache.num_blocks": 128},
+    )
+    engine = AsyncEngine(config)
+    engine.engine.tokenizer.chat_template = TEMPLATE
+    server = TestServer(build_engine_app(engine, "tiny-llama"))
+    await server.start_server()
+    try:
+        async with aiohttp.ClientSession() as session:
+            async with session.post(
+                f"http://127.0.0.1:{server.port}/v1/chat/completions",
+                json={"model": "tiny-llama", "max_tokens": 4,
+                      "messages": [{"role": "user", "content": "hi"}]},
+            ) as resp:
+                assert resp.status == 200
+                body = await resp.json()
+        assert body["choices"][0]["message"]["content"] is not None
+        # The custom template determines the prompt token count: the
+        # rendered string is shorter than the default <|role|> framing.
+        tok = ByteTokenizer()
+        expected = len(tok.encode("[user]hi[assistant]"))
+        assert body["usage"]["prompt_tokens"] == expected
+    finally:
+        await server.close()

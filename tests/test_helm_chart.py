@@ -119,6 +119,46 @@ def test_tpu_example_renders_tpu_first():
     assert "production_stack_tpu.kvserver.server" in cache_cmd
 
 
+def test_chat_template_configmap_and_mount():
+    """modelSpec.chatTemplate -> per-model ConfigMap, read-only mount at
+    /templates, and --chat-template on the engine command (reference
+    deployment-vllm-multi.yaml:260-270)."""
+    values = tpu_values()
+    values["servingEngineSpec"]["modelSpec"][0]["chatTemplate"] = (
+        "{% for m in messages %}{{ m.role }}: {{ m.content }}\n{% endfor %}"
+    )
+    objs = load_manifests(render_chart(CHART_DIR, values, release_name="ct"))
+    cms = {o["metadata"]["name"]: o for o in by_kind(objs, "ConfigMap")}
+    cm = cms["ct-llama3-8b-chat-template"]
+    assert "{% for m in messages %}" in cm["data"]["chat-template.jinja"]
+
+    engine = [
+        o for o in by_kind(objs, "Deployment")
+        if o["metadata"]["name"] == "ct-llama3-8b-deployment-engine"
+    ][0]
+    pod = engine["spec"]["template"]["spec"]
+    container = pod["containers"][0]
+    cmd = container["command"]
+    assert cmd[cmd.index("--chat-template") + 1] == "/templates/chat-template.jinja"
+    mounts = {m["name"]: m for m in container["volumeMounts"]}
+    assert mounts["chat-template"]["mountPath"] == "/templates"
+    assert mounts["chat-template"]["readOnly"] is True
+    volumes = {v["name"]: v for v in pod["volumes"]}
+    assert volumes["chat-template"]["configMap"]["name"] == \
+        "ct-llama3-8b-chat-template"
+
+    # numSchedulerSteps flows through when set.
+    values["servingEngineSpec"]["modelSpec"][0]["engineConfig"][
+        "numSchedulerSteps"] = 8
+    objs = load_manifests(render_chart(CHART_DIR, values, release_name="ct"))
+    engine = [
+        o for o in by_kind(objs, "Deployment")
+        if o["metadata"]["name"] == "ct-llama3-8b-deployment-engine"
+    ][0]
+    cmd = engine["spec"]["template"]["spec"]["containers"][0]["command"]
+    assert cmd[cmd.index("--num-scheduler-steps") + 1] == "8"
+
+
 def test_router_rbac_matches_discovery():
     """The Role must grant exactly what k8s_discovery.py uses (pods
     get/list/watch) and the router args must select the fixed engine label
