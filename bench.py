@@ -35,8 +35,11 @@ import time
 import numpy as np
 
 
+_T0 = time.time()
+
+
 def log(msg: str) -> None:
-    print(msg, file=sys.stderr, flush=True)
+    print(f"[{time.time() - _T0:6.1f}s] {msg}", file=sys.stderr, flush=True)
 
 
 _ATTEMPT_ENV = "PSTPU_BENCH_INIT_ATTEMPT"
@@ -406,6 +409,31 @@ def main() -> None:
         roofline_step = (param_bytes + kv_bytes) / (peak_gbs * 1e9)
         vs_baseline = round(decode_tps * roofline_step / S, 3)
         detail["decode_roofline_tokens_per_s"] = round(S / roofline_step)
+
+    if not args.quick:
+        # Int8 weight-only A/B (model.quantization="int8"): decode is
+        # HBM-bound, so halving the projection bytes should approach a 2x
+        # step-time cut; report the measured ratio next to its own
+        # roofline so the claim is falsifiable.
+        try:
+            from production_stack_tpu.engine.models import llama as _llama
+            import dataclasses as _dc
+
+            qcfg = _dc.replace(cfg, quantization="int8")
+            qparams = _llama.quantize_params(params, qcfg)
+            t_decode_q = bench_decode(
+                jax, jnp, qcfg, qparams, kv, S, ctx, bmax, bs
+            )
+            detail["decode_step_ms_int8"] = round(t_decode_q * 1e3, 3)
+            detail["decode_tokens_per_s_int8"] = round(S / t_decode_q, 1)
+            detail["int8_decode_speedup"] = round(t_decode / t_decode_q, 2)
+            del qparams
+            log(f"decode int8: {t_decode_q*1e3:.2f} ms/step "
+                f"({S/t_decode_q:.0f} tok/s, "
+                f"{detail['int8_decode_speedup']}x vs bf16)")
+        except Exception as e:
+            log(f"int8 decode bench failed: {e}")
+            detail["int8_decode_error"] = str(e)[:200]
 
     if not args.quick:
         # North-star serving metrics (BASELINE.md): multi-round QA through

@@ -42,11 +42,20 @@ class ModelConfig:
     rms_norm_offset: float = 0.0
     hidden_act: str = "silu"  # silu | gelu_tanh
     scale_embeddings: bool = False
+    # Weight-only quantization of the projection matmuls (decode is
+    # HBM-bandwidth-bound: int8 weights halve the bytes streamed per step,
+    # nearly doubling the decode roofline).  None | "int8" (per-out-channel
+    # symmetric scales; embeddings/norms/biases stay in dtype).
+    quantization: Optional[str] = None
 
     def __post_init__(self):
         if self.head_dim is None:
             self.head_dim = self.hidden_size // self.num_heads
         assert self.num_heads % self.num_kv_heads == 0
+        if self.quantization not in (None, "int8"):
+            raise ValueError(
+                f"Unknown quantization {self.quantization!r} (None | int8)"
+            )
         if self.hidden_act not in ("silu", "gelu_tanh"):
             # A typo (or HF's own string, "gelu_pytorch_tanh") silently
             # falling back to silu would serve wrong logits forever.
@@ -212,6 +221,24 @@ class CacheConfig:
     # Remote shared KV store URL, e.g. "kv://host:port"
     # (reference lm://host:port, _helpers.tpl:164-166).
     remote_kv_url: Optional[str] = None
+    # Cross-engine prefix sharing through the remote store, content-keyed
+    # by the same hash chain as the local prefix cache.  "prefill": export
+    # full prompt blocks after each prefill; "decode": import matching
+    # blocks on admission instead of recomputing; "both": symmetric
+    # sharing.  This is the disaggregated-prefill building block (the
+    # reference lists disagg as roadmap-only, README.md:57) and the
+    # TPU-native analogue of LMCache's shared-store prefill reuse.
+    # Requires remote_kv_url.
+    disagg_role: Optional[str] = None
+
+    def __post_init__(self):
+        if self.disagg_role not in (None, "prefill", "decode", "both"):
+            raise ValueError(
+                f"Unknown disagg_role {self.disagg_role!r} "
+                "(None | prefill | decode | both)"
+            )
+        if self.disagg_role is not None and not self.remote_kv_url:
+            raise ValueError("disagg_role requires remote_kv_url")
 
 
 @dataclasses.dataclass

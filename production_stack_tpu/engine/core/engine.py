@@ -14,7 +14,9 @@ the router expects.
 from __future__ import annotations
 
 import logging
+import threading
 import time
+from collections import OrderedDict
 from functools import partial
 from typing import Dict, List, Optional
 
@@ -36,7 +38,10 @@ from production_stack_tpu.engine.core.sequence import (
     SequenceStatus,
     StepOutput,
 )
-from production_stack_tpu.engine.kv.block_pool import BlockPool
+from production_stack_tpu.engine.kv.block_pool import (
+    BlockPool,
+    prefix_block_hashes,
+)
 from production_stack_tpu.engine.kv.offload import HostOffloadManager
 from production_stack_tpu.engine.models import get_model
 from production_stack_tpu.engine.models.weights import load_params
@@ -101,6 +106,9 @@ class LLMEngine:
 
         logger.info("Loading params for %s ...", cfg.name)
         self.params = load_params(cfg, config.weights_path, seed=config.seed)
+        if cfg.quantization is not None:
+            logger.info("Quantizing projections to %s ...", cfg.quantization)
+            self.params = self.model.quantize_params(self.params, cfg)
         self.params = jax.device_put(
             self.params, shardings_lib.param_shardings(cfg, self.mesh)
         )
@@ -111,11 +119,25 @@ class LLMEngine:
             config.cache.block_size,
             enable_prefix_caching=config.cache.enable_prefix_caching,
         )
+        # Cross-engine prefix sharing (cache.disagg_role): content-keyed
+        # block export/import through the remote store.
+        self._disagg_role = config.cache.disagg_role
+        self._exports = self._disagg_role in ("prefill", "both")
+        imports = self._disagg_role in ("decode", "both")
+        # digest -> export expiry: entries re-export after the TTL so a
+        # store-side eviction doesn't silently end sharing forever.
+        self._exported_hashes: "OrderedDict[bytes, float]" = OrderedDict()
+        self._export_ttl_s = 300.0
+        self._export_queue = None
+        self._export_thread = None
+        self.remote_prefix_blocks_fetched = 0
+        self.remote_prefix_blocks_exported = 0
         self.scheduler = Scheduler(
             config.scheduler,
             self.block_pool,
             offload_cb=self.offload_seq_blocks,
             restore_cb=self.restore_seq_blocks,
+            remote_prefix_cb=self.fetch_remote_prefix if imports else None,
         )
         self.kv_caches = self._allocate_kv(num_blocks)
         logger.info(
@@ -393,6 +415,178 @@ class LLMEngine:
         seq.partial_prefill = True
         return "restored"
 
+    # -- cross-engine prefix sharing (cache.disagg_role) -------------------
+
+    def _px_key_prefix(self) -> str:
+        """Content-key namespace binding blocks to THIS model's identity:
+        structural shape AND a weight fingerprint (a sample of the
+        embedding row), so two engines only exchange KV when they run the
+        same weights — a peer serving a different model (or different
+        random init) can never poison this one's cache."""
+        if not hasattr(self, "_px_prefix_cache"):
+            import hashlib
+
+            cfg = self.config.model
+            h = hashlib.blake2b(digest_size=8)
+            h.update(
+                f"{cfg.name}|{cfg.num_layers}|{cfg.num_kv_heads}|"
+                f"{cfg.head_dim}|{cfg.dtype}|{self.block_pool.block_size}"
+                .encode()
+            )
+            h.update(np.asarray(
+                self.params["embed_tokens"][0], np.float32
+            ).tobytes())
+            self._px_prefix_cache = f"px:{h.hexdigest()}:"
+        return self._px_prefix_cache
+
+    def _seq_prefix_hashes(self, seq) -> List[bytes]:
+        """Per-sequence memo: the chain is O(prompt) blake2b work and the
+        scheduler may retry admission many times."""
+        if getattr(seq, "_px_hashes", None) is None:
+            seq._px_hashes = prefix_block_hashes(
+                seq.prompt_token_ids,
+                self.block_pool.block_size,
+                namespace=seq.cache_ns,
+            )
+        return seq._px_hashes
+
+    def fetch_remote_prefix(self, seq, prefix_blocks, cached_len):
+        """Scheduler remote_prefix_cb: extend a local prefix-cache match
+        with blocks fetched from the shared store by content key (the same
+        hash chain the local prefix cache uses).  Returns the possibly
+        extended (prefix_blocks, cached_len); never raises — a store
+        outage (or a malformed entry) degrades to local-only prefill."""
+        client = self.offload.remote_client
+        if client is None:
+            return prefix_blocks, cached_len
+        bs = self.block_pool.block_size
+        hashes = self._seq_prefix_hashes(seq)
+        start = cached_len // bs
+        if start >= len(hashes):
+            return prefix_blocks, cached_len
+        # Don't fetch what admission can't hold: the whole remaining
+        # prompt (fetched + still-to-prefill blocks) must fit, or the
+        # scheduler would free the fetch and re-issue it every step.
+        remaining_blocks = -(
+            -(seq.num_prompt_tokens - cached_len) // bs
+        )
+        if not self.block_pool.can_allocate(remaining_blocks):
+            return prefix_blocks, cached_len
+        key_prefix = self._px_key_prefix()
+        try:
+            fetched: List = []
+            for digest in hashes[start:]:
+                entry = client.get_blocks(key_prefix + digest.hex())
+                if entry is None:
+                    break
+                layers, _ = entry
+                fetched.append(layers)
+            if not fetched or not self.block_pool.can_allocate(len(fetched)):
+                return prefix_blocks, cached_len
+            ids = self.block_pool.allocate(len(fetched))
+            idx = jnp.asarray(ids, jnp.int32)
+            for layer_idx, (k_cache, v_cache) in enumerate(self.kv_caches):
+                k_host = np.stack([f[layer_idx][0][0] for f in fetched])
+                v_host = np.stack([f[layer_idx][1][0] for f in fetched])
+                k_cache = k_cache.at[idx].set(
+                    jnp.asarray(k_host, k_cache.dtype)
+                )
+                v_cache = v_cache.at[idx].set(
+                    jnp.asarray(v_host, v_cache.dtype)
+                )
+                self.kv_caches[layer_idx] = (k_cache, v_cache)
+        except Exception:
+            # Includes shape mismatches from a store polluted by another
+            # binary version: degrade, never kill the step loop.
+            logger.exception("remote prefix fetch failed; continuing local")
+            return prefix_blocks, cached_len
+        self.remote_prefix_blocks_fetched += len(ids)
+        return prefix_blocks + ids, cached_len + len(ids) * bs
+
+    def _export_worker(self) -> None:
+        client = self.offload.remote_client
+        while True:
+            item = self._export_queue.get()
+            try:
+                if item is None:
+                    return
+                key, layers, bs = item
+                client.put_blocks(key, layers, bs)
+                self.remote_prefix_blocks_exported += 1
+            except Exception:
+                logger.exception("remote prefix export failed; continuing")
+            finally:
+                self._export_queue.task_done()
+
+    def flush_prefix_exports(self, timeout: float = 10.0) -> None:
+        """Block until queued exports have been written (tests; graceful
+        shutdown).  No-op when nothing was ever exported."""
+        if self._export_queue is None:
+            return
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if self._export_queue.unfinished_tasks == 0:
+                return
+            time.sleep(0.01)
+
+    def _export_prefix_blocks(self, seq) -> None:
+        """After a final prefill: push every full prompt block to the
+        shared store under its chain-hash content key, so peer engines
+        (and this one, post-restart) can import instead of recomputing.
+
+        The device->host gather happens here (the step thread owns the
+        kv_caches references — they are donated next step); the store RPCs
+        happen on a writer thread so server latency never becomes serving
+        latency.  Dedupe entries expire after a TTL so a store-side
+        eviction doesn't permanently end sharing."""
+        client = self.offload.remote_client
+        if client is None:
+            return
+        bs = self.block_pool.block_size
+        hashes = self._seq_prefix_hashes(seq)
+        now = time.time()
+        todo = [
+            (i, digest)
+            for i, digest in enumerate(hashes)
+            if self._exported_hashes.get(digest, 0.0) < now
+        ]
+        if not todo:
+            return
+        if self._export_thread is None:
+            import queue as _queue
+
+            self._export_queue = _queue.Queue(maxsize=64)
+            self._export_thread = threading.Thread(
+                target=self._export_worker, name="px-export", daemon=True
+            )
+            self._export_thread.start()
+        ids = jnp.asarray(
+            [seq.block_table[i] for i, _ in todo], jnp.int32
+        )
+        try:
+            # One device->host gather per layer for all exported blocks.
+            host_layers = [
+                (np.asarray(k_cache[ids]), np.asarray(v_cache[ids]))
+                for k_cache, v_cache in self.kv_caches
+            ]
+        except Exception:
+            logger.exception("prefix export gather failed; continuing")
+            return
+        key_prefix = self._px_key_prefix()
+        for row, (_, digest) in enumerate(todo):
+            layers = [
+                (k[row : row + 1], v[row : row + 1]) for k, v in host_layers
+            ]
+            try:
+                self._export_queue.put_nowait(
+                    (key_prefix + digest.hex(), layers, bs)
+                )
+            except Exception:
+                return  # writer backlogged: drop the rest of this export
+            self._exported_hashes[digest] = now + self._export_ttl_s
+        while len(self._exported_hashes) > 65536:
+            self._exported_hashes.popitem(last=False)
+
     def _run_prefill(self, plan: PrefillPlan) -> List[StepOutput]:
         seq = plan.seq
         bs = self.block_pool.block_size
@@ -428,6 +622,8 @@ class LLMEngine:
             # Non-final chunk of a long prompt: KV is written, but the
             # logits are mid-prompt — nothing to sample yet.
             return []
+        if self._exports:
+            self._export_prefix_blocks(seq)
         token_ids, logprob_info = self._sample_batch(logits[None, :], [seq])
         return self._append_and_check(
             [seq], token_ids, first_token=True, logprob_info=logprob_info
@@ -780,4 +976,6 @@ class LLMEngine:
             "total_finished": self.total_finished,
             "num_preemptions": self.scheduler.num_preemptions,
             "loaded_loras": len(self.loaded_adapters()),
+            "remote_prefix_blocks_fetched": self.remote_prefix_blocks_fetched,
+            "remote_prefix_blocks_exported": self.remote_prefix_blocks_exported,
         }

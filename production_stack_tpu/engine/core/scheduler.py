@@ -69,6 +69,7 @@ class Scheduler:
         block_pool: BlockPool,
         offload_cb=None,
         restore_cb=None,
+        remote_prefix_cb=None,
     ):
         self.config = config
         self.block_pool = block_pool
@@ -79,6 +80,12 @@ class Scheduler:
         # on success the engine sets seq.block_table/num_cached_tokens/
         # partial_prefill so the plan below resumes as a held prefix.
         self.restore_cb = restore_cb
+        # remote_prefix_cb(seq, prefix_blocks, cached_len) ->
+        # (prefix_blocks, cached_len): extend a local prefix-cache match
+        # with content-keyed blocks fetched from the shared remote store
+        # (cross-engine prefix reuse / disaggregated prefill; engine wires
+        # fetch_remote_prefix when cache.disagg_role imports).
+        self.remote_prefix_cb = remote_prefix_cb
         self.waiting: Deque[Sequence] = deque()
         self.running: List[Sequence] = []
         self.preempted: Deque[Sequence] = deque()
@@ -206,6 +213,10 @@ class Scheduler:
             prefix_blocks, cached_len = self.block_pool.match_prefix(
                 seq.prompt_token_ids, namespace=seq.cache_ns
             )
+            if self.remote_prefix_cb is not None:
+                prefix_blocks, cached_len = self.remote_prefix_cb(
+                    seq, prefix_blocks, cached_len
+                )
         num_new = seq.num_prompt_tokens - cached_len
         bucket = self._bucket_for(num_new)
         is_final = bucket is not None

@@ -25,6 +25,25 @@ def _chain_hash(prev: Optional[bytes], tokens: Sequence[int]) -> bytes:
     return h.digest()
 
 
+def prefix_block_hashes(
+    token_ids: Sequence[int], block_size: int, namespace: int = 0
+) -> List[bytes]:
+    """Chain hash of every full block of ``token_ids`` (leaving >= 1 token
+    uncached, mirroring match_prefix).  These digests are the content keys
+    for cross-engine prefix sharing through the remote KV store — two
+    engines hashing the same tokens under the same namespace produce the
+    same keys."""
+    usable = len(token_ids) - 1
+    prev: Optional[bytes] = (
+        _chain_hash(None, [namespace]) if namespace else None
+    )
+    out: List[bytes] = []
+    for start in range(0, usable - usable % block_size, block_size):
+        prev = _chain_hash(prev, token_ids[start : start + block_size])
+        out.append(prev)
+    return out
+
+
 class BlockPool:
     def __init__(self, num_blocks: int, block_size: int, enable_prefix_caching: bool = True):
         if num_blocks < 2:

@@ -115,6 +115,53 @@ def _embed(params: Params, cfg: ModelConfig, tokens: jax.Array) -> jax.Array:
     return x
 
 
+def _dot(x: jax.Array, w) -> jax.Array:
+    """Projection matmul, fp32 accumulation.  ``w`` is either a plain
+    [in, out] array or an int8 weight-only pair {"q": int8 [in, out],
+    "s": f32 [out]} (quantize_params).  For the quantized form the convert
+    fuses into the MXU operand read — int8 is what streams from HBM — and
+    the per-out-channel scale applies to the small output:
+    x @ (q * s) == (x @ q) * s."""
+    if isinstance(w, dict):
+        y = jnp.dot(x, w["q"].astype(x.dtype), preferred_element_type=jnp.float32)
+        return y * w["s"]
+    return jnp.dot(x, w, preferred_element_type=jnp.float32)
+
+
+_QUANT_TARGETS = (
+    "q_proj", "k_proj", "v_proj", "o_proj",
+    "gate_proj", "up_proj", "down_proj",
+)
+
+
+def quantize_params(params: Params, cfg: ModelConfig) -> Params:
+    """Per-out-channel symmetric int8 quantization of the projection
+    weights (and lm_head).  Embeddings, norms, biases, and MoE expert
+    stacks keep the model dtype — the dense projections are where decode's
+    weight traffic is."""
+    if cfg.quantization is None:
+        return params
+
+    def qw(w):
+        w32 = w.astype(jnp.float32)
+        amax = jnp.max(jnp.abs(w32), axis=0)
+        s = jnp.where(amax > 0, amax / 127.0, 1.0).astype(jnp.float32)
+        q = jnp.clip(jnp.round(w32 / s), -127, 127).astype(jnp.int8)
+        return {"q": q, "s": s}
+
+    out = dict(params)
+    out["layers"] = []
+    for layer in params["layers"]:
+        new = dict(layer)
+        for name in _QUANT_TARGETS:
+            if name in layer:
+                new[name] = qw(layer[name])
+        out["layers"].append(new)
+    if "lm_head" in params:
+        out["lm_head"] = qw(params["lm_head"])
+    return out
+
+
 def _maybe_lora(y, x, lora_layer, proj, adapter_idx, lora_scale):
     """Add the LoRA delta for ``proj`` when adapters are live (lora.py)."""
     if lora_layer is None:
@@ -129,9 +176,9 @@ def _project_qkv(layer: Params, x: jax.Array, cfg: ModelConfig,
                  lora_layer=None, adapter_idx=None, lora_scale=None):
     """x: [T, h] -> q [T, H, D], k/v [T, K, D]."""
     T = x.shape[0]
-    q = jnp.dot(x, layer["q_proj"], preferred_element_type=jnp.float32)
-    k = jnp.dot(x, layer["k_proj"], preferred_element_type=jnp.float32)
-    v = jnp.dot(x, layer["v_proj"], preferred_element_type=jnp.float32)
+    q = _dot(x, layer["q_proj"])
+    k = _dot(x, layer["k_proj"])
+    v = _dot(x, layer["v_proj"])
     q = _maybe_lora(q, x, lora_layer, "q_proj", adapter_idx, lora_scale)
     k = _maybe_lora(k, x, lora_layer, "k_proj", adapter_idx, lora_scale)
     v = _maybe_lora(v, x, lora_layer, "v_proj", adapter_idx, lora_scale)
@@ -146,7 +193,7 @@ def _project_qkv(layer: Params, x: jax.Array, cfg: ModelConfig,
 
 
 def _o_proj(layer: Params, out: jax.Array, lora_layer, adapter_idx, lora_scale):
-    y = jnp.dot(out, layer["o_proj"], preferred_element_type=jnp.float32)
+    y = _dot(out, layer["o_proj"])
     return _maybe_lora(y, out, lora_layer, "o_proj", adapter_idx, lora_scale)
 
 
@@ -201,19 +248,17 @@ def _mlp(layer: Params, x: jax.Array, lora_layer, adapter_idx, lora_scale,
     block for mixtral-style configs (LoRA then applies to attention only)."""
     if cfg.num_experts:
         return _moe_mlp(layer, x, cfg)
-    if lora_layer is None:
+    if lora_layer is None and not isinstance(layer["gate_proj"], dict):
         return swiglu(
             x, layer["gate_proj"], layer["up_proj"], layer["down_proj"],
             act=cfg.hidden_act,
         )
-    gate = jnp.dot(x, layer["gate_proj"], preferred_element_type=jnp.float32)
-    up = jnp.dot(x, layer["up_proj"], preferred_element_type=jnp.float32)
+    gate = _dot(x, layer["gate_proj"])
+    up = _dot(x, layer["up_proj"])
     gate = _maybe_lora(gate, x, lora_layer, "gate_proj", adapter_idx, lora_scale)
     up = _maybe_lora(up, x, lora_layer, "up_proj", adapter_idx, lora_scale)
     activated = (_act(gate, cfg) * up).astype(x.dtype)
-    down = jnp.dot(
-        activated, layer["down_proj"], preferred_element_type=jnp.float32
-    )
+    down = _dot(activated, layer["down_proj"])
     down = _maybe_lora(
         down, activated, lora_layer, "down_proj", adapter_idx, lora_scale
     )
@@ -223,10 +268,10 @@ def _mlp(layer: Params, x: jax.Array, lora_layer, adapter_idx, lora_scale,
 def _lm_head(params: Params, cfg: ModelConfig, hidden: jax.Array) -> jax.Array:
     """hidden [..., h] -> logits [..., V] in fp32."""
     if cfg.tie_word_embeddings:
-        w = params["embed_tokens"].T
-    else:
-        w = params["lm_head"]
-    return jnp.dot(hidden, w, preferred_element_type=jnp.float32)
+        return jnp.dot(
+            hidden, params["embed_tokens"].T, preferred_element_type=jnp.float32
+        )
+    return _dot(hidden, params["lm_head"])
 
 
 def prefill(

@@ -77,14 +77,23 @@ def validate_sp_mode(cfg: ModelConfig, par) -> None:
         )
 
 
+def _maybe_quant(spec: P, cfg) -> object:
+    """Quantized projections are {"q": int8 [in, out], "s": f32 [out]}
+    (models/llama.py quantize_params): the int8 block keeps the weight's
+    spec, the scale follows the OUT (last) axis partitioning."""
+    if cfg.quantization is None:
+        return spec
+    return {"q": spec, "s": P(spec[1] if len(spec) >= 2 else None)}
+
+
 def _layer_specs(cfg) -> Dict[str, P]:
     specs = {
         "input_layernorm": P(),
         "post_attention_layernorm": P(),
-        "q_proj": P(None, TP),
-        "k_proj": P(None, TP),
-        "v_proj": P(None, TP),
-        "o_proj": P(TP, None),
+        "q_proj": _maybe_quant(P(None, TP), cfg),
+        "k_proj": _maybe_quant(P(None, TP), cfg),
+        "v_proj": _maybe_quant(P(None, TP), cfg),
+        "o_proj": _maybe_quant(P(TP, None), cfg),
     }
     if cfg.num_experts:
         # MoE: experts shard over the tp axis (expert parallelism); the
@@ -95,9 +104,9 @@ def _layer_specs(cfg) -> Dict[str, P]:
         specs["experts_up"] = P(TP, None, None)
         specs["experts_down"] = P(TP, None, None)
     else:
-        specs["gate_proj"] = P(None, TP)
-        specs["up_proj"] = P(None, TP)
-        specs["down_proj"] = P(TP, None)
+        specs["gate_proj"] = _maybe_quant(P(None, TP), cfg)
+        specs["up_proj"] = _maybe_quant(P(None, TP), cfg)
+        specs["down_proj"] = _maybe_quant(P(TP, None), cfg)
     if cfg.attention_bias:
         # Biases follow their projection's output (head) dim.
         specs["q_bias"] = P(TP)
@@ -114,7 +123,7 @@ def param_specs(cfg: ModelConfig) -> Dict:
         "layers": [_layer_specs(cfg) for _ in range(cfg.num_layers)],
     }
     if not cfg.tie_word_embeddings:
-        specs["lm_head"] = P(None, TP)
+        specs["lm_head"] = _maybe_quant(P(None, TP), cfg)
     return specs
 
 

@@ -151,6 +151,10 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
             (vocab.TPU_TOTAL_GENERATED_TOKENS, s["total_generated_tokens"]),
             (vocab.TPU_TOTAL_FINISHED_REQUESTS, s["total_finished"]),
             (vocab.TPU_NUM_PREEMPTIONS, s["num_preemptions"]),
+            (vocab.TPU_REMOTE_PREFIX_BLOCKS_FETCHED,
+             s["remote_prefix_blocks_fetched"]),
+            (vocab.TPU_REMOTE_PREFIX_BLOCKS_EXPORTED,
+             s["remote_prefix_blocks_exported"]),
         ]
         return web.Response(text=vocab.render_prometheus(pairs))
 
@@ -840,8 +844,24 @@ def main(argv=None) -> None:
     )
     parser.add_argument("--host-offload-gb", type=float, default=0.0)
     parser.add_argument("--remote-kv-url", default=None)
+    parser.add_argument(
+        "--disagg-role",
+        default=None,
+        choices=["prefill", "decode", "both"],
+        help="cross-engine prefix sharing through the remote KV store: "
+        "'prefill' exports prompt KV blocks after prefill, 'decode' "
+        "imports matching blocks instead of recomputing, 'both' shares "
+        "symmetrically (requires --remote-kv-url)",
+    )
     parser.add_argument("--no-prefix-caching", action="store_true")
     parser.add_argument("--dtype", default=None, help="override preset dtype")
+    parser.add_argument(
+        "--quantization",
+        default=None,
+        choices=["int8"],
+        help="weight-only quantization of the projection matmuls "
+        "(halves decode's HBM weight traffic)",
+    )
     # Mesh axes (TPU-first: the reference chart only passes
     # --tensor-parallel-size through to vLLM, deployment-vllm-multi.yaml:84-87;
     # here dp/tp/sp are first-class — config.ParallelConfig).
@@ -882,8 +902,13 @@ def main(argv=None) -> None:
             "cache.num_blocks": args.num_blocks,
             "cache.host_offload_gb": args.host_offload_gb,
             "cache.remote_kv_url": args.remote_kv_url,
+            "cache.disagg_role": args.disagg_role,
             "cache.enable_prefix_caching": not args.no_prefix_caching,
             **({"model.dtype": args.dtype} if args.dtype else {}),
+            **(
+                {"model.quantization": args.quantization}
+                if args.quantization else {}
+            ),
             "parallel.data_parallel": args.data_parallel,
             "parallel.tensor_parallel": args.tensor_parallel,
             "parallel.sequence_parallel": args.sequence_parallel,

@@ -66,11 +66,17 @@ TPU_TOTAL_PROMPT_TOKENS = "tpu:total_prompt_tokens"
 TPU_TOTAL_GENERATED_TOKENS = "tpu:total_generated_tokens"
 TPU_TOTAL_FINISHED_REQUESTS = "tpu:total_finished_requests"
 TPU_NUM_PREEMPTIONS = "tpu:num_preemptions"
+# Cross-engine prefix sharing (cache.disagg_role): blocks imported from /
+# pushed to the shared store.
+TPU_REMOTE_PREFIX_BLOCKS_FETCHED = "tpu:remote_prefix_blocks_fetched"
+TPU_REMOTE_PREFIX_BLOCKS_EXPORTED = "tpu:remote_prefix_blocks_exported"
 TPU_COUNTERS = frozenset({
     TPU_TOTAL_PROMPT_TOKENS,
     TPU_TOTAL_GENERATED_TOKENS,
     TPU_TOTAL_FINISHED_REQUESTS,
     TPU_NUM_PREEMPTIONS,
+    TPU_REMOTE_PREFIX_BLOCKS_FETCHED,
+    TPU_REMOTE_PREFIX_BLOCKS_EXPORTED,
 })
 
 

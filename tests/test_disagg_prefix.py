@@ -1,0 +1,182 @@
+"""Cross-engine prefix sharing / disaggregated prefill
+(CacheConfig.disagg_role).
+
+A "prefill"-role engine exports full prompt blocks to the shared store
+under content keys (the prefix-cache hash chain); a "decode"-role engine
+with a cold local cache imports them on admission instead of recomputing.
+The reference lists disaggregated prefill as roadmap-only (README.md:57,
+docs/source/tutorials/disagg.rst); this is the working TPU-native
+mechanism, built on the kvserver tier.
+"""
+
+import asyncio
+import threading
+
+import pytest
+
+from production_stack_tpu.engine.config import (
+    CacheConfig,
+    EngineConfig,
+    ModelConfig,
+    SchedulerConfig,
+)
+from production_stack_tpu.engine.core.engine import LLMEngine
+from production_stack_tpu.engine.core.sequence import SamplingParams
+from production_stack_tpu.kvserver.server import KVStore, handle_client
+
+
+@pytest.fixture()
+def kv_port():
+    store = KVStore(capacity_bytes=64 << 20)
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+    state = {}
+
+    def run():
+        asyncio.set_event_loop(loop)
+
+        async def boot():
+            server = await asyncio.start_server(
+                lambda r, w: handle_client(store, r, w), "127.0.0.1", 0
+            )
+            state["port"] = server.sockets[0].getsockname()[1]
+            started.set()
+
+        loop.run_until_complete(boot())
+        loop.run_forever()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert started.wait(5)
+    yield state["port"]
+    loop.call_soon_threadsafe(loop.stop)
+    t.join(timeout=5)
+
+
+def make_engine(role, port):
+    return LLMEngine(EngineConfig(
+        model=ModelConfig(dtype="float32"),
+        cache=CacheConfig(
+            block_size=4,
+            num_blocks=64,
+            remote_kv_url=f"kv://127.0.0.1:{port}",
+            disagg_role=role,
+        ),
+        scheduler=SchedulerConfig(
+            max_num_seqs=2, prefill_buckets=(16, 32, 64), max_model_len=128
+        ),
+    ))
+
+
+PROMPT = "the quick brown fox jumps over the lazy dog again and again"
+
+
+def drain(engine, rid, max_tokens=6, close=True):
+    engine.add_request(rid, prompt=PROMPT,
+                       sampling_params=SamplingParams(max_tokens=max_tokens))
+    tokens = []
+    steps = 0
+    while engine.has_unfinished():
+        steps += 1
+        assert steps < 200
+        for out in engine.step():
+            tokens.append(out.new_token_id)
+    if close and engine.offload.remote_client is not None:
+        # Leaving the blocking socket open past the server loop's stop
+        # raises "Event loop is closed" in the server's reader task.
+        engine.offload.remote_client.close()
+    return tokens
+
+
+def test_prefill_role_exports_decode_role_imports(kv_port):
+    producer = make_engine("prefill", kv_port)
+    out_a = drain(producer, "a", close=False)
+    producer.flush_prefix_exports()
+    producer.offload.remote_client.close()
+    assert producer.remote_prefix_blocks_exported > 0
+    assert producer.remote_prefix_blocks_fetched == 0  # prefill never imports
+
+    consumer = make_engine("decode", kv_port)
+    out_b = drain(consumer, "b")
+    # The consumer imported blocks it never computed...
+    assert consumer.remote_prefix_blocks_fetched > 0
+    assert consumer.remote_prefix_blocks_exported == 0
+    # ...and still produces bit-identical greedy output.
+    assert out_b == out_a
+
+    # Baseline engine with no sharing agrees too (the imported KV is real).
+    baseline = make_engine(None, kv_port)
+    assert drain(baseline, "c") == out_a
+
+
+def test_both_role_dedupes_reexport(kv_port):
+    engine = make_engine("both", kv_port)
+    drain(engine, "r1", close=False)
+    engine.flush_prefix_exports()
+    first = engine.remote_prefix_blocks_exported
+    assert first > 0
+    # Same prompt again within the dedupe TTL: every block digest is in
+    # the export LRU (and the local prefix cache serves the match), so
+    # nothing re-uploads.
+    drain(engine, "r2", close=False)
+    engine.flush_prefix_exports()
+    assert engine.remote_prefix_blocks_exported == first
+    engine.offload.remote_client.close()
+
+
+def test_cross_model_blocks_never_imported(kv_port):
+    """Content keys carry a model fingerprint (shape + weight sample):
+    a peer serving a different model must never poison this engine."""
+    producer = make_engine("prefill", kv_port)
+    drain(producer, "a", close=False)
+    producer.flush_prefix_exports()
+    producer.offload.remote_client.close()
+
+    other = LLMEngine(EngineConfig(
+        model=ModelConfig(name="llama-debug-1l", num_layers=1, dtype="float32"),
+        cache=CacheConfig(
+            block_size=4, num_blocks=64,
+            remote_kv_url=f"kv://127.0.0.1:{kv_port}",
+            disagg_role="decode",
+        ),
+        scheduler=SchedulerConfig(
+            max_num_seqs=2, prefill_buckets=(16, 32, 64), max_model_len=128
+        ),
+    ))
+    out = drain(other, "b")
+    assert len(out) == 6
+    assert other.remote_prefix_blocks_fetched == 0
+
+    # Same architecture but different weights (different seed): the
+    # embedding fingerprint differs, so nothing is imported either.
+    reseeded = LLMEngine(EngineConfig(
+        model=ModelConfig(dtype="float32"),
+        cache=CacheConfig(
+            block_size=4, num_blocks=64,
+            remote_kv_url=f"kv://127.0.0.1:{kv_port}",
+            disagg_role="decode",
+        ),
+        scheduler=SchedulerConfig(
+            max_num_seqs=2, prefill_buckets=(16, 32, 64), max_model_len=128
+        ),
+        seed=12345,
+    ))
+    drain(reseeded, "c")
+    assert reseeded.remote_prefix_blocks_fetched == 0
+
+
+def test_store_outage_degrades_gracefully(kv_port):
+    engine = make_engine("decode", kv_port)
+    # Point the client at a dead port: fetch must fail soft, not raise.
+    engine.offload.remote_client.port = 1
+    engine.offload.remote_client._reset()
+    out = drain(engine, "x")
+    assert len(out) == 6
+    assert engine.remote_prefix_blocks_fetched == 0
+
+
+def test_disagg_role_requires_remote_url():
+    with pytest.raises(ValueError, match="remote_kv_url"):
+        CacheConfig(disagg_role="prefill")
+    with pytest.raises(ValueError, match="disagg_role"):
+        CacheConfig(disagg_role="weird", remote_kv_url="kv://x:1")

@@ -1,0 +1,121 @@
+"""Int8 weight-only quantization (ModelConfig.quantization).
+
+Per-out-channel symmetric scales on the projection matmuls; decode is
+HBM-bound so int8 halves the weight bytes streamed per step.  Quality gate:
+quantized logits must track bf16/f32 logits closely, and the engine must
+serve end-to-end (including under a tp mesh, where the scale vectors shard
+with their projection's out axis).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from production_stack_tpu.engine.config import (
+    CacheConfig,
+    EngineConfig,
+    ModelConfig,
+    ParallelConfig,
+    SchedulerConfig,
+)
+from production_stack_tpu.engine.core.engine import LLMEngine
+from production_stack_tpu.engine.core.sequence import SamplingParams
+from production_stack_tpu.engine.models import llama
+
+
+def test_quantize_params_structure_and_reconstruction():
+    cfg = ModelConfig(dtype="float32")
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    qparams = llama.quantize_params(params, ModelConfig(
+        dtype="float32", quantization="int8"))
+    layer, qlayer = params["layers"][0], qparams["layers"][0]
+    assert set(qlayer["q_proj"]) == {"q", "s"}
+    assert qlayer["q_proj"]["q"].dtype == jnp.int8
+    assert qlayer["q_proj"]["s"].shape == (layer["q_proj"].shape[1],)
+    # Norms/embeddings untouched.
+    assert qlayer["input_layernorm"].dtype == jnp.float32
+    assert qparams["embed_tokens"].dtype == jnp.float32
+    # Dequantized reconstruction within one quantization step per channel.
+    recon = qlayer["q_proj"]["q"].astype(jnp.float32) * qlayer["q_proj"]["s"]
+    err = jnp.max(jnp.abs(recon - layer["q_proj"]))
+    assert float(err) <= float(jnp.max(qlayer["q_proj"]["s"])) + 1e-7
+
+
+def test_quantized_logits_track_full_precision():
+    cfg = ModelConfig(dtype="float32")
+    params = llama.init_params(cfg, jax.random.PRNGKey(1))
+    qcfg = ModelConfig(dtype="float32", quantization="int8")
+    qparams = llama.quantize_params(params, qcfg)
+
+    T = 16
+    tokens = jnp.asarray(np.random.RandomState(0).randint(4, 200, T), jnp.int32)
+    kv = [
+        (jnp.zeros((8, 4, cfg.num_kv_heads, cfg.head_dim), jnp.float32),) * 2
+        for _ in range(cfg.num_layers)
+    ]
+    kwargs = dict(
+        tokens=tokens,
+        cached_len=jnp.int32(0),
+        prefix_block_ids=jnp.zeros((4,), jnp.int32),
+        new_block_ids=jnp.asarray([1, 2, 3, 4], jnp.int32),
+        valid_len=jnp.int32(T),
+    )
+    ref, _ = llama.prefill(params, cfg, kv_caches=[tuple(c) for c in kv], **kwargs)
+    got, _ = llama.prefill(qparams, qcfg, kv_caches=[tuple(c) for c in kv], **kwargs)
+    ref, got = np.asarray(ref), np.asarray(got)
+    # Cosine similarity of the next-token logit rows stays high.
+    cos = np.sum(ref * got) / (np.linalg.norm(ref) * np.linalg.norm(got))
+    assert cos > 0.999
+    # Greedy argmax agrees on the final (sampled) position.
+    assert int(ref[-1].argmax()) == int(got[-1].argmax())
+
+
+def _engine(quantization=None, parallel=None):
+    return LLMEngine(EngineConfig(
+        model=ModelConfig(dtype="float32", quantization=quantization),
+        cache=CacheConfig(block_size=4, num_blocks=64),
+        scheduler=SchedulerConfig(
+            max_num_seqs=2, prefill_buckets=(16, 32, 64), max_model_len=128
+        ),
+        parallel=parallel or ParallelConfig(),
+    ))
+
+
+def _drain(engine, prompt="quantization smoke test", max_tokens=8):
+    engine.add_request("q1", prompt=prompt,
+                       sampling_params=SamplingParams(max_tokens=max_tokens))
+    tokens = []
+    steps = 0
+    while engine.has_unfinished():
+        steps += 1
+        assert steps < 200
+        for out in engine.step():
+            tokens.append(out.new_token_id)
+    return tokens
+
+
+def test_engine_serves_quantized_end_to_end():
+    tokens = _drain(_engine(quantization="int8"))
+    assert len(tokens) == 8
+
+
+def test_quantized_under_tensor_parallel_mesh():
+    if jax.device_count() < 2:
+        pytest.skip("needs multi-device mesh")
+    tokens_tp = _drain(_engine(
+        quantization="int8",
+        parallel=ParallelConfig(tensor_parallel=2),
+    ))
+    assert len(tokens_tp) == 8
+
+
+def test_embed_works_quantized():
+    engine = _engine(quantization="int8")
+    vec = engine.embed(engine.tokenizer.encode("quantized embedding"))
+    np.testing.assert_allclose(np.linalg.norm(vec), 1.0, rtol=1e-5)
+
+
+def test_unknown_quantization_rejected():
+    with pytest.raises(ValueError, match="quantization"):
+        ModelConfig(quantization="fp4")
