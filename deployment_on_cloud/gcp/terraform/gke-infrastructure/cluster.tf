@@ -1,0 +1,56 @@
+# GKE cluster + node pools for the TPU production stack.
+#
+# Two pools: a CPU pool for the control plane and a TPU podslice pool for
+# engines.  GKE's built-in TPU support exposes google.com/tpu resources
+# and stamps the nodes with cloud.google.com/gke-tpu-accelerator /
+# gke-tpu-topology labels — exactly what the chart's engine deployment
+# selects on (helm/templates/deployment-engine.yaml).  No driver
+# daemonset (the reference needs the NVIDIA GPU operator; TPUs don't).
+
+resource "google_container_cluster" "stack" {
+  name     = var.cluster_name
+  project  = var.project_id
+  location = var.zone
+
+  # Pools are managed below; drop the default one.
+  remove_default_node_pool = true
+  initial_node_count       = 1
+
+  release_channel {
+    channel = "REGULAR"
+  }
+
+  ip_allocation_policy {} # VPC-native (alias IPs), required for TPU pools
+}
+
+resource "google_container_node_pool" "cpu" {
+  name       = "control-plane"
+  project    = var.project_id
+  location   = var.zone
+  cluster    = google_container_cluster.stack.name
+  node_count = var.cpu_node_count
+
+  node_config {
+    machine_type = var.cpu_machine_type
+    oauth_scopes = ["https://www.googleapis.com/auth/cloud-platform"]
+  }
+}
+
+resource "google_container_node_pool" "tpu" {
+  name       = "tpu-slices"
+  project    = var.project_id
+  location   = var.zone
+  cluster    = google_container_cluster.stack.name
+  node_count = var.tpu_node_count
+
+  node_config {
+    machine_type = var.tpu_machine_type
+    spot         = var.tpu_spot
+    oauth_scopes = ["https://www.googleapis.com/auth/cloud-platform"]
+  }
+
+  placement_policy {
+    type         = "COMPACT"
+    tpu_topology = var.tpu_topology
+  }
+}

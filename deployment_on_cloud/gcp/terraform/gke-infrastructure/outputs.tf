@@ -1,0 +1,18 @@
+output "cluster_name" {
+  value = google_container_cluster.stack.name
+}
+
+output "cluster_endpoint" {
+  value     = google_container_cluster.stack.endpoint
+  sensitive = true
+}
+
+output "get_credentials" {
+  description = "Run this to point kubectl at the cluster"
+  value       = "gcloud container clusters get-credentials ${google_container_cluster.stack.name} --zone ${var.zone} --project ${var.project_id}"
+}
+
+output "tpu_topology" {
+  description = "Use as modelSpec.tpuTopology in the chart values"
+  value       = var.tpu_topology
+}

@@ -1,0 +1,65 @@
+# GKE TPU infrastructure variables.
+#
+# TPU-native analogue of the reference terraform
+# (tutorials/terraform/gke/gke-infrastructure/variables.tf): the
+# accelerator pool is a GKE TPU podslice node pool instead of GPU nodes
+# with the NVIDIA driver daemonset.
+
+variable "project_id" {
+  description = "GCP project to deploy into"
+  type        = string
+}
+
+variable "region" {
+  description = "Region for the cluster control plane"
+  type        = string
+  default     = "us-central2"
+}
+
+variable "zone" {
+  description = "Zone with TPU capacity (v5e: us-central2-b et al.)"
+  type        = string
+  default     = "us-central2-b"
+}
+
+variable "cluster_name" {
+  description = "GKE cluster name"
+  type        = string
+  default     = "production-stack-tpu"
+}
+
+variable "cpu_machine_type" {
+  description = "Machine type for the control-plane pool (router, operator, cache server, observability)"
+  type        = string
+  default     = "n2-standard-8"
+}
+
+variable "cpu_node_count" {
+  description = "Nodes in the control-plane pool"
+  type        = number
+  default     = 2
+}
+
+variable "tpu_machine_type" {
+  description = "TPU machine type; ct5lp-hightpu-8t is one v5e-8 host"
+  type        = string
+  default     = "ct5lp-hightpu-8t"
+}
+
+variable "tpu_topology" {
+  description = "TPU slice topology (matches modelSpec.tpuTopology in the chart)"
+  type        = string
+  default     = "2x4"
+}
+
+variable "tpu_node_count" {
+  description = "TPU slice nodes (engine replicas schedule one per slice)"
+  type        = number
+  default     = 1
+}
+
+variable "tpu_spot" {
+  description = "Use spot TPU capacity"
+  type        = bool
+  default     = false
+}

@@ -1,0 +1,27 @@
+data "google_client_config" "default" {}
+
+data "google_container_cluster" "stack" {
+  name     = var.cluster_name
+  project  = var.project_id
+  location = var.zone
+}
+
+provider "helm" {
+  kubernetes {
+    host  = "https://${data.google_container_cluster.stack.endpoint}"
+    token = data.google_client_config.default.access_token
+    cluster_ca_certificate = base64decode(
+      data.google_container_cluster.stack.master_auth[0].cluster_ca_certificate
+    )
+  }
+}
+
+resource "helm_release" "production_stack" {
+  name   = var.release_name
+  chart  = var.chart_path
+  values = [file(var.values_file)]
+
+  # Engine pods wait on TPU node-pool scale-up + weight downloads.
+  timeout = 1800
+  wait    = true
+}

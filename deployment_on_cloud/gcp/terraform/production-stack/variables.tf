@@ -1,0 +1,32 @@
+# Helm release of the stack onto an existing GKE TPU cluster
+# (reference: tutorials/terraform/gke/production-stack/variables.tf).
+
+variable "project_id" {
+  type = string
+}
+
+variable "zone" {
+  type    = string
+  default = "us-central2-b"
+}
+
+variable "cluster_name" {
+  type    = string
+  default = "production-stack-tpu"
+}
+
+variable "release_name" {
+  type    = string
+  default = "tpu-stack"
+}
+
+variable "chart_path" {
+  description = "Path to the in-repo chart"
+  type        = string
+  default     = "../../../../helm"
+}
+
+variable "values_file" {
+  description = "Values file for the release (e.g. helm/values-tpu-example.yaml)"
+  type        = string
+}

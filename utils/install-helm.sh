@@ -1,0 +1,11 @@
+#!/bin/bash
+# Install helm if absent (reference utils/install-helm.sh).
+set -euo pipefail
+
+if command -v helm >/dev/null 2>&1; then
+  echo "helm already installed: $(helm version --short)"
+  exit 0
+fi
+
+curl -fsSL https://raw.githubusercontent.com/helm/helm/main/scripts/get-helm-3 | bash
+echo "Installed $(helm version --short)"

@@ -1,0 +1,21 @@
+#!/bin/bash
+# Install kubectl if absent (reference utils/install-kubectl.sh).
+set -euo pipefail
+
+if command -v kubectl >/dev/null 2>&1; then
+  echo "kubectl already installed: $(kubectl version --client --output=yaml | head -3)"
+  exit 0
+fi
+
+ARCH=$(uname -m)
+case "$ARCH" in
+  x86_64) ARCH=amd64 ;;
+  aarch64 | arm64) ARCH=arm64 ;;
+  *) echo "Unsupported arch: $ARCH" >&2; exit 1 ;;
+esac
+
+VERSION=$(curl -fsSL https://dl.k8s.io/release/stable.txt)
+curl -fsSLo /tmp/kubectl "https://dl.k8s.io/release/${VERSION}/bin/linux/${ARCH}/kubectl"
+chmod +x /tmp/kubectl
+sudo install -o root -g root -m 0755 /tmp/kubectl /usr/local/bin/kubectl
+echo "Installed kubectl ${VERSION}"

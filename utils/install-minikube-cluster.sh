@@ -1,0 +1,44 @@
+#!/bin/bash
+# Single-node minikube cluster ready for the CPU (clusterless-CI) profile
+# of the stack.
+#
+# TPU-native divergence from the reference (utils/install-minikube-cluster.sh:44-84):
+# the reference must install the NVIDIA container toolkit + GPU operator
+# so minikube can see GPUs.  There is no TPU in a laptop/CI VM at all, so
+# the TPU analogue of "minikube profile" is the chart's CPU values
+# (helm/values-ci.yaml): tiny-preset engines on JAX-CPU behind the real
+# router — every stack component real except the accelerator.  Real TPU
+# scheduling is exercised on GKE (deployment_on_cloud/gcp).
+#
+# Usage: ./install-minikube-cluster.sh [--install-stack]
+set -euo pipefail
+
+SCRIPT_DIR="$(cd "$(dirname "${BASH_SOURCE[0]}")" && pwd)"
+REPO_ROOT="$(dirname "$SCRIPT_DIR")"
+
+bash "$SCRIPT_DIR/install-kubectl.sh"
+bash "$SCRIPT_DIR/install-helm.sh"
+
+if ! command -v minikube >/dev/null 2>&1; then
+  ARCH=$(uname -m)
+  case "$ARCH" in
+    x86_64) ARCH=amd64 ;;
+    aarch64 | arm64) ARCH=arm64 ;;
+    *) echo "Unsupported arch: $ARCH" >&2; exit 1 ;;
+  esac
+  curl -fsSLo /tmp/minikube "https://storage.googleapis.com/minikube/releases/latest/minikube-linux-${ARCH}"
+  sudo install /tmp/minikube /usr/local/bin/minikube
+fi
+
+if ! minikube status >/dev/null 2>&1; then
+  minikube start --cpus 4 --memory 8g
+fi
+
+if [ "${1:-}" = "--install-stack" ]; then
+  echo "== Installing the stack with the CPU CI values"
+  helm install tpu-stack "$REPO_ROOT/helm" -f "$REPO_ROOT/helm/values-ci.yaml"
+  kubectl rollout status deployment -l app.production-stack-tpu/release=tpu-stack --timeout=600s || true
+  echo "== Port-forward the router and send a request:"
+  echo "   kubectl port-forward svc/tpu-stack-router-service 8001:80 &"
+  echo "   curl localhost:8001/v1/models"
+fi
