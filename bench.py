@@ -301,6 +301,12 @@ def main() -> None:
     ap.add_argument("--ctx", type=int, default=2048)
     ap.add_argument("--quick", action="store_true", help="skip secondary benches")
     ap.add_argument(
+        "--budget-s", type=float, default=480.0,
+        help="soft wall-clock budget: optional A/B stages are skipped "
+        "when fewer than 120s remain, so the final JSON line always "
+        "prints inside the driver's window",
+    )
+    ap.add_argument(
         "--serving-scheduler-steps", type=int, default=8,
         help="num_scheduler_steps for the serving bench engine (8 amortizes "
         "dispatch RTT when the TPU sits behind a network tunnel; set 1 for "
@@ -411,31 +417,6 @@ def main() -> None:
         detail["decode_roofline_tokens_per_s"] = round(S / roofline_step)
 
     if not args.quick:
-        # Int8 weight-only A/B (model.quantization="int8"): decode is
-        # HBM-bound, so halving the projection bytes should approach a 2x
-        # step-time cut; report the measured ratio next to its own
-        # roofline so the claim is falsifiable.
-        try:
-            from production_stack_tpu.engine.models import llama as _llama
-            import dataclasses as _dc
-
-            qcfg = _dc.replace(cfg, quantization="int8")
-            qparams = _llama.quantize_params(params, qcfg)
-            t_decode_q = bench_decode(
-                jax, jnp, qcfg, qparams, kv, S, ctx, bmax, bs
-            )
-            detail["decode_step_ms_int8"] = round(t_decode_q * 1e3, 3)
-            detail["decode_tokens_per_s_int8"] = round(S / t_decode_q, 1)
-            detail["int8_decode_speedup"] = round(t_decode / t_decode_q, 2)
-            del qparams
-            log(f"decode int8: {t_decode_q*1e3:.2f} ms/step "
-                f"({S/t_decode_q:.0f} tok/s, "
-                f"{detail['int8_decode_speedup']}x vs bf16)")
-        except Exception as e:
-            log(f"int8 decode bench failed: {e}")
-            detail["int8_decode_error"] = str(e)[:200]
-
-    if not args.quick:
         # North-star serving metrics (BASELINE.md): multi-round QA through
         # the REAL stack — engine -> OpenAI server -> session router — on
         # localhost.  Small scale (the chip is shared with the kernel
@@ -470,7 +451,44 @@ def main() -> None:
             log(f"serving bench failed: {e}")
             detail["serving"] = {"error": str(e)[:200]}
 
-    if not args.quick and on_tpu:
+    # Optional A/B stages, in value order, each gated on the remaining
+    # time budget: the driver runs this under a finite window and the
+    # JSON line with the core + serving numbers must always print.
+    def budget_left(stage: str) -> bool:
+        remaining = args.budget_s - (time.time() - _T0)
+        if remaining < 120.0:
+            log(f"skipping {stage}: {remaining:.0f}s left of "
+                f"--budget-s {args.budget_s}")
+            detail[f"{stage}_skipped_budget"] = True
+            return False
+        return True
+
+    if not args.quick and budget_left("int8_ab"):
+        # Int8 weight-only A/B (model.quantization="int8"): decode is
+        # HBM-bound, so halving the projection bytes should approach a 2x
+        # step-time cut; report the measured ratio next to its own
+        # roofline so the claim is falsifiable.
+        try:
+            from production_stack_tpu.engine.models import llama as _llama
+            import dataclasses as _dc
+
+            qcfg = _dc.replace(cfg, quantization="int8")
+            qparams = _llama.quantize_params(params, qcfg)
+            t_decode_q = bench_decode(
+                jax, jnp, qcfg, qparams, kv, S, ctx, bmax, bs
+            )
+            detail["decode_step_ms_int8"] = round(t_decode_q * 1e3, 3)
+            detail["decode_tokens_per_s_int8"] = round(S / t_decode_q, 1)
+            detail["int8_decode_speedup"] = round(t_decode / t_decode_q, 2)
+            del qparams
+            log(f"decode int8: {t_decode_q*1e3:.2f} ms/step "
+                f"({S/t_decode_q:.0f} tok/s, "
+                f"{detail['int8_decode_speedup']}x vs bf16)")
+        except Exception as e:
+            log(f"int8 decode bench failed: {e}")
+            detail["int8_decode_error"] = str(e)[:200]
+
+    if not args.quick and on_tpu and budget_left("gather_ab"):
         # A/B the full decode step with the gather attention path (the KV
         # cache is loop-carried, so XLA cannot hoist the gather): this is
         # the honest Pallas-kernel delta at engine level.

@@ -188,7 +188,7 @@ class LLMEngine:
 
             def multi_decode(
                 params, tokens, positions, block_tables, ctx_lens,
-                max_steps, kv_caches, temps, top_ps, top_ks,
+                max_steps, kv_caches, temps, top_ps, top_ks, min_ps,
                 step_key, seq_seeds, lora=None, adapter_idx=None,
             ):
                 def body(carry, t):
@@ -215,6 +215,7 @@ class LLMEngine:
                     sampled = sample_tokens(
                         logits, temps, top_ps, top_ks,
                         jax.random.fold_in(step_key, t), seq_seeds,
+                        min_p=min_ps,
                     )
                     step = active.astype(jnp.int32)
                     return (
@@ -668,12 +669,15 @@ class LLMEngine:
             s.sampling_params.presence_penalty
             or s.sampling_params.frequency_penalty
             or s.sampling_params.logprobs
+            or s.sampling_params.logit_bias
             for s in seqs
         )
         if use_multi:
             max_steps = np.zeros((S,), np.int32)
             max_steps[: len(seqs)] = plan.steps
-            temps, top_ps, top_ks, seeds = self._sampling_arrays(seqs, S)
+            temps, top_ps, top_ks, min_ps, seeds = self._sampling_arrays(
+                seqs, S
+            )
             sampled, self.kv_caches = self._decode_multi_fn(
                 self.params,
                 tokens=self._put(tokens, batch_spec),
@@ -685,6 +689,7 @@ class LLMEngine:
                 temps=self._put(temps, batch_spec),
                 top_ps=self._put(top_ps, batch_spec),
                 top_ks=self._put(top_ks, batch_spec),
+                min_ps=self._put(min_ps, batch_spec),
                 step_key=jax.random.PRNGKey(
                     self.config.seed + self._step_counter
                 ),
@@ -741,6 +746,10 @@ class LLMEngine:
         top_ks = np.array(
             [s.sampling_params.top_k for s in seqs] + [0] * pad, np.int32
         )
+        min_ps = np.array(
+            [s.sampling_params.min_p for s in seqs] + [0.0] * pad,
+            np.float32,
+        )
         seeds = np.array(
             [
                 (s.sampling_params.seed if s.sampling_params.seed is not None else idx)
@@ -749,7 +758,7 @@ class LLMEngine:
             + [0] * pad,
             np.int32,
         )
-        return temps, top_ps, top_ks, seeds
+        return temps, top_ps, top_ks, min_ps, seeds
 
     def _sample_batch(self, logits: jax.Array, seqs: List[Sequence]):
         """Returns (token_ids, logprob_info) where logprob_info is a list of
@@ -790,7 +799,30 @@ class LLMEngine:
                 jnp.asarray(frequency),
             )
 
-        temps, top_ps, top_ks, seeds = self._sampling_arrays(seqs, S)
+        # OpenAI logit_bias: sparse per-request token biases, applied to
+        # the raw logits (so greedy argmax shifts too).  The dense [S, V]
+        # device array is cached across steps keyed on the batch's bias
+        # composition — a biased request decodes many tokens against the
+        # same bias, and rebuilding/transferring it per token would
+        # dominate the step.
+        if any(s.sampling_params.logit_bias for s in seqs):
+            V = logits.shape[-1]
+            key = (S, V) + tuple(
+                (i, tuple(sorted((s.sampling_params.logit_bias or {}).items())))
+                for i, s in enumerate(seqs)
+            )
+            cached = getattr(self, "_bias_cache", None)
+            if cached is None or cached[0] != key:
+                bias = np.zeros((S, V), np.float32)
+                for i, s in enumerate(seqs):
+                    for tid, b in (s.sampling_params.logit_bias or {}).items():
+                        t = int(tid)
+                        if 0 <= t < V:
+                            bias[i, t] = float(b)
+                self._bias_cache = (key, jnp.asarray(bias))
+            logits = logits + self._bias_cache[1]
+
+        temps, top_ps, top_ks, min_ps, seeds = self._sampling_arrays(seqs, S)
         step_key = jax.random.PRNGKey(self.config.seed + self._step_counter)
         out = self._sample_fn(
             logits,
@@ -799,6 +831,7 @@ class LLMEngine:
             jnp.asarray(top_ks),
             step_key,
             jnp.asarray(seeds),
+            min_p=jnp.asarray(min_ps),
         )
         token_ids = [int(t) for t in np.asarray(out[: len(seqs)])]
 
@@ -838,11 +871,22 @@ class LLMEngine:
         if logprob_info is None:
             logprob_info = [None] * len(seqs)
         for seq, token_id, lp in zip(seqs, token_ids, logprob_info):
-            seq.output_token_ids.append(token_id)
-            self.total_generated_tokens += 1
+            sp = seq.sampling_params
+            # vLLM stop_token_ids semantics: the token ends generation
+            # like EOS but is never appended/streamed (the server treats
+            # the -1 sentinel as text-free).
+            stop_hit = bool(sp.stop_token_ids and token_id in sp.stop_token_ids)
+            if not stop_hit:
+                seq.output_token_ids.append(token_id)
+                self.total_generated_tokens += 1
             if seq.first_token_time is None:
                 seq.first_token_time = now
-            finish = self._check_finish(seq, token_id)
+            if stop_hit:
+                finish = FinishReason.STOP
+                token_id = -1
+                lp = None
+            else:
+                finish = self._check_finish(seq, token_id)
             if finish is not None:
                 seq.finish_reason = finish
                 self.scheduler.finish_seq(seq)

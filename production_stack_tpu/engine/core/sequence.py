@@ -27,13 +27,19 @@ class SamplingParams:
     temperature: float = 0.0  # 0 -> greedy
     top_p: float = 1.0
     top_k: int = 0  # 0 -> disabled
+    min_p: float = 0.0  # 0 -> disabled (vLLM min_p: mass cut vs the max prob)
     stop: Optional[List[str]] = None
+    # Token ids that end generation like EOS, but are NOT appended to the
+    # output (vLLM stop_token_ids semantics).
+    stop_token_ids: Optional[List[int]] = None
     ignore_eos: bool = False
     seed: Optional[int] = None
     logprobs: bool = False
     top_logprobs: int = 0  # alternatives returned per token when logprobs
     presence_penalty: float = 0.0
     frequency_penalty: float = 0.0
+    # OpenAI logit_bias: token id -> additive bias in [-100, 100].
+    logit_bias: Optional[dict] = None
 
 
 @dataclasses.dataclass

@@ -7,6 +7,8 @@ temperature/top-k/top-p/seed); the greedy-vs-sampled choice is a
 
 from __future__ import annotations
 
+from typing import Optional
+
 import jax
 import jax.numpy as jnp
 
@@ -39,6 +41,15 @@ def _apply_top_p(logits: jax.Array, top_p: jax.Array) -> jax.Array:
     return jnp.where((top_p < 1.0)[:, None], masked, logits)
 
 
+def _apply_min_p(logits: jax.Array, min_p: jax.Array) -> jax.Array:
+    """vLLM min_p: drop tokens whose probability is below
+    ``min_p * max_prob``.  min_p<=0 disables."""
+    probs = jax.nn.softmax(logits, axis=-1)
+    cut = jnp.max(probs, axis=-1, keepdims=True) * min_p[:, None]
+    masked = jnp.where(probs < cut, NEG_INF, logits)
+    return jnp.where((min_p > 0)[:, None], masked, logits)
+
+
 def sample_tokens(
     logits: jax.Array,  # [S, V] fp32
     temperature: jax.Array,  # [S]
@@ -46,6 +57,7 @@ def sample_tokens(
     top_k: jax.Array,  # [S] int32
     step_key: jax.Array,  # PRNG key
     seq_seeds: jax.Array,  # [S] int32 per-sequence seed fold
+    min_p: Optional[jax.Array] = None,  # [S]; None -> disabled
 ) -> jax.Array:
     """Returns sampled token ids [S] (int32)."""
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -54,6 +66,8 @@ def sample_tokens(
     scaled = logits / safe_temp[:, None]
     scaled = _apply_top_k(scaled, top_k)
     scaled = _apply_top_p(scaled, top_p)
+    if min_p is not None:
+        scaled = _apply_min_p(scaled, min_p)
 
     keys = jax.vmap(lambda s: jax.random.fold_in(step_key, s))(seq_seeds)
     sampled = jax.vmap(

@@ -45,6 +45,27 @@ def _sampling_from_body(body: dict, chat: bool) -> SamplingParams:
         raw = body.get("logprobs")
         want_logprobs = raw is not None and raw is not False
         top_logprobs = int(raw or 0) if not isinstance(raw, bool) else 0
+    logit_bias = body.get("logit_bias") or None
+    if logit_bias is not None:
+        if not isinstance(logit_bias, dict):
+            raise ValueError("'logit_bias' must be a map of token id -> bias")
+        try:
+            logit_bias = {int(k): float(v) for k, v in logit_bias.items()}
+        except (TypeError, ValueError):
+            raise ValueError(
+                "'logit_bias' keys must be token ids and values numbers"
+            ) from None
+    stop_token_ids = body.get("stop_token_ids") or None
+    if stop_token_ids is not None:
+        if not isinstance(stop_token_ids, list):
+            raise ValueError("'stop_token_ids' must be a list of token ids")
+        try:
+            stop_token_ids = [int(t) for t in stop_token_ids]
+        except (TypeError, ValueError):
+            raise ValueError("'stop_token_ids' entries must be token ids") from None
+    min_p = float(body.get("min_p") or 0.0)
+    if not 0.0 <= min_p <= 1.0:
+        raise ValueError(f"'min_p' must be in [0, 1], got {min_p}")
     return SamplingParams(
         max_tokens=int(
             body.get("max_tokens") or body.get("max_completion_tokens") or 128
@@ -52,7 +73,10 @@ def _sampling_from_body(body: dict, chat: bool) -> SamplingParams:
         temperature=float(body.get("temperature") or 0.0),
         top_p=float(body.get("top_p") or 1.0),
         top_k=int(body.get("top_k") or 0),
+        min_p=min_p,
         stop=stop,
+        stop_token_ids=stop_token_ids,
+        logit_bias=logit_bias,
         ignore_eos=bool(body.get("ignore_eos", False)),
         seed=body.get("seed"),
         logprobs=want_logprobs,
@@ -72,8 +96,11 @@ class StopChecker:
         self.emitted_text = ""
 
     def push(self, token_id: int):
-        """Returns (delta_text, stopped)."""
-        self.token_ids.append(token_id)
+        """Returns (delta_text, stopped).  Negative ids are no-text
+        sentinels (a stop_token_ids match ends generation without
+        contributing text)."""
+        if token_id >= 0:
+            self.token_ids.append(token_id)
         text = self.tokenizer.decode(self.token_ids)
         for s in self.stop:
             idx = text.find(s)
@@ -180,7 +207,14 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
             prompt = body.get("prompt") or ""
             if isinstance(prompt, list):
                 prompt = "\n".join(str(p) for p in prompt)
-        params = _sampling_from_body(body, chat)
+        try:
+            params = _sampling_from_body(body, chat)
+        except (TypeError, ValueError) as e:
+            return web.json_response(
+                {"error": {"message": str(e),
+                           "type": "invalid_request_error"}},
+                status=400,
+            )
         stream = bool(body.get("stream", False))
         request_id = request.headers.get("x-request-id") or f"cmpl-{uuid.uuid4().hex[:16]}"
         created = int(time.time())
@@ -274,7 +308,10 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
         def _logprob_entry(event) -> dict:
             """One token's OpenAI chat-style logprobs entry."""
             return {
-                "token": tokenizer.decode([event.token_id]),
+                "token": (
+                    tokenizer.decode([event.token_id])
+                    if event.token_id >= 0 else ""
+                ),
                 "logprob": event.logprob,
                 "top_logprobs": [
                     {"token": tokenizer.decode([tid]), "logprob": lp}
@@ -298,7 +335,10 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
                 choice = {"index": index, "text": delta_text,
                           "finish_reason": finish_reason}
                 if params.logprobs and event is not None:
-                    tok_text = tokenizer.decode([event.token_id])
+                    tok_text = (
+                        tokenizer.decode([event.token_id])
+                        if event.token_id >= 0 else ""
+                    )
                     choice["logprobs"] = {
                         "tokens": [tok_text],
                         "token_logprobs": [event.logprob],
@@ -374,7 +414,13 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
                         # (OpenAI: logprobs.content aligns with content).
                         payload = chunk_payload(
                             delta, None, first[i],
-                            event=None if stopped else event, index=i,
+                            # The -1 sentinel (stop_token_ids) is equally
+                            # absent from content, so no entry for it.
+                            event=(
+                                None if stopped or event.token_id < 0
+                                else event
+                            ),
+                            index=i,
                         )
                         await response.write(
                             f"data: {json.dumps(payload)}\n\n".encode()
@@ -433,7 +479,9 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
             async for event in gen:
                 delta, stopped = checker.push(event.token_id)
                 text_parts.append(delta)
-                if params.logprobs:
+                if params.logprobs and event.token_id >= 0:
+                    # The stop_token_ids sentinel contributes no text, so
+                    # it must not contribute a logprobs entry either.
                     logprob_entries.append(event)
                 if stopped:
                     finish_reason = "stop"
@@ -484,7 +532,8 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
                           "finish_reason": finish_reason}
                 if params.logprobs:
                     token_texts = [
-                        tokenizer.decode([e.token_id]) for e in logprob_entries
+                        tokenizer.decode([e.token_id]) if e.token_id >= 0 else ""
+                        for e in logprob_entries
                     ]
                     offsets, pos = [], 0
                     for t in token_texts:
