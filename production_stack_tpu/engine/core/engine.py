@@ -167,6 +167,7 @@ class LLMEngine:
                 sp_mode=par.sequence_parallel_mode,
             ),
             donate_argnames=("kv_caches",),
+            static_argnames=("prompt_topk",),
         )
         self._decode_fn = jax.jit(
             partial(self.model.decode, cfg=cfg, mesh=self.mesh),
@@ -349,6 +350,7 @@ class LLMEngine:
             adapter=adapter,
             adapter_idx=adapter_idx,
             cache_ns=cache_ns,
+            echo_prompt_len=len(prompt_token_ids),
         )
         self._seqs[request_id] = seq
         self.scheduler.add_seq(seq)
@@ -609,7 +611,33 @@ class LLMEngine:
                 "lora": self.lora_registry.params,
                 "adapter_idx": jnp.int32(seq.adapter_idx),
             }
-        logits, self.kv_caches = self._prefill_fn(
+
+        sp = seq.sampling_params
+        want_plp = sp.echo and sp.logprobs
+        plp_kwargs = {}
+        if want_plp:
+            # Target of row t (absolute position cached_len+t) is the NEXT
+            # prompt token; rows at/past the prompt tail target 0 (their
+            # entries are discarded below).
+            targets = np.zeros((T,), np.int32)
+            m = min(
+                plan.num_new_tokens,
+                len(seq.prompt_token_ids) - plan.cached_len - 1,
+            )
+            if m > 0:
+                targets[:m] = seq.prompt_token_ids[
+                    plan.cached_len + 1 : plan.cached_len + 1 + m
+                ]
+            plp_kwargs = {
+                "prompt_targets": self._put(targets, P(AXES.SP)),
+                # Fixed k: prompt_topk is a STATIC jit arg, and a
+                # per-request value would compile a fresh prefill variant
+                # per (bucket, k) pair; _collect_prompt_logprobs slices to
+                # the request's k host-side.
+                "prompt_topk": 20,
+            }
+
+        out = self._prefill_fn(
             self.params,
             tokens=self._put(tokens, P(AXES.SP)),
             cached_len=jnp.int32(plan.cached_len),
@@ -617,18 +645,72 @@ class LLMEngine:
             new_block_ids=self._put(new_block_ids, P(AXES.SP)),
             valid_len=jnp.int32(plan.num_new_tokens),
             kv_caches=self.kv_caches,
+            **plp_kwargs,
             **lora_kwargs,
         )
+        if want_plp:
+            logits, self.kv_caches, plp = out
+            self._collect_prompt_logprobs(seq, plan, plp)
+        else:
+            logits, self.kv_caches = out
         if not plan.is_final:
             # Non-final chunk of a long prompt: KV is written, but the
             # logits are mid-prompt — nothing to sample yet.
             return []
         if self._exports:
             self._export_prefix_blocks(seq)
-        token_ids, logprob_info = self._sample_batch(logits[None, :], [seq])
-        return self._append_and_check(
-            [seq], token_ids, first_token=True, logprob_info=logprob_info
-        )
+        if sp.max_tokens == 0:
+            # Scoring-only request (echo+logprobs with max_tokens=0):
+            # nothing to sample — finish at prefill with the text-free
+            # sentinel the server already understands.
+            seq.finish_reason = FinishReason.LENGTH
+            self.scheduler.finish_seq(seq)
+            self.offload.discard(seq.seq_id)
+            self.total_finished += 1
+            self._seqs.pop(seq.seq_id, None)
+            seq.first_token_time = time.time()
+            outputs = [StepOutput(
+                seq_id=seq.seq_id,
+                new_token_id=-1,
+                finished=True,
+                finish_reason=FinishReason.LENGTH,
+                num_prompt_tokens=seq.num_prompt_tokens,
+                num_output_tokens=0,
+            )]
+        else:
+            token_ids, logprob_info = self._sample_batch(logits[None, :], [seq])
+            outputs = self._append_and_check(
+                [seq], token_ids, first_token=True, logprob_info=logprob_info
+            )
+        if want_plp and outputs and seq.prompt_lp is not None:
+            # Attach the assembled per-position entries to the request's
+            # FIRST token event (position 0 has no predictor -> None).
+            n = seq.echo_prompt_len
+            entries: List = [(None, None)]
+            for pos in range(1, n):
+                entries.append(seq.prompt_lp.get(pos, (None, None)))
+            outputs[0].prompt_logprobs = entries
+        return outputs
+
+    def _collect_prompt_logprobs(self, seq, plan, plp) -> None:
+        """Stitch one chunk's (target_lp, top_ids, top_lps) into the
+        sequence's absolute-position map (chunked prefill delivers the
+        prompt in pieces)."""
+        tlp = np.asarray(plp[0])
+        top_ids = np.asarray(plp[1])
+        top_lps = np.asarray(plp[2])
+        if seq.prompt_lp is None:
+            seq.prompt_lp = {}
+        k = min(seq.sampling_params.top_logprobs or 0, top_ids.shape[1])
+        for t in range(plan.num_new_tokens):
+            pos = plan.cached_len + t + 1  # entry FOR the predicted token
+            if pos >= seq.echo_prompt_len:
+                break
+            pairs = (
+                [(int(top_ids[t, j]), float(top_lps[t, j])) for j in range(k)]
+                if k else None
+            )
+            seq.prompt_lp[pos] = (float(tlp[t]), pairs)
 
     def _run_decode(self, plan: DecodePlan) -> List[StepOutput]:
         seqs = plan.seqs

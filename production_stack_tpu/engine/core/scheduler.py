@@ -209,6 +209,12 @@ class Scheduler:
             # Chunks already written: the sequence owns its blocks.
             prefix_blocks = list(seq.block_table)
             cached_len = seq.num_cached_tokens
+        elif seq.sampling_params.echo and seq.sampling_params.logprobs:
+            # echo+logprobs needs a logprob for EVERY prompt position; a
+            # prefix-cache hit would skip those rows' compute, so this
+            # sequence prefills from scratch (vLLM's prompt_logprobs makes
+            # the same trade).
+            prefix_blocks, cached_len = [], 0
         else:
             prefix_blocks, cached_len = self.block_pool.match_prefix(
                 seq.prompt_token_ids, namespace=seq.cache_ns

@@ -40,6 +40,10 @@ class SamplingParams:
     frequency_penalty: float = 0.0
     # OpenAI logit_bias: token id -> additive bias in [-100, 100].
     logit_bias: Optional[dict] = None
+    # OpenAI completions echo: return the prompt ahead of the completion;
+    # combined with logprobs, per-position prompt logprobs are computed
+    # during prefill (the lm-eval-harness loglikelihood pattern).
+    echo: bool = False
 
 
 @dataclasses.dataclass
@@ -72,6 +76,12 @@ class Sequence:
     # Generated tokens absorbed into prompt_token_ids by preemption
     # (re-prefill path); keeps max_tokens accounting correct across preempts.
     outputs_absorbed: int = 0
+    # echo+logprobs: per-ABSOLUTE-position prompt logprob entries collected
+    # during prefill (position -> (logprob|None, [(tid, lp), ...])), and
+    # the original prompt length (preemption absorbs outputs into the
+    # prompt; echoed positions never grow past this).
+    prompt_lp: Optional[dict] = None
+    echo_prompt_len: int = 0
 
     @property
     def num_prompt_tokens(self) -> int:
@@ -113,3 +123,7 @@ class StepOutput:
     # alternatives as (token_id, logprob) pairs.
     logprob: Optional[float] = None
     top_logprobs: Optional[List] = None
+    # First-token event of an echo+logprobs request: ordered per-prompt-
+    # position entries [(logprob|None, top_pairs|None), ...] (index 0 is
+    # None — no context predicts the first token).
+    prompt_logprobs: Optional[List] = None

@@ -15,6 +15,7 @@ tests/test_llama_vs_hf.py.
 
 from __future__ import annotations
 
+import math
 from functools import partial
 from typing import Dict, List, Optional, Tuple
 
@@ -287,8 +288,15 @@ def prefill(
     lora: Optional[Dict] = None,  # LoRA slot arrays (lora.py); None = off
     adapter_idx: Optional[jax.Array] = None,  # scalar slot for this seq
     sp_mode: str = "ring",  # sequence-parallel strategy when sp>1
+    prompt_targets: Optional[jax.Array] = None,  # [T] int32 next-token ids
+    prompt_topk: int = 0,  # static: top-k alternatives per prompt position
 ) -> Tuple[jax.Array, KVCaches]:
-    """One sequence's prefill.  Returns (last-token logits [V], new caches).
+    """One sequence's prefill.  Returns (last-token logits [V], new caches);
+    with ``prompt_targets`` set, returns (logits, caches, (target_logprob
+    [T], top_ids [T, k], top_logps [T, k])) — the per-position
+    next-token logprobs the OpenAI ``echo`` + ``logprobs`` surface needs
+    (lm-eval-harness loglikelihood scoring).  The lm_head sweep runs in
+    row chunks so the full [T, V] logits are never materialized.
 
     Under a mesh, the token axis is sharded over ``sp`` (every projection /
     MLP matmul computes on T/sp rows per device) and attention runs the
@@ -373,7 +381,33 @@ def prefill(
 
     x = _norm(x, params["norm"], cfg)
     last = x[jnp.maximum(valid_len - 1, 0)]  # [h]
-    return _lm_head(params, cfg, last), new_caches
+    logits = _lm_head(params, cfg, last)
+    if prompt_targets is None:
+        return logits, new_caches
+
+    # Chunked lm_head sweep: [C, V] at a time (T=2048, V=128k fp32 would
+    # be ~1 GB if materialized whole).  C must divide T (buckets are
+    # free-form CLI ints, e.g. 192).
+    C = math.gcd(T, 128)
+    k = max(prompt_topk, 1)
+    rows = x.reshape(T // C, C, cfg.hidden_size)
+    tgts = prompt_targets.reshape(T // C, C)
+
+    def head_chunk(args):
+        r, t = args
+        lg = _lm_head(params, cfg, r)  # [C, V] fp32
+        lsm = jax.nn.log_softmax(lg, axis=-1)
+        tlp = jnp.take_along_axis(lsm, t[:, None], axis=-1)[:, 0]
+        top_lp, top_id = jax.lax.top_k(lsm, k)
+        return tlp, top_id.astype(jnp.int32), top_lp
+
+    tlp, top_ids, top_lps = jax.lax.map(head_chunk, (rows, tgts))
+    plp = (
+        tlp.reshape(T),
+        top_ids.reshape(T, k),
+        top_lps.reshape(T, k),
+    )
+    return logits, new_caches, plp
 
 
 def encode(

@@ -66,10 +66,16 @@ def _sampling_from_body(body: dict, chat: bool) -> SamplingParams:
     min_p = float(body.get("min_p") or 0.0)
     if not 0.0 <= min_p <= 1.0:
         raise ValueError(f"'min_p' must be in [0, 1], got {min_p}")
+    raw_max = body.get("max_tokens")
+    if raw_max is None:
+        raw_max = body.get("max_completion_tokens")
+    # Explicit 0 is meaningful (echo+logprobs scoring wants NO generated
+    # tokens); only absence falls back to the default.
+    max_tokens = 128 if raw_max is None else int(raw_max)
+    if max_tokens < 0:
+        raise ValueError(f"'max_tokens' must be >= 0, got {max_tokens}")
     return SamplingParams(
-        max_tokens=int(
-            body.get("max_tokens") or body.get("max_completion_tokens") or 128
-        ),
+        max_tokens=max_tokens,
         temperature=float(body.get("temperature") or 0.0),
         top_p=float(body.get("top_p") or 1.0),
         top_k=int(body.get("top_k") or 0),
@@ -77,6 +83,7 @@ def _sampling_from_body(body: dict, chat: bool) -> SamplingParams:
         stop=stop,
         stop_token_ids=stop_token_ids,
         logit_bias=logit_bias,
+        echo=bool(body.get("echo")) and not chat,
         ignore_eos=bool(body.get("ignore_eos", False)),
         seed=body.get("seed"),
         logprobs=want_logprobs,
@@ -216,6 +223,12 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
                 status=400,
             )
         stream = bool(body.get("stream", False))
+        if params.echo and stream:
+            return web.json_response(
+                {"error": {"message": "'echo' is not supported with "
+                           "streaming", "type": "invalid_request_error"}},
+                status=400,
+            )
         request_id = request.headers.get("x-request-id") or f"cmpl-{uuid.uuid4().hex[:16]}"
         created = int(time.time())
         model_name = body.get("model", served_model)
@@ -474,9 +487,12 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
             checker = checkers[i]
             text_parts = []
             logprob_entries = []
+            prompt_lp = None
             finish_reason = "length"
             out_tokens = 0
             async for event in gen:
+                if event.prompt_logprobs is not None:
+                    prompt_lp = event.prompt_logprobs
                 delta, stopped = checker.push(event.token_id)
                 text_parts.append(delta)
                 if params.logprobs and event.token_id >= 0:
@@ -497,16 +513,16 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
                         else "length"
                     )
                     break
-            return "".join(text_parts), logprob_entries, finish_reason, out_tokens
+            return ("".join(text_parts), logprob_entries, finish_reason,
+                    out_tokens, prompt_lp)
 
         drained = await asyncio.gather(
             *[drain(i, g) for i, g in enumerate(gens)]
         )
         choices = []
         total_out = 0
-        for i, (text, logprob_entries, finish_reason, out_tokens) in enumerate(
-            drained
-        ):
+        for i, (text, logprob_entries, finish_reason, out_tokens,
+                prompt_lp) in enumerate(drained):
             checker = checkers[i]
             total_out += out_tokens
             if params.logprobs:
@@ -528,27 +544,49 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
                         "content": [_logprob_entry(e) for e in logprob_entries]
                     }
             else:
-                choice = {"index": i, "text": text,
+                out_text = (prompt + text) if params.echo else text
+                choice = {"index": i, "text": out_text,
                           "finish_reason": finish_reason}
                 if params.logprobs:
                     token_texts = [
                         tokenizer.decode([e.token_id]) if e.token_id >= 0 else ""
                         for e in logprob_entries
                     ]
+                    token_lps = [e.logprob for e in logprob_entries]
+                    tops = [
+                        {
+                            tokenizer.decode([tid]): lp
+                            for tid, lp in (e.top_logprobs or [])
+                        }
+                        for e in logprob_entries
+                    ]
+                    if params.echo and prompt_lp is not None:
+                        # Prepend the prompt's per-position entries (echo
+                        # + logprobs: the lm-eval loglikelihood surface;
+                        # position 0 has null logprob per OpenAI).
+                        p_texts = [
+                            tokenizer.decode([tid])
+                            for tid in prompt_token_ids[: len(prompt_lp)]
+                        ]
+                        p_lps = [entry[0] for entry in prompt_lp]
+                        p_tops = [
+                            {
+                                tokenizer.decode([tid]): lp
+                                for tid, lp in (entry[1] or [])
+                            } if entry[1] is not None else None
+                            for entry in prompt_lp
+                        ]
+                        token_texts = p_texts + token_texts
+                        token_lps = p_lps + token_lps
+                        tops = p_tops + tops
                     offsets, pos = [], 0
                     for t in token_texts:
                         offsets.append(pos)
                         pos += len(t)
                     choice["logprobs"] = {
                         "tokens": token_texts,
-                        "token_logprobs": [e.logprob for e in logprob_entries],
-                        "top_logprobs": [
-                            {
-                                tokenizer.decode([tid]): lp
-                                for tid, lp in (e.top_logprobs or [])
-                            }
-                            for e in logprob_entries
-                        ],
+                        "token_logprobs": token_lps,
+                        "top_logprobs": tops,
                         "text_offset": offsets,
                     }
             choices.append(choice)
