@@ -16,6 +16,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
+import zlib
 from collections import OrderedDict
 from functools import partial
 from typing import Dict, List, Optional
@@ -253,6 +254,7 @@ class LLMEngine:
 
         self._step_counter = 0
         self._encode_fn = None  # lazily jitted /v1/embeddings path
+        self._token_texts = None  # guided decoding token-text cache
         self._seqs: Dict[str, Sequence] = {}
         # Cumulative counters for /metrics.
         self.total_prompt_tokens = 0
@@ -333,6 +335,16 @@ class LLMEngine:
             prompt_token_ids = self.tokenizer.encode(prompt)
         if not prompt_token_ids:
             prompt_token_ids = [self.tokenizer.bos_token_id or 0]
+        params_obj = sampling_params or SamplingParams()
+        guide = None
+        if params_obj.response_format == "json_object":
+            from production_stack_tpu.engine.guided import JsonGuide
+
+            guide = JsonGuide(require_object=True)
+        elif params_obj.response_format not in (None, "text"):
+            raise ValueError(
+                f"Unsupported response_format {params_obj.response_format!r}"
+            )
         adapter_idx = 0
         cache_ns = 0
         if adapter:
@@ -346,11 +358,12 @@ class LLMEngine:
         seq = Sequence(
             seq_id=request_id,
             prompt_token_ids=list(prompt_token_ids),
-            sampling_params=sampling_params or SamplingParams(),
+            sampling_params=params_obj,
             adapter=adapter,
             adapter_idx=adapter_idx,
             cache_ns=cache_ns,
             echo_prompt_len=len(prompt_token_ids),
+            guide=guide,
         )
         self._seqs[request_id] = seq
         self.scheduler.add_seq(seq)
@@ -663,12 +676,8 @@ class LLMEngine:
             # Scoring-only request (echo+logprobs with max_tokens=0):
             # nothing to sample — finish at prefill with the text-free
             # sentinel the server already understands.
-            seq.finish_reason = FinishReason.LENGTH
-            self.scheduler.finish_seq(seq)
-            self.offload.discard(seq.seq_id)
-            self.total_finished += 1
-            self._seqs.pop(seq.seq_id, None)
             seq.first_token_time = time.time()
+            self._finish_seq_now(seq, FinishReason.LENGTH)
             outputs = [StepOutput(
                 seq_id=seq.seq_id,
                 new_token_id=-1,
@@ -752,6 +761,7 @@ class LLMEngine:
             or s.sampling_params.frequency_penalty
             or s.sampling_params.logprobs
             or s.sampling_params.logit_bias
+            or s.guide is not None
             for s in seqs
         )
         if use_multi:
@@ -916,6 +926,11 @@ class LLMEngine:
             min_p=jnp.asarray(min_ps),
         )
         token_ids = [int(t) for t in np.asarray(out[: len(seqs)])]
+        if any(s.guide is not None for s in seqs):
+            token_ids = self._guided_override(logits, seqs, token_ids)
+            out = jnp.asarray(
+                np.array(token_ids + [0] * pad, np.int32)
+            )
 
         logprob_info: List = [None] * len(seqs)
         if any(s.sampling_params.logprobs for s in seqs):
@@ -940,6 +955,95 @@ class LLMEngine:
                         ],
                     )
         return token_ids, logprob_info
+
+    def _guided_override(
+        self, logits: jax.Array, seqs: List[Sequence], token_ids: List[int]
+    ) -> List[int]:
+        """Constrained choice for guided sequences (engine/guided.py):
+        the device-sampled token is kept when the automaton accepts it;
+        otherwise candidates are validated host-side in logit order and
+        the best valid token replaces it.  A completed JSON value forces
+        EOS."""
+        from production_stack_tpu.engine.guided import TokenTextCache
+
+        if self._token_texts is None:
+            self._token_texts = TokenTextCache(self.tokenizer)
+        cache = self._token_texts
+        eos = self.tokenizer.eos_token_id or 0
+        out = list(token_ids)
+        for i, seq in enumerate(seqs):
+            guide = seq.guide
+            if guide is None:
+                continue
+            if guide.done:
+                out[i] = eos
+                continue
+            # Budget-aware closing: when the remaining token budget nears
+            # the bytes needed to close the JSON, admit only
+            # closure-reducing tokens so the value completes instead of
+            # truncating (tokens are >=1 byte, so cost+margin tokens
+            # always suffice).
+            sp = seq.sampling_params
+            remaining = sp.max_tokens - seq.num_generated
+            guide.closing = remaining <= guide.closure_cost() + 4
+            # Fast path: the unconstrained choice is usually valid.
+            fast_bytes = cache.text(out[i]).encode()
+            st = guide.try_token(fast_bytes)
+            if st is not None and out[i] != eos:
+                guide.accept(st, fast_bytes)
+                continue
+            row = np.asarray(logits[i])  # [V] fp32, post bias/penalties
+            # Validate candidates in descending-logit order; with
+            # temperature, sample among the first few valid candidates.
+            # Valid tokens live at the top of the distribution in
+            # practice, so scan an argpartitioned top slice first and only
+            # pay the full sort if it comes up empty.
+            want = 1 if sp.temperature <= 0 else 8
+            valid: List = []
+            for scope in (64, len(row)):
+                if scope >= len(row):
+                    order = np.argsort(-row)
+                else:
+                    top = np.argpartition(-row, scope)[:scope]
+                    order = top[np.argsort(-row[top])]
+                for tid in order:
+                    tid = int(tid)
+                    if tid == eos:
+                        continue
+                    st = guide.try_token(cache.text(tid).encode())
+                    if st is not None:
+                        valid.append((tid, st))
+                        if len(valid) >= want:
+                            break
+                if valid:
+                    break
+            if not valid:
+                # No token makes progress (pathological vocab): end the
+                # request rather than loop.
+                logger.warning(
+                    "guided decoding: no valid continuation for %s",
+                    seq.seq_id,
+                )
+                out[i] = eos
+                continue
+            if len(valid) == 1:
+                tid, st = valid[0]
+            else:
+                lps = np.array([row[t] for t, _ in valid], np.float64)
+                lps = lps / max(sp.temperature, 1e-5)
+                p = np.exp(lps - lps.max())
+                p /= p.sum()
+                rng = np.random.default_rng(
+                    # Per-sequence stream: co-batched guided choices (the
+                    # n>1 fan-out) must not collapse to the same picks.
+                    (sp.seed if sp.seed is not None else 0) * 1000003
+                    + self._step_counter * 31
+                    + zlib.crc32(seq.seq_id.encode())
+                )
+                tid, st = valid[int(rng.choice(len(valid), p=p))]
+            guide.accept(st, cache.text(tid).encode())
+            out[i] = tid
+        return out
 
     def _append_and_check(
         self,
@@ -970,11 +1074,7 @@ class LLMEngine:
             else:
                 finish = self._check_finish(seq, token_id)
             if finish is not None:
-                seq.finish_reason = finish
-                self.scheduler.finish_seq(seq)
-                self.offload.discard(seq.seq_id)
-                self.total_finished += 1
-                self._seqs.pop(seq.seq_id, None)
+                self._finish_seq_now(seq, finish)
             outputs.append(
                 StepOutput(
                     seq_id=seq.seq_id,
@@ -988,6 +1088,15 @@ class LLMEngine:
                 )
             )
         return outputs
+
+    def _finish_seq_now(self, seq: Sequence, reason: FinishReason) -> None:
+        """The single finish protocol: scheduler release + prefix-cache
+        registration, offload cleanup, counters, registry removal."""
+        seq.finish_reason = reason
+        self.scheduler.finish_seq(seq)
+        self.offload.discard(seq.seq_id)
+        self.total_finished += 1
+        self._seqs.pop(seq.seq_id, None)
 
     def _check_finish(self, seq: Sequence, token_id: int) -> Optional[FinishReason]:
         sp = seq.sampling_params

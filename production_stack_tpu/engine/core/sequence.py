@@ -44,6 +44,9 @@ class SamplingParams:
     # combined with logprobs, per-position prompt logprobs are computed
     # during prefill (the lm-eval-harness loglikelihood pattern).
     echo: bool = False
+    # OpenAI response_format type: None | "json_object" (guided decoding;
+    # engine/guided.py).
+    response_format: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -82,6 +85,9 @@ class Sequence:
     # prompt; echoed positions never grow past this).
     prompt_lp: Optional[dict] = None
     echo_prompt_len: int = 0
+    # Guided decoding state (engine/guided.py JsonGuide) when the request
+    # set response_format.
+    guide: Optional[object] = None
 
     @property
     def num_prompt_tokens(self) -> int:

@@ -66,6 +66,19 @@ def _sampling_from_body(body: dict, chat: bool) -> SamplingParams:
     min_p = float(body.get("min_p") or 0.0)
     if not 0.0 <= min_p <= 1.0:
         raise ValueError(f"'min_p' must be in [0, 1], got {min_p}")
+    response_format = None
+    rf = body.get("response_format")
+    if rf is not None:
+        rf_type = rf.get("type") if isinstance(rf, dict) else rf
+        if rf_type == "json_object":
+            response_format = "json_object"
+        elif rf_type in ("text", None):
+            response_format = None
+        else:
+            raise ValueError(
+                f"Unsupported response_format type {rf_type!r} "
+                "(supported: text, json_object)"
+            )
     raw_max = body.get("max_tokens")
     if raw_max is None:
         raw_max = body.get("max_completion_tokens")
@@ -84,7 +97,10 @@ def _sampling_from_body(body: dict, chat: bool) -> SamplingParams:
         stop_token_ids=stop_token_ids,
         logit_bias=logit_bias,
         echo=bool(body.get("echo")) and not chat,
-        ignore_eos=bool(body.get("ignore_eos", False)),
+        # Guided decoding forces EOS when the JSON completes, so
+        # ignore_eos would loop forever; response_format wins.
+        ignore_eos=bool(body.get("ignore_eos", False)) and response_format is None,
+        response_format=response_format,
         seed=body.get("seed"),
         logprobs=want_logprobs,
         top_logprobs=max(0, min(top_logprobs, 20)),
