@@ -341,6 +341,10 @@ class LLMEngine:
             from production_stack_tpu.engine.guided import JsonGuide
 
             guide = JsonGuide(require_object=True)
+            # Completion forces EOS; ignore_eos would append eos text
+            # forever.  Enforced here (not only at the API boundary) so
+            # direct engine users get the same behavior.
+            params_obj.ignore_eos = False
         elif params_obj.response_format not in (None, "text"):
             raise ValueError(
                 f"Unsupported response_format {params_obj.response_format!r}"
@@ -926,14 +930,16 @@ class LLMEngine:
             min_p=jnp.asarray(min_ps),
         )
         token_ids = [int(t) for t in np.asarray(out[: len(seqs)])]
+        any_logprobs = any(s.sampling_params.logprobs for s in seqs)
         if any(s.guide is not None for s in seqs):
             token_ids = self._guided_override(logits, seqs, token_ids)
-            out = jnp.asarray(
-                np.array(token_ids + [0] * pad, np.int32)
-            )
+            if any_logprobs:
+                # `out` feeds the logprobs gather below; keep it in sync
+                # with the constrained choices.
+                out = jnp.asarray(np.array(token_ids + [0] * pad, np.int32))
 
         logprob_info: List = [None] * len(seqs)
-        if any(s.sampling_params.logprobs for s in seqs):
+        if any_logprobs:
             # Fixed k = the API clamp (20): a per-batch k would compile a
             # fresh XLA variant inside the step thread for every new value,
             # stalling all in-flight sequences; per-sequence counts are
@@ -984,7 +990,11 @@ class LLMEngine:
             # truncating (tokens are >=1 byte, so cost+margin tokens
             # always suffice).
             sp = seq.sampling_params
-            remaining = sp.max_tokens - seq.num_generated
+            remaining = min(
+                sp.max_tokens - seq.num_generated,
+                # max_model_len can bind first (long prompts).
+                self.config.scheduler.max_model_len - seq.num_tokens,
+            )
             guide.closing = remaining <= guide.closure_cost() + 4
             # Fast path: the unconstrained choice is usually valid.
             fast_bytes = cache.text(out[i]).encode()

@@ -234,13 +234,15 @@ def closure_cost(state: FSMState) -> int:
     if mode == AFTER:
         return depth
     if mode == STR:
-        extra = 3 if state.aux == "key" else 0  # "':' + minimal value
+        # The leading 1 is the closing quote; a key then needs ':' plus a
+        # minimal value (2 more).
+        extra = 2 if state.aux == "key" else 0
         return 1 + extra + depth
     if mode == STR_ESC:
-        return 2 + depth + (3 if state.aux == "key" else 0)
+        return 2 + depth + (2 if state.aux == "key" else 0)
     if mode == STR_U:
         n = int(state.aux.rsplit("|", 1)[1])
-        return 1 + n + depth + (3 if "key" in state.aux else 0)
+        return 1 + n + depth + (2 if "key" in state.aux else 0)
     if mode == NUM:
         return depth if state.aux in _N_TERMINAL else 1 + depth
     if mode == LIT:
