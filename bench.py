@@ -152,8 +152,12 @@ def diff_time(make_fn, n1, n2, *args, repeats=3):
 # -- microbenches ----------------------------------------------------------
 
 
-def bench_matmul_tfs(jax, jnp):
-    a = jax.random.normal(jax.random.PRNGKey(0), (8192, 8192), jnp.bfloat16)
+def bench_matmul_tfs(jax, jnp, on_tpu=True):
+    # Off-TPU (CI / tunnel-down fallback) the TPU-sized problem takes
+    # minutes on a CPU; a small probe keeps the fallback inside the
+    # driver's window (the number is only a roofline anchor on TPU).
+    n_dim = 8192 if on_tpu else 1024
+    a = jax.random.normal(jax.random.PRNGKey(0), (n_dim, n_dim), jnp.bfloat16)
 
     def mk(n):
         @jax.jit
@@ -163,12 +167,13 @@ def bench_matmul_tfs(jax, jnp):
         return f
 
     dt = diff_time(mk, 4, 24, a)
-    return 2 * 8192**3 / dt / 1e12
+    return 2 * n_dim**3 / dt / 1e12
 
 
-def bench_hbm_gbs(jax, jnp):
-    x = jax.random.normal(jax.random.PRNGKey(1), (128 * 2**20,), jnp.bfloat16)
-    y = jax.random.normal(jax.random.PRNGKey(2), (128 * 2**20,), jnp.bfloat16)
+def bench_hbm_gbs(jax, jnp, on_tpu=True):
+    size = (128 if on_tpu else 16) * 2**20
+    x = jax.random.normal(jax.random.PRNGKey(1), (size,), jnp.bfloat16)
+    y = jax.random.normal(jax.random.PRNGKey(2), (size,), jnp.bfloat16)
 
     def mk(n):
         @jax.jit
@@ -349,8 +354,8 @@ def main() -> None:
         detail["tpu_unavailable"] = True
 
     if not args.quick:
-        detail["matmul_tflops"] = round(bench_matmul_tfs(jax, jnp), 1)
-        detail["hbm_gbs"] = round(bench_hbm_gbs(jax, jnp), 1)
+        detail["matmul_tflops"] = round(bench_matmul_tfs(jax, jnp, on_tpu), 1)
+        detail["hbm_gbs"] = round(bench_hbm_gbs(jax, jnp, on_tpu), 1)
         log(f"microbench: {detail.get('matmul_tflops')} TF/s, "
             f"{detail.get('hbm_gbs')} GB/s")
 
@@ -433,12 +438,21 @@ def main() -> None:
             serving_bench = importlib.util.module_from_spec(spec)
             spec.loader.exec_module(serving_bench)
             log("serving bench: booting engine + router in-process ...")
+            # Scale the workload's prompt sizes to the serving context:
+            # the byte-fallback tokenizer yields ~3 tokens per word, so
+            # the nominal 600-word prompts reach ~3.7k tokens — fine under
+            # the 8k presets (capped 4096) but overflowing a 2048-context
+            # fallback preset, which made every CPU-fallback request 400.
+            serving_len = min(cfg.max_model_len, 4096)
+            # //10 leaves headroom for chat framing + 3 rounds of history
+            # growth at the byte tokenizer's ~3 tokens/word.
+            plen = min(600, serving_len // 10)
             serving = serving_bench.run_serving_bench_sync(
                 preset=preset,
                 num_users=6, num_rounds=3, qps=2.0,
-                system_prompt_len=600, user_info_len=600, answer_len=48,
+                system_prompt_len=plen, user_info_len=plen, answer_len=48,
                 max_num_seqs=args.batch,
-                max_model_len=min(cfg.max_model_len, 4096),
+                max_model_len=serving_len,
                 num_scheduler_steps=args.serving_scheduler_steps,
             )
             detail["serving"] = serving

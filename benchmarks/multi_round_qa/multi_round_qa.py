@@ -25,6 +25,7 @@ import argparse
 import asyncio
 import csv
 import dataclasses
+from collections import Counter
 import json
 import logging
 import re
@@ -333,6 +334,10 @@ def summarize(records: List[RequestRecord], wall_time: float,
     }
     if kv_hit_rate is not None:
         summary["kv_hit_rate"] = round(kv_hit_rate, 4)
+    if failed:
+        # Failure breakdown: "18 failed" with no cause is undiagnosable
+        # from a driver artifact.
+        summary["errors"] = dict(Counter(r.error for r in failed))
     return summary
 
 
