@@ -223,9 +223,90 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
                 status=400,
             )
         tokenizer = engine.engine.tokenizer
+        tools = body.get("tools") if chat else None
+        tool_choice = body.get("tool_choice", "auto") if chat else "auto"
+        forced_tool = None
+        if chat and tool_choice not in ("auto", "none") and not tools:
+            # OpenAI: tool_choice is only allowed when tools are given.
+            return web.json_response(
+                {"error": {"message": "'tool_choice' requires a non-empty "
+                           "'tools' array", "type": "invalid_request_error"}},
+                status=400,
+            )
+        if chat and tools:
+            if not isinstance(tools, list) or not all(
+                isinstance(t, dict) and t.get("type") == "function"
+                and isinstance(t.get("function"), dict)
+                and t["function"].get("name")
+                for t in tools
+            ):
+                return web.json_response(
+                    {"error": {"message": "'tools' must be a list of "
+                               "{type: function, function: {name, ...}}",
+                               "type": "invalid_request_error"}},
+                    status=400,
+                )
+            if isinstance(tool_choice, dict):
+                wanted = (tool_choice.get("function") or {}).get("name")
+                match = [t for t in tools
+                         if t["function"]["name"] == wanted]
+                if not match:
+                    return web.json_response(
+                        {"error": {"message": f"tool_choice function "
+                                   f"{wanted!r} not in tools",
+                                   "type": "invalid_request_error"}},
+                        status=400,
+                    )
+                forced_tool = match[0]
+            elif tool_choice == "required":
+                if len(tools) > 1:
+                    # Model-driven tool selection needs per-family output
+                    # parsers (out of scope); with several tools the
+                    # caller must force one explicitly rather than get
+                    # tools[0] silently.
+                    return web.json_response(
+                        {"error": {"message": "tool_choice 'required' with "
+                                   "multiple tools is not supported; force "
+                                   "one with {type: function, function: "
+                                   "{name: ...}}",
+                                   "type": "invalid_request_error"}},
+                        status=400,
+                    )
+                forced_tool = tools[0]
+            elif tool_choice not in ("auto", "none"):
+                return web.json_response(
+                    {"error": {"message": f"Unsupported tool_choice "
+                               f"{tool_choice!r} (auto | none | required | "
+                               "{type: function, ...})",
+                               "type": "invalid_request_error"}},
+                    status=400,
+                )
         if chat:
-            messages = body.get("messages") or []
-            prompt = tokenizer.apply_chat_template(messages)
+            messages = list(body.get("messages") or [])
+            if forced_tool is not None:
+                # Steer content quality; the JSON guarantee comes from the
+                # guided decoder below.  The instruction rides the LAST
+                # USER turn — an appended system message would be rejected
+                # by strict templates (gemma; role-alternation checks).
+                steer = (
+                    f"\n\n(Call the function "
+                    f"{forced_tool['function']['name']} by replying with "
+                    "ONLY its JSON arguments object.)"
+                )
+                if messages and messages[-1].get("role") == "user" and \
+                        isinstance(messages[-1].get("content"), str):
+                    messages[-1] = dict(
+                        messages[-1],
+                        content=messages[-1]["content"] + steer,
+                    )
+                else:
+                    messages.append({"role": "user", "content": steer.strip()})
+            prompt = tokenizer.apply_chat_template(
+                messages,
+                # 'none' means the model must not call tools: don't prompt
+                # it with them.
+                tools=tools if tool_choice != "none" else None,
+            )
         else:
             prompt = body.get("prompt") or ""
             if isinstance(prompt, list):
@@ -245,6 +326,17 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
                            "streaming", "type": "invalid_request_error"}},
                 status=400,
             )
+        if forced_tool is not None:
+            if stream:
+                return web.json_response(
+                    {"error": {"message": "forced tool_choice is not "
+                               "supported with streaming",
+                               "type": "invalid_request_error"}},
+                    status=400,
+                )
+            # The arguments object is produced under the JSON guarantee.
+            params.response_format = "json_object"
+            params.ignore_eos = False
         request_id = request.headers.get("x-request-id") or f"cmpl-{uuid.uuid4().hex[:16]}"
         created = int(time.time())
         model_name = body.get("model", served_model)
@@ -550,9 +642,38 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
                     : checker.aligned_token_count()
                 ]
             if chat:
+                tool_args_ok = False
+                if forced_tool is not None:
+                    try:
+                        json.loads(text)
+                        tool_args_ok = True
+                    except (json.JSONDecodeError, TypeError):
+                        # Budget too small for the guided close: surface
+                        # the truncation (finish_reason from drain, plain
+                        # content) instead of claiming a tool call with
+                        # unparseable arguments.
+                        tool_args_ok = False
+                if forced_tool is not None and tool_args_ok:
+                    # OpenAI tool-calling shape: arguments carry the
+                    # guided-JSON output verbatim.
+                    message = {
+                        "role": "assistant",
+                        "content": None,
+                        "tool_calls": [{
+                            "id": f"call_{uuid.uuid4().hex[:20]}",
+                            "type": "function",
+                            "function": {
+                                "name": forced_tool["function"]["name"],
+                                "arguments": text,
+                            },
+                        }],
+                    }
+                    finish_reason = "tool_calls"
+                else:
+                    message = {"role": "assistant", "content": text}
                 choice = {
                     "index": i,
-                    "message": {"role": "assistant", "content": text},
+                    "message": message,
                     "finish_reason": finish_reason,
                 }
                 if params.logprobs:

@@ -39,10 +39,16 @@ class ByteTokenizer:
         )
         return data.decode("utf-8", errors="replace")
 
-    def apply_chat_template(self, messages) -> str:
+    def apply_chat_template(self, messages, tools=None) -> str:
         if self.chat_template is not None:
-            return _render_jinja(self.chat_template, messages, bos="", eos="")
+            return _render_jinja(
+                self.chat_template, messages, bos="", eos="", tools=tools
+            )
         parts = [f"<|{m.get('role', 'user')}|>{m.get('content', '')}" for m in messages]
+        if tools:
+            import json as _json
+
+            parts.insert(0, "<|tools|>" + _json.dumps(tools))
         return "\n".join(parts) + "\n<|assistant|>"
 
 
@@ -65,7 +71,8 @@ class HFTokenizer:
     def decode(self, ids: List[int]) -> str:
         return self._tok.decode(ids, skip_special_tokens=True)
 
-    def apply_chat_template(self, messages) -> str:
+    def apply_chat_template(self, messages, tools=None) -> str:
+        tool_kwargs = {"tools": tools} if tools else {}
         if self.chat_template is not None:
             # An explicitly configured template must never be silently
             # replaced by the degenerate fallback: the server validates it
@@ -75,10 +82,12 @@ class HFTokenizer:
                 tokenize=False,
                 add_generation_prompt=True,
                 chat_template=self.chat_template,
+                **tool_kwargs,
             )
         try:
             return self._tok.apply_chat_template(
-                messages, tokenize=False, add_generation_prompt=True
+                messages, tokenize=False, add_generation_prompt=True,
+                **tool_kwargs,
             )
         except Exception:
             parts = [f"{m.get('role')}: {m.get('content', '')}" for m in messages]
@@ -96,7 +105,7 @@ def _compile_jinja(template: str):
     return env.from_string(template)
 
 
-def _render_jinja(template: str, messages, bos: str, eos: str) -> str:
+def _render_jinja(template: str, messages, bos: str, eos: str, tools=None) -> str:
     """Render a custom chat template (the reference chart's chatTemplate
     ConfigMap, deployment-vllm-multi.yaml:260-270, passed to vllm serve as
     --chat-template).  jinja2 ships with transformers in this image.
@@ -107,6 +116,7 @@ def _render_jinja(template: str, messages, bos: str, eos: str) -> str:
         add_generation_prompt=True,
         bos_token=bos,
         eos_token=eos,
+        tools=tools,
     )
 
 

@@ -1,0 +1,170 @@
+"""Chat tool calling: forced function calls built on guided JSON.
+
+Supported subset (documented in docs/engine.md): tools are injected into
+the chat template; tool_choice "auto"/"none" is prompt-only; a forced
+function (dict form or "required") constrains the output to a JSON
+arguments object and returns an OpenAI tool_calls message with
+finish_reason "tool_calls".
+"""
+
+import json
+
+import aiohttp
+from aiohttp.test_utils import TestServer
+
+from production_stack_tpu.engine.config import config_from_preset
+from production_stack_tpu.engine.server.api_server import build_engine_app
+from production_stack_tpu.engine.server.async_engine import AsyncEngine
+
+TOOLS = [{
+    "type": "function",
+    "function": {
+        "name": "get_weather",
+        "description": "Get the weather for a city",
+        "parameters": {
+            "type": "object",
+            "properties": {"city": {"type": "string"}},
+        },
+    },
+}]
+
+
+async def _server():
+    config = config_from_preset(
+        "tiny-llama",
+        **{"scheduler.max_num_seqs": 2, "scheduler.max_model_len": 512,
+           "cache.num_blocks": 160},
+    )
+    engine = AsyncEngine(config)
+    server = TestServer(build_engine_app(engine, "tiny-llama"))
+    await server.start_server()
+    return server
+
+
+async def _post(server, body):
+    async with aiohttp.ClientSession() as session:
+        async with session.post(
+            f"http://127.0.0.1:{server.port}/v1/chat/completions", json=body
+        ) as resp:
+            return resp.status, await resp.json()
+
+
+async def test_forced_function_returns_tool_call_with_json_args():
+    server = await _server()
+    try:
+        status, body = await _post(server, {
+            "model": "tiny-llama", "max_tokens": 80,
+            "messages": [{"role": "user", "content": "weather in Paris?"}],
+            "tools": TOOLS,
+            "tool_choice": {"type": "function",
+                            "function": {"name": "get_weather"}},
+        })
+        assert status == 200
+        choice = body["choices"][0]
+        assert choice["finish_reason"] == "tool_calls"
+        assert choice["message"]["content"] is None
+        call = choice["message"]["tool_calls"][0]
+        assert call["type"] == "function"
+        assert call["function"]["name"] == "get_weather"
+        args = json.loads(call["function"]["arguments"])
+        assert isinstance(args, dict)  # guided JSON guarantee
+        assert call["id"].startswith("call_")
+    finally:
+        await server.close()
+
+
+async def test_required_uses_first_tool():
+    server = await _server()
+    try:
+        status, body = await _post(server, {
+            "model": "tiny-llama", "max_tokens": 60,
+            "messages": [{"role": "user", "content": "go"}],
+            "tools": TOOLS,
+            "tool_choice": "required",
+        })
+        assert status == 200
+        call = body["choices"][0]["message"]["tool_calls"][0]
+        assert call["function"]["name"] == "get_weather"
+        json.loads(call["function"]["arguments"])
+    finally:
+        await server.close()
+
+
+async def test_auto_is_prompt_only_and_none_tolerated():
+    server = await _server()
+    try:
+        for choice in ("auto", "none"):
+            status, body = await _post(server, {
+                "model": "tiny-llama", "max_tokens": 6,
+                "messages": [{"role": "user", "content": "hello"}],
+                "tools": TOOLS,
+                "tool_choice": choice,
+            })
+            assert status == 200
+            msg = body["choices"][0]["message"]
+            assert "tool_calls" not in msg  # plain text reply
+            assert msg["content"] is not None
+    finally:
+        await server.close()
+
+
+async def test_validation_errors():
+    server = await _server()
+    try:
+        # Unknown forced function.
+        status, _ = await _post(server, {
+            "model": "tiny-llama",
+            "messages": [{"role": "user", "content": "x"}],
+            "tools": TOOLS,
+            "tool_choice": {"type": "function",
+                            "function": {"name": "nope"}},
+        })
+        assert status == 400
+        # Malformed tools list.
+        status, _ = await _post(server, {
+            "model": "tiny-llama",
+            "messages": [{"role": "user", "content": "x"}],
+            "tools": [{"type": "function"}],
+        })
+        assert status == 400
+        # Forced tool + streaming unsupported.
+        status, _ = await _post(server, {
+            "model": "tiny-llama", "stream": True,
+            "messages": [{"role": "user", "content": "x"}],
+            "tools": TOOLS, "tool_choice": "required",
+        })
+        assert status == 400
+        # tool_choice without tools (OpenAI 400s this too).
+        status, _ = await _post(server, {
+            "model": "tiny-llama",
+            "messages": [{"role": "user", "content": "x"}],
+            "tool_choice": "required",
+        })
+        assert status == 400
+        # 'required' with several tools: rejected, never tools[0] silently.
+        status, _ = await _post(server, {
+            "model": "tiny-llama",
+            "messages": [{"role": "user", "content": "x"}],
+            "tools": TOOLS + [{
+                "type": "function", "function": {"name": "other"}}],
+            "tool_choice": "required",
+        })
+        assert status == 400
+    finally:
+        await server.close()
+
+
+async def test_tiny_budget_surfaces_truncation_not_bogus_tool_call():
+    server = await _server()
+    try:
+        status, body = await _post(server, {
+            "model": "tiny-llama", "max_tokens": 1,
+            "messages": [{"role": "user", "content": "weather?"}],
+            "tools": TOOLS, "tool_choice": "required",
+        })
+        assert status == 200
+        choice = body["choices"][0]
+        assert "tool_calls" not in choice["message"]
+        assert choice["finish_reason"] == "length"
+    finally:
+        await server.close()
