@@ -1,0 +1,78 @@
+"""Property tests: the guided-decoding automaton vs Python's json module.
+
+For randomly generated JSON objects, the automaton must accept the exact
+serialization (ending in DONE); for random single-character corruptions
+that json.loads rejects, the automaton must reject too (no false
+accepts).  Divergence in either direction would mean guided decoding can
+emit unparseable output or needlessly forbid valid JSON.
+"""
+
+import json
+import random
+import string
+
+from production_stack_tpu.engine.guided import (
+    DONE,
+    advance_bytes,
+    initial_state,
+)
+
+
+def random_value(rng, depth=0):
+    kinds = ["str", "int", "float", "bool", "null"]
+    if depth < 3:
+        kinds += ["obj", "arr", "obj"]
+    kind = rng.choice(kinds)
+    if kind == "str":
+        n = rng.randrange(0, 12)
+        alphabet = string.ascii_letters + string.digits + ' .,:;{}[]"\\/\n\té中'
+        return "".join(rng.choice(alphabet) for _ in range(n))
+    if kind == "int":
+        return rng.randrange(-10**9, 10**9)
+    if kind == "float":
+        return rng.choice([0.5, -2.25e10, 1e-3, 3.14159, -0.0])
+    if kind == "bool":
+        return rng.choice([True, False])
+    if kind == "null":
+        return None
+    if kind == "arr":
+        return [random_value(rng, depth + 1) for _ in range(rng.randrange(0, 4))]
+    return {
+        f"k{j}_{rng.randrange(100)}": random_value(rng, depth + 1)
+        for j in range(rng.randrange(0, 4))
+    }
+
+
+def accepts(text: str) -> bool:
+    state = advance_bytes(initial_state(True), text.encode("utf-8"))
+    return state is not None and state.mode == DONE
+
+
+def test_accepts_every_json_dumps_serialization():
+    rng = random.Random(7)
+    for i in range(300):
+        obj = {f"root{i}": random_value(rng)}
+        for kwargs in ({}, {"indent": 2}, {"separators": (",", ":")},
+                       {"ensure_ascii": False}):
+            s = json.dumps(obj, **kwargs)
+            assert accepts(s), f"rejected valid JSON: {s[:120]!r}"
+
+
+def test_no_false_accepts_on_corruptions():
+    """Single-character corruptions: whenever the automaton accepts, the
+    string must be real JSON (the automaton may be STRICTER than
+    json.loads — e.g. json accepts NaN — but never looser)."""
+    rng = random.Random(11)
+    for i in range(200):
+        s = json.dumps({f"k{i}": random_value(rng)})
+        pos = rng.randrange(len(s))
+        corrupted = s[:pos] + rng.choice("{}[]\",:x0") + s[pos + 1:]
+        if accepts(corrupted):
+            obj = json.loads(corrupted)  # must parse if we accept it
+            assert isinstance(obj, dict)
+
+
+def test_non_object_top_level_rejected():
+    for s in ("[1]", '"str"', "17", "true", "null", "1.5"):
+        assert not accepts(s)
+        assert json.loads(s) is not None or s == "null"  # valid JSON though
