@@ -102,7 +102,19 @@ async def run_serving_bench(
             duration=duration,
             warmup_requests=warmup_requests,
         ))
-        return result["summary"]
+        summary = result["summary"]
+        # Engine-side context for the driver artifact — CUMULATIVE
+        # counters only (run-level meaning): preemptions force KV offload
+        # round-trips, prefix hits shorten prefills.  Gauges (duty cycle,
+        # HBM usage) are trailing-window snapshots that read near-idle
+        # after the drain, so they'd mislead here.
+        es = engine.stats()
+        summary["engine"] = {
+            "prefix_cache_hit_rate": round(es["prefix_cache_hit_rate"], 4),
+            "num_preemptions": es["num_preemptions"],
+            "total_generated_tokens": es["total_generated_tokens"],
+        }
+        return summary
     finally:
         await router_runner.cleanup()
         await engine_runner.cleanup()
