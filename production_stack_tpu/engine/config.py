@@ -289,6 +289,23 @@ class SchedulerConfig:
     # of up to N-1 wasted tokens past a stop condition (truncated on the
     # host, never surfaced).  1 = classic one-token steps.
     num_scheduler_steps: int = 1
+    # N-gram (prompt-lookup) speculative decoding: draft K tokens by
+    # matching the sequence's own trailing bigram against its history and
+    # verify them in ONE forward (the K+1 rows share the step's weight
+    # streaming, so accepted drafts are nearly free on an HBM-bound
+    # decode).  Greedy-only; batches with sampling/penalties/logprobs/
+    # bias/guided members fall back to classic stepping.  0 = off.
+    # Mutually exclusive with num_scheduler_steps > 1.
+    speculative_ngram: int = 0
+
+    def __post_init__(self):
+        if self.speculative_ngram and self.num_scheduler_steps > 1:
+            raise ValueError(
+                "speculative_ngram and num_scheduler_steps > 1 are mutually "
+                "exclusive (both widen the per-dispatch token window)"
+            )
+        if self.speculative_ngram < 0:
+            raise ValueError("speculative_ngram must be >= 0")
 
 
 @dataclasses.dataclass
@@ -337,4 +354,12 @@ def config_from_preset(name: str, **overrides) -> EngineConfig:
         for part in path:
             obj = getattr(obj, part)
         setattr(obj, last, value)
+    # setattr bypasses dataclass validation: re-run every sub-config's
+    # __post_init__ so invalid override COMBINATIONS (e.g. speculative +
+    # multi-step, disagg without a store URL) fail at construction, not
+    # as undefined runtime behavior.
+    for sub in (cfg.model, cfg.cache, cfg.scheduler, cfg.parallel, cfg.lora):
+        post = getattr(sub, "__post_init__", None)
+        if post is not None:
+            post()
     return cfg

@@ -260,10 +260,15 @@ class Scheduler:
         )
 
     def _step_budget(self, seq: Sequence) -> int:
-        """Decode iterations this sequence can run in one multi-step plan:
-        bounded by max_model_len and the request's max_tokens (stop/EOS cut
-        shorter on the host — those tokens are computed and discarded)."""
-        n = self.config.num_scheduler_steps
+        """Decode iterations this sequence can run in one multi-step (or
+        speculative) plan: bounded by max_model_len and the request's
+        max_tokens (stop/EOS cut shorter on the host — those tokens are
+        computed and discarded)."""
+        n = max(
+            self.config.num_scheduler_steps,
+            # K drafts + the bonus token per dispatch.
+            self.config.speculative_ngram + 1,
+        )
         room_len = self.config.max_model_len - seq.num_tokens
         room_out = seq.sampling_params.max_tokens - seq.num_generated
         return max(1, min(n, room_len, room_out))

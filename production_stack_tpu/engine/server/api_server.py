@@ -1059,6 +1059,14 @@ def main(argv=None) -> None:
         "largest bucket run as chunked prefill)",
     )
     parser.add_argument(
+        "--speculative-ngram",
+        type=int,
+        default=0,
+        help="n-gram (prompt-lookup) speculative decoding: draft K tokens "
+        "from the sequence's own history and verify in one forward "
+        "(greedy-only; mutually exclusive with --num-scheduler-steps > 1)",
+    )
+    parser.add_argument(
         "--num-scheduler-steps",
         type=int,
         default=1,
@@ -1122,6 +1130,7 @@ def main(argv=None) -> None:
                 else {}
             ),
             "scheduler.num_scheduler_steps": args.num_scheduler_steps,
+            "scheduler.speculative_ngram": args.speculative_ngram,
             "cache.block_size": args.block_size,
             "cache.num_blocks": args.num_blocks,
             "cache.host_offload_gb": args.host_offload_gb,
