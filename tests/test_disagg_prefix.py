@@ -175,6 +175,50 @@ def test_store_outage_degrades_gracefully(kv_port):
     assert engine.remote_prefix_blocks_fetched == 0
 
 
+def test_disagg_through_native_cpp_kvserver(tmp_path):
+    """The production tier: the same export/import flow over the C++
+    epoll server (native/kvserver) instead of the Python asyncio twin —
+    the wire protocol and content keys must be implementation-agnostic."""
+    import shutil
+    import subprocess
+    from pathlib import Path
+
+    import pytest
+
+    native_dir = Path(__file__).resolve().parent.parent / "native" / "kvserver"
+    if shutil.which("g++") is None or shutil.which("make") is None:
+        pytest.skip("no C++ toolchain")
+    build = subprocess.run(
+        ["make", "-C", str(native_dir)], capture_output=True, text=True
+    )
+    if build.returncode != 0:
+        pytest.fail(f"native kvserver build failed:\n{build.stderr}")
+    proc = subprocess.Popen(
+        [str(native_dir / "kvserver"), "--host", "127.0.0.1", "--port", "0",
+         "--capacity-gb", "0.0625"],
+        stdout=subprocess.PIPE,
+        text=True,
+    )
+    try:
+        line = proc.stdout.readline().strip()
+        assert line.startswith("LISTENING "), line
+        port = int(line.split()[1])
+
+        producer = make_engine("prefill", port)
+        out_a = drain(producer, "a", close=False)
+        producer.flush_prefix_exports()
+        producer.offload.remote_client.close()
+        assert producer.remote_prefix_blocks_exported > 0
+
+        consumer = make_engine("decode", port)
+        out_b = drain(consumer, "b")
+        assert consumer.remote_prefix_blocks_fetched > 0
+        assert out_b == out_a
+    finally:
+        proc.terminate()
+        proc.wait(timeout=5)
+
+
 def test_disagg_role_requires_remote_url():
     with pytest.raises(ValueError, match="remote_kv_url"):
         CacheConfig(disagg_role="prefill")
