@@ -467,13 +467,18 @@ class LLMEngine:
 
     def _seq_prefix_hashes(self, seq) -> List[bytes]:
         """Per-sequence memo: the chain is O(prompt) blake2b work and the
-        scheduler may retry admission many times."""
-        if getattr(seq, "_px_hashes", None) is None:
+        scheduler may retry admission many times.  Keyed on the prompt
+        length so recompute-preemption (which absorbs generated tokens
+        into prompt_token_ids) invalidates the memo and the absorbed
+        blocks become export/fetch-able too."""
+        key = len(seq.prompt_token_ids)
+        if getattr(seq, "_px_hashes_key", None) != key:
             seq._px_hashes = prefix_block_hashes(
                 seq.prompt_token_ids,
                 self.block_pool.block_size,
                 namespace=seq.cache_ns,
             )
+            seq._px_hashes_key = key
         return seq._px_hashes
 
     def fetch_remote_prefix(self, seq, prefix_blocks, cached_len):
@@ -509,7 +514,13 @@ class LLMEngine:
                 fetched.append(layers)
             if not fetched or not self.block_pool.can_allocate(len(fetched)):
                 return prefix_blocks, cached_len
-            ids = self.block_pool.allocate(len(fetched))
+        except Exception:
+            # Includes a store outage mid-chain: degrade, never kill the
+            # step loop.
+            logger.exception("remote prefix fetch failed; continuing local")
+            return prefix_blocks, cached_len
+        ids = self.block_pool.allocate(len(fetched))
+        try:
             idx = jnp.asarray(ids, jnp.int32)
             for layer_idx, (k_cache, v_cache) in enumerate(self.kv_caches):
                 k_host = np.stack([f[layer_idx][0][0] for f in fetched])
@@ -522,9 +533,13 @@ class LLMEngine:
                 )
                 self.kv_caches[layer_idx] = (k_cache, v_cache)
         except Exception:
-            # Includes shape mismatches from a store polluted by another
-            # binary version: degrade, never kill the step loop.
-            logger.exception("remote prefix fetch failed; continuing local")
+            # A malformed entry (wrong layer count / block shape — a store
+            # polluted by another binary version) fails here: return the
+            # blocks to the pool (partially written cache lines are
+            # unreferenced until a block_table points at them, so freeing
+            # is safe) and degrade to local-only prefill.
+            self.block_pool.free(ids)
+            logger.exception("remote prefix copy-in failed; continuing local")
             return prefix_blocks, cached_len
         self.remote_prefix_blocks_fetched += len(ids)
         return prefix_blocks + ids, cached_len + len(ids) * bs
@@ -1217,7 +1232,7 @@ class LLMEngine:
             else:
                 finish = self._check_finish(seq, token_id)
             if finish is not None:
-                self._finish_seq_now(seq, finish)
+                finish = self._finish_seq_now(seq, finish)
             outputs.append(
                 StepOutput(
                     seq_id=seq.seq_id,
@@ -1232,14 +1247,37 @@ class LLMEngine:
             )
         return outputs
 
-    def _finish_seq_now(self, seq: Sequence, reason: FinishReason) -> None:
+    def _finish_seq_now(
+        self, seq: Sequence, reason: FinishReason
+    ) -> FinishReason:
         """The single finish protocol: scheduler release + prefix-cache
-        registration, offload cleanup, counters, registry removal."""
+        registration, offload cleanup, counters, registry removal.
+        Returns the final reason (guided re-validation may rewrite it);
+        callers must surface the returned value, not their local one."""
+        if (
+            reason == FinishReason.STOP
+            and seq.guide is not None
+            and seq.sampling_params.response_format == "json_object"
+        ):
+            # The automaton validated per-token text from decode([id]);
+            # re-validate the assembled text, which is the ground truth
+            # the client receives.
+            import json as _json
+
+            try:
+                _json.loads(self.tokenizer.decode(seq.output_token_ids))
+            except Exception:
+                logger.warning(
+                    "guided json output failed final parse for %s",
+                    seq.seq_id,
+                )
+                reason = FinishReason.GUIDED_INVALID
         seq.finish_reason = reason
         self.scheduler.finish_seq(seq)
         self.offload.discard(seq.seq_id)
         self.total_finished += 1
         self._seqs.pop(seq.seq_id, None)
+        return reason
 
     def _check_finish(self, seq: Sequence, token_id: int) -> Optional[FinishReason]:
         sp = seq.sampling_params

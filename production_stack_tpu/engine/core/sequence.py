@@ -19,6 +19,12 @@ class FinishReason(enum.Enum):
     STOP = "stop"  # EOS or stop string
     LENGTH = "length"
     ABORT = "abort"
+    # response_format json_object whose assembled text failed the final
+    # json.loads re-check (single-token decode() need not equal a token's
+    # in-context byte contribution for sentencepiece/byte-BPE vocabs, so
+    # the automaton can diverge from the emitted text; the finish-time
+    # re-validation makes that divergence visible instead of silent).
+    GUIDED_INVALID = "guided_invalid"
 
 
 @dataclasses.dataclass

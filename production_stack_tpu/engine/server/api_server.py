@@ -548,12 +548,12 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
                         )
                         first[i] = False
                     if stopped or event.finished:
-                        reason = (
-                            "stop"
-                            if stopped
-                            or event.finish_reason == FinishReason.STOP
-                            else "length"
-                        )
+                        if stopped or event.finish_reason == FinishReason.STOP:
+                            reason = "stop"
+                        elif event.finish_reason == FinishReason.GUIDED_INVALID:
+                            reason = "guided_invalid"
+                        else:
+                            reason = "length"
                         if stopped and not event.finished:
                             # Abort emits no further events, so this pump
                             # will never send its sentinel: retire the
@@ -616,10 +616,12 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
                 if event.finished:
                     text_parts.append(checker.flush())
                     out_tokens = event.num_output_tokens
-                    finish_reason = (
-                        "stop" if event.finish_reason == FinishReason.STOP
-                        else "length"
-                    )
+                    if event.finish_reason == FinishReason.STOP:
+                        finish_reason = "stop"
+                    elif event.finish_reason == FinishReason.GUIDED_INVALID:
+                        finish_reason = "guided_invalid"
+                    else:
+                        finish_reason = "length"
                     break
             return ("".join(text_parts), logprob_entries, finish_reason,
                     out_tokens, prompt_lp)
@@ -779,7 +781,8 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
                 status=400,
             )
         try:
-            vectors, total_tokens = await _embed_texts(inputs)
+            vectors, token_counts = await _embed_texts(inputs)
+            total_tokens = sum(token_counts)
         except ValueError as e:
             # Over-long input, or a model without an encode path.
             return web.json_response(
@@ -810,12 +813,12 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
         path — callers map that to a 400.
         """
         tokenizer = engine.engine.tokenizer
-        vectors, total_tokens = [], 0
+        vectors, token_counts = [], []
         for text in texts:
             ids = tokenizer.encode(text)
-            total_tokens += len(ids)
+            token_counts.append(len(ids))
             vectors.append(await asyncio.to_thread(engine.engine.embed, ids))
-        return vectors, total_tokens
+        return vectors, token_counts
 
     def _dot(a, b) -> float:
         return float(np.dot(a, b))
@@ -862,7 +865,8 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
                 status=400,
             )
         try:
-            vectors, total_tokens = await _embed_texts([query] + documents)
+            vectors, token_counts = await _embed_texts([query] + documents)
+            total_tokens = sum(token_counts)
         except ValueError as e:
             return web.json_response(
                 {"error": {"message": str(e), "type": "invalid_request_error"}},
@@ -935,13 +939,19 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
             # Embed each distinct text once: a broadcast text_1 would
             # otherwise re-run the device forward per pair.
             distinct = list(dict.fromkeys(t1 + t2))
-            vectors, total_tokens = await _embed_texts(distinct)
+            vectors, token_counts = await _embed_texts(distinct)
         except ValueError as e:
             return web.json_response(
                 {"error": {"message": str(e), "type": "invalid_request_error"}},
                 status=400,
             )
         by_text = dict(zip(distinct, vectors))
+        tokens_by_text = dict(zip(distinct, token_counts))
+        # Usage reflects the logical pairs (per-pair accounting), even
+        # though broadcast texts are embedded once.
+        total_tokens = sum(
+            tokens_by_text[a] + tokens_by_text[b] for a, b in zip(t1, t2)
+        )
         data = [
             {"object": "score", "index": i,
              "score": _dot(by_text[a], by_text[b])}

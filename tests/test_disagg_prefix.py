@@ -219,6 +219,60 @@ def test_disagg_through_native_cpp_kvserver(tmp_path):
         proc.wait(timeout=5)
 
 
+def test_malformed_store_entry_leaks_no_blocks(kv_port):
+    """A polluted store (wrong layer count / block shape) must degrade to
+    local-only prefill WITHOUT leaking pool blocks — host arrays are
+    validated before allocation (advisor r4 finding)."""
+    import numpy as np
+
+    engine = make_engine("decode", kv_port)
+    engine.offload.remote_client.close()
+
+    class PollutedClient:
+        def get_blocks(self, key):
+            # One bogus layer where the model has many: np.stack over
+            # layer_idx > 0 raises IndexError during validation.
+            bad = np.zeros((1, 2, 2), np.float32)
+            return ([(bad, bad)], 4)
+
+        def close(self):
+            pass
+
+    engine.offload.remote_client = PollutedClient()
+    engine.add_request("r", prompt=PROMPT,
+                       sampling_params=SamplingParams(max_tokens=2))
+    seq = engine.scheduler.waiting[0]
+    free_before = engine.block_pool.num_free_blocks
+    blocks, cached = engine.fetch_remote_prefix(seq, [], 0)
+    assert (blocks, cached) == ([], 0)
+    assert engine.block_pool.num_free_blocks == free_before
+    assert engine.remote_prefix_blocks_fetched == 0
+    # And the engine still serves the request (local prefill).
+    tokens = []
+    while engine.has_unfinished():
+        for out in engine.step():
+            tokens.append(out.new_token_id)
+    assert len(tokens) == 2
+
+
+def test_prefix_hash_memo_invalidated_on_prompt_growth(kv_port):
+    """Recompute-preemption absorbs generated tokens into
+    prompt_token_ids; the per-seq hash memo must follow (advisor r4)."""
+    engine = make_engine("decode", kv_port)
+    engine.offload.remote_client.close()
+    engine.offload.remote_client = None
+    engine.add_request("r", prompt=PROMPT,
+                       sampling_params=SamplingParams(max_tokens=2))
+    seq = engine.scheduler.waiting[0]
+    h1 = engine._seq_prefix_hashes(seq)
+    assert engine._seq_prefix_hashes(seq) is h1  # memo hit
+    seq.prompt_token_ids = list(seq.prompt_token_ids) + [7, 8, 9, 10]
+    h2 = engine._seq_prefix_hashes(seq)
+    assert h2 is not h1
+    assert len(h2) >= len(h1)
+    assert h2[: len(h1)] == h1  # chain prefix property preserved
+
+
 def test_disagg_role_requires_remote_url():
     with pytest.raises(ValueError, match="remote_kv_url"):
         CacheConfig(disagg_role="prefill")

@@ -149,3 +149,26 @@ async def test_response_format_through_api():
                 assert resp.status == 400
     finally:
         await server.close()
+
+
+def test_guided_finish_revalidates_assembled_text(monkeypatch):
+    """Per-token validation uses decode([id]), whose concatenation need
+    not equal the assembled decode() for sentencepiece/byte-BPE vocabs;
+    the finish-time re-check must surface the divergence as
+    finish_reason=guided_invalid instead of returning non-JSON under a
+    json_object contract (advisor r4 finding)."""
+    engine = make_engine()
+    orig_decode = engine.tokenizer.decode
+
+    def corrupting_decode(ids, *args, **kwargs):
+        # Single-token calls (TokenTextCache) see the real text; the
+        # finish-time assembled decode sees a divergent string.
+        if hasattr(ids, "__len__") and len(ids) > 1:
+            return "not json {"
+        return orig_decode(ids, *args, **kwargs)
+
+    monkeypatch.setattr(engine.tokenizer, "decode", corrupting_decode)
+    _, finish = drain(engine, SamplingParams(
+        max_tokens=120, temperature=0.0, response_format="json_object",
+    ))
+    assert finish == FinishReason.GUIDED_INVALID

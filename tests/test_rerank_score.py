@@ -154,3 +154,30 @@ async def test_rerank_proxied_through_router():
         await client.close()
         await router.close()
         await engine_server.close()
+
+
+async def test_score_broadcast_usage_counts_pairs():
+    """Usage reflects the logical pairs, not the deduped embed set: a
+    1-to-N broadcast of identical texts must report N× the single-pair
+    token count (advisor r4 finding)."""
+    server = await _engine_server()
+    url = f"http://127.0.0.1:{server.port}"
+    try:
+        async with aiohttp.ClientSession() as session:
+            async with session.post(f"{url}/score", json={
+                "text_1": "alpha beta gamma",
+                "text_2": ["delta epsilon"],
+            }) as resp:
+                assert resp.status == 200
+                single = await resp.json()
+            async with session.post(f"{url}/score", json={
+                "text_1": "alpha beta gamma",
+                "text_2": ["delta epsilon", "delta epsilon"],
+            }) as resp:
+                assert resp.status == 200
+                double = await resp.json()
+        assert len(double["data"]) == 2
+        assert (double["usage"]["prompt_tokens"]
+                == 2 * single["usage"]["prompt_tokens"])
+    finally:
+        await server.close()
