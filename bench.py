@@ -42,8 +42,91 @@ def log(msg: str) -> None:
     print(f"[{time.time() - _T0:6.1f}s] {msg}", file=sys.stderr, flush=True)
 
 
-_ATTEMPT_ENV = "PSTPU_BENCH_INIT_ATTEMPT"
 _FALLBACK_ENV = "PSTPU_BENCH_TPU_UNAVAILABLE"
+
+# Backoff schedule for TPU probe attempts: the r04 tunnel outage outlived
+# 2x150s, so wait minutes, not seconds, before concluding the chip is
+# gone (~13 min worst case; each attempt is a throwaway subprocess, so a
+# hang costs a kill, never the bench process).
+_PROBE_SCHEDULE = (120.0, 240.0, 420.0)
+
+_PROBE_CODE = r"""
+import sys
+def say(stage):
+    print("STAGE " + stage, flush=True)
+say("import_jax")
+import jax
+say("enumerate_devices")
+devs = jax.devices()
+say("tiny_matmul")
+import jax.numpy as jnp
+x = jnp.ones((128, 128), jnp.bfloat16)
+(x @ x).block_until_ready()
+print("OK " + jax.default_backend() + " " + devs[0].device_kind, flush=True)
+"""
+
+
+def probe_tpu_subprocess(schedule=_PROBE_SCHEDULE):
+    """Stage-attributed TPU liveness probe in throwaway subprocesses.
+
+    Runs import -> device enumerate -> tiny compiled matmul in a child
+    process per attempt; a hang is killed at the attempt's timeout and
+    recorded with the stage it died in.  The per-attempt log lands in
+    the JSON artifact, so an environment fault (tunnel down — r04's
+    mode: jax.devices() hangs forever) is provable from the artifact
+    alone and distinguishable from a builder regression.  Returns
+    {"ok": bool, "backend": str|None, "attempts": [...]}.
+    """
+    import os
+    import subprocess
+
+    attempts = []
+    for attempt, timeout_s in enumerate(schedule, 1):
+        t0 = time.time()
+        stage, outcome, err = "spawn", "hang", ""
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", _PROBE_CODE],
+                capture_output=True, text=True, timeout=timeout_s,
+                env=dict(os.environ),
+            )
+            stages = [
+                ln.split(" ", 1)[1] for ln in proc.stdout.splitlines()
+                if ln.startswith("STAGE ")
+            ]
+            stage = stages[-1] if stages else "spawn"
+            ok_line = [
+                ln for ln in proc.stdout.splitlines() if ln.startswith("OK ")
+            ]
+            if proc.returncode == 0 and ok_line:
+                backend = ok_line[0].split()[1]
+                attempts.append({
+                    "attempt": attempt, "outcome": "ok",
+                    "waited_s": round(time.time() - t0, 1),
+                    "backend": backend,
+                    "device": ok_line[0].split(maxsplit=2)[2],
+                })
+                log(f"probe: {backend} up in {time.time()-t0:.1f}s "
+                    f"(attempt {attempt})")
+                return {"ok": True, "backend": backend, "attempts": attempts}
+            outcome, err = "error", (proc.stderr or "").strip()[-300:]
+        except subprocess.TimeoutExpired as e:
+            out = e.stdout or b""
+            if isinstance(out, bytes):  # TimeoutExpired ignores text=True
+                out = out.decode(errors="replace")
+            stages = [
+                ln.split(" ", 1)[1] for ln in out.splitlines()
+                if ln.startswith("STAGE ")
+            ]
+            stage = stages[-1] if stages else "spawn"
+        attempts.append({
+            "attempt": attempt, "stage": stage, "outcome": outcome,
+            "waited_s": round(time.time() - t0, 1),
+            **({"error": err} if err else {}),
+        })
+        log(f"probe: attempt {attempt} {outcome} at stage={stage} "
+            f"after {time.time()-t0:.1f}s")
+    return {"ok": False, "backend": None, "attempts": attempts}
 
 
 def _reexec(extra_env: dict) -> None:
@@ -54,33 +137,27 @@ def _reexec(extra_env: dict) -> None:
     os.execve(sys.executable, [sys.executable] + sys.argv, env)
 
 
-def init_backend_or_fallback(timeout_s: float = 150.0, attempts: int = 2) -> str:
-    """Initialize jax IN-PROCESS, surviving a hung or dead TPU tunnel.
+def init_backend_or_fallback(timeout_s: float = 180.0) -> str:
+    """Initialize jax IN-PROCESS after a successful probe.
 
-    BENCH_r02 died with rc=1 at jax.default_backend() (UNAVAILABLE), and a
-    bare jax.devices() can simply hang on the tunnel.  A watchdog thread
-    re-execs this script if init doesn't finish in time; a fast UNAVAILABLE
-    retries with backoff, then re-execs pinned to CPU so the bench always
-    emits its one JSON line.  Healthy runs pay zero extra init.
+    Second line of defense: the probe subprocess said the TPU was up,
+    but the tunnel can die between probe and init — a watchdog re-execs
+    this script pinned to CPU if in-process init stalls, so the bench
+    always emits its one JSON line.
     """
     import os
     import threading
 
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         return "cpu"
-    attempt = int(os.environ.get(_ATTEMPT_ENV, "1"))
     done = threading.Event()
 
     def watchdog():
         if done.wait(timeout_s):
             return
-        if attempt < attempts:
-            log(f"init: hung >{timeout_s:.0f}s; re-exec attempt {attempt + 1}")
-            _reexec({_ATTEMPT_ENV: str(attempt + 1)})
-        else:
-            log("init: TPU unreachable after retries — re-exec on CPU "
-                "(vs_baseline will be 0; no roofline claim)")
-            _reexec({"JAX_PLATFORMS": "cpu", _FALLBACK_ENV: "1"})
+        log(f"init: hung >{timeout_s:.0f}s AFTER a successful probe — "
+            "re-exec on CPU")
+        _reexec({"JAX_PLATFORMS": "cpu", _FALLBACK_ENV: "1"})
 
     threading.Thread(target=watchdog, daemon=True).start()
     try:
@@ -91,12 +168,8 @@ def init_backend_or_fallback(timeout_s: float = 150.0, attempts: int = 2) -> str
         return backend
     except Exception as e:
         done.set()
-        log(f"init: backend init failed: {e}")
-        if attempt < attempts:
-            time.sleep(10.0 * attempt)
-            _reexec({_ATTEMPT_ENV: str(attempt + 1)})
-        else:
-            _reexec({"JAX_PLATFORMS": "cpu", _FALLBACK_ENV: "1"})
+        log(f"init: backend init failed after successful probe: {e}")
+        _reexec({"JAX_PLATFORMS": "cpu", _FALLBACK_ENV: "1"})
         raise  # unreachable (execve does not return)
 
 
@@ -149,6 +222,36 @@ def diff_time(make_fn, n1, n2, *args, repeats=3):
     return max((t2 - t1) / (n2 - n1), 1e-9)
 
 
+def fit_time(make_fn, ns, *args, repeats=3):
+    """Per-iteration time via a least-squares fit of T(n) over several
+    chain lengths, plus an absolute estimate from the longest chain.
+
+    The 2-point diff (r03's method) is exposed to tunnel-RTT noise in
+    BOTH endpoints; with a per-step time of ~10 ms a 30 ms swing between
+    best-of-3 samples moves the diff by ~2 ms/step — enough to "beat the
+    roofline" (r03: measured 7.48 ms vs a 10.1 ms bandwidth bound).  The
+    fit averages the noise over len(ns) points; T(max_n)/max_n bounds the
+    answer from above (dispatch+RTT amortized over the longest chain can
+    only over-estimate the per-step time).  Disagreement between the two
+    beyond the RTT budget marks the measurement suspect in the artifact.
+    """
+    ts = {n: timed(make_fn(n), *args, repeats=repeats) for n in ns}
+    xs = np.asarray(sorted(ts), np.float64)
+    ys = np.asarray([ts[n] for n in sorted(ts)], np.float64)
+    slope, intercept = np.polyfit(xs, ys, 1)
+    pred = slope * xs + intercept
+    ss_res = float(((ys - pred) ** 2).sum())
+    ss_tot = float(((ys - ys.mean()) ** 2).sum())
+    n_max = int(xs[-1])
+    return {
+        "per_iter_s": max(float(slope), 1e-9),
+        "intercept_ms": round(float(intercept) * 1e3, 2),
+        "r2": round(1.0 - ss_res / ss_tot, 5) if ss_tot > 0 else 1.0,
+        "abs_per_iter_s": ts[n_max] / n_max,
+        "points": {int(n): round(ts[n] * 1e3, 2) for n in sorted(ts)},
+    }
+
+
 # -- microbenches ----------------------------------------------------------
 
 
@@ -188,6 +291,32 @@ def bench_hbm_gbs(jax, jnp, on_tpu=True):
     dt = diff_time(mk, 4, 24, x, y)
     nbytes = 3 * x.size * 2  # read c, read y, write c
     return nbytes / dt / 1e9
+
+
+def bench_hbm_read_gbs(jax, jnp, on_tpu=True):
+    """Achievable WEIGHT-STREAMING read bandwidth: a small activation
+    [8, N] times a large loop-invariant matrix [N, N], output feeding
+    input.  This is decode's dominant memory pattern (read N^2 weight
+    bytes per step, negligible writes), so it is the honest ceiling for
+    the decode roofline — the triad bench above pays write traffic that
+    decode does not, and read-only streaming usually runs faster.  The
+    carried activation defeats loop-invariant hoisting; tanh blocks any
+    algebraic refactor of the chain."""
+    n_dim = 8192 if on_tpu else 1024
+    m = jax.random.normal(jax.random.PRNGKey(3), (n_dim, n_dim), jnp.bfloat16)
+    v = jax.random.normal(jax.random.PRNGKey(4), (8, n_dim), jnp.bfloat16)
+
+    def mk(n):
+        @jax.jit
+        def f(v, m):
+            def body(i, c):
+                return jnp.tanh(c @ m)
+            return jax.lax.fori_loop(0, n, body, v).sum()
+
+        return f
+
+    dt = diff_time(mk, 4, 24, v, m)
+    return m.size * 2 / dt / 1e9
 
 
 # -- model-level benches ---------------------------------------------------
@@ -239,15 +368,15 @@ def bench_prefill(jax, jnp, cfg, params, kv_caches, bucket, block_size):
     return diff_time(mk, 1, 5, params, tokens, kv_caches)
 
 
-def bench_decode(jax, jnp, cfg, params, kv_caches, S, ctx_len, bmax, block_size):
-    """Per-step decode time, batch S, every sequence at ctx_len context."""
+def make_decode_bench(jax, jnp, cfg, S, ctx_len, bmax, block_size, total_blocks):
+    """Build the chained decode executable factory (see bench_decode)."""
     from production_stack_tpu.engine.models import llama
 
     bs = block_size
     nb = -(-ctx_len // bs)
     tables = np.zeros((S, bmax), np.int32)
     nf = 1
-    total = kv_caches[0][0].shape[0]
+    total = total_blocks
     for s in range(S):
         ids = (np.arange(nf, nf + nb) - 1) % (total - 1) + 1
         tables[s, :nb] = ids
@@ -280,6 +409,14 @@ def bench_decode(jax, jnp, cfg, params, kv_caches, S, ctx_len, bmax, block_size)
 
         return f
 
+    return mk
+
+
+def bench_decode(jax, jnp, cfg, params, kv_caches, S, ctx_len, bmax, block_size):
+    """Per-step decode time, batch S, every sequence at ctx_len context."""
+    mk = make_decode_bench(
+        jax, jnp, cfg, S, ctx_len, bmax, block_size, kv_caches[0][0].shape[0]
+    )
     return diff_time(mk, 4, 20, params, kv_caches)
 
 
@@ -297,6 +434,57 @@ def approx_param_count(cfg) -> int:
     per_layer = h * H * hd + 2 * h * K * hd + H * hd * h + 3 * h * I + 2 * h
     embed = V * h * (1 if cfg.tie_word_embeddings else 2)
     return L * per_layer + embed + h
+
+
+def _run_serving_phase(args) -> dict:
+    """North-star serving metrics (BASELINE.md): multi-round QA through
+    the REAL stack — engine api_server process -> router process -> the
+    multi-round-QA harness over HTTP (the actual instrument; round-4
+    verdict weak #3).  Runs before this process touches the accelerator
+    so the engine subprocess can own it."""
+    import importlib.util
+    import os as _os
+
+    try:
+        spec = importlib.util.spec_from_file_location(
+            "serving_bench",
+            _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
+                          "benchmarks", "serving_bench.py"),
+        )
+        serving_bench = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(serving_bench)
+        from production_stack_tpu.engine.config import PRESETS
+
+        on_tpu = _os.environ.get("JAX_PLATFORMS") != "cpu"
+        preset = args.preset or ("llama-3.2-3b" if on_tpu else "tiny-llama")
+        cfg = PRESETS[preset]
+        # Scale the workload's prompt sizes to the serving context: the
+        # byte-fallback tokenizer yields ~3 tokens per word, so nominal
+        # 600-word prompts reach ~3.7k tokens — fine under the 8k presets
+        # (capped 4096) but overflowing a 2048-context fallback preset.
+        serving_len = min(cfg.max_model_len, 4096)
+        # //10 leaves headroom for chat framing + 3 rounds of history
+        # growth at the byte tokenizer's ~3 tokens/word.
+        plen = min(600, serving_len // 10)
+        log("serving bench: booting engine + router processes ...")
+        summary = serving_bench.run_serving_bench_processes_sync(
+            preset=preset,
+            num_users=6, num_rounds=3, qps=2.0,
+            system_prompt_len=plen, user_info_len=plen, answer_len=48,
+            max_num_seqs=args.batch,
+            max_model_len=serving_len,
+            num_scheduler_steps=args.serving_scheduler_steps,
+            boot_timeout_s=300.0,
+        )
+        log(f"serving: ttft_p50={summary.get('ttft_p50_s')}s "
+            f"out_tok/s={summary.get('output_tokens_per_s')} "
+            f"kv_hit={summary.get('kv_hit_rate')} "
+            f"failed={summary.get('requests_failed')}")
+        return summary
+    except Exception as e:
+        # The kernel benches are still valid; record the failure.
+        log(f"serving bench failed: {e}")
+        return {"error": str(e)[:200]}
 
 
 def main() -> None:
@@ -321,8 +509,30 @@ def main() -> None:
 
     import os
 
-    # Initialize the backend with hang/crash protection: a dead TPU tunnel
-    # re-execs this script pinned to CPU instead of exiting rc!=0.
+    # Phase 0: stage-attributed liveness probe in throwaway subprocesses.
+    # A dead tunnel pins the rest of the run (this process AND children)
+    # to CPU instead of hanging or exiting rc!=0.
+    probe_attempts = []
+    if os.environ.get("JAX_PLATFORMS") != "cpu":
+        probe = probe_tpu_subprocess()
+        probe_attempts = probe["attempts"]
+        if not probe["ok"]:
+            log("probe: TPU unreachable — pinning run to CPU "
+                "(vs_baseline will be 0; no roofline claim)")
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            os.environ[_FALLBACK_ENV] = "1"
+
+    # Phase 1 (before THIS process claims the chip): the north-star
+    # serving bench with REAL process boundaries — engine server process
+    # + router process + the multi-round-QA harness over HTTP.  Must run
+    # first because the engine subprocess needs the TPU, and a PJRT
+    # client in this process would hold it.
+    serving_summary = None
+    if not args.quick:
+        serving_summary = _run_serving_phase(args)
+
+    # Initialize the backend with hang/crash protection: the tunnel can
+    # die between probe and init; a stall re-execs pinned to CPU.
     init_backend_or_fallback()
 
     import jax
@@ -352,12 +562,18 @@ def main() -> None:
               "ctx": args.ctx}
     if tpu_unavailable:
         detail["tpu_unavailable"] = True
+    if probe_attempts:
+        detail["init_attempts"] = probe_attempts
+    if serving_summary is not None:
+        detail["serving"] = serving_summary
 
     if not args.quick:
         detail["matmul_tflops"] = round(bench_matmul_tfs(jax, jnp, on_tpu), 1)
         detail["hbm_gbs"] = round(bench_hbm_gbs(jax, jnp, on_tpu), 1)
+        detail["hbm_read_gbs"] = round(bench_hbm_read_gbs(jax, jnp, on_tpu), 1)
         log(f"microbench: {detail.get('matmul_tflops')} TF/s, "
-            f"{detail.get('hbm_gbs')} GB/s")
+            f"triad {detail.get('hbm_gbs')} GB/s, "
+            f"weight-stream {detail.get('hbm_read_gbs')} GB/s")
 
     bs = 16
     S, ctx = args.batch, args.ctx
@@ -396,13 +612,35 @@ def main() -> None:
     log(f"prefill[{bucket}]: {t_prefill*1e3:.1f} ms "
         f"({prefill_tps:.0f} tok/s, MFU {detail.get('prefill_mfu', '-')})")
 
-    # Decode (the primary metric).
-    t_decode = bench_decode(jax, jnp, cfg, params, kv, S, ctx, bmax, bs)
+    # Decode (the primary metric): least-squares fit over 4 chain
+    # lengths, cross-checked against the longest chain's absolute time
+    # (r03's 2-point diff produced 7.48 ms/step against its own 10.1 ms
+    # bandwidth bound — a physically impossible number that the fit's
+    # residuals + the absolute estimate make detectable and correctable).
+    mk_decode = make_decode_bench(jax, jnp, cfg, S, ctx, bmax, bs, num_blocks)
+    decode_ns = (4, 12, 20, 128) if on_tpu else (4, 12, 20)
+    fit = fit_time(mk_decode, decode_ns, params, kv)
+    t_decode = fit["per_iter_s"]
+    detail["decode_timing"] = {
+        "fit_step_ms": round(fit["per_iter_s"] * 1e3, 3),
+        "abs_step_ms": round(fit["abs_per_iter_s"] * 1e3, 3),
+        "intercept_ms": fit["intercept_ms"],
+        "r2": fit["r2"],
+        "points_ms": fit["points"],
+    }
+    # The absolute estimate includes one dispatch+RTT amortized over the
+    # longest chain (over-estimates by <1% at n=128): if the fit claims
+    # a per-step time more than 10% FASTER than that upper bound, the
+    # fit is noise-contaminated — take the conservative estimate.
+    if fit["per_iter_s"] < 0.9 * fit["abs_per_iter_s"]:
+        detail["decode_timing"]["suspect"] = True
+        t_decode = fit["abs_per_iter_s"]
     decode_tps = S / t_decode
     detail["decode_step_ms"] = round(t_decode * 1e3, 3)
     detail["decode_tokens_per_s"] = round(decode_tps, 1)
     log(f"decode[b{S} ctx{ctx}]: {t_decode*1e3:.2f} ms/step "
-        f"({decode_tps:.0f} tok/s)")
+        f"({decode_tps:.0f} tok/s; fit r2={fit['r2']}, "
+        f"abs {fit['abs_per_iter_s']*1e3:.2f} ms)")
 
     # Roofline: per step, read all params once + each sequence's live KV.
     vs_baseline = 0.0
@@ -420,50 +658,31 @@ def main() -> None:
         roofline_step = (param_bytes + kv_bytes) / (peak_gbs * 1e9)
         vs_baseline = round(decode_tps * roofline_step / S, 3)
         detail["decode_roofline_tokens_per_s"] = round(S / roofline_step)
-
-    if not args.quick:
-        # North-star serving metrics (BASELINE.md): multi-round QA through
-        # the REAL stack — engine -> OpenAI server -> session router — on
-        # localhost.  Small scale (the chip is shared with the kernel
-        # benches above), but the data path is the production one.
-        try:
-            import importlib.util
-            import os as _os
-
-            spec = importlib.util.spec_from_file_location(
-                "serving_bench",
-                _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
-                              "benchmarks", "serving_bench.py"),
-            )
-            serving_bench = importlib.util.module_from_spec(spec)
-            spec.loader.exec_module(serving_bench)
-            log("serving bench: booting engine + router in-process ...")
-            # Scale the workload's prompt sizes to the serving context:
-            # the byte-fallback tokenizer yields ~3 tokens per word, so
-            # the nominal 600-word prompts reach ~3.7k tokens — fine under
-            # the 8k presets (capped 4096) but overflowing a 2048-context
-            # fallback preset, which made every CPU-fallback request 400.
-            serving_len = min(cfg.max_model_len, 4096)
-            # //10 leaves headroom for chat framing + 3 rounds of history
-            # growth at the byte tokenizer's ~3 tokens/word.
-            plen = min(600, serving_len // 10)
-            serving = serving_bench.run_serving_bench_sync(
-                preset=preset,
-                num_users=6, num_rounds=3, qps=2.0,
-                system_prompt_len=plen, user_info_len=plen, answer_len=48,
-                max_num_seqs=args.batch,
-                max_model_len=serving_len,
-                num_scheduler_steps=args.serving_scheduler_steps,
-            )
-            detail["serving"] = serving
-            log(f"serving: ttft_p50={serving.get('ttft_p50_s')}s "
-                f"out_tok/s={serving.get('output_tokens_per_s')} "
-                f"kv_hit={serving.get('kv_hit_rate')} "
-                f"failed={serving.get('requests_failed')}")
-        except Exception as e:
-            # The kernel benches above are still valid; record the failure.
-            log(f"serving bench failed: {e}")
-            detail["serving"] = {"error": str(e)[:200]}
+        # Self-consistency: the effective bandwidth implied by the
+        # measurement can't exceed what this chip demonstrably streams
+        # (hbm_read_gbs).  If it does, either the timing or the
+        # bytes-touched model is wrong — localize with a KV-bytes sweep:
+        # step time at 3 context lengths; the slope is the incremental
+        # cost of KV bytes, the intercept the parameter-streaming cost.
+        eff_gbs = (param_bytes + kv_bytes) / t_decode / 1e9
+        detail["decode_effective_gbs"] = round(eff_gbs, 1)
+        measured_ceiling = detail.get("hbm_read_gbs") or peak_gbs
+        if eff_gbs > 1.05 * max(measured_ceiling, 1e-9) and on_tpu:
+            detail["roofline_violation"] = True
+            sweep = {}
+            for c in (256, 1024, ctx):
+                if c > ctx:
+                    continue
+                mk_c = make_decode_bench(
+                    jax, jnp, cfg, S, c, bmax, bs, num_blocks
+                )
+                sweep[c] = round(
+                    diff_time(mk_c, 4, 20, params, kv) * 1e3, 3
+                )
+            detail["decode_kv_sweep_ms"] = sweep
+            log(f"ROOFLINE VIOLATION: effective {eff_gbs:.0f} GB/s > "
+                f"measured ceiling {measured_ceiling:.0f} GB/s; "
+                f"kv sweep {sweep}")
 
     # Optional A/B stages, in value order, each gated on the remaining
     # time budget: the driver runs this under a finite window and the

