@@ -1,0 +1,196 @@
+"""Multi-host (multi-process) bootstrap + lockstep serving protocol.
+
+The reference scales a single engine across accelerators with NCCL over
+/dev/shm inside one pod (reference helm/templates/deployment-vllm-multi.yaml:198-228);
+a multi-host TPU slice (e.g. v5e-16 = 4x4, four 4-chip workers) instead
+runs ONE jax program across several worker pods: every process calls
+``jax.distributed.initialize`` against worker 0, ``jax.devices()``
+becomes the global chip list, and the engine's mesh/pjit shardings span
+hosts with XLA emitting ICI/DCN collectives.
+
+Serving on top of SPMD needs one more ingredient: every process must
+launch the SAME jitted computations in the same order.  The engine is
+deterministic given its request stream, so the leader (process 0, the
+only one serving HTTP) broadcasts the per-iteration event batch —
+(new requests, aborts, shutdown) — and every follower applies it to its
+own engine replica and steps in lockstep.  Followers hold the model/KV
+shards jax assigned them; outputs are read on the leader.
+
+Environment contract (set by the Helm chart's multi-host StatefulSet
+mode, templates/deployment-engine.yaml):
+
+  PSTPU_NUM_PROCESSES       total worker pods in the slice group
+  PSTPU_PROCESS_ID          this pod's ordinal (StatefulSet pod index)
+  PSTPU_COORDINATOR_ADDRESS worker-0 DNS name:port (headless service)
+
+GKE TPU pod environments (TPU_WORKER_ID / TPU_WORKER_HOSTNAMES, injected
+by the TPU device plugin) are honored as a fallback, so a hand-rolled
+JobSet works too.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import pickle
+from typing import Any, Optional
+
+logger = logging.getLogger(__name__)
+
+_COORD_PORT = 8476
+
+
+@dataclasses.dataclass(frozen=True)
+class DistributedEnv:
+    coordinator_address: str
+    num_processes: int
+    process_id: int
+
+    @property
+    def is_leader(self) -> bool:
+        return self.process_id == 0
+
+
+def detect_env(environ=None) -> Optional[DistributedEnv]:
+    """Multi-process topology from the environment, or None for the
+    ordinary single-process case.
+
+    Explicit PSTPU_* variables win; the GKE TPU pod contract
+    (TPU_WORKER_ID + TPU_WORKER_HOSTNAMES) is the fallback.  A
+    single-entry hostname list (the axon tunnel sets
+    TPU_WORKER_HOSTNAMES=localhost) is single-process.
+    """
+    env = os.environ if environ is None else environ
+    if "PSTPU_NUM_PROCESSES" in env:
+        n = int(env["PSTPU_NUM_PROCESSES"])
+        if n <= 1:
+            return None
+        return DistributedEnv(
+            coordinator_address=env["PSTPU_COORDINATOR_ADDRESS"],
+            num_processes=n,
+            process_id=int(env["PSTPU_PROCESS_ID"]),
+        )
+    hostnames = [
+        h for h in env.get("TPU_WORKER_HOSTNAMES", "").split(",") if h
+    ]
+    if len(hostnames) > 1:
+        return DistributedEnv(
+            coordinator_address=f"{hostnames[0]}:{_COORD_PORT}",
+            num_processes=len(hostnames),
+            process_id=int(env.get("TPU_WORKER_ID", "0")),
+        )
+    return None
+
+
+def maybe_initialize(environ=None) -> Optional[DistributedEnv]:
+    """Call ``jax.distributed.initialize`` when the environment declares a
+    multi-process topology.  Must run before any jax computation; after
+    it, ``jax.devices()`` is the GLOBAL device list.  Returns the
+    detected topology (None = single process, nothing done)."""
+    denv = detect_env(environ)
+    if denv is None:
+        return None
+    import jax
+
+    logger.info(
+        "initializing jax.distributed: coordinator=%s process %d/%d",
+        denv.coordinator_address, denv.process_id, denv.num_processes,
+    )
+    jax.distributed.initialize(
+        coordinator_address=denv.coordinator_address,
+        num_processes=denv.num_processes,
+        process_id=denv.process_id,
+    )
+    return denv
+
+
+# -- lockstep event channel ------------------------------------------------
+
+
+def broadcast_pyobj(obj: Any, is_source: bool) -> Any:
+    """Broadcast a picklable object from process 0 to all processes.
+
+    Two fixed-shape collectives (broadcast_one_to_all requires identical
+    shapes everywhere): first the payload length, then the padded payload
+    bytes.  Cost is one small + one payload-sized collective — the
+    lockstep payload is request metadata (token ids, sampling params),
+    thousands of times smaller than one decode step's activations.
+    """
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    payload = pickle.dumps(obj) if is_source else b""
+    n = multihost_utils.broadcast_one_to_all(
+        jnp.asarray(len(payload), jnp.int32)
+    )
+    n = int(n)
+    buf = np.zeros((n,), np.uint8)
+    if is_source:
+        buf[:] = np.frombuffer(payload, np.uint8)
+    buf = multihost_utils.broadcast_one_to_all(jnp.asarray(buf))
+    return pickle.loads(np.asarray(buf).tobytes())
+
+
+@dataclasses.dataclass
+class StepEvents:
+    """One lockstep iteration's inputs, leader -> followers."""
+
+    requests: list = dataclasses.field(default_factory=list)
+    # (request_id, prompt_token_ids, SamplingParams, adapter)
+    aborts: list = dataclasses.field(default_factory=list)
+    shutdown: bool = False
+
+
+class LockstepChannel:
+    """Leader/follower event exchange for multi-host serving.
+
+    The leader calls :meth:`publish` with each iteration's event batch
+    right before stepping its engine; followers call :meth:`receive` and
+    apply the same batch to their replica, keeping every process's
+    scheduler state — and therefore every jitted launch — identical.
+    Idle iterations are NOT published: the leader only publishes when it
+    is about to step (or shut down), so followers block in ``receive``
+    without spinning collectives.
+    """
+
+    def __init__(self, denv: DistributedEnv):
+        self.denv = denv
+
+    def publish(self, events: StepEvents) -> None:
+        assert self.denv.is_leader
+        broadcast_pyobj(events, is_source=True)
+
+    def receive(self) -> StepEvents:
+        assert not self.denv.is_leader
+        return broadcast_pyobj(None, is_source=False)
+
+
+def follower_loop(engine, channel: LockstepChannel) -> None:
+    """Run a follower replica: apply the leader's event batches and step
+    in lockstep until shutdown.  Outputs are discarded — the leader owns
+    the HTTP surface; this process only contributes its device shards to
+    the collective computation."""
+    logger.info("follower %d: entering lockstep loop", channel.denv.process_id)
+    while True:
+        events = channel.receive()
+        if events.shutdown:
+            logger.info("follower: leader announced shutdown")
+            return
+        for request_id in events.aborts:
+            engine.abort_request(request_id)
+        for request_id, token_ids, params, adapter in events.requests:
+            try:
+                engine.add_request(
+                    request_id,
+                    prompt_token_ids=token_ids,
+                    sampling_params=params,
+                    adapter=adapter,
+                )
+            except Exception:
+                # The leader hit the same validation error and already
+                # answered the client; stay in lockstep.
+                logger.exception("follower: add_request failed")
+        if engine.has_unfinished():
+            engine.step()

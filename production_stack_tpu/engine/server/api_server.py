@@ -1043,6 +1043,41 @@ def _parse_buckets(args):
     return tuple(buckets)
 
 
+def _run_follower(config, denv, args) -> None:
+    """Follower process of a multi-host slice group: tiny /health app for
+    k8s probes (the StatefulSet has one pod template, so every ordinal
+    must answer probes) + the lockstep step loop."""
+    import threading
+
+    from production_stack_tpu.engine.core.engine import LLMEngine
+    from production_stack_tpu.engine.parallel import distributed
+
+    health_app = web.Application()
+
+    async def health(_req: web.Request) -> web.Response:
+        return web.json_response(
+            {"status": "ok", "role": "follower",
+             "process_id": denv.process_id}
+        )
+
+    health_app.router.add_get("/health", health)
+
+    def serve_health():
+        web.run_app(
+            health_app, host=args.host, port=args.port,
+            access_log=None, handle_signals=False,
+        )
+
+    threading.Thread(target=serve_health, daemon=True).start()
+    engine = LLMEngine(config)
+    channel = distributed.LockstepChannel(denv)
+    logger.info(
+        "tpu-engine follower %d/%d ready (leader owns the HTTP surface)",
+        denv.process_id, denv.num_processes,
+    )
+    distributed.follower_loop(engine, channel)
+
+
 def main(argv=None) -> None:
     parser = argparse.ArgumentParser(description="TPU serving engine (OpenAI API)")
     parser.add_argument("--host", default="0.0.0.0")
@@ -1160,7 +1195,19 @@ def main(argv=None) -> None:
             "lora.max_rank": args.max_lora_rank,
         },
     )
-    engine = AsyncEngine(config)
+    # Multi-host slice bootstrap (chart StatefulSet mode / GKE TPU pod
+    # env): initialize jax.distributed so the mesh spans every worker's
+    # chips.  Follower processes build the same engine, serve only
+    # /health, and step in lockstep with the leader's event broadcasts.
+    from production_stack_tpu.engine.parallel import distributed
+
+    denv = distributed.maybe_initialize()
+    if denv is not None and not denv.is_leader:
+        _run_follower(config, denv, args)
+        return
+    lockstep = distributed.LockstepChannel(denv) if denv is not None else None
+
+    engine = AsyncEngine(config, lockstep=lockstep)
     if args.chat_template:
         with open(args.chat_template, "r", encoding="utf-8") as f:
             engine.engine.tokenizer.chat_template = f.read()

@@ -39,8 +39,13 @@ class TokenEvent:
 
 
 class AsyncEngine:
-    def __init__(self, config: EngineConfig):
+    def __init__(self, config: EngineConfig, lockstep=None):
+        # lockstep: parallel.distributed.LockstepChannel when this is the
+        # leader of a multi-host slice group — every event batch is
+        # broadcast to follower processes right before stepping, keeping
+        # all replicas' jitted launches identical (SPMD requirement).
         self.engine = LLMEngine(config)
+        self._lockstep = lockstep
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._queues: Dict[str, asyncio.Queue] = {}
         self._pending: List = []  # (request_id, prompt_ids, sampling_params)
@@ -124,6 +129,20 @@ class AsyncEngine:
             with self._lock:
                 pending, self._pending = self._pending, []
                 aborts, self._aborts = self._aborts, []
+            if self._lockstep is not None and (
+                pending or aborts or self.engine.has_unfinished()
+            ):
+                from production_stack_tpu.engine.parallel.distributed import (
+                    StepEvents,
+                )
+
+                self._lockstep.publish(StepEvents(
+                    requests=[
+                        (rid, toks, params, adapter)
+                        for rid, toks, params, adapter in pending
+                    ],
+                    aborts=list(aborts),
+                ))
             for request_id in aborts:
                 self.engine.abort_request(request_id)
             for request_id, token_ids, params, adapter in pending:
@@ -162,6 +181,12 @@ class AsyncEngine:
                             prompt_logprobs=out.prompt_logprobs,
                         ),
                     )
+        if self._lockstep is not None:
+            from production_stack_tpu.engine.parallel.distributed import (
+                StepEvents,
+            )
+
+            self._lockstep.publish(StepEvents(shutdown=True))
         logger.info("engine step loop exited")
 
     def _emit(self, request_id: str, event) -> None:

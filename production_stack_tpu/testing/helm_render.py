@@ -10,8 +10,9 @@ This renderer implements exactly the template dialect used by
   pipelines      value | fn arg | fn
   data access    .Values.a.b, $m.field, $.Release.Name, quoted strings, ints
   control flow   if / else / end, range $var := expr
+  variables      {{ $x := expr }} assignment, {{ /* comments */ }}
   functions      default, quote, toYaml, nindent, indent, required,
-                 eq, ne, not, and, or, kindIs
+                 eq, ne, not, and, or, kindIs, hasKey, gt, int, printf
 
 It is NOT a general Helm implementation — unsupported constructs raise so
 the chart cannot silently drift outside the tested subset.  Also usable as
@@ -317,6 +318,17 @@ def _call(name: str, args: List[Any], env: _Env) -> Any:
         return isinstance(args[0], dict) and args[1] in args[0]
     if name == "print":
         return "".join(str(a) for a in args)
+    if name == "gt":
+        return args[0] > args[1]
+    if name == "lt":
+        return args[0] < args[1]
+    if name == "int":
+        v = args[0]
+        return int(v) if v not in (None, "") else 0
+    if name == "printf":
+        fmt, rest = args[0], args[1:]
+        # Go verbs used in-chart: %s and %d behave like Python's.
+        return fmt % tuple(rest)
     raise HelmTemplateError(f"unsupported template function {name!r}")
 
 
@@ -378,7 +390,14 @@ def _exec_nodes(nodes: List[tuple], env: _Env, out: List[str]) -> None:
         if node[0] == "text":
             out.append(node[1])
         elif node[0] == "action":
-            value = _eval(_parse_expr(node[1]), env)
+            body = node[1]
+            if body.startswith("/*"):  # template comment
+                continue
+            m = re.match(r"(\$\w+)\s*:=\s*(.+)", body, re.S)
+            if m:  # variable assignment: binds in the enclosing scope
+                env.vars[m.group(1)] = _eval(_parse_expr(m.group(2)), env)
+                continue
+            value = _eval(_parse_expr(body), env)
             out.append("" if value is None else str(value))
         elif node[0] == "if":
             _, cond, then, otherwise, _, _ = node

@@ -311,3 +311,65 @@ def test_ingress_renders_when_enabled():
     assert rule["host"] == "tpu-router.local"
     backend = rule["http"]["paths"][0]["backend"]["service"]
     assert backend["name"] == "i-router-service"
+
+
+def test_multihost_slice_renders_statefulset_pod_group():
+    """tpuNumWorkers > 1 (v5e-16 = 4x4 = 4 workers x 4 chips) must render
+    a StatefulSet pod group with a headless worker service and the
+    jax.distributed bootstrap env — the TPU analogue of the reference's
+    TP-over-/dev/shm plumbing (deployment-vllm-multi.yaml:198-228) and
+    SURVEY §7's "multi-host slices need StatefulSet-like pod groups"."""
+    with open(os.path.join(CHART_DIR, "values-multihost-example.yaml")) as f:
+        values = yaml.safe_load(f)
+    objs = load_manifests(
+        render_chart(CHART_DIR, values, release_name="ms")
+    )
+    # Engine is a StatefulSet, not a Deployment (router stays Deployment).
+    stss = by_kind(objs, "StatefulSet")
+    assert len(stss) == 1
+    sts = stss[0]
+    assert sts["metadata"]["name"] == "ms-llama-3-8b-engine"
+    assert sts["spec"]["replicas"] == 4
+    assert sts["spec"]["podManagementPolicy"] == "Parallel"
+    assert sts["spec"]["serviceName"] == "ms-llama-3-8b-engine-workers"
+    deployments = [d["metadata"]["name"] for d in by_kind(objs, "Deployment")]
+    assert deployments == ["ms-deployment-router"]
+
+    pod = sts["spec"]["template"]["spec"]
+    container = pod["containers"][0]
+    env = {e["name"]: e for e in container["env"]}
+    assert env["PSTPU_NUM_PROCESSES"]["value"] == "4"
+    assert (env["PSTPU_PROCESS_ID"]["valueFrom"]["fieldRef"]["fieldPath"]
+            == "metadata.labels['apps.kubernetes.io/pod-index']")
+    assert (env["PSTPU_COORDINATOR_ADDRESS"]["value"]
+            == "ms-llama-3-8b-engine-0.ms-llama-3-8b-engine-workers"
+               ".default.svc:8476")
+    # Per-worker chip count + multi-host topology selectors.
+    assert container["resources"]["limits"]["google.com/tpu"] == "4"
+    assert pod["nodeSelector"]["cloud.google.com/gke-tpu-topology"] == "4x4"
+
+    # Two services: the client-facing one pinned to ordinal 0, and the
+    # headless bootstrap service covering every worker.
+    services = {s["metadata"]["name"]: s for s in by_kind(objs, "Service")}
+    facing = services["ms-llama-3-8b-engine-service"]
+    assert (facing["spec"]["selector"]["statefulset.kubernetes.io/pod-name"]
+            == "ms-llama-3-8b-engine-0")
+    headless = services["ms-llama-3-8b-engine-workers"]
+    # k8s expects the literal string "None" for headless services.
+    assert headless["spec"]["clusterIP"] == "None"
+    assert headless["spec"]["publishNotReadyAddresses"] is True
+    assert "statefulset.kubernetes.io/pod-name" not in headless["spec"]["selector"]
+
+
+def test_single_host_unchanged_by_multihost_support():
+    """tpuNumWorkers absent or 1 keeps the plain-Deployment rendering."""
+    values = tpu_values()
+    objs = load_manifests(
+        render_chart(CHART_DIR, values, release_name="sh")
+    )
+    assert by_kind(objs, "StatefulSet") == []
+    names = [d["metadata"]["name"] for d in by_kind(objs, "Deployment")]
+    assert any(n.endswith("-deployment-engine") for n in names)
+    for d in by_kind(objs, "Deployment"):
+        env = d["spec"]["template"]["spec"]["containers"][0].get("env", [])
+        assert "PSTPU_NUM_PROCESSES" not in {e["name"] for e in env}
