@@ -721,6 +721,37 @@ def main() -> None:
             log(f"int8 decode bench failed: {e}")
             detail["int8_decode_error"] = str(e)[:200]
 
+    if not args.quick and budget_left("kv_int8_ab"):
+        # Int8 KV cache A/B (cache.kv_cache_dtype="int8"): the KV read is
+        # the context-scaling term of decode bandwidth; int8 halves it
+        # (and the pool bytes — capacity ratio reported alongside).
+        try:
+            from production_stack_tpu.engine.kv import quant as kv_quant
+
+            kvq = [
+                (kv_quant.quantize_vectors(k), kv_quant.quantize_vectors(v))
+                for k, v in kv
+            ]
+            mk_q = make_decode_bench(
+                jax, jnp, cfg, S, ctx, bmax, bs, num_blocks
+            )
+            t_decode_kvq = diff_time(mk_q, 4, 20, params, kvq)
+            detail["decode_step_ms_kv_int8"] = round(t_decode_kvq * 1e3, 3)
+            detail["kv_int8_decode_speedup"] = round(
+                t_decode / t_decode_kvq, 2
+            )
+            hd = cfg.head_dim
+            detail["kv_int8_capacity_ratio"] = round(
+                (2 * hd) / (hd + 4), 2
+            )
+            del kvq
+            log(f"decode kv-int8: {t_decode_kvq*1e3:.2f} ms/step "
+                f"({detail['kv_int8_decode_speedup']}x vs bf16 KV, "
+                f"{detail['kv_int8_capacity_ratio']}x pool capacity)")
+        except Exception as e:
+            log(f"kv int8 decode bench failed: {e}")
+            detail["kv_int8_decode_error"] = str(e)[:200]
+
     if not args.quick and on_tpu and budget_left("gather_ab"):
         # A/B the full decode step with the gather attention path (the KV
         # cache is loop-carried, so XLA cannot hoist the gather): this is

@@ -230,6 +230,15 @@ class CacheConfig:
     # TPU-native analogue of LMCache's shared-store prefill reuse.
     # Requires remote_kv_url.
     disagg_role: Optional[str] = None
+    # KV cache precision (vLLM --kv-cache-dtype analogue).  "int8" stores
+    # each cached K/V vector as int8 with a per-(token, head) fp32 scale:
+    # KV HBM traffic and pool bytes roughly halve (decode is
+    # KV-bandwidth-bound at long context, SURVEY §5 long-context story),
+    # so num_blocks roughly doubles at equal memory.  Host-offload /
+    # remote-store wire format is dense fp32 for int8 caches (exact
+    # requantization on restore — kv/quant.py); importers cast/quantize,
+    # so engines with different kv dtypes still share prefixes.
+    kv_cache_dtype: str = "auto"
 
     def __post_init__(self):
         if self.disagg_role not in (None, "prefill", "decode", "both"):
@@ -239,6 +248,11 @@ class CacheConfig:
             )
         if self.disagg_role is not None and not self.remote_kv_url:
             raise ValueError("disagg_role requires remote_kv_url")
+        if self.kv_cache_dtype not in ("auto", "int8"):
+            raise ValueError(
+                f"Unknown kv_cache_dtype {self.kv_cache_dtype!r} "
+                "(auto | int8)"
+            )
 
 
 @dataclasses.dataclass

@@ -43,6 +43,7 @@ from production_stack_tpu.engine.kv.block_pool import (
     BlockPool,
     prefix_block_hashes,
 )
+from production_stack_tpu.engine.kv import quant as kv_quant
 from production_stack_tpu.engine.kv.offload import HostOffloadManager
 from production_stack_tpu.engine.models import get_model
 from production_stack_tpu.engine.models.weights import load_params
@@ -276,7 +277,15 @@ class LLMEngine:
 
     def _kv_bytes(self, num_blocks: int) -> int:
         cfg = self.config.model
-        per_token = 2 * cfg.num_kv_heads * cfg.head_dim * _dtype_size(cfg.dtype)
+        if self.config.cache.kv_cache_dtype == "int8":
+            # int8 data + one fp32 scale per (token, kv head): bytes per
+            # token roughly halve vs bf16, so _decide_num_blocks fits
+            # roughly 2x the blocks in the same HBM budget.
+            per_token = 2 * cfg.num_kv_heads * (cfg.head_dim * 1 + 4)
+        else:
+            per_token = (
+                2 * cfg.num_kv_heads * cfg.head_dim * _dtype_size(cfg.dtype)
+            )
         return num_blocks * self.config.cache.block_size * per_token * cfg.num_layers
 
     def _decide_num_blocks(self) -> int:
@@ -305,16 +314,23 @@ class LLMEngine:
 
     def _allocate_kv(self, num_blocks: int):
         cfg = self.config.model
-        shape = (
-            num_blocks,
-            self.config.cache.block_size,
-            cfg.num_kv_heads,
-            cfg.head_dim,
-        )
+        bs = self.config.cache.block_size
+        shape = (num_blocks, bs, cfg.num_kv_heads, cfg.head_dim)
         dtype = jnp.dtype(cfg.dtype)
         # Allocate directly sharded (jit with out_shardings): materializing
         # the full unsharded layer on one device first would OOM at high tp.
         layer_shardings = shardings_lib.kv_cache_shardings(cfg, self.mesh)
+        if self.config.cache.kv_cache_dtype == "int8":
+            # (data int8, scale fp32 [N, bs, K]) per side — kv/quant.py.
+            scale_sharding = shardings_lib.kv_scale_sharding(self.mesh)
+            zeros = jax.jit(
+                lambda: (
+                    jnp.zeros(shape, jnp.int8),
+                    jnp.zeros(shape[:3], jnp.float32),
+                ),
+                out_shardings=(layer_shardings[0][0], scale_sharding),
+            )
+            return [(zeros(), zeros()) for _ in range(cfg.num_layers)]
         zeros = jax.jit(
             lambda: jnp.zeros(shape, dtype),
             out_shardings=layer_shardings[0][0],
@@ -433,9 +449,11 @@ class LLMEngine:
         ids = jnp.asarray(restored, jnp.int32)
         for layer_idx, (k_host, v_host) in enumerate(entry.layers):
             k_cache, v_cache = self.kv_caches[layer_idx]
-            k_cache = k_cache.at[ids].set(jnp.asarray(k_host[:usable_blocks]))
-            v_cache = v_cache.at[ids].set(jnp.asarray(v_host[:usable_blocks]))
-            self.kv_caches[layer_idx] = (k_cache, v_cache)
+            # set_blocks handles both dense and int8 (data, scale) sides.
+            self.kv_caches[layer_idx] = (
+                kv_quant.set_blocks(k_cache, ids, k_host[:usable_blocks]),
+                kv_quant.set_blocks(v_cache, ids, v_host[:usable_blocks]),
+            )
         seq.block_table = restored
         seq.num_cached_tokens = usable_blocks * bs
         seq.partial_prefill = True
@@ -525,13 +543,10 @@ class LLMEngine:
             for layer_idx, (k_cache, v_cache) in enumerate(self.kv_caches):
                 k_host = np.stack([f[layer_idx][0][0] for f in fetched])
                 v_host = np.stack([f[layer_idx][1][0] for f in fetched])
-                k_cache = k_cache.at[idx].set(
-                    jnp.asarray(k_host, k_cache.dtype)
+                self.kv_caches[layer_idx] = (
+                    kv_quant.set_blocks(k_cache, idx, k_host),
+                    kv_quant.set_blocks(v_cache, idx, v_host),
                 )
-                v_cache = v_cache.at[idx].set(
-                    jnp.asarray(v_host, v_cache.dtype)
-                )
-                self.kv_caches[layer_idx] = (k_cache, v_cache)
         except Exception:
             # A malformed entry (wrong layer count / block shape — a store
             # polluted by another binary version) fails here: return the
@@ -605,9 +620,12 @@ class LLMEngine:
             [seq.block_table[i] for i, _ in todo], jnp.int32
         )
         try:
-            # One device->host gather per layer for all exported blocks.
+            # One device->host gather per layer for all exported blocks
+            # (dense model-dtype wire format; int8 caches dequantize here
+            # so peers with any kv dtype can import).
             host_layers = [
-                (np.asarray(k_cache[ids]), np.asarray(v_cache[ids]))
+                (kv_quant.gather_blocks_host(k_cache, ids),
+                 kv_quant.gather_blocks_host(v_cache, ids))
                 for k_cache, v_cache in self.kv_caches
             ]
         except Exception:

@@ -68,13 +68,17 @@ class HostOffloadManager:
         does not fit (caller falls back to recompute)."""
         if not block_ids or self.capacity_bytes <= 0:
             return False
+        from production_stack_tpu.engine.kv import quant as kv_quant
+
         ids = np.asarray(block_ids, dtype=np.int32)
         layers: List[Tuple[np.ndarray, np.ndarray]] = []
         nbytes = 0
         for k_cache, v_cache in kv_caches:
-            # Device-side gather then one contiguous DMA per layer.
-            k_host = np.asarray(k_cache[ids])
-            v_host = np.asarray(v_cache[ids])
+            # Device-side gather then one contiguous DMA per layer
+            # (int8 caches dequantize to the dense host/wire format —
+            # the requantize on restore is exactly idempotent, quant.py).
+            k_host = kv_quant.gather_blocks_host(k_cache, ids)
+            v_host = kv_quant.gather_blocks_host(v_cache, ids)
             layers.append((k_host, v_host))
             nbytes += k_host.nbytes + v_host.nbytes
         while self.used_bytes + nbytes > self.capacity_bytes and self._entries:

@@ -1,0 +1,100 @@
+"""Int8 KV-cache quantization (CacheConfig.kv_cache_dtype="int8").
+
+Decode at long context is KV-bandwidth-bound: every step re-reads every
+live KV block (SURVEY §5 — the stack's long-context story is KV capacity
++ reuse).  Storing each cached K/V vector as int8 with a per-(token,
+kv-head) fp32 scale halves the bytes the decode kernel streams AND the
+bytes a block occupies, so the pool holds ~2x the tokens at equal HBM.
+
+Representation: a quantized cache side is the 2-tuple
+
+    (data int8 [N, bs, K, D], scale fp32 [N, bs, K])
+
+threaded through the engine/model code in place of the plain
+``[N, bs, K, D]`` array — an ordinary jax pytree, so jit/donation/
+sharding work unchanged (scales shard over tp on the K axis exactly like
+the data).  Quantization is DYNAMIC per written vector (scale =
+max|x|/127 at write time), so appends never rescale existing entries.
+
+Host offload and the remote store keep a DENSE FP32 wire format: the
+fp32 dequantize/requantize round-trip is exactly idempotent (the
+dequantized vector's max-abs IS scale*127, so requantization reproduces
+the identical int8 data), which is what makes offload-restore
+bit-preserving; a model-dtype (bf16) wire would halve those bytes but
+round the values and break that guarantee.  The trade is deliberate:
+offload lives in host DRAM and the store on the datacenter network,
+where 2x bytes is cheaper than any restore-fidelity wobble.  Importers
+cast-or-quantize whatever arrives, so engines with different kv dtypes
+interoperate either way.
+
+The reference has no analogue (KV precision lives inside its external
+vLLM engine; its stack-level lever is LMCache offload,
+deployment-vllm-multi.yaml:154-178).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# int8 symmetric range; -128 is unused so the grid is symmetric.
+_QMAX = 127.0
+
+
+def is_quantized(side) -> bool:
+    """A cache side is either a plain array or a (data, scale) tuple."""
+    return isinstance(side, tuple)
+
+
+def cache_shape(side) -> Tuple[int, ...]:
+    """[N, bs, K, D] of the underlying block data."""
+    return side[0].shape if is_quantized(side) else side.shape
+
+
+def quantize_vectors(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Per-vector symmetric int8 quantization over the trailing (D) axis.
+
+    x: [..., D] -> (int8 [..., D], fp32 scale [...]).  A zero vector gets
+    scale 0 and dequantizes back to exact zeros.
+    """
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    scale = amax / _QMAX
+    safe = jnp.where(scale > 0, scale, 1.0)
+    data = jnp.clip(
+        jnp.round(x.astype(jnp.float32) / safe[..., None]), -_QMAX, _QMAX
+    ).astype(jnp.int8)
+    return data, scale
+
+
+def dequantize(data: jax.Array, scale: jax.Array, dtype=None) -> jax.Array:
+    """(int8 [..., D], scale [...]) -> values [..., D] (fp32 by default)."""
+    out = data.astype(jnp.float32) * scale.astype(jnp.float32)[..., None]
+    return out if dtype is None else out.astype(dtype)
+
+
+# -- generic cache-side block transfer (engine / offload / disagg) ---------
+#
+# Host/wire blocks are DENSE [n, bs, K, D] arrays — the cache's own dtype
+# for plain caches, fp32 for quantized ones (exact requantization; see
+# module docstring).  These helpers are the single conversion boundary.
+
+
+def gather_blocks_host(side, ids: jax.Array) -> np.ndarray:
+    """Device gather of whole blocks -> dense host array [n, bs, K, D]."""
+    if is_quantized(side):
+        data, scale = side
+        return np.asarray(dequantize(data[ids], scale[ids]))
+    return np.asarray(side[ids])
+
+
+def set_blocks(side, ids: jax.Array, host_blocks) -> object:
+    """Write dense host blocks [n, bs, K, D] into the cache side
+    (quantizing when the side is quantized).  Returns the new side."""
+    if is_quantized(side):
+        data, scale = side
+        q, s = quantize_vectors(jnp.asarray(host_blocks))
+        return (data.at[ids].set(q), scale.at[ids].set(s.astype(scale.dtype)))
+    return side.at[ids].set(jnp.asarray(host_blocks, side.dtype))

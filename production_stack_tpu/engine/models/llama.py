@@ -325,7 +325,7 @@ def prefill(
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
         k_prefix, v_prefix = attn_ops.gather_prefix_kv(
-            k_cache, v_cache, prefix_block_ids
+            k_cache, v_cache, prefix_block_ids, dtype=k.dtype
         )
         if use_ring:
             if sp_mode == "ulysses":

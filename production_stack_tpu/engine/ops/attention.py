@@ -33,6 +33,9 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 NEG_INF = -1e30  # large-but-finite: keeps masked softmax rows NaN-free
 
+# Quantized (int8) cache sides are (data, scale) tuples — kv/quant.py.
+from production_stack_tpu.engine.kv import quant as kv_quant
+
 
 def use_pallas_decode(num_kv_heads: int = 128, head_dim: int = 128) -> bool:
     """Trace-time dispatch check for the streaming decode kernel.
@@ -69,7 +72,8 @@ def decode_attention(
     """
     from production_stack_tpu.engine.parallel.mesh import AXES
 
-    K, D = k_cache.shape[2], k_cache.shape[3]
+    quantized = kv_quant.is_quantized(k_cache)
+    K, D = kv_quant.cache_shape(k_cache)[2:4]
     # Under tp the kernel sees K/tp heads per shard; alignment must hold
     # for the per-shard KV row.
     tp = mesh.shape[AXES.TP] if mesh is not None and mesh.size > 1 else 1
@@ -88,13 +92,17 @@ def decode_attention(
     if mesh is None or mesh.size == 1:
         return kernel(q, k_cache, v_cache, block_tables, ctx_lens)
 
+    cache_spec = (
+        (P(None, None, AXES.TP, None), P(None, None, AXES.TP))
+        if quantized else P(None, None, AXES.TP, None)
+    )
     return shard_map(
         kernel,
         mesh=mesh,
         in_specs=(
             P(AXES.DP, AXES.TP, None),  # q: batch over dp, heads over tp
-            P(None, None, AXES.TP, None),  # k_cache: kv heads over tp
-            P(None, None, AXES.TP, None),  # v_cache
+            cache_spec,  # k_cache: kv heads over tp (scales follow)
+            cache_spec,  # v_cache
             P(AXES.DP, None),  # block_tables rows follow the batch
             P(AXES.DP),  # ctx_lens
         ),
@@ -206,12 +214,20 @@ def paged_decode_attention(
 ) -> jax.Array:
     """Decode attention over paged KV via gather (reference path)."""
     S, H, D = q.shape
-    N, bs, K, _ = k_cache.shape
+    N, bs, K, _ = kv_quant.cache_shape(k_cache)
     Bmax = block_tables.shape[1]
     G = H // K
 
-    k = k_cache[block_tables].reshape(S, Bmax * bs, K, D)
-    v = v_cache[block_tables].reshape(S, Bmax * bs, K, D)
+    if kv_quant.is_quantized(k_cache):
+        k = kv_quant.dequantize(
+            k_cache[0][block_tables], k_cache[1][block_tables]
+        ).reshape(S, Bmax * bs, K, D)
+        v = kv_quant.dequantize(
+            v_cache[0][block_tables], v_cache[1][block_tables]
+        ).reshape(S, Bmax * bs, K, D)
+    else:
+        k = k_cache[block_tables].reshape(S, Bmax * bs, K, D)
+        v = v_cache[block_tables].reshape(S, Bmax * bs, K, D)
 
     key_pos = jnp.arange(Bmax * bs)[None, :]  # [1, max_ctx]
     mask = key_pos < ctx_lens[:, None]  # [S, max_ctx]
@@ -240,8 +256,20 @@ def write_prefill_kv(
     new_block_ids: jax.Array,  # [T // bs] int32; padding slots -> 0 (null)
 ) -> Tuple[jax.Array, jax.Array]:
     """Scatter freshly computed prefill KV into the paged cache."""
-    N, bs, K, D = k_cache.shape
+    N, bs, K, D = kv_quant.cache_shape(k_cache)
     nb = new_block_ids.shape[0]
+    if kv_quant.is_quantized(k_cache):
+        kd, ks = kv_quant.quantize_vectors(k_new.reshape(nb, bs, K, D))
+        vd, vs = kv_quant.quantize_vectors(v_new.reshape(nb, bs, K, D))
+        k_cache = (
+            k_cache[0].at[new_block_ids].set(kd),
+            k_cache[1].at[new_block_ids].set(ks),
+        )
+        v_cache = (
+            v_cache[0].at[new_block_ids].set(vd),
+            v_cache[1].at[new_block_ids].set(vs),
+        )
+        return k_cache, v_cache
     k_blocks = k_new.reshape(nb, bs, K, D).astype(k_cache.dtype)
     v_blocks = v_new.reshape(nb, bs, K, D).astype(v_cache.dtype)
     k_cache = k_cache.at[new_block_ids].set(k_blocks)
@@ -258,19 +286,46 @@ def append_decode_kv(
     slot_offsets: jax.Array,  # [S] int32 offset within the block
 ) -> Tuple[jax.Array, jax.Array]:
     """Scatter one new token's KV per sequence into the paged cache."""
+    if kv_quant.is_quantized(k_cache):
+        kd, ks = kv_quant.quantize_vectors(k)  # [S, K, D] -> + [S, K]
+        vd, vs = kv_quant.quantize_vectors(v)
+        k_cache = (
+            k_cache[0].at[slot_block_ids, slot_offsets].set(kd),
+            k_cache[1].at[slot_block_ids, slot_offsets].set(ks),
+        )
+        v_cache = (
+            v_cache[0].at[slot_block_ids, slot_offsets].set(vd),
+            v_cache[1].at[slot_block_ids, slot_offsets].set(vs),
+        )
+        return k_cache, v_cache
     k_cache = k_cache.at[slot_block_ids, slot_offsets].set(k.astype(k_cache.dtype))
     v_cache = v_cache.at[slot_block_ids, slot_offsets].set(v.astype(v_cache.dtype))
     return k_cache, v_cache
 
 
 def gather_prefix_kv(
-    k_cache: jax.Array,  # [N, bs, K, D]
+    k_cache: jax.Array,  # [N, bs, K, D] (or (data, scale) when int8)
     v_cache: jax.Array,
     prefix_block_ids: jax.Array,  # [P] int32 (0-padded)
+    dtype=None,  # dequantization target for quantized caches (fp32 default)
 ) -> Tuple[jax.Array, jax.Array]:
-    """Gather a cached prefix as [P*bs, K, D] for prefill attention."""
-    N, bs, K, D = k_cache.shape
+    """Gather a cached prefix as [P*bs, K, D] for prefill attention.
+
+    Quantized caches dequantize here — downstream prefill attention
+    (dense, flash kernel, ring, ulysses) is precision-agnostic.
+    """
+    N, bs, K, D = kv_quant.cache_shape(k_cache)
     P = prefix_block_ids.shape[0]
+    if kv_quant.is_quantized(k_cache):
+        k = kv_quant.dequantize(
+            k_cache[0][prefix_block_ids], k_cache[1][prefix_block_ids],
+            dtype=dtype,
+        ).reshape(P * bs, K, D)
+        v = kv_quant.dequantize(
+            v_cache[0][prefix_block_ids], v_cache[1][prefix_block_ids],
+            dtype=dtype,
+        ).reshape(P * bs, K, D)
+        return k, v
     k = k_cache[prefix_block_ids].reshape(P * bs, K, D)
     v = v_cache[prefix_block_ids].reshape(P * bs, K, D)
     return k, v

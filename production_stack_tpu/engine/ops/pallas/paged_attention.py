@@ -42,17 +42,10 @@ def _decode_kernel(
     # scalar prefetch (SMEM)
     block_tables_ref,  # [S, Bmax] int32
     ctx_lens_ref,  # [S] int32
-    # inputs
-    q_ref,  # [1, H, D] VMEM (this sequence's query)
-    k_hbm,  # [N, bs, K, D] stays in HBM; blocks DMA'd on demand
-    v_hbm,  # [N, bs, K, D]
-    # outputs
-    o_ref,  # [1, H, D] VMEM
-    # scratch
-    k_buf,  # [2, C, bs, K, D] VMEM double buffer
-    v_buf,  # [2, C, bs, K, D]
-    sems,  # [2, 2, C] DMA semaphores (k/v x slot x block-in-chunk)
-    *,
+    # inputs: q_ref, k_hbm, v_hbm[, ks_hbm, vs_hbm] (int8 cache scales)
+    # outputs: o_ref
+    # scratch: k_buf, v_buf[, ks_buf, vs_buf], sems
+    *refs,
     bs: int,
     chunk_blocks: int,
     num_kv_heads: int,
@@ -60,7 +53,14 @@ def _decode_kernel(
     head_dim: int,
     scale: float,
     sliding_window: Optional[int],
+    quantized: bool,
 ):
+    if quantized:
+        (q_ref, k_hbm, v_hbm, ks_hbm, vs_hbm, o_ref,
+         k_buf, v_buf, ks_buf, vs_buf, sems) = refs
+    else:
+        q_ref, k_hbm, v_hbm, o_ref, k_buf, v_buf, sems = refs
+        ks_hbm = vs_hbm = ks_buf = vs_buf = None
     s = pl.program_id(0)
     ctx = ctx_lens_ref[s]
     nb = (ctx + bs - 1) // bs  # live KV blocks for this sequence
@@ -82,15 +82,20 @@ def _decode_kernel(
             cache.at[block_id(j)], buf.at[slot, c], sems.at[kv, slot, c]
         )
 
+    streams = [(k_hbm, k_buf, 0), (v_hbm, v_buf, 1)]
+    if quantized:
+        # Scale planes ride the same pipeline (tiny: [bs, K] fp32/block).
+        streams += [(ks_hbm, ks_buf, 2), (vs_hbm, vs_buf, 3)]
+
     def start_chunk(slot, chunk):
         for c in range(C):  # static unroll: C parallel DMA issues
-            dma(k_hbm, k_buf, 0, slot, c, chunk * C + c).start()
-            dma(v_hbm, v_buf, 1, slot, c, chunk * C + c).start()
+            for cache, buf, kv in streams:
+                dma(cache, buf, kv, slot, c, chunk * C + c).start()
 
     def wait_chunk(slot, chunk):
         for c in range(C):
-            dma(k_hbm, k_buf, 0, slot, c, chunk * C + c).wait()
-            dma(v_hbm, v_buf, 1, slot, c, chunk * C + c).wait()
+            for cache, buf, kv in streams:
+                dma(cache, buf, kv, slot, c, chunk * C + c).wait()
 
     # Padded batch slots (ctx == 0) must not start DMAs: an un-waited DMA
     # leaves its semaphore signaled and poisons the next grid step's waits.
@@ -113,6 +118,14 @@ def _decode_kernel(
         # merging the leading dims is layout-free, D stays the lane dim).
         k = k_buf[slot].astype(jnp.float32).reshape(T, K, D).swapaxes(0, 1)
         v = v_buf[slot].astype(jnp.float32).reshape(T, K, D).swapaxes(0, 1)
+        if quantized:
+            # Per-(token, head) scales: [C, bs, K] -> [K, T, 1].
+            ks = ks_buf[slot].astype(jnp.float32).reshape(T, K) \
+                .swapaxes(0, 1)[..., None]
+            vs = vs_buf[slot].astype(jnp.float32).reshape(T, K) \
+                .swapaxes(0, 1)[..., None]
+            k = k * ks
+            v = v * vs
 
         # [K, G, D] x [K, T, D] -> [K, G, T]  (batch over kv heads)
         scores = jax.lax.dot_general(
@@ -163,9 +176,19 @@ def paged_decode_attention_pallas(
     chunk_blocks: int = 8,
     interpret: bool = False,
 ) -> jax.Array:
-    """Decode attention over paged KV, streaming blocks HBM->VMEM."""
+    """Decode attention over paged KV, streaming blocks HBM->VMEM.
+
+    ``k_cache``/``v_cache`` may be int8 (data, scale) tuples
+    (kv/quant.py): the scale planes stream through the same
+    double-buffered pipeline and the dequantize (one VPU multiply per
+    element) happens in VMEM — HBM traffic is the int8 bytes plus ~3%
+    scales, the whole point of the mode.
+    """
+    from production_stack_tpu.engine.kv import quant as kv_quant
+
+    quantized = kv_quant.is_quantized(k_cache)
     S, H, D = q.shape
-    N, bs, K, _ = k_cache.shape
+    N, bs, K, _ = kv_quant.cache_shape(k_cache)
     G = H // K
     C = min(chunk_blocks, block_tables.shape[1])
     if D % 128 and not interpret:
@@ -183,25 +206,40 @@ def paged_decode_attention_pallas(
         head_dim=D,
         scale=scale,
         sliding_window=sliding_window,
+        quantized=quantized,
     )
+    cache_in_specs = [pl.BlockSpec(memory_space=pl.ANY)] * (
+        4 if quantized else 2
+    )
+    scratch = [
+        pltpu.VMEM((2, C, bs, K, D),
+                   jnp.int8 if quantized else k_cache.dtype),
+        pltpu.VMEM((2, C, bs, K, D),
+                   jnp.int8 if quantized else v_cache.dtype),
+    ]
+    if quantized:
+        scratch += [
+            pltpu.VMEM((2, C, bs, K), k_cache[1].dtype),
+            pltpu.VMEM((2, C, bs, K), v_cache[1].dtype),
+        ]
+    scratch.append(pltpu.SemaphoreType.DMA((4 if quantized else 2, 2, C)))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(S,),
         in_specs=[
             pl.BlockSpec((1, H, D), lambda s, *_: (s, 0, 0)),
-            pl.BlockSpec(memory_space=pl.ANY),  # k_cache stays in HBM
-            pl.BlockSpec(memory_space=pl.ANY),  # v_cache
+            *cache_in_specs,  # caches (+ scale planes) stay in HBM
         ],
         out_specs=pl.BlockSpec((1, H, D), lambda s, *_: (s, 0, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((2, C, bs, K, D), k_cache.dtype),
-            pltpu.VMEM((2, C, bs, K, D), v_cache.dtype),
-            pltpu.SemaphoreType.DMA((2, 2, C)),
-        ],
+        scratch_shapes=scratch,
+    )
+    inputs = (
+        (q, k_cache[0], v_cache[0], k_cache[1], v_cache[1])
+        if quantized else (q, k_cache, v_cache)
     )
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((S, H, D), q.dtype),
         interpret=interpret,
-    )(block_tables, ctx_lens, q, k_cache, v_cache)
+    )(block_tables, ctx_lens, *inputs)

@@ -142,6 +142,12 @@ def kv_cache_spec() -> P:
     return P(None, None, TP, None)
 
 
+def kv_scale_sharding(mesh: Mesh) -> NamedSharding:
+    # int8 KV scale planes [num_blocks, block_size, num_kv_heads]: the
+    # head axis shards over tp exactly like the data (kv/quant.py).
+    return NamedSharding(mesh, P(None, None, TP))
+
+
 def kv_cache_shardings(cfg: ModelConfig, mesh: Mesh) -> List[Tuple]:
     sharding = NamedSharding(mesh, kv_cache_spec())
     return [(sharding, sharding) for _ in range(cfg.num_layers)]

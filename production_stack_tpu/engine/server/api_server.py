@@ -1131,6 +1131,14 @@ def main(argv=None) -> None:
         "symmetrically (requires --remote-kv-url)",
     )
     parser.add_argument("--no-prefix-caching", action="store_true")
+    parser.add_argument(
+        "--kv-cache-dtype",
+        default=None,
+        choices=["auto", "int8"],
+        help="KV cache precision (vLLM --kv-cache-dtype analogue): int8 "
+        "stores cached K/V as int8 with per-(token, head) scales — KV HBM "
+        "bytes roughly halve, so the pool holds ~2x the tokens",
+    )
     parser.add_argument("--dtype", default=None, help="override preset dtype")
     parser.add_argument(
         "--quantization",
@@ -1182,6 +1190,10 @@ def main(argv=None) -> None:
             "cache.remote_kv_url": args.remote_kv_url,
             "cache.disagg_role": args.disagg_role,
             "cache.enable_prefix_caching": not args.no_prefix_caching,
+            **(
+                {"cache.kv_cache_dtype": args.kv_cache_dtype}
+                if args.kv_cache_dtype else {}
+            ),
             **({"model.dtype": args.dtype} if args.dtype else {}),
             **(
                 {"model.quantization": args.quantization}
