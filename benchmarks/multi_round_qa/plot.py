@@ -1,0 +1,109 @@
+"""TTFT-vs-QPS sweep plots from the per-request CSVs run.sh writes.
+
+Reference counterpart: benchmarks/multi-round-qa/plot.py (pandas +
+matplotlib figures comparing stacks at each QPS point).  Input files are
+``<prefix>_qps<q>.csv`` as produced by ``run.sh``; pass several prefixes
+to overlay configurations (e.g. session routing vs round robin, KV
+offload on vs off).
+
+  python plot.py --prefix sweep --prefix baseline --output ttft_vs_qps.png
+
+Outputs one figure with two panels: mean/p50/p90 TTFT vs offered QPS,
+and aggregate output tokens/s vs offered QPS.
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import glob
+import os
+import re
+from typing import Dict, List
+
+
+def load_sweep(prefix: str) -> Dict[float, List[dict]]:
+    """{qps: [request rows]} for every <prefix>_qps*.csv present."""
+    out: Dict[float, List[dict]] = {}
+    for path in sorted(glob.glob(f"{prefix}_qps*.csv")):
+        m = re.search(r"_qps([0-9.]+)\.csv$", path)
+        if not m:
+            continue
+        with open(path, newline="") as f:
+            rows = [r for r in csv.DictReader(f) if not r.get("error")]
+        if rows:
+            out[float(m.group(1))] = rows
+    if not out:
+        raise SystemExit(f"no files matched {prefix}_qps*.csv")
+    return out
+
+
+def percentile(values: List[float], p: float) -> float:
+    xs = sorted(values)
+    if not xs:
+        return float("nan")
+    idx = min(int(len(xs) * p), len(xs) - 1)
+    return xs[idx]
+
+
+def summarize(sweep: Dict[float, List[dict]]):
+    qps_points = sorted(sweep)
+    stats = {"qps": qps_points, "ttft_mean": [], "ttft_p50": [],
+             "ttft_p90": [], "out_tps": []}
+    for q in qps_points:
+        rows = sweep[q]
+        ttfts = [float(r["ttft"]) for r in rows]
+        stats["ttft_mean"].append(sum(ttfts) / len(ttfts))
+        stats["ttft_p50"].append(percentile(ttfts, 0.50))
+        stats["ttft_p90"].append(percentile(ttfts, 0.90))
+        t0 = min(float(r["launch_time"]) for r in rows)
+        t1 = max(float(r["finish_time"]) for r in rows)
+        total_gen = sum(int(r["generation_tokens"]) for r in rows)
+        stats["out_tps"].append(total_gen / max(t1 - t0, 1e-9))
+    return stats
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description="Plot multi-round-QA sweeps")
+    ap.add_argument("--prefix", action="append", required=True,
+                    help="CSV prefix as passed to run.sh (repeatable to "
+                    "overlay configurations)")
+    ap.add_argument("--label", action="append", default=None,
+                    help="legend label per --prefix (defaults to prefix)")
+    ap.add_argument("--output", default="ttft_vs_qps.png")
+    args = ap.parse_args(argv)
+
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    labels = args.label or args.prefix
+    if len(labels) != len(args.prefix):
+        raise SystemExit("--label count must match --prefix count")
+
+    fig, (ax_ttft, ax_tps) = plt.subplots(1, 2, figsize=(11, 4.2))
+    for prefix, label in zip(args.prefix, labels):
+        stats = summarize(load_sweep(prefix))
+        ax_ttft.plot(stats["qps"], stats["ttft_mean"], "o-",
+                     label=f"{label} mean")
+        ax_ttft.plot(stats["qps"], stats["ttft_p90"], "^--",
+                     label=f"{label} p90", alpha=0.6)
+        ax_tps.plot(stats["qps"], stats["out_tps"], "o-", label=label)
+    ax_ttft.set_xlabel("offered QPS")
+    ax_ttft.set_ylabel("TTFT (s)")
+    ax_ttft.set_title("Time to first token vs load")
+    ax_ttft.grid(True, alpha=0.3)
+    ax_ttft.legend()
+    ax_tps.set_xlabel("offered QPS")
+    ax_tps.set_ylabel("output tokens/s")
+    ax_tps.set_title("Aggregate generation throughput vs load")
+    ax_tps.grid(True, alpha=0.3)
+    ax_tps.legend()
+    fig.tight_layout()
+    fig.savefig(args.output, dpi=144)
+    print(f"wrote {args.output} ({os.path.getsize(args.output)} bytes)")
+
+
+if __name__ == "__main__":
+    main()

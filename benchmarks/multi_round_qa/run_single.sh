@@ -1,0 +1,23 @@
+#!/bin/bash
+# One measurement point (reference run_single.sh): a single QPS against a
+# running stack, with CSV output for plot.py.
+#
+# usage: ./run_single.sh <model> <base-url> <qps> [output.csv]
+set -euo pipefail
+cd "$(dirname "$0")"
+
+MODEL="${1:?usage: run_single.sh <model> <base-url> <qps> [output.csv]}"
+BASE_URL="${2:?usage: run_single.sh <model> <base-url> <qps> [output.csv]}"
+QPS="${3:?usage: run_single.sh <model> <base-url> <qps> [output.csv]}"
+OUTPUT="${4:-single_qps${QPS}.csv}"
+
+python3 multi_round_qa.py \
+  --base-url "$BASE_URL" --model "$MODEL" \
+  --num-users 320 --num-rounds 10 \
+  --qps "$QPS" \
+  --shared-system-prompt 1000 \
+  --user-history-prompt 20000 \
+  --answer-len 100 \
+  --seed-history-rounds 3 \
+  --duration 100 \
+  --output "$OUTPUT"

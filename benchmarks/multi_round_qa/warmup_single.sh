@@ -1,0 +1,21 @@
+#!/bin/bash
+# Warmup pass (reference warmup_single.sh): seed every user's chat history
+# through the stack (fills prefix caches / KV offload tiers) without
+# recording, so a following run_single.sh measures steady state.
+#
+# usage: ./warmup_single.sh <model> <base-url>
+set -euo pipefail
+cd "$(dirname "$0")"
+
+MODEL="${1:?usage: warmup_single.sh <model> <base-url>}"
+BASE_URL="${2:?usage: warmup_single.sh <model> <base-url>}"
+
+python3 multi_round_qa.py \
+  --base-url "$BASE_URL" --model "$MODEL" \
+  --num-users 320 --num-rounds 2 \
+  --qps 2.0 \
+  --shared-system-prompt 1000 \
+  --user-history-prompt 20000 \
+  --answer-len 100 \
+  --duration 60 \
+  --output /dev/null
