@@ -51,6 +51,11 @@ class PIIType(enum.Enum):
     IBAN = "iban"
     API_KEY = "api_key"
     PRIVATE_KEY = "private_key"
+    # NER-detected entity classes (NERAnalyzer; reference
+    # analyzers/presidio.py maps the same presidio entities).
+    PERSON = "person"
+    LOCATION = "location"
+    ORGANIZATION = "organization"
 
 
 class RegexAnalyzer:
@@ -167,10 +172,96 @@ class StrictAnalyzer:
         return found
 
 
+# Model-side entity labels -> PIIType.  Covers the two common NER label
+# vocabularies: CoNLL (PER/LOC/ORG, with or without B-/I- prefixes, the
+# `entity_group` keys of transformers' aggregation) and presidio's
+# (PERSON/LOCATION/ORGANIZATION).
+_NER_LABEL_MAP = {
+    "PER": PIIType.PERSON,
+    "PERSON": PIIType.PERSON,
+    "LOC": PIIType.LOCATION,
+    "LOCATION": PIIType.LOCATION,
+    "GPE": PIIType.LOCATION,
+    "ORG": PIIType.ORGANIZATION,
+    "ORGANIZATION": PIIType.ORGANIZATION,
+}
+
+
+class NERAnalyzer:
+    """NER-grade analyzer (reference analyzers/presidio.py, 172 LoC).
+
+    Presidio itself is not an installable dependency here; the same
+    capability comes from a ``transformers`` token-classification
+    pipeline over a LOCAL model checkpoint (``model_path`` argument or
+    ``PSTPU_PII_NER_MODEL`` env — e.g. a dslim/bert-base-NER download
+    baked into the deployment image).  Like presidio — whose analyzer
+    bundles pattern recognizers alongside the NLP engine — this composes
+    the regex + secrets analyzers with the model, so "ner" is a strict
+    superset of "strict".
+
+    ``pipeline`` injection exists for tests and for callers that already
+    hold a loaded pipeline (one model can back many router workers).
+    """
+
+    name = "ner"
+
+    def __init__(self, pipeline=None, model_path: str = None,
+                 score_threshold: float = 0.5):
+        import os
+
+        self.score_threshold = score_threshold
+        self._pattern_analyzers = [RegexAnalyzer(), SecretsAnalyzer()]
+        if pipeline is not None:
+            self._pipeline = pipeline
+            return
+        model_path = model_path or os.environ.get("PSTPU_PII_NER_MODEL")
+        if not model_path:
+            raise RuntimeError(
+                "PII analyzer 'ner' needs a token-classification model: "
+                "set PSTPU_PII_NER_MODEL to a local checkpoint directory "
+                "(e.g. a dslim/bert-base-NER download) or pass "
+                "model_path=.  The 'strict' analyzer needs no model."
+            )
+        try:
+            from transformers import pipeline as hf_pipeline
+        except ImportError as e:  # pragma: no cover - transformers baked in
+            raise RuntimeError(
+                "PII analyzer 'ner' requires the 'transformers' package"
+            ) from e
+        self._pipeline = hf_pipeline(
+            "token-classification", model=model_path,
+            aggregation_strategy="simple",
+        )
+
+    def analyze(self, text: str) -> Set[PIIType]:
+        found: Set[PIIType] = set()
+        for analyzer in self._pattern_analyzers:
+            found |= analyzer.analyze(text)
+        try:
+            entities = self._pipeline(text)
+        except Exception:
+            # Fail toward detection pressure, not silence: the middleware's
+            # block-on-error policy handles hard failures; a soft model
+            # error keeps the pattern findings.
+            logger.exception("NER pipeline failed; pattern results only")
+            return found
+        for ent in entities or []:
+            label = str(
+                ent.get("entity_group") or ent.get("entity") or ""
+            ).upper()
+            label = label.split("-", 1)[-1]  # B-PER / I-PER -> PER
+            score = float(ent.get("score", 1.0))
+            pii_type = _NER_LABEL_MAP.get(label)
+            if pii_type is not None and score >= self.score_threshold:
+                found.add(pii_type)
+        return found
+
+
 _ANALYZERS = {
     RegexAnalyzer.name: RegexAnalyzer,
     SecretsAnalyzer.name: SecretsAnalyzer,
     StrictAnalyzer.name: StrictAnalyzer,
+    NERAnalyzer.name: NERAnalyzer,
 }
 
 

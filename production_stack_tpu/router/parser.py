@@ -103,9 +103,12 @@ def parse_args(argv=None) -> argparse.Namespace:
     parser.add_argument(
         "--pii-analyzer",
         default="regex",
-        choices=["regex", "secrets", "strict"],
+        choices=["regex", "secrets", "strict", "ner"],
         help="regex: classic PII patterns; secrets: credential material "
-        "(API keys, private keys, IBANs); strict: both",
+        "(API keys, private keys, IBANs); strict: both; ner: strict plus "
+        "a transformers token-classification model (PERSON/LOCATION/"
+        "ORGANIZATION entities; needs PSTPU_PII_NER_MODEL pointing at a "
+        "local checkpoint — the reference's presidio-analyzer analogue)",
     )
 
     parser.add_argument("--request-rewriter", default="noop")

@@ -316,3 +316,80 @@ async def test_unknown_gate_fails_startup():
     ]
     with pytest.raises(ValueError, match="Unknown feature gate"):
         build_app(parse_args(argv))
+
+
+class _FakePipeline:
+    """Stand-in transformers token-classification pipeline."""
+
+    def __init__(self, entities):
+        self.entities = entities
+        self.calls = []
+
+    def __call__(self, text):
+        self.calls.append(text)
+        return self.entities
+
+
+def test_ner_analyzer_maps_entities_and_thresholds():
+    from production_stack_tpu.router.experimental.pii import NERAnalyzer
+
+    pipe = _FakePipeline([
+        {"entity_group": "PER", "score": 0.99, "word": "Ada Lovelace"},
+        {"entity_group": "LOC", "score": 0.95, "word": "London"},
+        {"entity_group": "ORG", "score": 0.30, "word": "Acme"},  # below thr.
+        {"entity_group": "MISC", "score": 0.99, "word": "Python"},  # unmapped
+    ])
+    analyzer = NERAnalyzer(pipeline=pipe)
+    found = analyzer.analyze("Ada Lovelace moved to London for Acme.")
+    assert PIIType.PERSON in found
+    assert PIIType.LOCATION in found
+    assert PIIType.ORGANIZATION not in found  # thresholded out
+    assert pipe.calls  # the model actually ran
+
+
+def test_ner_analyzer_handles_bio_tags_and_presidio_labels():
+    from production_stack_tpu.router.experimental.pii import NERAnalyzer
+
+    pipe = _FakePipeline([
+        {"entity": "B-PER", "score": 0.9},
+        {"entity": "I-PER", "score": 0.9},
+        {"entity_group": "PERSON", "score": 0.9},
+        {"entity_group": "GPE", "score": 0.9},
+    ])
+    found = NERAnalyzer(pipeline=pipe).analyze("x")
+    assert found >= {PIIType.PERSON, PIIType.LOCATION}
+
+
+def test_ner_analyzer_supersets_strict():
+    """Presidio-style: the NLP analyzer bundles the pattern recognizers,
+    so regex/secrets findings surface even with a silent model."""
+    from production_stack_tpu.router.experimental.pii import NERAnalyzer
+
+    text = "ssn 123-45-6789 and key sk-abcdefghijklmnopqrstuvwx"
+    want = StrictAnalyzer().analyze(text)
+    got = NERAnalyzer(pipeline=_FakePipeline([])).analyze(text)
+    assert got >= want and want
+
+
+def test_ner_analyzer_soft_fails_to_pattern_results():
+    from production_stack_tpu.router.experimental.pii import NERAnalyzer
+
+    class ExplodingPipeline:
+        def __call__(self, text):
+            raise RuntimeError("model died")
+
+    found = NERAnalyzer(pipeline=ExplodingPipeline()).analyze(
+        "reach me at a@b.co"
+    )
+    assert PIIType.EMAIL in found  # pattern findings survive
+
+
+def test_ner_analyzer_requires_model_path(monkeypatch):
+    from production_stack_tpu.router.experimental.pii import NERAnalyzer
+
+    monkeypatch.delenv("PSTPU_PII_NER_MODEL", raising=False)
+    with pytest.raises(RuntimeError, match="PSTPU_PII_NER_MODEL"):
+        NERAnalyzer()
+    # And the factory exposes it by name (parser choice 'ner').
+    with pytest.raises(RuntimeError, match="PSTPU_PII_NER_MODEL"):
+        create_analyzer("ner")
