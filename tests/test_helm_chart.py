@@ -373,3 +373,42 @@ def test_single_host_unchanged_by_multihost_support():
     for d in by_kind(objs, "Deployment"):
         env = d["spec"]["template"]["spec"]["containers"][0].get("env", [])
         assert "PSTPU_NUM_PROCESSES" not in {e["name"] for e in env}
+
+
+def test_router_dynamic_config_mount():
+    """routerSpec.dynamicConfig.enabled wires the operator pipeline into
+    the chart: ConfigMap projected at /dynamic, --dynamic-config-json
+    flag, optional:true so the router boots before the first reconcile
+    (consumed by .github/workflows/minikube-e2e.yml)."""
+    values = ci_values()
+    values.setdefault("routerSpec", {})["dynamicConfig"] = {"enabled": True}
+    objs = load_manifests(render_chart(CHART_DIR, values, release_name="dc"))
+    router = [d for d in by_kind(objs, "Deployment")
+              if d["metadata"]["name"] == "dc-deployment-router"][0]
+    pod = router["spec"]["template"]["spec"]
+    container = pod["containers"][0]
+    args = container["args"]
+    idx = args.index("--dynamic-config-json")
+    assert args[idx + 1] == "/dynamic/dynamic_config.json"
+    mounts = {m["name"]: m for m in container["volumeMounts"]}
+    assert mounts["dynamic-config"]["mountPath"] == "/dynamic"
+    vols = {v["name"]: v for v in pod["volumes"]}
+    cm = vols["dynamic-config"]["configMap"]
+    assert cm["name"] == "dc-dynamic-config"
+    assert cm["optional"] is True
+    # Explicit name override flows through.
+    values["routerSpec"]["dynamicConfig"]["configMapName"] = "custom-cm"
+    objs = load_manifests(render_chart(CHART_DIR, values, release_name="dc"))
+    router = [d for d in by_kind(objs, "Deployment")
+              if d["metadata"]["name"] == "dc-deployment-router"][0]
+    vols = {v["name"]: v
+            for v in router["spec"]["template"]["spec"]["volumes"]}
+    assert vols["dynamic-config"]["configMap"]["name"] == "custom-cm"
+    # And off by default: no mount, no flag.
+    objs = load_manifests(
+        render_chart(CHART_DIR, ci_values(), release_name="dc")
+    )
+    router = [d for d in by_kind(objs, "Deployment")
+              if d["metadata"]["name"] == "dc-deployment-router"][0]
+    container = router["spec"]["template"]["spec"]["containers"][0]
+    assert "--dynamic-config-json" not in container["args"]
