@@ -38,10 +38,21 @@ class FakeK8sControlPlane:
     def __init__(self, projection_dir: Optional[str] = None):
         self.staticroutes: Dict[Tuple[str, str], dict] = {}
         self.configmaps: Dict[Tuple[str, str], dict] = {}
+        self.leases: Dict[Tuple[str, str], dict] = {}
         self.status_patches: List[dict] = []
         self.projection_dir = projection_dir
         self.watch_queues: List[asyncio.Queue] = []
         self._rv = 0
+        # API load accounting (operator soak tests: the status-write /
+        # watch-wake feedback loop must not hot-spin the API server).
+        self.request_count = 0
+        self.request_log: List[str] = []
+
+    @web.middleware
+    async def _count_requests(self, request: web.Request, handler):
+        self.request_count += 1
+        self.request_log.append(f"{request.method} {request.path}")
+        return await handler(request)
 
     # -- state manipulation (the "kubectl" side) ---------------------------
 
@@ -117,9 +128,22 @@ class FakeK8sControlPlane:
     # -- HTTP handlers ------------------------------------------------------
 
     def build_app(self) -> web.Application:
-        app = web.Application()
+        app = web.Application(middlewares=[self._count_requests])
         app.router.add_get(
             f"/apis/{GROUP}/{VERSION}/{PLURAL}", self.handle_list_or_watch
+        )
+        # coordination.k8s.io Leases (operator leader election).
+        app.router.add_get(
+            "/apis/coordination.k8s.io/v1/namespaces/{ns}/leases/{name}",
+            self.handle_lease_get,
+        )
+        app.router.add_post(
+            "/apis/coordination.k8s.io/v1/namespaces/{ns}/leases",
+            self.handle_lease_create,
+        )
+        app.router.add_put(
+            "/apis/coordination.k8s.io/v1/namespaces/{ns}/leases/{name}",
+            self.handle_lease_update,
         )
         app.router.add_get(
             f"/apis/{GROUP}/{VERSION}/namespaces/{{ns}}/{PLURAL}",
@@ -195,6 +219,48 @@ class FakeK8sControlPlane:
         # operator must not reconcile-loop on its own status patches.
         await self._emit("MODIFIED", obj)
         return web.json_response(obj)
+
+    # -- coordination.k8s.io Leases (leader election) ----------------------
+
+    async def handle_lease_get(self, request: web.Request):
+        key = (request.match_info["ns"], request.match_info["name"])
+        lease = self.leases.get(key)
+        if lease is None:
+            return web.json_response(
+                {"kind": "Status", "reason": "NotFound", "code": 404},
+                status=404,
+            )
+        return web.json_response(lease)
+
+    async def handle_lease_create(self, request: web.Request):
+        ns = request.match_info["ns"]
+        lease = await request.json()
+        name = lease.get("metadata", {}).get("name")
+        if not name:
+            return web.json_response({"reason": "Invalid"}, status=422)
+        if (ns, name) in self.leases:
+            return web.json_response({"reason": "AlreadyExists"}, status=409)
+        lease.setdefault("metadata", {})["resourceVersion"] = self.next_rv()
+        self.leases[(ns, name)] = lease
+        return web.json_response(lease, status=201)
+
+    async def handle_lease_update(self, request: web.Request):
+        key = (request.match_info["ns"], request.match_info["name"])
+        current = self.leases.get(key)
+        if current is None:
+            return web.json_response({"reason": "NotFound"}, status=404)
+        lease = await request.json()
+        sent_rv = lease.get("metadata", {}).get("resourceVersion")
+        # Optimistic concurrency: two contenders racing an update must
+        # conflict exactly like a real apiserver.
+        if sent_rv != current["metadata"]["resourceVersion"]:
+            return web.json_response(
+                {"kind": "Status", "reason": "Conflict", "code": 409},
+                status=409,
+            )
+        lease.setdefault("metadata", {})["resourceVersion"] = self.next_rv()
+        self.leases[key] = lease
+        return web.json_response(lease)
 
     async def handle_cm_get(self, request: web.Request):
         ns, name = request.match_info["ns"], request.match_info["name"]
