@@ -307,7 +307,8 @@ def prefill(
     scale = cfg.head_dim**-0.5
     use_ring = _sp_size(mesh) > 1
     positions = cached_len + jnp.arange(T)
-    cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
+    cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta,
+                            cfg.rope_scaling)
 
     x = _embed(params, cfg, tokens)  # [T, h]
     x = _constrain(x, mesh, P(AXES.SP, None))
@@ -425,7 +426,8 @@ def encode(
     T = tokens.shape[0]
     scale = cfg.head_dim**-0.5
     positions = jnp.arange(T)
-    cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
+    cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta,
+                            cfg.rope_scaling)
     empty_k = jnp.zeros((0, cfg.num_kv_heads, cfg.head_dim), cfg.dtype)
     empty_v = empty_k
 
@@ -473,7 +475,8 @@ def decode(
     replicated across dp so any sequence can land on any dp group."""
     S = tokens.shape[0]
     scale = cfg.head_dim**-0.5
-    cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
+    cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta,
+                            cfg.rope_scaling)
 
     x = _embed(params, cfg, tokens)  # [S, h]
     x = _constrain(x, mesh, P(AXES.DP, None))

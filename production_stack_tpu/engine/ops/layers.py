@@ -25,16 +25,41 @@ def rms_norm(
     return (normed * (offset + weight.astype(jnp.float32))).astype(orig_dtype)
 
 
-def rope_cos_sin(positions: jax.Array, head_dim: int, theta: float):
+def rope_cos_sin(positions: jax.Array, head_dim: int, theta: float,
+                 rope_scaling=None):
     """cos/sin tables for rotate-half RoPE at the given positions.
 
     positions: int array [...]; returns cos/sin of shape [..., head_dim]
     (frequencies duplicated across both halves, HF convention).
+
+    ``rope_scaling`` supports the llama-3.1 "llama3" scheme (HF
+    modeling_rope_utils._compute_llama3_parameters): low-frequency bands
+    (long wavelengths) are divided by ``factor``, high-frequency bands
+    kept, and the middle band smoothly interpolated — how the 3.1 family
+    stretches an 8k-trained RoPE to 128k contexts.
     """
     half = head_dim // 2
     inv_freq = 1.0 / (
         theta ** (jnp.arange(0, half, dtype=jnp.float32) / half)
     )
+    if rope_scaling is not None:
+        factor = float(rope_scaling["factor"])
+        low = float(rope_scaling.get("low_freq_factor", 1.0))
+        high = float(rope_scaling.get("high_freq_factor", 4.0))
+        orig = float(
+            rope_scaling.get("original_max_position_embeddings", 8192)
+        )
+        low_freq_wavelen = orig / low
+        high_freq_wavelen = orig / high
+        wavelen = 2.0 * jnp.pi / inv_freq
+        scaled = inv_freq / factor
+        smooth = (orig / wavelen - low) / (high - low)
+        interp = (1.0 - smooth) * scaled + smooth * inv_freq
+        inv_freq = jnp.where(
+            wavelen < high_freq_wavelen,
+            inv_freq,
+            jnp.where(wavelen > low_freq_wavelen, scaled, interp),
+        )
     freqs = positions.astype(jnp.float32)[..., None] * inv_freq  # [..., half]
     emb = jnp.concatenate([freqs, freqs], axis=-1)  # [..., head_dim]
     return jnp.cos(emb), jnp.sin(emb)

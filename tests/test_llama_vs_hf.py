@@ -34,6 +34,7 @@ def make_hf_model(cfg: ModelConfig):
         head_dim=cfg.head_dim,
         rms_norm_eps=cfg.rms_norm_eps,
         rope_theta=cfg.rope_theta,
+        rope_scaling=cfg.rope_scaling,
         max_position_embeddings=cfg.max_model_len,
         attention_bias=False,
         tie_word_embeddings=cfg.tie_word_embeddings,
@@ -510,3 +511,60 @@ def test_gemma_prefill_and_decode_match_hf():
     np.testing.assert_allclose(
         np.asarray(step_logits)[0], expected_step, rtol=3e-4, atol=3e-4
     )
+
+
+def test_llama31_rope_scaling_matches_hf():
+    """llama3-style rope scaling (Llama-3.1/3.2 checkpoints: factor,
+    low/high_freq_factor, original_max_position_embeddings) must
+    reproduce HF's scaled-RoPE logits through BOTH paged prefill and
+    iterative decode — positions past original_max are where the scaled
+    bands dominate, so decode continues beyond the prompt."""
+    cfg = tiny_cfg(rope_scaling={
+        "rope_type": "llama3",
+        "factor": 8.0,
+        "low_freq_factor": 1.0,
+        "high_freq_factor": 4.0,
+        # Tiny "original" horizon so the test prompt actually crosses it
+        # (scaling then matters even for short sequences).
+        "original_max_position_embeddings": 8,
+    })
+    model = make_hf_model(cfg)
+    params = hf_to_params(model, cfg)
+
+    prompt = [5, 17, 92, 3, 44, 101, 9, 77, 23, 54, 12, 33]  # 12 > 8
+    T_bucket = 12
+    tokens = jnp.asarray(prompt, jnp.int32)
+    logits, caches = llama.prefill(
+        params, cfg, tokens,
+        cached_len=jnp.int32(0),
+        prefix_block_ids=jnp.zeros((1,), jnp.int32),
+        new_block_ids=jnp.asarray([1, 2, 3], jnp.int32),
+        valid_len=jnp.int32(len(prompt)),
+        kv_caches=fresh_caches(cfg),
+    )
+    expected = hf_all_logits(model, prompt)
+    np.testing.assert_allclose(
+        np.asarray(logits), expected[-1], rtol=2e-4, atol=2e-4
+    )
+
+    # Iterative decode continues past original_max_position_embeddings.
+    seq = list(prompt)
+    block_table = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
+    for step in range(3):
+        pos = len(seq)
+        next_tok = int(np.argmax(expected[-1]))
+        seq.append(next_tok)
+        logits, caches = llama.decode(
+            params, cfg,
+            tokens=jnp.asarray([next_tok], jnp.int32),
+            positions=jnp.asarray([pos], jnp.int32),
+            block_tables=block_table,
+            ctx_lens=jnp.asarray([pos + 1], jnp.int32),
+            slot_block_ids=jnp.asarray([1 + pos // BLOCK_SIZE], jnp.int32),
+            slot_offsets=jnp.asarray([pos % BLOCK_SIZE], jnp.int32),
+            kv_caches=caches,
+        )
+        expected = hf_all_logits(model, seq)
+        np.testing.assert_allclose(
+            np.asarray(logits[0]), expected[-1], rtol=2e-4, atol=2e-4
+        )
