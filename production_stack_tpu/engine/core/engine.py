@@ -778,6 +778,7 @@ class LLMEngine:
             s.sampling_params.temperature <= 0
             and not s.sampling_params.presence_penalty
             and not s.sampling_params.frequency_penalty
+            and s.sampling_params.repetition_penalty == 1.0
             and not s.sampling_params.logprobs
             and not s.sampling_params.logit_bias
             and s.guide is None
@@ -811,6 +812,7 @@ class LLMEngine:
         use_multi = self._decode_multi_fn is not None and not any(
             s.sampling_params.presence_penalty
             or s.sampling_params.frequency_penalty
+            or s.sampling_params.repetition_penalty != 1.0
             or s.sampling_params.logprobs
             or s.sampling_params.logit_bias
             or s.guide is not None
@@ -1028,15 +1030,20 @@ class LLMEngine:
         S = logits.shape[0]
         pad = S - len(seqs)
 
-        # Presence/frequency penalties (OpenAI surface): only pay the
-        # scatter-add when some live sequence uses them AND has output.
-        if any(
+        # Presence/frequency/repetition penalties (OpenAI + vLLM surface):
+        # only pay the scatter-adds when some live sequence uses them.
+        use_rep = any(
+            s.sampling_params.repetition_penalty != 1.0 for s in seqs
+        )
+        if use_rep or any(
             (s.sampling_params.presence_penalty
              or s.sampling_params.frequency_penalty)
             and s.output_token_ids
             for s in seqs
         ):
-            max_len = max(len(s.output_token_ids) for s in seqs)
+            max_len = max(
+                max((len(s.output_token_ids) for s in seqs), default=1), 1
+            )
             # Bucket L so XLA compiles O(log) penalty variants, not one per
             # generated length.
             L = 64
@@ -1054,11 +1061,31 @@ class LLMEngine:
                 [s.sampling_params.frequency_penalty for s in seqs] + [0.0] * pad,
                 np.float32,
             )
+            kwargs = {}
+            if use_rep:
+                # repetition_penalty covers prompt AND generated tokens
+                # (HF/vLLM semantics) — needs the full context ids.
+                max_ctx = max(len(s.all_token_ids) for s in seqs)
+                Lc = 64
+                while Lc < max_ctx:
+                    Lc *= 2
+                ctx_tokens = np.full((S, Lc), -1, np.int32)
+                for i, s in enumerate(seqs):
+                    ids = s.all_token_ids[-Lc:]
+                    ctx_tokens[i, : len(ids)] = ids
+                kwargs = {
+                    "repetition": jnp.asarray(np.array(
+                        [s.sampling_params.repetition_penalty
+                         for s in seqs] + [1.0] * pad, np.float32,
+                    )),
+                    "ctx_tokens": jnp.asarray(ctx_tokens),
+                }
             logits = self._penalties_fn(
                 logits,
                 jnp.asarray(out_tokens),
                 jnp.asarray(presence),
                 jnp.asarray(frequency),
+                **kwargs,
             )
 
         # OpenAI logit_bias: sparse per-request token biases, applied to

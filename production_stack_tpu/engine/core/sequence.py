@@ -44,6 +44,7 @@ class SamplingParams:
     top_logprobs: int = 0  # alternatives returned per token when logprobs
     presence_penalty: float = 0.0
     frequency_penalty: float = 0.0
+    repetition_penalty: float = 1.0  # HF/vLLM semantics; 1.0 = off
     # OpenAI logit_bias: token id -> additive bias in [-100, 100].
     logit_bias: Optional[dict] = None
     # OpenAI completions echo: return the prompt ahead of the completion;

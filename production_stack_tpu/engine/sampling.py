@@ -88,9 +88,14 @@ def apply_penalties(
     out_tokens: jax.Array,  # [S, L] int32 generated-so-far, -1 padded
     presence: jax.Array,  # [S]
     frequency: jax.Array,  # [S]
+    repetition: jax.Array = None,  # [S]; 1.0 = off
+    ctx_tokens: jax.Array = None,  # [S, Lc] prompt+generated, -1 padded
 ) -> jax.Array:
     """OpenAI presence/frequency penalties over the GENERATED tokens (vLLM
-    semantics: the prompt is not penalized).  Per sequence:
+    semantics: the prompt is not penalized), plus the HF/vLLM
+    ``repetition_penalty`` over prompt AND generated tokens: for every
+    seen token, positive logits divide by the penalty, negative multiply
+    (HF ``RepetitionPenaltyLogitsProcessor``).  Per sequence:
     ``logit[t] -= presence*[count(t)>0] + frequency*count(t)``.
 
     The [S, V] count matrix is built on-device by scatter-add from the
@@ -104,7 +109,17 @@ def apply_penalties(
         )
     )(ids, valid)
     penalty = presence[:, None] * (counts > 0) + frequency[:, None] * counts
-    return logits - penalty
+    logits = logits - penalty
+    if repetition is not None:
+        cvalid = ctx_tokens >= 0
+        cids = jnp.where(cvalid, ctx_tokens, 0)
+        seen = jax.vmap(
+            lambda i, v: jnp.zeros((V,), jnp.bool_).at[i].max(v)
+        )(cids, cvalid)
+        rep = repetition[:, None]
+        scaled = jnp.where(logits > 0, logits / rep, logits * rep)
+        logits = jnp.where(seen, scaled, logits)
+    return logits
 
 
 def top_logprobs_of(

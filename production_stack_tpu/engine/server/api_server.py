@@ -87,6 +87,11 @@ def _sampling_from_body(body: dict, chat: bool) -> SamplingParams:
     max_tokens = 128 if raw_max is None else int(raw_max)
     if max_tokens < 0:
         raise ValueError(f"'max_tokens' must be >= 0, got {max_tokens}")
+    rep = body.get("repetition_penalty")
+    if rep is not None and not (isinstance(rep, (int, float)) and rep > 0):
+        raise ValueError(
+            f"'repetition_penalty' must be a positive number, got {rep}"
+        )
     return SamplingParams(
         max_tokens=max_tokens,
         temperature=float(body.get("temperature") or 0.0),
@@ -106,6 +111,7 @@ def _sampling_from_body(body: dict, chat: bool) -> SamplingParams:
         top_logprobs=max(0, min(top_logprobs, 20)),
         presence_penalty=float(body.get("presence_penalty") or 0.0),
         frequency_penalty=float(body.get("frequency_penalty") or 0.0),
+        repetition_penalty=float(body.get("repetition_penalty") or 1.0),
     )
 
 
