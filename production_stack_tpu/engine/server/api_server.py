@@ -326,6 +326,23 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
                 status=400,
             )
         stream = bool(body.get("stream", False))
+        stream_options = body.get("stream_options")
+        if stream_options is not None:
+            # OpenAI: stream_options is only valid with stream=true.
+            if not isinstance(stream_options, dict):
+                return web.json_response(
+                    {"error": {"message": "'stream_options' must be an "
+                               "object", "type": "invalid_request_error"}},
+                    status=400,
+                )
+            if not stream:
+                return web.json_response(
+                    {"error": {"message": "'stream_options' is only "
+                               "allowed when 'stream' is true",
+                               "type": "invalid_request_error"}},
+                    status=400,
+                )
+        include_usage = bool((stream_options or {}).get("include_usage"))
         if params.echo and stream:
             return web.json_response(
                 {"error": {"message": "'echo' is not supported with "
@@ -571,15 +588,28 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
                         live[i] = False
                         total_out += event.num_output_tokens
                         final = chunk_payload("", reason, first[i], index=i)
-                        if sum(live) == 0:
-                            final["usage"] = {
-                                "prompt_tokens": len(prompt_token_ids),
-                                "completion_tokens": total_out,
-                                "total_tokens": len(prompt_token_ids) + total_out,
-                            }
                         await response.write(
                             f"data: {json.dumps(final)}\n\n".encode()
                         )
+                if include_usage:
+                    # OpenAI stream_options.include_usage: one extra
+                    # final chunk with empty choices carrying the usage
+                    # (and no usage anywhere otherwise).
+                    usage_chunk = {
+                        "id": request_id,
+                        "object": object_name,
+                        "created": created,
+                        "model": model_name,
+                        "choices": [],
+                        "usage": {
+                            "prompt_tokens": len(prompt_token_ids),
+                            "completion_tokens": total_out,
+                            "total_tokens": len(prompt_token_ids) + total_out,
+                        },
+                    }
+                    await response.write(
+                        f"data: {json.dumps(usage_chunk)}\n\n".encode()
+                    )
                 await response.write(b"data: [DONE]\n\n")
                 await response.write_eof()
             except ConnectionResetError:

@@ -1,6 +1,8 @@
 """min_p / logit_bias / stop_token_ids (OpenAI + vLLM sampling surface).
 """
 
+import json
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -101,3 +103,71 @@ def test_logit_bias_falls_back_to_single_step():
     out, _ = drain(engine, SamplingParams(
         max_tokens=3, logit_bias={banned: -100.0}))
     assert banned not in out
+
+
+async def test_stream_options_include_usage_conformance():
+    """OpenAI stream_options semantics: without include_usage no chunk
+    carries usage; with it, one extra final chunk (empty choices) does;
+    stream_options without stream=true is a 400."""
+    import aiohttp
+    from aiohttp.test_utils import TestServer
+
+    from production_stack_tpu.engine.config import config_from_preset
+    from production_stack_tpu.engine.server.api_server import build_engine_app
+    from production_stack_tpu.engine.server.async_engine import AsyncEngine
+
+    config = config_from_preset(
+        "tiny-llama",
+        **{"scheduler.max_num_seqs": 2, "scheduler.max_model_len": 256,
+           "cache.num_blocks": 128},
+    )
+    engine = AsyncEngine(config)
+    server = TestServer(build_engine_app(engine, "tiny-llama"))
+    await server.start_server()
+    url = f"http://127.0.0.1:{server.port}"
+
+    async def stream_chunks(payload):
+        chunks = []
+        async with aiohttp.ClientSession() as session:
+            async with session.post(
+                f"{url}/v1/chat/completions", json=payload
+            ) as resp:
+                assert resp.status == 200
+                async for raw in resp.content:
+                    line = raw.decode().strip()
+                    if line.startswith("data: ") and line != "data: [DONE]":
+                        chunks.append(json.loads(line[6:]))
+        return chunks
+
+    base = {"model": "tiny-llama", "max_tokens": 4,
+            "messages": [{"role": "user", "content": "hi"}], "stream": True}
+    try:
+        plain = await stream_chunks(base)
+        assert plain and all("usage" not in c for c in plain)
+
+        with_usage = await stream_chunks(
+            {**base, "stream_options": {"include_usage": True}}
+        )
+        usage_chunks = [c for c in with_usage if "usage" in c]
+        assert len(usage_chunks) == 1
+        assert usage_chunks[0] is with_usage[-1]
+        assert usage_chunks[0]["choices"] == []
+        u = usage_chunks[0]["usage"]
+        assert u["completion_tokens"] == 4
+        assert u["total_tokens"] == u["prompt_tokens"] + 4
+        # Content chunks still arrived before it.
+        assert any(
+            c["choices"] and c["choices"][0]["delta"].get("content")
+            for c in with_usage[:-1]
+        )
+
+        async with aiohttp.ClientSession() as session:
+            async with session.post(f"{url}/v1/chat/completions", json={
+                **{k: v for k, v in base.items() if k != "stream"},
+                "stream_options": {"include_usage": True},
+            }) as resp:
+                assert resp.status == 400
+                body = await resp.json()
+                assert "stream_options" in body["error"]["message"]
+    finally:
+        await server.close()
