@@ -211,6 +211,8 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
              s["remote_prefix_blocks_fetched"]),
             (vocab.TPU_REMOTE_PREFIX_BLOCKS_EXPORTED,
              s["remote_prefix_blocks_exported"]),
+            (vocab.TPU_SPEC_TOKENS_DRAFTED, s["spec_tokens_drafted"]),
+            (vocab.TPU_SPEC_TOKENS_ACCEPTED, s["spec_tokens_accepted"]),
         ]
         return web.Response(text=vocab.render_prometheus(pairs))
 

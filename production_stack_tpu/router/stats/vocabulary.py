@@ -70,6 +70,10 @@ TPU_NUM_PREEMPTIONS = "tpu:num_preemptions"
 # pushed to the shared store.
 TPU_REMOTE_PREFIX_BLOCKS_FETCHED = "tpu:remote_prefix_blocks_fetched"
 TPU_REMOTE_PREFIX_BLOCKS_EXPORTED = "tpu:remote_prefix_blocks_exported"
+# N-gram speculative decoding effectiveness (acceptance rate =
+# accepted/drafted; a low rate means the drafter wastes verify FLOPs).
+TPU_SPEC_TOKENS_DRAFTED = "tpu:spec_tokens_drafted"
+TPU_SPEC_TOKENS_ACCEPTED = "tpu:spec_tokens_accepted"
 TPU_COUNTERS = frozenset({
     TPU_TOTAL_PROMPT_TOKENS,
     TPU_TOTAL_GENERATED_TOKENS,
@@ -77,6 +81,8 @@ TPU_COUNTERS = frozenset({
     TPU_NUM_PREEMPTIONS,
     TPU_REMOTE_PREFIX_BLOCKS_FETCHED,
     TPU_REMOTE_PREFIX_BLOCKS_EXPORTED,
+    TPU_SPEC_TOKENS_DRAFTED,
+    TPU_SPEC_TOKENS_ACCEPTED,
 })
 
 

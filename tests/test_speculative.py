@@ -106,3 +106,48 @@ def test_eos_or_stop_mid_acceptance_truncates():
 def test_config_exclusivity():
     with pytest.raises(ValueError, match="mutually exclusive"):
         SchedulerConfig(num_scheduler_steps=4, speculative_ngram=4)
+
+
+async def test_spec_counters_exported_at_metrics():
+    """The drafted/accepted counters surface on the engine's /metrics in
+    the tpu: vocabulary (dashboards derive the acceptance rate)."""
+    import aiohttp
+    from aiohttp.test_utils import TestServer
+
+    from production_stack_tpu.engine.config import config_from_preset
+    from production_stack_tpu.engine.server.api_server import build_engine_app
+    from production_stack_tpu.engine.server.async_engine import AsyncEngine
+
+    config = config_from_preset(
+        "tiny-llama",
+        **{"scheduler.max_num_seqs": 2, "scheduler.max_model_len": 256,
+           "cache.num_blocks": 128, "scheduler.speculative_ngram": 2},
+    )
+    engine = AsyncEngine(config)
+    server = TestServer(build_engine_app(engine, "tiny-llama"))
+    await server.start_server()
+    url = f"http://127.0.0.1:{server.port}"
+    try:
+        async with aiohttp.ClientSession() as session:
+            async with session.post(f"{url}/v1/completions", json={
+                "model": "tiny-llama",
+                "prompt": "one two three one two three one two three",
+                "max_tokens": 12,
+            }) as resp:
+                assert resp.status == 200
+            async with session.get(f"{url}/metrics") as resp:
+                text = await resp.text()
+        assert "tpu:spec_tokens_drafted" in text
+        assert "tpu:spec_tokens_accepted" in text
+        # Drafting is opportunistic (depends on n-gram hits in the random
+        # model's output); the contract here is exported, parseable,
+        # consistent counters.
+        def read(name):
+            return [float(ln.rsplit(" ", 1)[1]) for ln in text.splitlines()
+                    if ln.startswith(name + " ")]
+        drafted = read("tpu:spec_tokens_drafted")
+        accepted = read("tpu:spec_tokens_accepted")
+        assert drafted and accepted
+        assert 0 <= accepted[0] <= drafted[0] or drafted[0] == 0
+    finally:
+        await server.close()
