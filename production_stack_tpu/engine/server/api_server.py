@@ -1054,6 +1054,61 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
     app.router.add_post("/admin/lora", lora_load)
     app.router.add_delete("/admin/lora/{name}", lora_unload)
 
+    # On-demand device profiling (vLLM's /start_profile and /stop_profile,
+    # TPU-native: jax.profiler traces, viewable in TensorBoard/XProf or
+    # Perfetto).  Serving continues while the trace records, so a
+    # production TTFT spike can be captured in situ.
+    profile_state = {"dir": None}
+
+    async def start_profile(request: web.Request) -> web.Response:
+        if profile_state["dir"] is not None:
+            return web.json_response(
+                {"error": {"message": "profiling already running "
+                           f"(writing {profile_state['dir']})"}},
+                status=409,
+            )
+        import jax
+
+        try:
+            body = await request.json()
+        except Exception:
+            body = {}
+        trace_dir = body.get("trace_dir") or os.environ.get(
+            "PSTPU_PROFILE_DIR", "/tmp/pstpu_profile"
+        )
+        try:
+            jax.profiler.start_trace(trace_dir)
+        except Exception as e:
+            return web.json_response(
+                {"error": {"message": f"start_trace failed: {e}"}},
+                status=500,
+            )
+        profile_state["dir"] = trace_dir
+        logger.info("profiling started -> %s", trace_dir)
+        return web.json_response({"ok": True, "trace_dir": trace_dir})
+
+    async def stop_profile(_req: web.Request) -> web.Response:
+        if profile_state["dir"] is None:
+            return web.json_response(
+                {"error": {"message": "profiling is not running"}},
+                status=409,
+            )
+        import jax
+
+        trace_dir, profile_state["dir"] = profile_state["dir"], None
+        try:
+            jax.profiler.stop_trace()
+        except Exception as e:
+            return web.json_response(
+                {"error": {"message": f"stop_trace failed: {e}"}},
+                status=500,
+            )
+        logger.info("profiling stopped; trace in %s", trace_dir)
+        return web.json_response({"ok": True, "trace_dir": trace_dir})
+
+    app.router.add_post("/start_profile", start_profile)
+    app.router.add_post("/stop_profile", stop_profile)
+
     async def lifecycle(app):
         await engine.start()
         yield
