@@ -779,6 +779,7 @@ class LLMEngine:
             and not s.sampling_params.presence_penalty
             and not s.sampling_params.frequency_penalty
             and s.sampling_params.repetition_penalty == 1.0
+            and not s.sampling_params.min_tokens
             and not s.sampling_params.logprobs
             and not s.sampling_params.logit_bias
             and s.guide is None
@@ -813,6 +814,7 @@ class LLMEngine:
             s.sampling_params.presence_penalty
             or s.sampling_params.frequency_penalty
             or s.sampling_params.repetition_penalty != 1.0
+            or s.sampling_params.min_tokens > len(s.output_token_ids)
             or s.sampling_params.logprobs
             or s.sampling_params.logit_bias
             or s.guide is not None
@@ -1094,10 +1096,29 @@ class LLMEngine:
         # composition — a biased request decodes many tokens against the
         # same bias, and rebuilding/transferring it per token would
         # dominate the step.
-        if any(s.sampling_params.logit_bias for s in seqs):
+        def _min_tokens_banned(s) -> tuple:
+            """Token ids suppressed while min_tokens is unmet: EOS and
+            every stop_token_id (vLLM min_tokens semantics)."""
+            sp = s.sampling_params
+            if sp.min_tokens <= len(s.output_token_ids):
+                return ()
+            banned = list(sp.stop_token_ids or ())
+            if self.tokenizer.eos_token_id is not None and not sp.ignore_eos:
+                banned.append(self.tokenizer.eos_token_id)
+            return tuple(sorted(set(banned)))
+
+        min_tok_banned = [_min_tokens_banned(s) for s in seqs]
+        if any(s.sampling_params.logit_bias for s in seqs) or any(
+            min_tok_banned
+        ):
             V = logits.shape[-1]
+            # The cache key includes the min_tokens ban set, which flips
+            # exactly once per sequence (unmet -> met): two rebuilds per
+            # affected batch composition, not one per step.
             key = (S, V) + tuple(
-                (i, tuple(sorted((s.sampling_params.logit_bias or {}).items())))
+                (i,
+                 tuple(sorted((s.sampling_params.logit_bias or {}).items())),
+                 min_tok_banned[i])
                 for i, s in enumerate(seqs)
             )
             cached = getattr(self, "_bias_cache", None)
@@ -1108,6 +1129,9 @@ class LLMEngine:
                         t = int(tid)
                         if 0 <= t < V:
                             bias[i, t] = float(b)
+                    for t in min_tok_banned[i]:
+                        if 0 <= t < V:
+                            bias[i, t] = -1e9
                 self._bias_cache = (key, jnp.asarray(bias))
             logits = logits + self._bias_cache[1]
 

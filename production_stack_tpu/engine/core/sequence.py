@@ -45,6 +45,9 @@ class SamplingParams:
     presence_penalty: float = 0.0
     frequency_penalty: float = 0.0
     repetition_penalty: float = 1.0  # HF/vLLM semantics; 1.0 = off
+    # vLLM min_tokens: EOS + stop_token_ids are suppressed at the logits
+    # until this many tokens have been generated.
+    min_tokens: int = 0
     # OpenAI logit_bias: token id -> additive bias in [-100, 100].
     logit_bias: Optional[dict] = None
     # OpenAI completions echo: return the prompt ahead of the completion;

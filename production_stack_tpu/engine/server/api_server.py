@@ -92,6 +92,11 @@ def _sampling_from_body(body: dict, chat: bool) -> SamplingParams:
         raise ValueError(
             f"'repetition_penalty' must be a positive number, got {rep}"
         )
+    min_tokens = int(body.get("min_tokens") or 0)
+    if min_tokens < 0 or min_tokens > max_tokens:
+        raise ValueError(
+            f"'min_tokens' must be in [0, max_tokens], got {min_tokens}"
+        )
     return SamplingParams(
         max_tokens=max_tokens,
         temperature=float(body.get("temperature") or 0.0),
@@ -112,6 +117,7 @@ def _sampling_from_body(body: dict, chat: bool) -> SamplingParams:
         presence_penalty=float(body.get("presence_penalty") or 0.0),
         frequency_penalty=float(body.get("frequency_penalty") or 0.0),
         repetition_penalty=float(body.get("repetition_penalty") or 1.0),
+        min_tokens=min_tokens,
     )
 
 
@@ -1053,6 +1059,68 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
     app.router.add_get("/admin/lora", lora_list)
     app.router.add_post("/admin/lora", lora_load)
     app.router.add_delete("/admin/lora/{name}", lora_unload)
+
+    # vLLM's /tokenize + /detokenize: clients budget long-context
+    # requests against max_model_len without shipping the tokenizer.
+    async def tokenize(request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response(
+                {"error": {"message": "invalid JSON",
+                           "type": "invalid_request_error"}},
+                status=400,
+            )
+        tokenizer = engine.engine.tokenizer
+        prompt = body.get("prompt")
+        messages = body.get("messages")
+        if isinstance(messages, list):
+            try:
+                prompt = tokenizer.apply_chat_template(messages)
+            except Exception as e:
+                return web.json_response(
+                    {"error": {"message": f"chat template failed: {e}",
+                               "type": "invalid_request_error"}},
+                    status=400,
+                )
+        if not isinstance(prompt, str):
+            return web.json_response(
+                {"error": {"message": "'prompt' (string) or 'messages' "
+                           "(list) is required",
+                           "type": "invalid_request_error"}},
+                status=400,
+            )
+        ids = tokenizer.encode(prompt)
+        return web.json_response({
+            "tokens": ids,
+            "count": len(ids),
+            "max_model_len": engine.engine.config.scheduler.max_model_len,
+        })
+
+    async def detokenize(request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response(
+                {"error": {"message": "invalid JSON",
+                           "type": "invalid_request_error"}},
+                status=400,
+            )
+        tokens = body.get("tokens")
+        if not isinstance(tokens, list) or not all(
+            isinstance(t, int) for t in tokens
+        ):
+            return web.json_response(
+                {"error": {"message": "'tokens' must be a list of ids",
+                           "type": "invalid_request_error"}},
+                status=400,
+            )
+        return web.json_response(
+            {"prompt": engine.engine.tokenizer.decode(tokens)}
+        )
+
+    app.router.add_post("/tokenize", tokenize)
+    app.router.add_post("/detokenize", detokenize)
 
     # On-demand device profiling (vLLM's /start_profile and /stop_profile,
     # TPU-native: jax.profiler traces, viewable in TensorBoard/XProf or

@@ -315,6 +315,7 @@ async def test_n_choices_streaming_interleaved():
                 "max_tokens": 3,
                 "n": 2,
                 "stream": True,
+                "stream_options": {"include_usage": True},
             }) as resp:
                 assert resp.status == 200
                 raw = await resp.text()
@@ -327,13 +328,16 @@ async def test_n_choices_streaming_interleaved():
         finishes = {}
         usage = None
         for chunk in chunks:
+            if "usage" in chunk:
+                # include_usage: the final chunk has empty choices.
+                assert chunk["choices"] == []
+                usage = chunk["usage"]
+                continue
             (choice,) = chunk["choices"]
             idx = choice["index"]
             per_index[idx] += choice["delta"].get("content", "")
             if choice["finish_reason"]:
                 finishes[idx] = choice["finish_reason"]
-            if "usage" in chunk:
-                usage = chunk["usage"]
         assert set(finishes) == {0, 1}
         assert per_index[0] == per_index[1]  # greedy
         assert usage is not None and usage["completion_tokens"] == 6
