@@ -28,6 +28,10 @@ _PROXY_PATHS = [
     "/rerank",
     "/v1/score",
     "/score",
+    # Engine utility endpoints (vLLM parity): tokenization follows the
+    # model, so these route like any model-bound request.
+    "/tokenize",
+    "/detokenize",
 ]
 
 

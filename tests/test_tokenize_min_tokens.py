@@ -139,3 +139,37 @@ async def test_min_tokens_validation_through_server():
             assert body["usage"]["completion_tokens"] == 6
     finally:
         await server.close()
+
+
+async def test_tokenize_proxied_through_router():
+    """The router proxies /tokenize and /detokenize to the model's
+    engine like any model-bound request."""
+    from aiohttp.test_utils import TestClient
+
+    from production_stack_tpu.router.app import build_app
+    from production_stack_tpu.router.parser import parse_args
+
+    engine_server, engine_url = await _server()
+    app = build_app(parse_args([
+        "--static-backends", engine_url,
+        "--static-models", "tiny-llama",
+        "--engine-stats-interval", "1",
+    ]))
+    router = TestServer(app)
+    await router.start_server()
+    client = TestClient(router)
+    try:
+        resp = await client.post("/tokenize", json={
+            "model": "tiny-llama", "prompt": "router tokenize",
+        })
+        assert resp.status == 200
+        body = await resp.json()
+        assert body["count"] == len(body["tokens"]) > 0
+        resp = await client.post("/detokenize", json={
+            "model": "tiny-llama", "tokens": body["tokens"],
+        })
+        assert resp.status == 200
+        assert "router" in (await resp.json())["prompt"]
+    finally:
+        await client.close()
+        await engine_server.close()
