@@ -367,6 +367,16 @@ class LLMEngine:
             # forever.  Enforced here (not only at the API boundary) so
             # direct engine users get the same behavior.
             params_obj.ignore_eos = False
+        elif (
+            isinstance(params_obj.response_format, dict)
+            and params_obj.response_format.get("type") == "json_schema"
+        ):
+            from production_stack_tpu.engine.guided_schema import SchemaGuide
+
+            # Raises SchemaCompileError (a ValueError) for schemas
+            # outside the supported subset -> 400 at the API boundary.
+            guide = SchemaGuide(params_obj.response_format.get("schema") or {})
+            params_obj.ignore_eos = False
         elif params_obj.response_format not in (None, "text"):
             raise ValueError(
                 f"Unsupported response_format {params_obj.response_format!r}"
@@ -1213,7 +1223,13 @@ class LLMEngine:
                 self.config.scheduler.max_model_len - seq.num_tokens,
             )
             guide.closing = remaining <= guide.closure_cost() + 4
-            # Fast path: the unconstrained choice is usually valid.
+            # Fast path: the unconstrained choice is usually valid.  An
+            # EOS pick at a may-finish point is a valid CHOICE to end
+            # (root-position scalars: "42" may end or grow another digit;
+            # finalize collapses the script so done holds).
+            if out[i] == eos and guide.may_finish():
+                guide.finalize()
+                continue
             fast_bytes = cache.text(out[i]).encode()
             st = guide.try_token(fast_bytes)
             if st is not None and out[i] != eos:
@@ -1236,6 +1252,10 @@ class LLMEngine:
                 for tid in order:
                     tid = int(tid)
                     if tid == eos:
+                        if guide.may_finish():
+                            valid.append((tid, "FINISH"))
+                            if len(valid) >= want:
+                                break
                         continue
                     st = guide.try_token(cache.text(tid).encode())
                     if st is not None:
@@ -1268,7 +1288,10 @@ class LLMEngine:
                     + zlib.crc32(seq.seq_id.encode())
                 )
                 tid, st = valid[int(rng.choice(len(valid), p=p))]
-            guide.accept(st, cache.text(tid).encode())
+            if st == "FINISH":
+                guide.finalize()
+            else:
+                guide.accept(st, cache.text(tid).encode())
             out[i] = tid
         return out
 
@@ -1323,18 +1346,26 @@ class LLMEngine:
         registration, offload cleanup, counters, registry removal.
         Returns the final reason (guided re-validation may rewrite it);
         callers must surface the returned value, not their local one."""
+        rf = seq.sampling_params.response_format
         if (
             reason == FinishReason.STOP
             and seq.guide is not None
-            and seq.sampling_params.response_format == "json_object"
+            and (rf == "json_object" or isinstance(rf, dict))
         ):
             # The automaton validated per-token text from decode([id]);
             # re-validate the assembled text, which is the ground truth
-            # the client receives.
+            # the client receives (for json_schema, against the schema).
             import json as _json
 
             try:
-                _json.loads(self.tokenizer.decode(seq.output_token_ids))
+                obj = _json.loads(self.tokenizer.decode(seq.output_token_ids))
+                if isinstance(rf, dict):
+                    from production_stack_tpu.engine.guided_schema import (
+                        validate_instance,
+                    )
+
+                    if not validate_instance(rf.get("schema") or {}, obj):
+                        raise ValueError("schema mismatch")
             except Exception:
                 logger.warning(
                     "guided json output failed final parse for %s",

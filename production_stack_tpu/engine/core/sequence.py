@@ -5,7 +5,7 @@ from __future__ import annotations
 import dataclasses
 import enum
 import time
-from typing import List, Optional
+from typing import List, Optional, Union
 
 
 class SequenceStatus(enum.Enum):
@@ -54,9 +54,10 @@ class SamplingParams:
     # combined with logprobs, per-position prompt logprobs are computed
     # during prefill (the lm-eval-harness loglikelihood pattern).
     echo: bool = False
-    # OpenAI response_format type: None | "json_object" (guided decoding;
-    # engine/guided.py).
-    response_format: Optional[str] = None
+    # OpenAI response_format: None | "text" | "json_object" (byte-level
+    # guided decoding, engine/guided.py) | {"type": "json_schema",
+    # "schema": {...}} (schema-constrained script, engine/guided_schema.py).
+    response_format: Union[str, dict, None] = None
 
 
 @dataclasses.dataclass

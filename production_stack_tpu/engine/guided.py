@@ -280,6 +280,13 @@ class JsonGuide:
     def done(self) -> bool:
         return self.state.mode == DONE
 
+    def may_finish(self) -> bool:
+        # A closed top-level JSON value is unambiguous: done == may end.
+        return self.done
+
+    def finalize(self) -> None:
+        pass  # done already holds when may_finish() does
+
     def closure_cost(self) -> int:
         return closure_cost(self.state)
 

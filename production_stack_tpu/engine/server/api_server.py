@@ -72,12 +72,36 @@ def _sampling_from_body(body: dict, chat: bool) -> SamplingParams:
         rf_type = rf.get("type") if isinstance(rf, dict) else rf
         if rf_type == "json_object":
             response_format = "json_object"
+        elif rf_type == "json_schema":
+            # OpenAI structured outputs: {"type": "json_schema",
+            # "json_schema": {"name":..., "schema": {...}, "strict":...}}.
+            spec = rf.get("json_schema") if isinstance(rf, dict) else None
+            if not isinstance(spec, dict):
+                raise ValueError(
+                    "response_format json_schema requires a 'json_schema' "
+                    "object"
+                )
+            schema = spec.get("schema")
+            if not isinstance(schema, dict):
+                raise ValueError(
+                    "response_format json_schema requires "
+                    "json_schema.schema (an object)"
+                )
+            # Compile HERE so unsupported schemas 400 before any stream
+            # starts (SchemaCompileError is a ValueError); the cache makes
+            # the per-sequence guides reuse this compilation.
+            from production_stack_tpu.engine.guided_schema import (
+                compile_schema_cached,
+            )
+
+            compile_schema_cached(schema)
+            response_format = {"type": "json_schema", "schema": schema}
         elif rf_type in ("text", None):
             response_format = None
         else:
             raise ValueError(
                 f"Unsupported response_format type {rf_type!r} "
-                "(supported: text, json_object)"
+                "(supported: text, json_object, json_schema)"
             )
     raw_max = body.get("max_tokens")
     if raw_max is None:
