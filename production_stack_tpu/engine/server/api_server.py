@@ -389,8 +389,27 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
                                "type": "invalid_request_error"}},
                     status=400,
                 )
-            # The arguments object is produced under the JSON guarantee.
+            # The arguments object is produced under the JSON guarantee —
+            # and when the tool's parameters schema compiles under the
+            # guided_schema subset, under THAT schema (strict tool calls:
+            # correct keys/types by construction, not just valid JSON).
             params.response_format = "json_object"
+            tool_schema = (forced_tool.get("function") or {}).get(
+                "parameters"
+            )
+            if isinstance(tool_schema, dict):
+                from production_stack_tpu.engine.guided_schema import (
+                    SchemaCompileError,
+                    compile_schema_cached,
+                )
+
+                try:
+                    compile_schema_cached(tool_schema)
+                    params.response_format = {
+                        "type": "json_schema", "schema": tool_schema,
+                    }
+                except SchemaCompileError:
+                    pass  # outside the subset: generic JSON guarantee
             params.ignore_eos = False
         request_id = request.headers.get("x-request-id") or f"cmpl-{uuid.uuid4().hex[:16]}"
         created = int(time.time())

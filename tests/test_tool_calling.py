@@ -168,3 +168,62 @@ async def test_tiny_budget_surfaces_truncation_not_bogus_tool_call():
         assert choice["finish_reason"] == "length"
     finally:
         await server.close()
+
+
+async def test_forced_function_args_conform_to_parameters_schema():
+    """A compilable parameters schema upgrades the arguments guarantee
+    from 'valid JSON object' to 'conforms to the schema': exact keys in
+    declaration order, correct types, enums enforced."""
+    from production_stack_tpu.engine.guided_schema import validate_instance
+
+    schema = {
+        "type": "object",
+        "properties": {
+            "city": {"type": "string"},
+            "days": {"type": "integer"},
+            "units": {"enum": ["metric", "imperial"]},
+        },
+    }
+    tools = [{
+        "type": "function",
+        "function": {"name": "forecast", "parameters": schema},
+    }]
+    server = await _server()
+    try:
+        status, body = await _post(server, {
+            "model": "tiny-llama", "max_tokens": 100,
+            "messages": [{"role": "user", "content": "forecast for Paris"}],
+            "tools": tools,
+            "tool_choice": {"type": "function",
+                            "function": {"name": "forecast"}},
+        })
+        assert status == 200
+        choice = body["choices"][0]
+        assert choice["finish_reason"] == "tool_calls"
+        args = json.loads(choice["message"]["tool_calls"][0]["function"]
+                          ["arguments"])
+        assert validate_instance(schema, args), args
+        assert list(args) == ["city", "days", "units"]
+
+        # Non-compilable schemas still get the generic JSON guarantee.
+        weird = [{
+            "type": "function",
+            "function": {"name": "odd",
+                         "parameters": {"anyOf": [{"type": "object"}]}},
+        }]
+        status, body = await _post(server, {
+            "model": "tiny-llama", "max_tokens": 80,
+            "messages": [{"role": "user", "content": "call odd"}],
+            "tools": weird,
+            "tool_choice": {"type": "function", "function": {"name": "odd"}},
+        })
+        assert status == 200
+        choice = body["choices"][0]
+        assert choice["finish_reason"] == "tool_calls"
+        assert isinstance(
+            json.loads(choice["message"]["tool_calls"][0]["function"]
+                       ["arguments"]),
+            dict,
+        )
+    finally:
+        await server.close()
