@@ -90,6 +90,10 @@ class Scheduler:
         self.running: List[Sequence] = []
         self.preempted: Deque[Sequence] = deque()
         self.num_preemptions = 0
+        # Deterministic admission counter: priority ties break FCFS, and
+        # (unlike wall-clock arrival_time) the ordering is identical on
+        # every lockstep replica of a multi-host group.
+        self._admit_counter = 0
 
     # -- admission ---------------------------------------------------------
 
@@ -111,6 +115,16 @@ class Scheduler:
                 f"only has {self.block_pool.num_blocks - 1}; lower max_tokens "
                 "or raise the KV pool size"
             )
+        seq._admit_idx = self._admit_counter
+        self._admit_counter += 1
+        # Priority order (vLLM semantics: LOWER value runs earlier; ties
+        # keep admission order).  Admission keys are monotone under FCFS,
+        # so the all-default case stays a plain append.
+        key = (seq.sampling_params.priority, seq._admit_idx)
+        for i, other in enumerate(self.waiting):
+            if (other.sampling_params.priority, other._admit_idx) > key:
+                self.waiting.insert(i, seq)
+                return
         self.waiting.append(seq)
 
     def abort_seq(self, seq_id: str) -> Optional[Sequence]:
@@ -306,7 +320,14 @@ class Scheduler:
     # -- preemption / release ---------------------------------------------
 
     def _preempt_youngest(self) -> None:
-        seq = max(self.running, key=lambda s: s.arrival_time)
+        # Victim: the lowest-priority running sequence (highest value),
+        # youngest among equals — high-priority work survives pool
+        # pressure at the expense of low-priority work.
+        seq = max(
+            self.running,
+            key=lambda s: (s.sampling_params.priority,
+                           getattr(s, "_admit_idx", 0)),
+        )
         self.running.remove(seq)
         seq.status = SequenceStatus.PREEMPTED
         seq.preempt_count += 1

@@ -48,6 +48,10 @@ class SamplingParams:
     # vLLM min_tokens: EOS + stop_token_ids are suppressed at the logits
     # until this many tokens have been generated.
     min_tokens: int = 0
+    # vLLM priority scheduling: LOWER value = scheduled earlier; equal
+    # priorities keep FCFS order.  Preemption evicts the
+    # highest-value (lowest-priority) running sequence first.
+    priority: int = 0
     # OpenAI logit_bias: token id -> additive bias in [-100, 100].
     logit_bias: Optional[dict] = None
     # OpenAI completions echo: return the prompt ahead of the completion;

@@ -121,6 +121,10 @@ def _sampling_from_body(body: dict, chat: bool) -> SamplingParams:
         raise ValueError(
             f"'min_tokens' must be in [0, max_tokens], got {min_tokens}"
         )
+    try:
+        priority = int(body.get("priority") or 0)
+    except (TypeError, ValueError):
+        raise ValueError("'priority' must be an integer") from None
     return SamplingParams(
         max_tokens=max_tokens,
         temperature=float(body.get("temperature") or 0.0),
@@ -142,6 +146,7 @@ def _sampling_from_body(body: dict, chat: bool) -> SamplingParams:
         frequency_penalty=float(body.get("frequency_penalty") or 0.0),
         repetition_penalty=float(body.get("repetition_penalty") or 1.0),
         min_tokens=min_tokens,
+        priority=priority,
     )
 
 
