@@ -1423,6 +1423,17 @@ def main(argv=None) -> None:
     from production_stack_tpu.engine.parallel import distributed
 
     denv = distributed.maybe_initialize()
+    if denv is not None and args.data_parallel > 1:
+        # dp shards the decode batch; across PROCESSES the leader could
+        # not read the non-addressable logit/token shards (and dp over
+        # DCN wastes the slice's ICI anyway).  Replica-level dp belongs
+        # to the chart (replicaCount = more slice groups); within a
+        # multi-host group use tp/sp.
+        raise SystemExit(
+            "--data-parallel > 1 is not supported inside a multi-host "
+            "slice group; scale replicas with the chart's replicaCount "
+            "and use --tensor-parallel/--sequence-parallel across hosts"
+        )
     if denv is not None and not denv.is_leader:
         _run_follower(config, denv, args)
         return
