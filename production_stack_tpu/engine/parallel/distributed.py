@@ -34,6 +34,7 @@ import dataclasses
 import logging
 import os
 import pickle
+import time
 from typing import Any, Optional
 
 logger = logging.getLogger(__name__)
@@ -150,21 +151,35 @@ class LockstepChannel:
     right before stepping its engine; followers call :meth:`receive` and
     apply the same batch to their replica, keeping every process's
     scheduler state — and therefore every jitted launch — identical.
-    Idle iterations are NOT published: the leader only publishes when it
-    is about to step (or shut down), so followers block in ``receive``
-    without spinning collectives.
+    Idle iterations are not published beyond a periodic empty HEARTBEAT
+    batch (liveness signal), so followers block in ``receive`` without
+    spinning collectives.
     """
 
-    def __init__(self, denv: DistributedEnv):
+    def __init__(self, denv: DistributedEnv, heartbeat_seconds: float = 10.0):
         self.denv = denv
+        # Leader publishes an empty batch at least this often while idle;
+        # followers treat event staleness beyond a few heartbeats as a
+        # dead leader (follower /health fails -> k8s restarts the pod;
+        # SPMD groups cannot heal a lost member in place).
+        self.heartbeat_seconds = heartbeat_seconds
+        self.last_event_time = time.time()
 
     def publish(self, events: StepEvents) -> None:
         assert self.denv.is_leader
         broadcast_pyobj(events, is_source=True)
+        self.last_event_time = time.time()
 
     def receive(self) -> StepEvents:
         assert not self.denv.is_leader
-        return broadcast_pyobj(None, is_source=False)
+        events = broadcast_pyobj(None, is_source=False)
+        self.last_event_time = time.time()
+        return events
+
+    def stale(self, factor: float = 6.0) -> bool:
+        """No event for ``factor`` heartbeats: the leader is gone."""
+        return time.time() - self.last_event_time \
+            > factor * self.heartbeat_seconds
 
 
 def follower_loop(engine, channel: LockstepChannel) -> None:

@@ -1262,8 +1262,19 @@ def _run_follower(config, denv, args) -> None:
     from production_stack_tpu.engine.parallel import distributed
 
     health_app = web.Application()
+    engine = LLMEngine(config)
+    channel = distributed.LockstepChannel(denv)
 
     async def health(_req: web.Request) -> web.Response:
+        if channel.stale():
+            # Leader heartbeats while idle; prolonged silence means it is
+            # gone, and an SPMD group cannot heal a lost member in place:
+            # fail liveness so k8s restarts this pod into a fresh group.
+            return web.json_response(
+                {"status": "unhealthy", "role": "follower",
+                 "problem": "no leader event within the staleness window"},
+                status=503,
+            )
         return web.json_response(
             {"status": "ok", "role": "follower",
              "process_id": denv.process_id}
@@ -1278,8 +1289,6 @@ def _run_follower(config, denv, args) -> None:
         )
 
     threading.Thread(target=serve_health, daemon=True).start()
-    engine = LLMEngine(config)
-    channel = distributed.LockstepChannel(denv)
     logger.info(
         "tpu-engine follower %d/%d ready (leader owns the HTTP surface)",
         denv.process_id, denv.num_processes,

@@ -125,12 +125,19 @@ class AsyncEngine:
 
     def _run_loop(self) -> None:
         logger.info("engine step loop started")
+        last_publish = time.time()
         while not self._shutdown.is_set():
             with self._lock:
                 pending, self._pending = self._pending, []
                 aborts, self._aborts = self._aborts, []
             if self._lockstep is not None and (
                 pending or aborts or self.engine.has_unfinished()
+                # Idle heartbeat: followers detect a dead leader by event
+                # staleness (their /health fails, k8s restarts the group
+                # member); without it an idle group is indistinguishable
+                # from a dead one.
+                or time.time() - last_publish
+                > self._lockstep.heartbeat_seconds
             ):
                 from production_stack_tpu.engine.parallel.distributed import (
                     StepEvents,
@@ -143,6 +150,7 @@ class AsyncEngine:
                     ],
                     aborts=list(aborts),
                 ))
+                last_publish = time.time()
             for request_id in aborts:
                 self.engine.abort_request(request_id)
             for request_id, token_ids, params, adapter in pending:

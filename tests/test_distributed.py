@@ -16,6 +16,7 @@ mounts for NCCL (helm/templates/deployment-vllm-multi.yaml:198-228); here
 the transport is jax.distributed + XLA collectives over ICI/DCN.
 """
 
+import asyncio
 import json
 import os
 import socket
@@ -177,6 +178,8 @@ async def test_leader_publishes_lockstep_events():
     published = []
 
     class RecordingChannel:
+        heartbeat_seconds = 10.0
+
         def publish(self, events):
             published.append(events)
 
@@ -209,6 +212,7 @@ async def test_leader_publishes_lockstep_events():
 
 
 _ENGINE_WORKER = r"""
+import asyncio
 import json
 
 from production_stack_tpu.engine.parallel import distributed
@@ -332,3 +336,45 @@ def test_two_process_lockstep_engine_serving(tmp_path):
             if out.new_token_id >= 0:
                 want.setdefault(out.seq_id, []).append(out.new_token_id)
     assert got == want, f"lockstep diverged: {got} != {want}"
+
+
+async def test_leader_heartbeats_while_idle():
+    """An idle lockstep leader must publish periodic empty batches: the
+    followers' liveness (channel.stale -> follower /health 503) keys off
+    event recency, and an idle group must stay distinguishable from a
+    dead one."""
+    from production_stack_tpu.engine.config import config_from_preset
+    from production_stack_tpu.engine.server.async_engine import AsyncEngine
+
+    published = []
+
+    class RecordingChannel:
+        heartbeat_seconds = 0.2
+
+        def publish(self, events):
+            published.append(events)
+
+    config = config_from_preset(
+        "tiny-llama",
+        **{"scheduler.max_num_seqs": 2, "scheduler.max_model_len": 128,
+           "cache.num_blocks": 64},
+    )
+    engine = AsyncEngine(config, lockstep=RecordingChannel())
+    await engine.start()
+    try:
+        await asyncio.sleep(1.0)  # no requests at all
+    finally:
+        await engine.close()
+    heartbeats = [ev for ev in published
+                  if not ev.requests and not ev.aborts and not ev.shutdown]
+    assert len(heartbeats) >= 3  # ~1s idle at 0.2s heartbeat
+
+
+def test_channel_staleness_window(monkeypatch):
+    from production_stack_tpu.engine.parallel import distributed
+
+    denv = distributed.DistributedEnv("x:1", 2, 1)
+    channel = distributed.LockstepChannel(denv, heartbeat_seconds=10.0)
+    assert not channel.stale()
+    channel.last_event_time -= 100.0  # > 6 heartbeats ago
+    assert channel.stale()
