@@ -422,6 +422,78 @@ def bench_decode(jax, jnp, cfg, params, kv_caches, S, ctx_len, bmax, block_size)
 
 
 
+def bench_engine_pipeline_ab(args, preset: str) -> dict:
+    """Pipelined vs synchronous decode A/B through the REAL engine
+    (LLMEngine.step with pipeline_decode on/off), not a raw model loop:
+    the async one-step-lookahead pipeline is an engine-level
+    restructuring, so only engine-level stepping can show its win.
+    Reports per-step wall time for both modes plus each run's
+    decode_host_gap_ms — the host serialization the pipeline hides.
+    Engines are built serially with explicit small KV pools so two boots
+    fit beside each other's freed memory."""
+    import dataclasses as _dc
+    import gc
+
+    from production_stack_tpu.engine.config import (
+        CacheConfig,
+        EngineConfig,
+        PRESETS,
+        SchedulerConfig,
+    )
+    from production_stack_tpu.engine.core.engine import LLMEngine
+    from production_stack_tpu.engine.core.sequence import SamplingParams
+
+    S = args.batch
+    warm, measured = 8, 48
+    ctx_tokens = 128
+
+    def run(pipeline: bool):
+        cfg = EngineConfig(
+            model=_dc.replace(PRESETS[preset]),
+            cache=CacheConfig(num_blocks=S * 32 + 16),
+            scheduler=SchedulerConfig(
+                max_num_seqs=S,
+                prefill_buckets=(128, 256),
+                max_model_len=512,
+                pipeline_decode=pipeline,
+            ),
+        )
+        eng = LLMEngine(cfg)
+        for i in range(S):
+            eng.add_request(
+                f"r{i}",
+                prompt_token_ids=[(7 * i + j) % 101 for j in range(ctx_tokens)],
+                sampling_params=SamplingParams(
+                    max_tokens=warm + measured + 8, ignore_eos=True
+                ),
+            )
+        produced = 0
+        while produced < warm * S:  # prefills + compile + pipeline fill
+            produced += len(eng.step())
+        t0 = time.perf_counter()
+        produced = 0
+        while produced < measured * S:
+            produced += len(eng.step())
+        dt = time.perf_counter() - t0
+        steps = max(1, round(produced / S))
+        out = {
+            "step_ms": round(dt / steps * 1e3, 3),
+            "tokens_per_s": round(produced / dt, 1),
+            "host_gap_ms": round(eng.stats()["decode_host_gap_ms"], 3),
+        }
+        del eng
+        gc.collect()
+        return out
+
+    sync = run(False)
+    piped = run(True)
+    return {
+        "sync": sync,
+        "pipelined": piped,
+        "speedup": round(sync["step_ms"] / max(piped["step_ms"], 1e-9), 3),
+    }
+
+
 # -- main ------------------------------------------------------------------
 
 
@@ -765,6 +837,26 @@ def main() -> None:
         detail["pallas_decode_speedup"] = round(t_gather / t_decode, 2)
         log(f"decode gather-path: {t_gather*1e3:.2f} ms/step "
             f"(pallas speedup {t_gather/t_decode:.2f}x)")
+
+    if not args.quick and budget_left("pipeline_ab"):
+        # Pipelined vs sync decode through the REAL engine — run last so
+        # the bench's own params/kv can be freed first (two extra engine
+        # boots of the flagship preset must fit in HBM).
+        try:
+            del params, kv
+            import gc as _gc
+
+            _gc.collect()
+            detail["pipeline_ab"] = bench_engine_pipeline_ab(args, preset)
+            log(f"pipeline A/B: sync "
+                f"{detail['pipeline_ab']['sync']['step_ms']} ms/step "
+                f"(gap {detail['pipeline_ab']['sync']['host_gap_ms']} ms) "
+                f"vs pipelined "
+                f"{detail['pipeline_ab']['pipelined']['step_ms']} ms/step "
+                f"({detail['pipeline_ab']['speedup']}x)")
+        except Exception as e:
+            log(f"pipeline A/B failed: {e}")
+            detail["pipeline_ab_error"] = str(e)[:200]
 
     result = {
         "metric": f"decode_throughput_{preset}_b{S}_ctx{ctx}",

@@ -113,6 +113,9 @@ async def run_serving_bench(
             "prefix_cache_hit_rate": round(es["prefix_cache_hit_rate"], 4),
             "num_preemptions": es["num_preemptions"],
             "total_generated_tokens": es["total_generated_tokens"],
+            # Per-step host serialization: ≈0 with the lookahead decode
+            # pipeline feeding the device ahead of collection.
+            "decode_host_gap_ms": round(es["decode_host_gap_ms"], 3),
         }
         return summary
     finally:
@@ -161,6 +164,7 @@ async def _scrape_engine_counters(url: str) -> Dict:
         vocab.TPU_PREFIX_CACHE_HIT_RATE: "prefix_cache_hit_rate",
         vocab.TPU_NUM_PREEMPTIONS: "num_preemptions",
         vocab.TPU_TOTAL_GENERATED_TOKENS: "total_generated_tokens",
+        vocab.TPU_DECODE_HOST_GAP_MS: "decode_host_gap_ms",
     }
     out: Dict = {}
     async with aiohttp.ClientSession() as session:

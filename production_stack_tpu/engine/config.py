@@ -356,6 +356,17 @@ class SchedulerConfig:
     # bias/guided members fall back to classic stepping.  0 = off.
     # Mutually exclusive with num_scheduler_steps > 1.
     speculative_ngram: int = 0
+    # Async one-step-lookahead decode pipeline: dispatch decode step N+1
+    # (input tokens = step N's still-in-flight device-resident sample)
+    # before reading step N's result back, so host scheduling/detokenize
+    # overlaps device compute.  Greedy streams are byte-identical to
+    # synchronous stepping; batches using host-state sampling features
+    # (penalties, logprobs, logit_bias, min_tokens, guided) fall back per
+    # step like multi-step does.  None = auto (ON whenever the classic
+    # single-step path is active); explicit True conflicts with
+    # speculative/multi-step the same way those two conflict with each
+    # other; False forces classic synchronous stepping.
+    pipeline_decode: Optional[bool] = None
 
     def __post_init__(self):
         if self.speculative_ngram and self.num_scheduler_steps > 1:
@@ -365,6 +376,22 @@ class SchedulerConfig:
             )
         if self.speculative_ngram < 0:
             raise ValueError("speculative_ngram must be >= 0")
+        if self.pipeline_decode and (
+            self.num_scheduler_steps > 1 or self.speculative_ngram
+        ):
+            raise ValueError(
+                "pipeline_decode is mutually exclusive with "
+                "num_scheduler_steps > 1 and speculative_ngram (all three "
+                "restructure the per-step dispatch; pick one)"
+            )
+
+    @property
+    def pipeline_enabled(self) -> bool:
+        """Resolved pipeline gate: auto (None) turns on exactly when the
+        classic single-step non-speculative decode path is active."""
+        if self.pipeline_decode is None:
+            return self.num_scheduler_steps == 1 and not self.speculative_ngram
+        return self.pipeline_decode
 
 
 @dataclasses.dataclass

@@ -1,9 +1,17 @@
-"""LLMEngine: the synchronous serving engine core.
+"""LLMEngine: the serving engine core.
 
 Owns params + paged KV caches on device, the block pool, the scheduler and
-the jitted step functions.  ``step()`` executes exactly one scheduler plan
+the jitted step functions.  Each step executes exactly one scheduler plan
 (one bucketed prefill or one padded decode batch) — every plan shape maps to
 a cached XLA executable, so steady-state serving never recompiles.
+
+Stepping is split into a ``dispatch()``/``collect()`` pair wired as an
+async one-step-lookahead pipeline: decode step N+1 is dispatched to the
+device (its input tokens chained from step N's still-in-flight sample)
+BEFORE step N's result is read back, so host-side scheduling, sampling
+post-processing and detokenization overlap device compute instead of
+serializing against it.  ``step()`` keeps the classic contract
+(one plan's outputs per call) on top of that pipeline.
 
 The engine is the TPU-side counterpart of what the reference runs as an
 external ``vllm serve`` container (deployment-vllm-multi.yaml:57-64); the
@@ -13,13 +21,14 @@ the router expects.
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 import threading
 import time
 import zlib
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from functools import partial
-from typing import Dict, List, Optional
+from typing import Deque, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -58,6 +67,22 @@ logger = logging.getLogger(__name__)
 
 def _dtype_size(dtype: str) -> int:
     return jnp.dtype(dtype).itemsize
+
+
+@dataclasses.dataclass
+class _PendingStep:
+    """One dispatched-but-not-yet-collected engine step.
+
+    Synchronous steps (prefill, speculative, multi-step, and decode
+    batches using host-state sampling features) carry precomputed
+    ``outputs``; pipelined decode steps carry the batch rows and the
+    still-in-flight device sample instead."""
+
+    outputs: Optional[List[StepOutput]] = None
+    seqs: Optional[List[Sequence]] = None
+    sampled: Optional[object] = None  # jax.Array [S], uncollected
+    is_decode: bool = False
+    host_s: float = 0.0  # host time spent dispatching this step
 
 
 class LLMEngine:
@@ -273,6 +298,86 @@ class LLMEngine:
         self._busy_window: List[tuple] = []
         self._busy_window_s = 10.0
 
+        # -- async one-step-lookahead decode pipeline ----------------------
+        # dispatch() launches decode N+1 with tokens chained from step N's
+        # still-in-flight device sample; collect() reads N back only when
+        # N+1 is already enqueued.  Host-state sampling features drop a
+        # batch to the classic synchronous path per step (same fallback
+        # rule as the multi-step scan).
+        self._pipeline_enabled = config.scheduler.pipeline_enabled
+        self._pending: Deque[_PendingStep] = deque()
+        # Device-resident decode batch state, valid for the most recently
+        # dispatched pipelined step: block tables and sampling-parameter
+        # arrays stay on device between steps, so steady-state dispatch
+        # sends ONE packed [4, S] delta instead of eight per-array H2D
+        # transfers.
+        self._pipe_tables = None
+        self._pipe_sampling = None  # (temps, top_ps, top_ks, min_ps, seeds)
+        self._pipe_adapter = None
+        self._pipe_table_lens: List[int] = []
+        # decode_host_gap_ms: host time between one decode step retiring
+        # and the next decode launch while the device had nothing queued —
+        # the serialization the pipeline removes (≈0 when pipelining).
+        self._gap_total_s = 0.0
+        self._gap_steps = 0
+        self._last_decode_end: Optional[float] = None
+
+        bs_const = config.cache.block_size
+
+        def _pipe_unpack(packed, tables):
+            """Batch-(re)build path: ONE packed [11, S] int32 transfer
+            carries every per-row scalar (float rows bitcast); the block
+            tables ride in a second transfer only when the batch
+            composition changed."""
+            def as_f32(row):
+                return jax.lax.bitcast_convert_type(row, jnp.float32)
+
+            return {
+                "tokens": packed[0],
+                "positions": packed[1],
+                "ctx_lens": packed[2],
+                "slot_blocks": packed[3],
+                "slot_offsets": packed[4],
+                "temps": as_f32(packed[5]),
+                "top_ps": as_f32(packed[6]),
+                "top_ks": packed[7],
+                "min_ps": as_f32(packed[8]),
+                "seeds": packed[9],
+                "adapter": packed[10],
+                "tables": tables,
+            }
+
+        def _pipe_advance(packed, prev_sampled, tables):
+            """Steady path ("same batch, +1 token"): tokens chain from the
+            in-flight sample; the packed [4, S] int32 delta carries
+            (positions, ctx_lens, upd_col, upd_val) and block-table growth
+            is a jitted in-place scatter of at most one new block per row
+            (col -1 = no growth)."""
+            positions, ctx_lens = packed[0], packed[1]
+            cols, vals = packed[2], packed[3]
+            rows = jnp.arange(tables.shape[0])
+            valid = cols >= 0
+            safe_col = jnp.where(valid, cols, 0)
+            keep = tables[rows, safe_col]
+            tables = tables.at[rows, safe_col].set(
+                jnp.where(valid, vals, keep)
+            )
+            blk = jnp.take_along_axis(
+                tables, (positions // bs_const)[:, None], axis=1
+            )[:, 0]
+            active = ctx_lens > 0
+            return {
+                "tokens": prev_sampled,
+                "positions": positions,
+                "ctx_lens": ctx_lens,
+                "slot_blocks": jnp.where(active, blk, 0),
+                "slot_offsets": positions % bs_const,
+                "tables": tables,
+            }
+
+        self._pipe_unpack_fn = jax.jit(_pipe_unpack)
+        self._pipe_advance_fn = jax.jit(_pipe_advance)
+
     # -- sizing ------------------------------------------------------------
 
     def _kv_bytes(self, num_blocks: int) -> int:
@@ -419,22 +524,256 @@ class LLMEngine:
     # -- stepping ----------------------------------------------------------
 
     def step(self) -> List[StepOutput]:
-        t0 = time.time()
-        plan = self.scheduler.schedule()
-        if plan.is_empty:
+        """One engine step: top up the device pipeline, then collect the
+        oldest in-flight step.  With pipelining on, the collected outputs
+        belong to a step whose successor is already running on the
+        device; per-sequence greedy token streams are identical to
+        classic synchronous stepping."""
+        self.dispatch()
+        return self.collect()
+
+    def has_pending(self) -> bool:
+        """A dispatched step is awaiting collection."""
+        return bool(self._pending)
+
+    def dispatch(self) -> bool:
+        """Launch device work without reading anything back, filling the
+        pipeline to its depth (2 with lookahead, 1 otherwise).  Returns
+        True when at least one step was dispatched."""
+        depth = 2 if self._pipeline_enabled else 1
+        launched = False
+        while len(self._pending) < depth:
+            ok = (
+                self._dispatch_lookahead()
+                if self._pending
+                else self._dispatch_front()
+            )
+            if not ok:
+                break
+            launched = True
+        return launched
+
+    def collect(self) -> List[StepOutput]:
+        """Block on the oldest dispatched step and finalize it: append
+        sampled tokens, run finish checks, and roll back rows whose
+        sequence finished while the step was in flight (their token is a
+        discarded overrun — vLLM multi-step semantics)."""
+        if not self._pending:
             return []
-        if plan.prefill is not None:
-            outputs = self._run_prefill(plan.prefill)
+        t0 = time.time()
+        p = self._pending.popleft()
+        if p.outputs is not None:
+            outputs = p.outputs
         else:
-            outputs = self._run_decode(plan.decode)
-        self._step_counter += 1
+            arr = np.asarray(p.sampled)  # the ONE device sync point
+            live = [
+                (i, s) for i, s in enumerate(p.seqs) if not s.is_finished
+            ]
+            outputs = self._append_and_check(
+                [s for _, s in live],
+                [int(arr[i]) for i, _ in live],
+                first_token=False,
+            )
+            # Drop in-flight successors whose every row has now finished:
+            # pure overrun steps produce no outputs and must not wedge
+            # the pipeline when the engine drains.
+            while (
+                self._pending
+                and self._pending[0].sampled is not None
+                and all(s.is_finished for s in self._pending[0].seqs)
+            ):
+                self._pending.popleft()
         now = time.time()
-        dt = now - t0
-        self._step_time_accum += dt
-        self._busy_window.append((now, dt))
+        self._last_decode_end = now if p.is_decode else None
+        busy = (now - t0) + p.host_s
+        self._step_time_accum += busy
+        self._busy_window.append((now, busy))
         cutoff = now - self._busy_window_s
         self._busy_window = [(t, d) for (t, d) in self._busy_window if t > cutoff]
         return outputs
+
+    def _dispatch_front(self) -> bool:
+        """Dispatch with nothing in flight: full scheduler knowledge
+        (admission, preemption, partial-prefill rollback) — the only
+        place synchronous plans run."""
+        t0 = time.time()
+        plan = self.scheduler.schedule()
+        if plan.is_empty:
+            return False
+        if plan.prefill is not None:
+            outputs = self._run_prefill(plan.prefill)
+            self._step_counter += 1
+            self._pending.append(
+                _PendingStep(outputs=outputs, host_s=time.time() - t0)
+            )
+            return True
+        seqs = plan.decode.seqs
+        if self._can_pipeline(seqs):
+            self._pending.append(self._dispatch_decode_async(seqs, False))
+        else:
+            outputs = self._run_decode(plan.decode)
+            self._step_counter += 1
+            self._pending.append(_PendingStep(
+                outputs=outputs, is_decode=True, host_s=time.time() - t0,
+            ))
+        return True
+
+    def _dispatch_lookahead(self) -> bool:
+        """Provisionally dispatch decode N+1 while N is still in flight.
+        The scheduler plans under the optimistic no-finish assumption
+        (rolling back at collect when wrong); inputs chain from N's
+        device-resident sample, so no host sync separates the steps."""
+        prev = self._pending[-1]
+        if prev.sampled is None:
+            return False  # only pipelined decode steps chain
+        if not self._can_pipeline(prev.seqs):
+            return False
+        plan = self.scheduler.schedule_provisional(prev.seqs)
+        if plan is None:
+            return False
+        self._pending.append(
+            self._dispatch_decode_async(plan.seqs, True, prev.sampled)
+        )
+        return True
+
+    @staticmethod
+    def _batch_uses_host_state(seqs: List[Sequence]) -> bool:
+        """True when any sequence needs host-visible per-token state at
+        sampling time (penalties, a pending min_tokens floor, logprobs,
+        logit_bias, guided decoding).  The ONE gate shared by the fused
+        fast paths — multi-step scan and the lookahead pipeline — so a
+        new host-state feature added here falls back everywhere at once
+        instead of being silently skipped on one path."""
+        return any(
+            s.sampling_params.presence_penalty
+            or s.sampling_params.frequency_penalty
+            or s.sampling_params.repetition_penalty != 1.0
+            or s.sampling_params.min_tokens > len(s.output_token_ids)
+            or s.sampling_params.logprobs
+            or s.sampling_params.logit_bias
+            or s.guide is not None
+            for s in seqs
+        )
+
+    def _can_pipeline(self, seqs: List[Sequence]) -> bool:
+        """Pipelined decode covers the common fast path only: host-state
+        batches drop to the classic synchronous path per step — the same
+        per-batch fallback rule the multi-step scan uses."""
+        return self._pipeline_enabled and not self._batch_uses_host_state(seqs)
+
+    def _note_decode_launch(self) -> None:
+        """Host-gap bookkeeping: time since the previous decode step
+        retired with the device left idle.  Lookahead dispatches count a
+        zero gap by construction (the device was still busy)."""
+        if self._last_decode_end is not None:
+            self._gap_total_s += max(0.0, time.time() - self._last_decode_end)
+            self._gap_steps += 1
+        self._last_decode_end = None
+
+    def _dispatch_decode_async(
+        self, seqs: List[Sequence], lookahead: bool, prev_sampled=None
+    ) -> _PendingStep:
+        """Enqueue one decode+sample step on the device and return
+        without any host round-trip.  ``lookahead=False`` (re)builds the
+        device-resident batch state from host bookkeeping (one packed
+        [11, S] transfer + the block tables); ``lookahead=True`` is the
+        steady "same batch, +1 token" path (one packed [4, S] delta,
+        tokens chained from the in-flight sample)."""
+        t0 = time.time()
+        S = self._smax
+        bs = self.block_pool.block_size
+
+        if not lookahead:
+            tokens = np.zeros((S,), np.int32)
+            positions = np.zeros((S,), np.int32)
+            ctx_lens = np.zeros((S,), np.int32)
+            slot_blocks = np.zeros((S,), np.int32)
+            slot_offsets = np.zeros((S,), np.int32)
+            adapter = np.zeros((S,), np.int32)
+            tables = np.zeros((S, self._bmax), np.int32)
+            for i, seq in enumerate(seqs):
+                pos = seq.num_tokens - 1
+                tokens[i] = seq.all_token_ids[-1]
+                positions[i] = pos
+                ctx_lens[i] = seq.num_tokens
+                table = seq.block_table[: self._bmax]
+                tables[i, : len(table)] = table
+                slot_blocks[i] = seq.block_table[pos // bs]
+                slot_offsets[i] = pos % bs
+                adapter[i] = seq.adapter_idx
+            temps, top_ps, top_ks, min_ps, seeds = self._sampling_arrays(
+                seqs, S
+            )
+            packed = np.stack([
+                tokens, positions, ctx_lens, slot_blocks, slot_offsets,
+                temps.view(np.int32), top_ps.view(np.int32), top_ks,
+                min_ps.view(np.int32), seeds, adapter,
+            ])
+            st = self._pipe_unpack_fn(
+                self._put(packed, P(None, AXES.DP)),
+                self._put(tables, P(AXES.DP, None)),
+            )
+            self._pipe_sampling = (
+                st["temps"], st["top_ps"], st["top_ks"], st["min_ps"],
+                st["seeds"],
+            )
+            self._pipe_adapter = st["adapter"]
+            self._pipe_table_lens = [len(s.block_table) for s in seqs]
+        else:
+            positions = np.zeros((S,), np.int32)
+            ctx_lens = np.zeros((S,), np.int32)
+            cols = np.full((S,), -1, np.int32)
+            vals = np.zeros((S,), np.int32)
+            for i, seq in enumerate(seqs):
+                pos = seq.num_tokens  # consumes the in-flight token
+                positions[i] = pos
+                ctx_lens[i] = pos + 1
+                have = self._pipe_table_lens[i]
+                if len(seq.block_table) > have:
+                    # schedule_provisional grows by at most one block.
+                    cols[i] = have
+                    vals[i] = seq.block_table[have]
+                    self._pipe_table_lens[i] = have + 1
+            packed = np.stack([positions, ctx_lens, cols, vals])
+            st = self._pipe_advance_fn(
+                self._put(packed, P(None, AXES.DP)),
+                prev_sampled,
+                self._pipe_tables,
+            )
+        self._pipe_tables = st["tables"]
+
+        lora_kwargs = {}
+        if self.lora_registry is not None:
+            lora_kwargs = {
+                "lora": self.lora_registry.params,
+                "adapter_idx": self._pipe_adapter,
+            }
+        if lookahead:
+            self._gap_steps += 1  # device busy: zero gap by construction
+            self._last_decode_end = None
+        else:
+            self._note_decode_launch()
+        logits, self.kv_caches = self._decode_fn(
+            self.params,
+            tokens=st["tokens"],
+            positions=st["positions"],
+            block_tables=st["tables"],
+            ctx_lens=st["ctx_lens"],
+            slot_block_ids=st["slot_blocks"],
+            slot_offsets=st["slot_offsets"],
+            kv_caches=self.kv_caches,
+            **lora_kwargs,
+        )
+        temps, top_ps, top_ks, min_ps, seeds = self._pipe_sampling
+        step_key = jax.random.PRNGKey(self.config.seed + self._step_counter)
+        sampled = self._sample_fn(
+            logits, temps, top_ps, top_ks, step_key, seeds, min_p=min_ps,
+        )
+        self._step_counter += 1
+        return _PendingStep(
+            seqs=list(seqs), sampled=sampled, is_decode=True,
+            host_s=time.time() - t0,
+        )
 
     def restore_seq_blocks(self, seq: Sequence) -> str:
         """Scheduler restore_cb: page an offloaded sequence's KV snapshot
@@ -820,15 +1159,9 @@ class LLMEngine:
         # Multi-step path: penalties/logprobs need host-visible per-token
         # state, so any sequence using them drops the whole batch to
         # single-step (they're rare; the common path stays fused).
-        use_multi = self._decode_multi_fn is not None and not any(
-            s.sampling_params.presence_penalty
-            or s.sampling_params.frequency_penalty
-            or s.sampling_params.repetition_penalty != 1.0
-            or s.sampling_params.min_tokens > len(s.output_token_ids)
-            or s.sampling_params.logprobs
-            or s.sampling_params.logit_bias
-            or s.guide is not None
-            for s in seqs
+        use_multi = (
+            self._decode_multi_fn is not None
+            and not self._batch_uses_host_state(seqs)
         )
         if use_multi:
             max_steps = np.zeros((S,), np.int32)
@@ -836,6 +1169,7 @@ class LLMEngine:
             temps, top_ps, top_ks, min_ps, seeds = self._sampling_arrays(
                 seqs, S
             )
+            self._note_decode_launch()
             sampled, self.kv_caches = self._decode_multi_fn(
                 self.params,
                 tokens=self._put(tokens, batch_spec),
@@ -874,6 +1208,7 @@ class LLMEngine:
                 ]
             return outputs
 
+        self._note_decode_launch()
         logits, self.kv_caches = self._decode_fn(
             self.params,
             tokens=self._put(tokens, batch_spec),
@@ -975,6 +1310,7 @@ class LLMEngine:
 
         batch_spec = shardings_lib.decode_batch_spec()
         lora_kwargs = self._lora_kwargs(seqs, S, W, batch_spec)
+        self._note_decode_launch()
         logits, self.kv_caches = self._decode_fn(
             self.params,
             tokens=self._put(tokens, batch_spec),
@@ -1491,6 +1827,13 @@ class LLMEngine:
             "total_generated_tokens": self.total_generated_tokens,
             "total_finished": self.total_finished,
             "num_preemptions": self.scheduler.num_preemptions,
+            # Mean host-side serialization per decode step (ms): time the
+            # device sat idle between decode steps.  ≈0 when the lookahead
+            # pipeline is feeding the device ahead of collection.
+            "decode_host_gap_ms": (
+                1000.0 * self._gap_total_s / self._gap_steps
+                if self._gap_steps else 0.0
+            ),
             "loaded_loras": len(self.loaded_adapters()),
             "remote_prefix_blocks_fetched": self.remote_prefix_blocks_fetched,
             "remote_prefix_blocks_exported": self.remote_prefix_blocks_exported,

@@ -198,11 +198,30 @@ class Scheduler:
         seq.partial_prefill = False
         return True
 
+    def _admission_queue(self) -> Optional[Deque[Sequence]]:
+        """Pick which queue admits next.  Preempted sequences normally
+        resume first (their progress is largest), but a strictly
+        higher-priority waiting head (LOWER value) jumps ahead — without
+        this, any preemption would starve later high-priority arrivals
+        behind the whole preempted backlog.  Ties keep the preempted
+        queue (progress wins).  Residual gap vs vLLM is documented in
+        docs/engine.md (no priority-triggered preemption of running
+        sequences)."""
+        if not self.preempted:
+            return self.waiting if self.waiting else None
+        if not self.waiting:
+            return self.preempted
+        if (
+            self.waiting[0].sampling_params.priority
+            < self.preempted[0].sampling_params.priority
+        ):
+            return self.waiting
+        return self.preempted
+
     def _try_schedule_prefill(self) -> Optional[PrefillPlan]:
         if len(self.running) >= self.config.max_num_seqs:
             return None
-        # Preempted sequences resume first (their progress is largest).
-        queue = self.preempted if self.preempted else self.waiting
+        queue = self._admission_queue()
         if not queue:
             return None
         seq = queue[0]
@@ -316,6 +335,60 @@ class Scheduler:
             seqs=list(self.running),
             steps=[self._step_budget(seq) for seq in self.running],
         )
+
+    def schedule_provisional(
+        self, inflight_seqs: List[Sequence]
+    ) -> Optional[DecodePlan]:
+        """Plan the NEXT decode step while the previous one is still in
+        flight on the device, under the optimistic assumption that no
+        in-flight sequence finishes (the engine rolls back appends for
+        sequences that did — the same overrun argument multi-step decode
+        relies on).  Returns None whenever the pipeline must break and
+        replan synchronously:
+
+        * the running set changed under us (an abort landed),
+        * an admission is pending (a waiting/preempted sequence could
+          prefill into an open slot — ordering must match the
+          synchronous scheduler),
+        * any in-flight sequence PREDICTABLY finishes this step
+          (max_tokens / max_model_len — length finishes are host-known
+          before the token is),
+        * backing the extra token would require preemption (provisional
+          planning never preempts: the victim choice must see collected
+          state).
+
+        On success every returned sequence's block table already covers
+        the provisional +1 token (at most one new block per sequence)."""
+        if len(self.running) != len(inflight_seqs) or any(
+            a is not b for a, b in zip(self.running, inflight_seqs)
+        ):
+            return None
+        if not self.running:
+            return None
+        if (self.waiting or self.preempted) and (
+            len(self.running) < self.config.max_num_seqs
+        ):
+            return None
+        for seq in self.running:
+            if seq.num_generated + 1 >= seq.sampling_params.max_tokens:
+                return None
+            if seq.num_tokens + 1 >= self.config.max_model_len:
+                return None
+        bs = self.block_pool.block_size
+        needs = [
+            # After the in-flight token lands the sequence holds
+            # num_tokens+1 tokens; the next step writes KV at slot index
+            # num_tokens, so the table must cover num_tokens+1 slots.
+            max(0, -(-(seq.num_tokens + 1) // bs) - len(seq.block_table))
+            for seq in self.running
+        ]
+        total = sum(needs)
+        if total and not self.block_pool.can_allocate(total):
+            return None
+        for seq, need in zip(self.running, needs):
+            if need:
+                seq.block_table.extend(self.block_pool.allocate(need))
+        return DecodePlan(seqs=list(self.running), steps=[1] * len(self.running))
 
     # -- preemption / release ---------------------------------------------
 

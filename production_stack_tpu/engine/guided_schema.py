@@ -260,6 +260,11 @@ def _frame_step(frame, b: int):
         if phase == "after_open":
             if b == 0x5D:  # ] — empty array
                 return "COMPLETE"
+            if mx == 0:
+                # maxItems 0: only [] conforms — reject starting an
+                # element by construction instead of leaning on the
+                # finish-time validate_instance re-check.
+                return None
             # First element begins with this byte: push the item frame
             # and re-dispatch.
             return (("arr", item, mn, mx, 0, "elems"), "REPUSH", b)

@@ -21,7 +21,7 @@ from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from production_stack_tpu.engine.parallel.compat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from production_stack_tpu.engine.config import ModelConfig

@@ -28,7 +28,7 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from production_stack_tpu.engine.parallel.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 NEG_INF = -1e30  # large-but-finite: keeps masked softmax rows NaN-free

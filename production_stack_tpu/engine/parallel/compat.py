@@ -1,0 +1,28 @@
+"""JAX version compatibility shims.
+
+``shard_map`` graduated from ``jax.experimental.shard_map`` to the
+top-level ``jax`` namespace (and renamed ``check_rep`` to ``check_vma``)
+across jax releases; this repo must run on both sides of that move.
+Import ``shard_map`` from here instead of from ``jax`` directly.
+"""
+
+from __future__ import annotations
+
+try:  # jax >= 0.5: top-level export, check_vma keyword
+    from jax import shard_map as _shard_map
+
+    _LEGACY = False
+except ImportError:  # jax 0.4.x: experimental module, check_rep keyword
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _LEGACY = True
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True, **kw):
+    if _LEGACY:
+        kw["check_rep"] = check_vma
+    else:
+        kw["check_vma"] = check_vma
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw
+    )

@@ -42,6 +42,18 @@ logger = logging.getLogger(__name__)
 _COORD_PORT = 8476
 
 
+def fatal_exit(code: int = 1) -> None:
+    """Terminate the process immediately after flushing log handlers.
+
+    Used when a lockstep member must die NOW: ``sys.exit`` would run
+    atexit hooks (jax.distributed teardown blocks on collectives the
+    dead/desynced group will never complete), turning a clean k8s
+    restart into a hung pod.  Module-level indirection so tests can
+    monkeypatch it."""
+    logging.shutdown()
+    os._exit(code)
+
+
 @dataclasses.dataclass(frozen=True)
 class DistributedEnv:
     coordinator_address: str
@@ -186,7 +198,13 @@ def follower_loop(engine, channel: LockstepChannel) -> None:
     """Run a follower replica: apply the leader's event batches and step
     in lockstep until shutdown.  Outputs are discarded — the leader owns
     the HTTP surface; this process only contributes its device shards to
-    the collective computation."""
+    the collective computation.
+
+    ``engine.step()`` here is the same dispatch/collect pipeline the
+    leader's loop drives, so with pipeline_decode on every replica
+    enqueues the identical lookahead launch sequence (collects are pure
+    host reads of addressable shards — no collectives), keeping the SPMD
+    group in sync."""
     logger.info("follower %d: entering lockstep loop", channel.denv.process_id)
     while True:
         events = channel.receive()
@@ -208,4 +226,19 @@ def follower_loop(engine, channel: LockstepChannel) -> None:
                 # answered the client; stay in lockstep.
                 logger.exception("follower: add_request failed")
         if engine.has_unfinished():
-            engine.step()
+            try:
+                engine.step()
+            except Exception:
+                # An unguarded step error would kill this process while
+                # the leader keeps publishing, wedging the group in
+                # collectives until a partial restart that cannot rejoin
+                # the running jax.distributed incarnation anyway.  Exit
+                # nonzero promptly so k8s restarts the WHOLE slice group
+                # together (an SPMD group cannot heal a lost member in
+                # place).
+                logger.exception(
+                    "follower: engine.step failed; exiting nonzero so "
+                    "the slice group restarts together"
+                )
+                fatal_exit(1)
+                return  # unreachable except under monkeypatched exit

@@ -92,7 +92,9 @@ def _ring_partials(
     ring's partials).  Returns unnormalized (m, l, o)."""
     Tl, H, D = q.shape
     Tk = k.shape[0]
-    sp = lax.axis_size(axis_name)
+    # lax.axis_size is jax>=0.5; psum of 1 over the axis is the portable
+    # spelling (constant-folded at trace time).
+    sp = getattr(lax, "axis_size", lambda a: lax.psum(1, a))(axis_name)
     my_idx = lax.axis_index(axis_name)
 
     def body(step, carry):
@@ -215,7 +217,7 @@ def ring_prefill_with_prefix(
 
 def ring_prefill_attention(mesh, q, k, v, *, scale: float, valid_len=None):
     """Convenience wrapper: shard T over the sp axis and run the ring."""
-    from jax import shard_map
+    from production_stack_tpu.engine.parallel.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     from production_stack_tpu.engine.parallel.mesh import AXES

@@ -91,25 +91,21 @@ def apply_penalties(
     repetition: jax.Array = None,  # [S]; 1.0 = off
     ctx_tokens: jax.Array = None,  # [S, Lc] prompt+generated, -1 padded
 ) -> jax.Array:
-    """OpenAI presence/frequency penalties over the GENERATED tokens (vLLM
-    semantics: the prompt is not penalized), plus the HF/vLLM
-    ``repetition_penalty`` over prompt AND generated tokens: for every
-    seen token, positive logits divide by the penalty, negative multiply
-    (HF ``RepetitionPenaltyLogitsProcessor``).  Per sequence:
+    """HF/vLLM ``repetition_penalty`` over prompt AND generated tokens
+    applied to the RAW logits first (for every seen token, positive
+    logits divide by the penalty, negative multiply — HF
+    ``RepetitionPenaltyLogitsProcessor``), then the OpenAI
+    presence/frequency penalties over the GENERATED tokens (vLLM
+    semantics: the prompt is not penalized).  Per sequence:
     ``logit[t] -= presence*[count(t)>0] + frequency*count(t)``.
+
+    Order matters when both families hit the same token (HF/vLLM apply
+    repetition before the subtraction: logit 2.0, presence 1.5, rep 2.0
+    must give -0.5, not +0.25).
 
     The [S, V] count matrix is built on-device by scatter-add from the
     small [S, L] id array — no dense host->device transfer per step."""
     S, V = logits.shape
-    valid = out_tokens >= 0
-    ids = jnp.where(valid, out_tokens, 0)
-    counts = jax.vmap(
-        lambda i, v: jnp.zeros((V,), jnp.float32).at[i].add(
-            v.astype(jnp.float32)
-        )
-    )(ids, valid)
-    penalty = presence[:, None] * (counts > 0) + frequency[:, None] * counts
-    logits = logits - penalty
     if repetition is not None:
         cvalid = ctx_tokens >= 0
         cids = jnp.where(cvalid, ctx_tokens, 0)
@@ -119,7 +115,15 @@ def apply_penalties(
         rep = repetition[:, None]
         scaled = jnp.where(logits > 0, logits / rep, logits * rep)
         logits = jnp.where(seen, scaled, logits)
-    return logits
+    valid = out_tokens >= 0
+    ids = jnp.where(valid, out_tokens, 0)
+    counts = jax.vmap(
+        lambda i, v: jnp.zeros((V,), jnp.float32).at[i].add(
+            v.astype(jnp.float32)
+        )
+    )(ids, valid)
+    penalty = presence[:, None] * (counts > 0) + frequency[:, None] * counts
+    return logits - penalty
 
 
 def top_logprobs_of(

@@ -237,6 +237,7 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
             (vocab.TPU_PREFIX_CACHE_HIT_RATE, s["prefix_cache_hit_rate"]),
             (vocab.TPU_HOST_KV_USAGE_PERC, s["host_kv_usage_perc"]),
             (vocab.TPU_DUTY_CYCLE, s["duty_cycle"]),
+            (vocab.TPU_DECODE_HOST_GAP_MS, s["decode_host_gap_ms"]),
             (vocab.TPU_LOADED_LORAS, s["loaded_loras"]),
             (vocab.TPU_TOTAL_PROMPT_TOKENS, s["total_prompt_tokens"]),
             (vocab.TPU_TOTAL_GENERATED_TOKENS, s["total_generated_tokens"]),
@@ -1337,6 +1338,15 @@ def main(argv=None) -> None:
         "--num-scheduler-steps): amortizes dispatch latency, may compute "
         "up to N-1 discarded tokens past a stop condition",
     )
+    parser.add_argument(
+        "--no-pipeline-decode",
+        action="store_true",
+        help="disable the async one-step-lookahead decode pipeline "
+        "(dispatch decode N+1 while step N's tokens are in flight; "
+        "greedy streams are identical, decode_host_gap_ms shows the "
+        "recovered host serialization).  Auto-disabled by "
+        "--num-scheduler-steps > 1 and --speculative-ngram",
+    )
     parser.add_argument("--host-offload-gb", type=float, default=0.0)
     parser.add_argument("--remote-kv-url", default=None)
     parser.add_argument(
@@ -1402,6 +1412,10 @@ def main(argv=None) -> None:
             ),
             "scheduler.num_scheduler_steps": args.num_scheduler_steps,
             "scheduler.speculative_ngram": args.speculative_ngram,
+            **(
+                {"scheduler.pipeline_decode": False}
+                if args.no_pipeline_decode else {}
+            ),
             "cache.block_size": args.block_size,
             "cache.num_blocks": args.num_blocks,
             "cache.host_offload_gb": args.host_offload_gb,

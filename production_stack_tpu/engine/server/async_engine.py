@@ -1,4 +1,4 @@
-"""Async bridge between the aiohttp server and the synchronous LLMEngine.
+"""Async bridge between the aiohttp server and the LLMEngine.
 
 The engine step loop runs in one dedicated thread (device execution releases
 the GIL, so the event loop keeps serving HTTP while XLA runs).  Requests and
@@ -6,6 +6,15 @@ per-token outputs cross the thread boundary via a lock-guarded submission
 list and ``loop.call_soon_threadsafe`` hand-offs into per-request asyncio
 queues — one queue per request, one engine, no polling of shared state from
 the event loop.
+
+The loop drives the engine's dispatch/collect pipeline directly: each
+iteration tops up the device pipeline (with pipeline_decode on, decode
+step N+1 is enqueued before step N's tokens are read back), then collects
+and fans out step N — so detokenization and SSE emission overlap device
+compute of the next step instead of serializing against it.  The lockstep
+publish sits at the same dispatch boundary: followers replay the event
+batch and run the identical dispatch/collect discipline (engine.step()),
+keeping every replica's jitted launch sequence byte-identical.
 """
 
 from __future__ import annotations
@@ -168,8 +177,39 @@ class AsyncEngine:
                 self._wakeup.clear()
                 continue
             try:
-                outputs = self.engine.step()
+                # Keep the device fed before fanning out results: with
+                # pipeline_decode on, dispatch() enqueues decode N+1
+                # (chained on N's in-flight sample) and collect() then
+                # reads N back — the _emit loop below runs while N+1 is
+                # computing.
+                self.engine.dispatch()
+                outputs = self.engine.collect()
             except Exception:
+                if self._lockstep is not None:
+                    # Fatal under lockstep: followers have already
+                    # launched this iteration's collectives (or will
+                    # hang waiting for them).  Retrying against a
+                    # desynced SPMD group wedges it in collectives;
+                    # exiting lets k8s restart the slice group together.
+                    # The shutdown publish is best-effort — if the
+                    # collective transport still works, followers exit
+                    # cleanly instead of waiting out the staleness
+                    # window.
+                    logger.exception(
+                        "engine step failed under lockstep; exiting so "
+                        "the slice group restarts together"
+                    )
+                    from production_stack_tpu.engine.parallel.distributed import (
+                        StepEvents,
+                        fatal_exit,
+                    )
+
+                    try:
+                        self._lockstep.publish(StepEvents(shutdown=True))
+                    except Exception:
+                        logger.exception("shutdown publish failed")
+                    fatal_exit(1)
+                    return  # unreachable except under monkeypatched exit
                 logger.exception("engine step failed")
                 time.sleep(0.1)
                 continue

@@ -56,6 +56,10 @@ TPU_PREFIX_CACHE_HIT_RATE = "tpu:prefix_cache_hit_rate"
 TPU_HOST_KV_USAGE_PERC = "tpu:host_kv_usage_perc"
 TPU_DUTY_CYCLE = "tpu:duty_cycle"
 TPU_LOADED_LORAS = "tpu:loaded_loras"
+# Mean host-side serialization per decode step, ms: time the accelerator
+# sat idle between decode steps waiting on host work.  ≈0 when the
+# engine's one-step-lookahead decode pipeline is active.
+TPU_DECODE_HOST_GAP_MS = "tpu:decode_host_gap_ms"
 
 # The custom metric the prometheus-adapter exposes for HPA (reference:
 # observability/prom-adapter.yaml:8-20 exposes vllm:num_requests_waiting).

@@ -378,3 +378,82 @@ def test_channel_staleness_window(monkeypatch):
     assert not channel.stale()
     channel.last_event_time -= 100.0  # > 6 heartbeats ago
     assert channel.stale()
+
+
+def test_follower_step_failure_exits_nonzero(monkeypatch):
+    """A follower step exception must terminate the process promptly and
+    nonzero (the whole slice group restarts together) instead of leaking
+    the exception while the leader keeps publishing into a wedged group."""
+    from production_stack_tpu.engine.parallel import distributed
+
+    exits = []
+    monkeypatch.setattr(distributed, "fatal_exit", exits.append)
+
+    class BoomEngine:
+        def has_unfinished(self):
+            return True
+
+        def abort_request(self, rid):
+            pass
+
+        def add_request(self, *a, **kw):
+            pass
+
+        def step(self):
+            raise RuntimeError("collective desync")
+
+    class OneBatchChannel:
+        denv = distributed.DistributedEnv("x:1", 2, 1)
+
+        def receive(self):
+            return distributed.StepEvents(
+                requests=[("r1", [1, 2], None, None)]
+            )
+
+    distributed.follower_loop(BoomEngine(), OneBatchChannel())
+    assert exits == [1]
+
+
+async def test_leader_step_failure_under_lockstep_is_fatal(monkeypatch):
+    """Under lockstep a leader step exception must publish shutdown
+    (best-effort) and exit — never the retry loop, which would re-step
+    against followers that already advanced or died."""
+    from production_stack_tpu.engine.config import config_from_preset
+    from production_stack_tpu.engine.core.sequence import SamplingParams
+    from production_stack_tpu.engine.parallel import distributed
+    from production_stack_tpu.engine.server.async_engine import AsyncEngine
+
+    exits = []
+    published = []
+    monkeypatch.setattr(distributed, "fatal_exit", exits.append)
+
+    class RecordingChannel:
+        heartbeat_seconds = 10.0
+
+        def publish(self, events):
+            published.append(events)
+
+    config = config_from_preset(
+        "tiny-llama",
+        **{"scheduler.max_num_seqs": 2, "scheduler.max_model_len": 128,
+           "cache.num_blocks": 64},
+    )
+    engine = AsyncEngine(config, lockstep=RecordingChannel())
+    engine.engine.dispatch = None  # any step attempt raises TypeError
+    await engine.start()
+    try:
+        with pytest.raises(asyncio.TimeoutError):
+            # The stream never completes: the step thread dies fatally.
+            # Bound the wait so a regression fails fast instead of
+            # hanging the suite.
+            async def one_token():
+                async for _ in engine.generate(
+                    prompt="x", sampling_params=SamplingParams(max_tokens=1),
+                ):
+                    break
+
+            await asyncio.wait_for(one_token(), timeout=10.0)
+    finally:
+        await engine.close()
+    assert exits == [1]
+    assert any(ev.shutdown for ev in published)

@@ -57,3 +57,32 @@ def test_parse_empty_text_defaults():
     s = EngineStats.from_prometheus_text("")
     assert s.num_running_requests == 0
     assert s.kv_usage_perc == 0.0
+
+
+async def test_decode_host_gap_ms_exported():
+    """The pipeline-observability gauge must flow engine.stats() ->
+    /metrics under its vocabulary name (the bench and serving harness
+    scrape it to show the recovered host serialization)."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from production_stack_tpu.engine.config import config_from_preset
+    from production_stack_tpu.engine.server.api_server import build_engine_app
+    from production_stack_tpu.engine.server.async_engine import AsyncEngine
+    from production_stack_tpu.router.stats import vocabulary as vocab
+
+    config = config_from_preset(
+        "tiny-llama", **{"cache.num_blocks": 64, "scheduler.max_num_seqs": 2,
+                         "scheduler.prefill_buckets": (16, 32)}
+    )
+    engine = AsyncEngine(config)
+    assert "decode_host_gap_ms" in engine.stats()
+    server = TestServer(build_engine_app(engine, "tiny-llama"))
+    await server.start_server()
+    client = TestClient(server)
+    try:
+        resp = await client.get("/metrics")
+        text = await resp.text()
+        assert vocab.TPU_DECODE_HOST_GAP_MS in text
+        assert f"# TYPE {vocab.TPU_DECODE_HOST_GAP_MS} gauge" in text
+    finally:
+        await client.close()

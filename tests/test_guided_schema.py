@@ -83,6 +83,29 @@ def test_machine_array_bounds():
     assert accepts(free, '{"note":"hello, world !"}')
 
 
+def test_machine_max_items_zero_rejects_elements_by_construction():
+    """maxItems 0 admits only []: a non-']' byte after '[' must be
+    rejected by the machine itself, not merely caught by the finish-time
+    validate_instance re-check (which would surface as guided_invalid
+    after streaming a nonconforming element)."""
+    schema = {"type": "array", "items": {"type": "integer"}, "maxItems": 0}
+    assert accepts(SchemaGuide(schema), "[]")
+    g = SchemaGuide(schema)
+    assert g.try_token(b"[1") is None       # element start rejected
+    assert g.try_token(b"[") is not None    # open still fine
+    nested = SchemaGuide({
+        "type": "object",
+        "properties": {"tags": {"type": "array", "items": {"type": "string"},
+                                "maxItems": 0}},
+    })
+    assert not accepts(nested, '{"tags":["x"]}')
+    assert accepts(SchemaGuide({
+        "type": "object",
+        "properties": {"tags": {"type": "array", "items": {"type": "string"},
+                                "maxItems": 0}},
+    }), '{"tags":[]}')
+
+
 def test_machine_nested_object_and_free_slot():
     schema = {
         "type": "object",

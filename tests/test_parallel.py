@@ -13,7 +13,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
+from production_stack_tpu.engine.parallel.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from production_stack_tpu.engine.config import (

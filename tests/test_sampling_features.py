@@ -49,6 +49,27 @@ def test_apply_penalties_padding_token_not_penalized():
     np.testing.assert_allclose(got, 1.0)
 
 
+def test_repetition_applies_before_presence_frequency():
+    """HF/vLLM ordering: repetition_penalty divides/multiplies the RAW
+    logit first, presence/frequency subtract afterwards.  Both families
+    on the same seen token: logit 2.0, rep 2.0, presence 1.5 must give
+    2.0/2.0 - 1.5 = -0.5, not (2.0 - 1.5)/2.0 = 0.25."""
+    logits = jnp.asarray([[0.0, 2.0, -2.0]], jnp.float32)
+    out_tokens = jnp.asarray([[1, 2, -1, -1]], jnp.int32)
+    ctx_tokens = jnp.asarray([[1, 2, -1, -1]], jnp.int32)
+    got = np.asarray(apply_penalties(
+        logits,
+        out_tokens,
+        presence=jnp.asarray([1.5], jnp.float32),
+        frequency=jnp.asarray([0.0], jnp.float32),
+        repetition=jnp.asarray([2.0], jnp.float32),
+        ctx_tokens=ctx_tokens,
+    ))
+    np.testing.assert_allclose(got[0, 1], -0.5)      # 2/2 - 1.5
+    np.testing.assert_allclose(got[0, 2], -5.5)      # -2*2 - 1.5
+    np.testing.assert_allclose(got[0, 0], 0.0)       # unseen: untouched
+
+
 def test_top_logprobs_of():
     logits = jnp.asarray([[0.0, 1.0, 2.0, -1.0]], jnp.float32)
     chosen, top_ids, top_lps = top_logprobs_of(logits, jnp.asarray([1]), k=2)
