@@ -494,6 +494,91 @@ def bench_engine_pipeline_ab(args, preset: str) -> dict:
     }
 
 
+# -- trace report ----------------------------------------------------------
+
+
+def run_trace_report(num_requests: int = 12, max_tokens: int = 16) -> dict:
+    """Short serve through the router + fake engine, then pull the
+    /debug/requests join and print a per-phase latency attribution table.
+
+    CI-runnable on CPU (no jax import): the point is that every perf
+    number this repo reports can come WITH attribution — a regression in
+    the primary metric immediately shows which phase grew.  On hardware,
+    point the same join at a real engine (docs/observability.md)."""
+    import asyncio
+
+    async def run() -> dict:
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from production_stack_tpu.router.app import build_app
+        from production_stack_tpu.router.parser import parse_args
+        from production_stack_tpu.testing.fake_engine import (
+            FakeEngineState,
+            build_fake_engine_app,
+        )
+
+        state = FakeEngineState(tokens_per_sec=400.0, ttft=0.02)
+        engine_server = TestServer(build_fake_engine_app(state))
+        await engine_server.start_server()
+        backend = str(engine_server.make_url("")).rstrip("/")
+        args = parse_args([
+            "--static-backends", backend,
+            "--static-models", state.model,
+            "--engine-stats-interval", "1",
+        ])
+        router_server = TestServer(build_app(args))
+        await router_server.start_server()
+        client = TestClient(router_server)
+        try:
+            ids = []
+            for i in range(num_requests):
+                rid = f"trace-bench-{i}"
+                resp = await client.post(
+                    "/v1/completions",
+                    json={"model": state.model, "prompt": f"probe {i}",
+                          "max_tokens": max_tokens, "stream": True},
+                    headers={"x-request-id": rid},
+                )
+                await resp.read()
+                ids.append(rid)
+            phases: dict = {}
+            totals = []
+            for rid in ids:
+                resp = await client.get(f"/debug/requests/{rid}")
+                if resp.status != 200:
+                    continue
+                joined = await resp.json()
+                totals.append(joined["total_s"])
+                for name, dur in joined["phase_s"].items():
+                    phases.setdefault(name, []).append(dur)
+            report = {"requests": len(totals)}
+            if totals:
+                mean_total = sum(totals) / len(totals)
+                report["mean_total_ms"] = round(mean_total * 1e3, 2)
+                table = {}
+                for name, durs in sorted(phases.items()):
+                    mean = sum(durs) / len(durs)
+                    table[name] = {
+                        "mean_ms": round(mean * 1e3, 3),
+                        "max_ms": round(max(durs) * 1e3, 3),
+                        "share": round(mean / mean_total, 3) if mean_total else 0.0,
+                    }
+                report["phases"] = table
+                log("trace report: per-phase latency attribution "
+                    f"({len(totals)} requests, mean e2e "
+                    f"{report['mean_total_ms']} ms)")
+                log(f"  {'phase':<24} {'mean_ms':>9} {'max_ms':>9} {'share':>6}")
+                for name, row in table.items():
+                    log(f"  {name:<24} {row['mean_ms']:>9.3f} "
+                        f"{row['max_ms']:>9.3f} {row['share']:>6.1%}")
+            return report
+        finally:
+            await client.close()
+            await engine_server.close()
+
+    return asyncio.run(run())
+
+
 # -- main ------------------------------------------------------------------
 
 
@@ -572,12 +657,29 @@ def main() -> None:
         "prints inside the driver's window",
     )
     ap.add_argument(
+        "--trace-report", action="store_true",
+        help="run only the per-phase latency attribution stage: short "
+        "serve through router + fake engine (CPU-safe, no jax), pull "
+        "/debug/requests joins, print the phase table and exit",
+    )
+    ap.add_argument(
         "--serving-scheduler-steps", type=int, default=8,
         help="num_scheduler_steps for the serving bench engine (8 amortizes "
         "dispatch RTT when the TPU sits behind a network tunnel; set 1 for "
         "classic per-token stepping on a directly-attached chip)",
     )
     args = ap.parse_args()
+
+    if args.trace_report:
+        report = run_trace_report()
+        print(json.dumps({
+            "metric": "trace_report_mean_e2e",
+            "value": report.get("mean_total_ms", 0.0),
+            "unit": "ms",
+            "vs_baseline": 0.0,
+            "detail": report,
+        }), flush=True)
+        return
 
     import os
 

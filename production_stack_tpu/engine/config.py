@@ -412,12 +412,32 @@ class LoraServingConfig:
 
 
 @dataclasses.dataclass
+class ObsConfig:
+    """Observability layer (production_stack_tpu/obs): request tracing,
+    /debug/requests ring buffers, and the per-step phase histograms.
+
+    ``tracing=False`` is the fast-path gate: every obs hook in the engine
+    core returns before touching any state (no histogram observes, no
+    trace allocations per step) — the pre-tracing hot path, verified by
+    tests/test_observability.py."""
+
+    tracing: bool = True
+    # Completed request timelines kept per component (bounds /debug memory).
+    trace_ring_size: int = 256
+
+    def __post_init__(self):
+        if self.trace_ring_size < 1:
+            raise ValueError("trace_ring_size must be >= 1")
+
+
+@dataclasses.dataclass
 class EngineConfig:
     model: ModelConfig = dataclasses.field(default_factory=ModelConfig)
     cache: CacheConfig = dataclasses.field(default_factory=CacheConfig)
     parallel: ParallelConfig = dataclasses.field(default_factory=ParallelConfig)
     scheduler: SchedulerConfig = dataclasses.field(default_factory=SchedulerConfig)
     lora: LoraServingConfig = dataclasses.field(default_factory=LoraServingConfig)
+    obs: ObsConfig = dataclasses.field(default_factory=ObsConfig)
     seed: int = 0
     tokenizer: Optional[str] = None  # HF tokenizer path; None -> byte fallback
     weights_path: Optional[str] = None  # safetensors dir; None -> random init
@@ -444,7 +464,8 @@ def config_from_preset(name: str, **overrides) -> EngineConfig:
     # __post_init__ so invalid override COMBINATIONS (e.g. speculative +
     # multi-step, disagg without a store URL) fail at construction, not
     # as undefined runtime behavior.
-    for sub in (cfg.model, cfg.cache, cfg.scheduler, cfg.parallel, cfg.lora):
+    for sub in (cfg.model, cfg.cache, cfg.scheduler, cfg.parallel, cfg.lora,
+                cfg.obs):
         post = getattr(sub, "__post_init__", None)
         if post is not None:
             post()

@@ -56,6 +56,7 @@ from production_stack_tpu.engine.kv import quant as kv_quant
 from production_stack_tpu.engine.kv.offload import HostOffloadManager
 from production_stack_tpu.engine.models import get_model
 from production_stack_tpu.engine.models.weights import load_params
+from production_stack_tpu.obs.engine import EngineObs
 from production_stack_tpu.engine.parallel import shardings as shardings_lib
 from production_stack_tpu.engine.parallel.mesh import AXES, build_mesh
 from production_stack_tpu.engine import sampling as sampling_lib
@@ -283,6 +284,13 @@ class LLMEngine:
             self.lora_registry = AdapterRegistry(
                 cfg, config.lora, jnp.dtype(cfg.dtype)
             )
+
+        # Observability hub: request tracer + step-phase/latency histograms
+        # (all hooks no-op when config.obs.tracing is off).
+        self.obs = EngineObs(
+            enabled=config.obs.tracing,
+            ring_size=config.obs.trace_ring_size,
+        )
 
         self._step_counter = 0
         self._encode_fn = None  # lazily jitted /v1/embeddings path
@@ -517,6 +525,7 @@ class LLMEngine:
             seq.finish_reason = FinishReason.ABORT
         self.offload.discard(request_id)
         self._seqs.pop(request_id, None)
+        self.obs.on_abort(request_id)
 
     def has_unfinished(self) -> bool:
         return self.scheduler.has_unfinished()
@@ -566,6 +575,9 @@ class LLMEngine:
             outputs = p.outputs
         else:
             arr = np.asarray(p.sampled)  # the ONE device sync point
+            if self.obs.enabled:
+                self.obs.step_phase("collect", time.time() - t0)
+            t_post = time.time()
             live = [
                 (i, s) for i, s in enumerate(p.seqs) if not s.is_finished
             ]
@@ -574,6 +586,8 @@ class LLMEngine:
                 [int(arr[i]) for i, _ in live],
                 first_token=False,
             )
+            if self.obs.enabled:
+                self.obs.step_phase("sample", time.time() - t_post)
             # Drop in-flight successors whose every row has now finished:
             # pure overrun steps produce no outputs and must not wedge
             # the pipeline when the engine drains.
@@ -583,6 +597,15 @@ class LLMEngine:
                 and all(s.is_finished for s in self._pending[0].seqs)
             ):
                 self._pending.popleft()
+            if self.obs.enabled:
+                # Only pipelined steps have a pure-dispatch host_s: a
+                # synchronous step's host_s fuses array build, blocking
+                # device compute and sampling, and attributing THAT to
+                # "dispatch" would point slow-step debugging at H2D work
+                # when the time was device compute.  Sync steps feed only
+                # the schedule phase; the dispatch/collect/sample split
+                # covers the steady-state pipelined decode path.
+                self.obs.step_phase("dispatch", p.host_s)
         now = time.time()
         self._last_decode_end = now if p.is_decode else None
         busy = (now - t0) + p.host_s
@@ -598,6 +621,8 @@ class LLMEngine:
         place synchronous plans run."""
         t0 = time.time()
         plan = self.scheduler.schedule()
+        if self.obs.enabled:
+            self.obs.step_phase("schedule", time.time() - t0)
         if plan.is_empty:
             return False
         if plan.prefill is not None:
@@ -628,7 +653,10 @@ class LLMEngine:
             return False  # only pipelined decode steps chain
         if not self._can_pipeline(prev.seqs):
             return False
+        t0 = time.time()
         plan = self.scheduler.schedule_provisional(prev.seqs)
+        if self.obs.enabled:
+            self.obs.step_phase("schedule", time.time() - t0)
         if plan is None:
             return False
         self._pending.append(
@@ -781,6 +809,20 @@ class LLMEngine:
         now holds the blocks as a partial-prefill prefix — no recompute),
         "gone" (no snapshot: recompute), or "retry" (transient pool
         pressure: snapshot reinserted, try again next step)."""
+        if self.obs.enabled:
+            t0 = time.time()
+            result = self._restore_seq_blocks(seq)
+            if result != "retry":
+                # KV paging shows up on the request's timeline: a restore
+                # that precedes a slow re-admission is the attribution.
+                self.obs.tracer.add_span(
+                    seq.seq_id, "engine.kv_restore", t0, time.time(),
+                    result=result,
+                )
+            return result
+        return self._restore_seq_blocks(seq)
+
+    def _restore_seq_blocks(self, seq: Sequence) -> str:
         entry = self.offload.restore(seq.seq_id)
         if entry is None:
             return "gone"  # fall back to recompute via normal prefill
@@ -997,6 +1039,9 @@ class LLMEngine:
 
     def _run_prefill(self, plan: PrefillPlan) -> List[StepOutput]:
         seq = plan.seq
+        if self.obs.enabled and seq.first_scheduled_time is None:
+            seq.first_scheduled_time = time.time()
+            self.obs.on_first_scheduled(seq, seq.first_scheduled_time)
         bs = self.block_pool.block_size
         T = plan.bucket_len
         new_tokens = seq.prompt_token_ids[
@@ -1653,6 +1698,12 @@ class LLMEngine:
                 self.total_generated_tokens += 1
             if seq.first_token_time is None:
                 seq.first_token_time = now
+                if self.obs.enabled:
+                    self.obs.on_first_token(seq, now)
+            elif self.obs.enabled and seq.last_token_time is not None:
+                self.obs.on_token_gap(seq, now - seq.last_token_time)
+            if self.obs.enabled:
+                seq.last_token_time = now
             if stop_hit:
                 finish = FinishReason.STOP
                 token_id = -1
@@ -1713,6 +1764,7 @@ class LLMEngine:
         self.offload.discard(seq.seq_id)
         self.total_finished += 1
         self._seqs.pop(seq.seq_id, None)
+        self.obs.on_finish(seq)
         return reason
 
     def _check_finish(self, seq: Sequence, token_id: int) -> Optional[FinishReason]:
@@ -1732,9 +1784,23 @@ class LLMEngine:
     # -- preemption hook (called by scheduler via engine wrapper) ----------
 
     def offload_seq_blocks(self, seq: Sequence, block_ids: List[int]) -> bool:
-        return self.offload.save(
+        if not self.obs.enabled:
+            return self.offload.save(
+                seq.seq_id, self.kv_caches, block_ids,
+                num_tokens=seq.num_tokens,
+            )
+        t0 = time.time()
+        saved = self.offload.save(
             seq.seq_id, self.kv_caches, block_ids, num_tokens=seq.num_tokens
         )
+        if saved:
+            # Preemption paging on the request's timeline: the span names
+            # why this request's decode stalled.
+            self.obs.tracer.add_span(
+                seq.seq_id, "engine.kv_offload", t0, time.time(),
+                blocks=len(block_ids),
+            )
+        return saved
 
     # -- metrics -----------------------------------------------------------
 

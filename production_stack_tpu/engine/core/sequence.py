@@ -84,6 +84,11 @@ class Sequence:
     num_cached_tokens: int = 0  # prefix-cache hit length at admission
     finish_reason: Optional[FinishReason] = None
     first_token_time: Optional[float] = None
+    # Observability (obs/): first prefill-chunk launch (ends the queue-wait
+    # span) and the previous token's emit time (feeds the engine ITL
+    # histogram).  Maintained only when obs.tracing is on.
+    first_scheduled_time: Optional[float] = None
+    last_token_time: Optional[float] = None
     # Host-offload bookkeeping: host buffer ids per paged-out block.
     offloaded: bool = False
     # Mid-chunked-prefill: the sequence sits at its queue's head holding

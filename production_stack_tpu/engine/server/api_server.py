@@ -26,6 +26,7 @@ from aiohttp import web
 from production_stack_tpu.engine.config import EngineConfig, config_from_preset
 from production_stack_tpu.engine.core.sequence import FinishReason, SamplingParams
 from production_stack_tpu.engine.server.async_engine import AsyncEngine
+from production_stack_tpu.obs.trace import parse_traceparent
 from production_stack_tpu.router.stats import vocabulary as vocab
 from production_stack_tpu.utils.log import init_logger
 
@@ -250,7 +251,29 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
             (vocab.TPU_SPEC_TOKENS_DRAFTED, s["spec_tokens_drafted"]),
             (vocab.TPU_SPEC_TOKENS_ACCEPTED, s["spec_tokens_accepted"]),
         ]
-        return web.Response(text=vocab.render_prometheus(pairs))
+        # Latency histogram families (TTFT/ITL/e2e + step phases) ride the
+        # same exposition; rendered even at zero observations so the
+        # router scraper and dashboards see stable names.
+        text = vocab.render_prometheus(pairs) + engine.engine.obs.render_metrics()
+        return web.Response(text=text)
+
+    # -- request tracing debug surface (obs/) ------------------------------
+
+    async def debug_requests(_req: web.Request) -> web.Response:
+        """Ring buffer of completed request timelines, newest first."""
+        return web.json_response(engine.engine.obs.debug_payload())
+
+    async def debug_request(request: web.Request) -> web.Response:
+        snap = engine.engine.obs.tracer.snapshot(
+            request.match_info["request_id"]
+        )
+        if snap is None:
+            return web.json_response(
+                {"error": {"message": "unknown request id (expired from the "
+                           "trace ring, or tracing is off)"}},
+                status=404,
+            )
+        return web.json_response(snap)
 
     async def chat_completions(request: web.Request) -> web.StreamResponse:
         return await _serve_completion(request, chat=True)
@@ -480,6 +503,23 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
                 status=400,
             )
 
+        obs = engine.engine.obs
+        if obs.enabled:
+            # Start the trace only AFTER every validation 400 above: a
+            # rejected request must not leave a permanently-active trace
+            # (the bounded active map would evict legitimate in-flight
+            # timelines under a stream of rejects).  The router-propagated
+            # W3C context joins this timeline to the router's.  With n>1
+            # the trace follows the PRIMARY choice (choice 0 shares the
+            # request id); sibling choices' engine lifecycles are not
+            # traced — their token counts still land in the histograms.
+            obs.start_request(
+                request_id,
+                parse_traceparent(request.headers.get("traceparent")),
+                model=model_name, path=request.path, stream=stream,
+                n=n_choices,
+            )
+
         def choice_params(i: int) -> SamplingParams:
             if params.seed is None or i == 0:
                 return params if i == 0 else dataclasses.replace(params)
@@ -501,6 +541,21 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
         checkers = [
             StopChecker(tokenizer, params.stop) for _ in range(n_choices)
         ]
+        # Accumulated host detokenize time across all choices, reported to
+        # the obs layer when the request ends (the per-step phase the
+        # engine core cannot see — it happens here in the server).  With
+        # tracing off the untimed push keeps the pre-tracing hot path:
+        # zero perf_counter calls per token.
+        detok_s = [0.0]
+        if obs.enabled:
+            def timed_push(checker: StopChecker, token_id: int):
+                t0 = time.perf_counter()
+                out = checker.push(token_id)
+                detok_s[0] += time.perf_counter() - t0
+                return out
+        else:
+            def timed_push(checker: StopChecker, token_id: int):
+                return checker.push(token_id)
 
         # Running character offset per choice for the legacy completions
         # logprobs text_offset array (consumed by e.g. lm-evaluation-harness).
@@ -562,7 +617,11 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
 
         if stream:
             response = web.StreamResponse(
-                headers={"Content-Type": "text/event-stream", "Cache-Control": "no-cache"}
+                headers={
+                    "Content-Type": "text/event-stream",
+                    "Cache-Control": "no-cache",
+                    "X-Request-Id": request_id,
+                }
             )
             await response.prepare(request)
 
@@ -604,7 +663,7 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
                     if not live[i]:
                         continue  # post-stop events of an aborting choice
                     checker = checkers[i]
-                    delta, stopped = checker.push(event.token_id)
+                    delta, stopped = timed_push(checker, event.token_id)
                     if event.finished and not stopped:
                         # Flush any partial-stop-suffix holdback so the
                         # client gets the full tail.
@@ -678,6 +737,8 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
                 # leaves sibling choices decoding for nobody.
                 for task in pumps:
                     task.cancel()
+                if obs.enabled:
+                    obs.record_detokenize(request_id, detok_s[0])
             return response
 
         # Non-streaming: drain all choices CONCURRENTLY (async generators
@@ -694,7 +755,7 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
             async for event in gen:
                 if event.prompt_logprobs is not None:
                     prompt_lp = event.prompt_logprobs
-                delta, stopped = checker.push(event.token_id)
+                delta, stopped = timed_push(checker, event.token_id)
                 text_parts.append(delta)
                 if params.logprobs and event.token_id >= 0:
                     # The stop_token_ids sentinel contributes no text, so
@@ -722,6 +783,8 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
         drained = await asyncio.gather(
             *[drain(i, g) for i, g in enumerate(gens)]
         )
+        if obs.enabled:
+            obs.record_detokenize(request_id, detok_s[0])
         choices = []
         total_out = 0
         for i, (text, logprob_entries, finish_reason, out_tokens,
@@ -836,7 +899,8 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
                     "completion_tokens": n_out,
                     "total_tokens": len(prompt_token_ids) + n_out,
                 },
-            }
+            },
+            headers={"X-Request-Id": request_id},
         )
 
     async def embeddings(request: web.Request) -> web.Response:
@@ -1098,6 +1162,8 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
     app.router.add_get("/v1/models", models)
     app.router.add_get("/health", health)
     app.router.add_get("/metrics", metrics)
+    app.router.add_get("/debug/requests", debug_requests)
+    app.router.add_get("/debug/requests/{request_id}", debug_request)
     app.router.add_post("/v1/chat/completions", chat_completions)
     app.router.add_post("/v1/completions", completions)
     app.router.add_post("/v1/embeddings", embeddings)
@@ -1387,6 +1453,18 @@ def main(argv=None) -> None:
     # Multi-LoRA slots (engine/lora.py); adapters load via POST /admin/lora.
     parser.add_argument("--max-loras", type=int, default=0)
     parser.add_argument("--max-lora-rank", type=int, default=16)
+    parser.add_argument(
+        "--no-tracing",
+        action="store_true",
+        help="disable request tracing + step-phase histograms "
+        "(obs.tracing=off: restores the untraced hot path; /debug/requests "
+        "returns an empty ring and /metrics drops the histogram families' "
+        "samples growth)",
+    )
+    parser.add_argument(
+        "--trace-ring-size", type=int, default=256,
+        help="completed request timelines kept for GET /debug/requests",
+    )
     parser.add_argument("--log-level", default="info")
     args = parser.parse_args(argv)
 
@@ -1437,6 +1515,8 @@ def main(argv=None) -> None:
             "parallel.sequence_parallel_mode": args.sequence_parallel_mode,
             "lora.max_loras": args.max_loras,
             "lora.max_rank": args.max_lora_rank,
+            "obs.tracing": not args.no_tracing,
+            "obs.trace_ring_size": args.trace_ring_size,
         },
     )
     # Multi-host slice bootstrap (chart StatefulSet mode / GKE TPU pod

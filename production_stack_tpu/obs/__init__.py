@@ -1,0 +1,28 @@
+"""Self-contained observability layer: request tracing + latency histograms.
+
+No OpenTelemetry / client-library dependency.  ``trace`` carries W3C
+traceparent propagation and bounded per-request timelines; ``histogram``
+the shared Prometheus-style bucket layout; ``engine`` the engine-side hub
+(EngineObs) both the real engine core and the fake CI engine feed.
+"""
+
+from production_stack_tpu.obs.histogram import (  # noqa: F401
+    DEFAULT_LATENCY_BUCKETS,
+    Histogram,
+    render_histogram,
+    render_labeled_histograms,
+)
+from production_stack_tpu.obs.trace import (  # noqa: F401
+    RequestTrace,
+    Span,
+    Tracer,
+    make_traceparent,
+    new_trace_id,
+    parse_traceparent,
+)
+from production_stack_tpu.obs.engine import (  # noqa: F401
+    EngineObs,
+    PHASE_SPAN_NAMES,
+    REQUEST_HISTS,
+    STEP_PHASES,
+)

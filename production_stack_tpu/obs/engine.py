@@ -1,0 +1,177 @@
+"""Engine-side observability hub: request tracer + latency/step histograms.
+
+One ``EngineObs`` lives on each ``LLMEngine`` (and on the fake engine's
+state, so the CI contract matches the real engine).  The engine core calls
+the lifecycle hooks from its step thread; the API server starts traces
+(with the router-propagated trace id) and attaches the detokenize span.
+
+Everything is gated on ``enabled`` (config ``obs.tracing``): disabled, every
+hook returns before touching any state — no histogram observes, no trace
+allocations, no per-step bookkeeping — restoring the pre-tracing fast path.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+from production_stack_tpu.obs.histogram import (
+    Histogram,
+    render_histogram,
+)
+from production_stack_tpu.obs.trace import Tracer
+
+# Engine step phases (host-side attribution of ONE engine step; every
+# observation is per-step so the families are unit-comparable).  Keys map
+# to ``tpu:step_<phase>_seconds`` histogram families (vocabulary.py):
+#   schedule - scheduler planning (schedule / schedule_provisional)
+#   dispatch - host work launching device execution (array build + H2D)
+#   collect  - blocking device compute + sample readback
+#   sample   - host sampling post-process (append, finish checks, guided)
+# schedule covers every step; dispatch/collect/sample are the PIPELINED
+# decode split (the steady-state hot path) — synchronous steps (prefill,
+# host-state fallbacks) fuse those stages into one blocking call and
+# cannot be split without lying about where the time went.
+STEP_PHASES = ("schedule", "dispatch", "collect", "sample")
+
+# Request-level engine histograms -> ``tpu:*_seconds`` families; one
+# observation per request, EXCEPT itl which observes every token gap (its
+# _count is ~tokens, not requests).  detokenize_time is the request's
+# TOTAL host detokenize cost (accumulated across its tokens in the API
+# server) — a request-level quantity, which is why it lives here and not
+# in the per-step families above.
+REQUEST_HISTS = ("ttft", "itl", "e2e_latency", "queue_time", "prefill_time",
+                 "decode_time", "detokenize_time")
+
+# The span set a joined router+engine timeline is scored against
+# (/debug/requests/{id}: phase_sum_s vs total_s).  engine.detokenize is
+# accumulated host time interleaved WITH engine.decode (marked
+# accumulated=True on the span): it can push phase_sum slightly above
+# total for detokenize-heavy outputs, bounded by the detokenize fraction.
+# The other five partition the wall clock.
+PHASE_SPAN_NAMES = (
+    "router.queue",
+    "router.backend_connect",
+    "engine.queue",
+    "engine.prefill",
+    "engine.decode",
+    "engine.detokenize",
+)
+
+
+class EngineObs:
+    def __init__(self, enabled: bool = True, ring_size: int = 256):
+        self.enabled = bool(enabled)
+        self.tracer = Tracer("engine", enabled=self.enabled, ring_size=ring_size)
+        # Histograms are created eagerly (fixed, small set) so /metrics
+        # always renders every family — dashboards and the router scraper
+        # see stable names from the first scrape.
+        self.step_hists: Dict[str, Histogram] = {
+            phase: Histogram() for phase in STEP_PHASES
+        }
+        self.request_hists: Dict[str, Histogram] = {
+            name: Histogram() for name in REQUEST_HISTS
+        }
+
+    # -- step phases (engine step thread) ----------------------------------
+
+    def step_phase(self, phase: str, seconds: float) -> None:
+        if not self.enabled:
+            return
+        self.step_hists[phase].observe(seconds)
+
+    # -- request lifecycle (engine step thread) ----------------------------
+
+    def on_first_scheduled(self, seq, now: Optional[float] = None) -> None:
+        """First prefill chunk launched: the queue-wait span ends here."""
+        if not self.enabled:
+            return
+        now = now if now is not None else time.time()
+        self.request_hists["queue_time"].observe(now - seq.arrival_time)
+        self.tracer.add_span(seq.seq_id, "engine.queue", seq.arrival_time, now)
+
+    def on_first_token(self, seq, now: float) -> None:
+        if not self.enabled:
+            return
+        self.request_hists["ttft"].observe(now - seq.arrival_time)
+        sched = seq.first_scheduled_time
+        if sched is not None:
+            self.request_hists["prefill_time"].observe(now - sched)
+            self.tracer.add_span(seq.seq_id, "engine.prefill", sched, now)
+
+    def on_token_gap(self, seq, gap: float) -> None:
+        if not self.enabled:
+            return
+        self.request_hists["itl"].observe(gap)
+
+    def on_finish(self, seq, now: Optional[float] = None) -> None:
+        """Single finish hook (called from _finish_seq_now): e2e + decode
+        histograms, the decode span, and trace completion."""
+        if not self.enabled:
+            return
+        now = now if now is not None else time.time()
+        self.request_hists["e2e_latency"].observe(now - seq.arrival_time)
+        first = seq.first_token_time
+        if first is not None:
+            self.request_hists["decode_time"].observe(now - first)
+            self.tracer.add_span(seq.seq_id, "engine.decode", first, now)
+        self.tracer.finish(
+            seq.seq_id,
+            end=now,
+            finish_reason=(
+                seq.finish_reason.value if seq.finish_reason else None
+            ),
+            num_prompt_tokens=seq.num_prompt_tokens,
+            num_output_tokens=seq.num_generated,
+        )
+
+    def on_abort(self, request_id: str) -> None:
+        if not self.enabled:
+            return
+        self.tracer.finish(request_id, aborted=True)
+
+    # -- server-side hooks -------------------------------------------------
+
+    def start_request(
+        self, request_id: str, trace_id: Optional[str], **attrs
+    ) -> None:
+        if not self.enabled:
+            return
+        self.tracer.start(request_id, trace_id=trace_id, attrs=attrs)
+
+    def record_detokenize(self, request_id: str, seconds: float) -> None:
+        """Accumulated host detokenize time for one request, reported by
+        the API server after the stream ends.  The span is anchored at the
+        trace end (the work was interleaved with decode; ``accumulated``
+        marks it as a duration, not a wall-clock interval)."""
+        if not self.enabled:
+            return
+        self.request_hists["detokenize_time"].observe(seconds)
+        trace = self.tracer.get(request_id)
+        if trace is not None:
+            anchor = trace.end if trace.end is not None else time.time()
+            self.tracer.add_span(
+                request_id, "engine.detokenize", anchor, anchor + seconds,
+                accumulated=True,
+            )
+
+    # -- exposition --------------------------------------------------------
+
+    def render_metrics(self) -> str:
+        """Histogram families appended to the engine's /metrics body.
+        Rendered even at zero observations so names are scrape-stable."""
+        from production_stack_tpu.router.stats import vocabulary as vocab
+
+        parts = []
+        for name, hist in self.request_hists.items():
+            parts.append(render_histogram(vocab.TPU_REQUEST_HISTOGRAMS[name], hist))
+        for phase, hist in self.step_hists.items():
+            parts.append(render_histogram(vocab.TPU_STEP_HISTOGRAMS[phase], hist))
+        return "".join(parts)
+
+    def debug_payload(self) -> Dict:
+        return {
+            "enabled": self.enabled,
+            # Lock-held snapshots: the step thread mutates these traces.
+            "requests": self.tracer.snapshots(),
+        }

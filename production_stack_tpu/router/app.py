@@ -9,11 +9,13 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import uuid
 from typing import Optional
 
 import aiohttp
 from aiohttp import web
 
+from production_stack_tpu.obs.trace import Tracer
 from production_stack_tpu.router import parser as router_parser
 from production_stack_tpu.router.routing import initialize_routing_logic
 from production_stack_tpu.router.service_discovery import (
@@ -25,6 +27,7 @@ from production_stack_tpu.router.services.request_service.request import (
     ENGINE_STATS_SCRAPER,
     REQUEST_REWRITER,
     REQUEST_STATS_MONITOR,
+    ROUTER_TRACER,
 )
 from production_stack_tpu.router.services.request_service.rewriter import (
     get_request_rewriter,
@@ -49,6 +52,15 @@ def initialize_all(app: web.Application, args) -> ServiceRegistry:
 
     monitor = RequestStatsMonitor(sliding_window_size=args.request_stats_window)
     registry.set(REQUEST_STATS_MONITOR, monitor)
+
+    registry.set(
+        ROUTER_TRACER,
+        Tracer(
+            "router",
+            enabled=not args.no_tracing,
+            ring_size=args.trace_ring_size,
+        ),
+    )
 
     scraper = EngineStatsScraper(discovery, scrape_interval=args.engine_stats_interval)
     registry.set(ENGINE_STATS_SCRAPER, scraper)
@@ -98,16 +110,40 @@ def _unavailable(feature: str, exc: ImportError):
     )
 
 
+@web.middleware
+async def request_id_middleware(request: web.Request, handler):
+    """Honor an inbound X-Request-Id (mint one otherwise) and echo it on
+    EVERY response — success, error, and aiohttp HTTPException paths.
+    Streaming responses are prepared inside the proxy handler, so that
+    path stamps the header itself before prepare(); this middleware covers
+    everything else."""
+    request_id = request.headers.get("x-request-id") or f"req-{uuid.uuid4().hex[:16]}"
+    request["request_id"] = request_id
+    try:
+        response = await handler(request)
+    except web.HTTPException as exc:
+        exc.headers["X-Request-Id"] = request_id
+        raise
+    if not response.prepared:
+        response.headers["X-Request-Id"] = request_id
+    return response
+
+
 def build_app(args, registry: Optional[ServiceRegistry] = None) -> web.Application:
-    app = web.Application()
+    app = web.Application(middlewares=[request_id_middleware])
     app["registry"] = registry if registry is not None else ServiceRegistry()
     app["args"] = args
     initialize_all(app, args)
 
-    from production_stack_tpu.router.routers import main_router, metrics_router
+    from production_stack_tpu.router.routers import (
+        debug_router,
+        main_router,
+        metrics_router,
+    )
 
     app.add_routes(main_router.routes)
     app.add_routes(metrics_router.routes)
+    app.add_routes(debug_router.routes)
     if args.enable_batch_api:
         from production_stack_tpu.router.routers import batches_router, files_router
 

@@ -73,6 +73,20 @@ def parse_args(argv=None) -> argparse.Namespace:
     # Stats (reference parser.py:118-139).
     parser.add_argument("--engine-stats-interval", type=float, default=10.0)
     parser.add_argument("--request-stats-window", type=float, default=60.0)
+
+    # Request tracing (production_stack_tpu/obs): per-request span
+    # timelines at GET /debug/requests, joined with the engine's at
+    # /debug/requests/{id}.
+    parser.add_argument(
+        "--no-tracing",
+        action="store_true",
+        help="disable request tracing (obs.tracing=off): no spans, no "
+        "/debug/requests ring; request-id echo and latency histograms stay",
+    )
+    parser.add_argument(
+        "--trace-ring-size", type=int, default=256,
+        help="completed request timelines kept for GET /debug/requests",
+    )
     parser.add_argument(
         "--log-stats", action="store_true", help="Periodically log the stats planes"
     )

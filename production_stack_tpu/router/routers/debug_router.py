@@ -1,0 +1,110 @@
+"""Router /debug/requests: completed request timelines + router/engine join.
+
+``GET /debug/requests`` lists the router's completed timelines (bounded
+ring, newest first).  ``GET /debug/requests/{request_id}`` joins the
+router's timeline with the serving engine's (fetched live from the backend
+that handled the request) into one span list, and scores the
+non-overlapping phase set against wall-clock e2e — the "where did the time
+go" answer for a slow request.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, Optional
+
+import aiohttp
+from aiohttp import web
+
+from production_stack_tpu.obs.engine import PHASE_SPAN_NAMES
+from production_stack_tpu.router.services.request_service.request import (
+    CLIENT_SESSION,
+    ROUTER_TRACER,
+)
+
+logger = logging.getLogger(__name__)
+
+routes = web.RouteTableDef()
+
+# How long the join waits on the engine's debug endpoint; a slow/gone
+# engine degrades to a router-only timeline, never a hung debug request.
+_ENGINE_FETCH_TIMEOUT_S = 2.0
+
+
+@routes.get("/debug/requests")
+async def list_requests(request: web.Request) -> web.Response:
+    tracer = request.app["registry"].get(ROUTER_TRACER)
+    if tracer is None or not tracer.enabled:
+        return web.json_response({"enabled": False, "requests": []})
+    return web.json_response({
+        "enabled": True,
+        "requests": tracer.snapshots(),
+    })
+
+
+async def _fetch_engine_trace(
+    session: aiohttp.ClientSession, server: str, request_id: str
+) -> Optional[Dict]:
+    try:
+        async with session.get(
+            f"{server}/debug/requests/{request_id}",
+            timeout=aiohttp.ClientTimeout(total=_ENGINE_FETCH_TIMEOUT_S),
+        ) as resp:
+            if resp.status != 200:
+                return None
+            return await resp.json()
+    except Exception:
+        logger.debug("engine trace fetch failed for %s", request_id,
+                     exc_info=True)
+        return None
+
+
+def join_timelines(router_trace: Dict, engine_trace: Optional[Dict]) -> Dict:
+    """Merge router + engine span lists into one timeline and attribute
+    the request's wall-clock to the non-overlapping phase set
+    (PHASE_SPAN_NAMES).  Pure function — unit-testable without servers."""
+    spans = list(router_trace.get("spans", []))
+    if engine_trace is not None:
+        spans.extend(engine_trace.get("spans", []))
+    spans.sort(key=lambda s: s.get("start", 0.0))
+    phase_s = {
+        s["name"]: round(s.get("duration_s", 0.0), 6)
+        for s in spans
+        if s["name"] in PHASE_SPAN_NAMES
+    }
+    total_s = router_trace.get("duration_s", 0.0)
+    return {
+        "request_id": router_trace.get("request_id"),
+        "trace_id": router_trace.get("trace_id"),
+        "router": router_trace,
+        "engine": engine_trace,
+        "spans": spans,
+        "phase_s": phase_s,
+        "phase_sum_s": round(sum(phase_s.values()), 6),
+        "total_s": round(total_s, 6),
+    }
+
+
+@routes.get("/debug/requests/{request_id}")
+async def get_request(request: web.Request) -> web.Response:
+    registry = request.app["registry"]
+    tracer = registry.get(ROUTER_TRACER)
+    if tracer is None or not tracer.enabled:
+        return web.json_response(
+            {"error": {"message": "tracing is disabled (--no-tracing)"}},
+            status=404,
+        )
+    request_id = request.match_info["request_id"]
+    router_trace = tracer.snapshot(request_id)
+    if router_trace is None:
+        return web.json_response(
+            {"error": {"message": "unknown request id (expired from the "
+                       "trace ring?)"}},
+            status=404,
+        )
+    engine_trace = None
+    server = router_trace["attrs"].get("server")
+    session = registry.get(CLIENT_SESSION)
+    if server and session is not None:
+        engine_trace = await _fetch_engine_trace(session, server, request_id)
+    return web.json_response(join_timelines(router_trace, engine_trace))

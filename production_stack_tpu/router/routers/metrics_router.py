@@ -12,14 +12,38 @@ import time
 from aiohttp import web
 from prometheus_client import CONTENT_TYPE_LATEST, generate_latest
 
+from production_stack_tpu.obs.histogram import render_labeled_histograms
 from production_stack_tpu.router.service_discovery import DISCOVERY_SERVICE
 from production_stack_tpu.router.services import metrics_service as ms
 from production_stack_tpu.router.services.request_service.request import (
     ENGINE_STATS_SCRAPER,
     REQUEST_STATS_MONITOR,
 )
+from production_stack_tpu.router.stats.vocabulary import ROUTER_HISTOGRAMS
 
 routes = web.RouteTableDef()
+
+
+def render_router_histograms(monitor) -> str:
+    """Per-server latency histogram families (TTFT/ITL/e2e/queueing) —
+    the p50/p95/p99 counterpart of the averages above, appended after the
+    prometheus_client body.  Families render for every server the monitor
+    has seen, zero-observation instances included, so scrape names are
+    stable."""
+    by_server = monitor.get_histograms()
+    parts = []
+    for key, family_name in ROUTER_HISTOGRAMS.items():
+        per_server = {
+            server: hists[key] for server, hists in by_server.items()
+        }
+        # A family header with no instances is legal exposition; emitting
+        # it on an idle router keeps the names present from the first
+        # scrape, so alert rules can tell "no traffic yet" from "metric
+        # renamed/broken".
+        parts.append(
+            render_labeled_histograms(family_name, per_server, "server")
+        )
+    return "".join(parts)
 
 
 @routes.get("/metrics")
@@ -60,4 +84,7 @@ async def metrics(request: web.Request) -> web.Response:
         for model, count in per_model.items():
             ms.healthy_pods_total.labels(model=model).set(count)
 
-    return web.Response(body=generate_latest(), headers={"Content-Type": CONTENT_TYPE_LATEST})
+    body = generate_latest()
+    if monitor is not None:
+        body += render_router_histograms(monitor).encode()
+    return web.Response(body=body, headers={"Content-Type": CONTENT_TYPE_LATEST})

@@ -28,6 +28,7 @@ from typing import Any, Dict, Optional
 import aiohttp
 from aiohttp import web
 
+from production_stack_tpu.obs.trace import make_traceparent, parse_traceparent
 from production_stack_tpu.router.routing import ROUTING_SERVICE
 from production_stack_tpu.router.service_discovery import DISCOVERY_SERVICE
 
@@ -37,6 +38,7 @@ CLIENT_SESSION = "client_session"
 REQUEST_STATS_MONITOR = "request_stats_monitor"
 ENGINE_STATS_SCRAPER = "engine_stats_scraper"
 REQUEST_REWRITER = "request_rewriter"
+ROUTER_TRACER = "router_tracer"
 
 # Headers that must not be forwarded either direction: hop-by-hop headers,
 # plus encoding headers — aiohttp's client auto-decompresses the backend body
@@ -55,6 +57,11 @@ _HOP_BY_HOP = {
     "content-length",
     "content-encoding",
     "accept-encoding",
+    # Identity/trace headers the router owns and re-stamps explicitly on
+    # both directions; forwarding the inbound casing too would emit the
+    # header twice (dict keys are case-sensitive, the wire is not).
+    "x-request-id",
+    "traceparent",
 }
 
 
@@ -79,7 +86,16 @@ async def route_general_request(
     """
     registry = request.app["registry"]
     in_router_time = time.time()
-    request_id = request.headers.get("x-request-id") or str(uuid.uuid4())
+    # The request-id middleware (app.py) honors/mints x-request-id and
+    # echoes it on every response; fall back here for direct callers.
+    request_id = (
+        request.get("request_id")
+        or request.headers.get("x-request-id")
+        or str(uuid.uuid4())
+    )
+    tracer = registry.get(ROUTER_TRACER)
+    if tracer is not None and not tracer.enabled:
+        tracer = None
 
     body_bytes = await request.read()
     try:
@@ -100,6 +116,27 @@ async def route_general_request(
             body_bytes = json.dumps(body_json).encode("utf-8")
         requested_model = (body_json or {}).get("model", requested_model)
 
+    trace = None
+    if tracer is not None:
+        # Honor an inbound W3C traceparent (the caller's trace id) or mint
+        # one; either way the id is forwarded to the engine so both
+        # components' timelines join under it.  Started only AFTER the
+        # body read + validation: a client dying mid-upload (or a rejected
+        # body) must never leak a permanently-active trace.  The trace
+        # start timestamp is still the receive time.
+        trace = tracer.start(
+            request_id,
+            trace_id=parse_traceparent(request.headers.get("traceparent")),
+            attrs={"path": endpoint_path},
+            start=in_router_time,
+        )
+
+    def _reject(resp: web.Response, why: str) -> web.Response:
+        """Close the trace on pre-proxy rejections so the ring shows them."""
+        if tracer is not None:
+            tracer.finish(request_id, error=why, status=resp.status)
+        return resp
+
     discovery = registry.require(DISCOVERY_SERVICE)
     endpoints = [ep for ep in discovery.get_endpoint_info() if not ep.sleep]
     scraper = registry.get(ENGINE_STATS_SCRAPER)
@@ -119,8 +156,13 @@ async def route_general_request(
             if not ep.model_names or requested_model in ep.model_names
         ]
     if not endpoints:
-        return _error_response(
-            400, f"Model '{requested_model}' not served by any healthy engine", "model_not_found"
+        return _reject(
+            _error_response(
+                400,
+                f"Model '{requested_model}' not served by any healthy engine",
+                "model_not_found",
+            ),
+            "model_not_found",
         )
 
     engine_stats = scraper.get_engine_stats() if scraper else {}
@@ -133,7 +175,17 @@ async def route_general_request(
             endpoints, engine_stats, request_stats, request, body_json
         )
     except ValueError as e:
-        return _error_response(503, str(e), "service_unavailable")
+        return _reject(
+            _error_response(503, str(e), "service_unavailable"),
+            "routing_failed",
+        )
+
+    if tracer is not None and trace is not None:
+        tracer.add_span(
+            request_id, "router.route", in_router_time, time.time(),
+            server=server_url,
+        )
+        tracer.set_attrs(request_id, model=requested_model, server=server_url)
 
     logger.debug(
         "Routing request %s (model=%s) to %s at %.6f, took %.3f ms",
@@ -184,19 +236,60 @@ async def process_request(
     registry = request.app["registry"]
     monitor = registry.get(REQUEST_STATS_MONITOR)
     session: aiohttp.ClientSession = registry.require(CLIENT_SESSION)
+    tracer = registry.get(ROUTER_TRACER)
+    if tracer is not None and not tracer.enabled:
+        tracer = None
+    trace = tracer.get(request_id) if tracer is not None else None
 
     headers = _forward_headers(request.headers)
     headers["x-request-id"] = request_id
+    if trace is not None:
+        # Propagate the trace context so the engine's timeline joins this
+        # one under the same trace id (/debug/requests/{id}).
+        headers["traceparent"] = make_traceparent(trace.trace_id)
+    elif request.headers.get("traceparent"):
+        # Tracing off: stay a transparent proxy for the caller's context
+        # (it was stripped from the generic forward set above).
+        headers["traceparent"] = request.headers["traceparent"]
 
     candidates = [server_url] + list(fallback_urls or [])
     collected: list = []
     want_store = background is not None
+    # First connect attempt's start: router.queue must end HERE, not at
+    # the successful attempt's connect start — otherwise a dead backend's
+    # connect timeout would masquerade as router queueing.
+    first_connect0: Optional[float] = None
 
     for attempt, url in enumerate(candidates):
         if monitor:
             monitor.on_new_request(url, request_id, in_router_time)
         first_chunk_seen = False
+        t_first: Optional[float] = None
+        t_connected: Optional[float] = None
         response: Optional[web.StreamResponse] = None
+        t_connect0 = time.time()
+        if first_connect0 is None:
+            first_connect0 = t_connect0
+
+        def _fail_spans() -> None:
+            """Attach whatever phases completed before a failure — the
+            slow/failed requests are exactly the ones the debug surface
+            must explain, so their timelines can't be span-less."""
+            if tracer is None:
+                return
+            tracer.add_span(
+                request_id, "router.queue", in_router_time, first_connect0
+            )
+            if t_connected is not None:
+                tracer.add_span(
+                    request_id, "router.backend_connect", t_connect0,
+                    t_connected, server=url,
+                )
+                if t_first is not None:
+                    tracer.add_span(
+                        request_id, "router.first_token", t_connected, t_first
+                    )
+
         try:
             async with session.request(
                 request.method,
@@ -204,35 +297,76 @@ async def process_request(
                 data=body_bytes if body_bytes else None,
                 headers=headers,
             ) as backend:
+                t_connected = time.time()
                 if monitor:
-                    monitor.on_backend_connected(url, request_id, time.time())
+                    monitor.on_backend_connected(url, request_id, t_connected)
+                resp_headers = _forward_headers(backend.headers)
+                # Echo the request id on the proxied response too (the
+                # engine may predate the header; the client must always
+                # get it back, streaming included).
+                resp_headers["x-request-id"] = request_id
                 response = web.StreamResponse(
-                    status=backend.status, headers=_forward_headers(backend.headers)
+                    status=backend.status, headers=resp_headers
                 )
                 await response.prepare(request)
                 async for chunk in backend.content.iter_any():
                     if not chunk:
                         continue
                     now = time.time()
-                    if monitor:
-                        if not first_chunk_seen:
+                    if not first_chunk_seen:
+                        t_first = now
+                        first_chunk_seen = True
+                        if monitor:
                             # Seeds the token clock + counts this chunk; no
                             # ITL sample (first chunk defines no interval).
                             monitor.on_request_response(url, request_id, now)
-                            first_chunk_seen = True
-                        else:
-                            monitor.on_token_chunk(url, request_id, now)
+                    elif monitor:
+                        monitor.on_token_chunk(url, request_id, now)
                     if want_store:
                         collected.append(chunk)
                     await response.write(chunk)
                 await response.write_eof()
+            t_end = time.time()
             if monitor:
-                monitor.on_request_complete(url, request_id, time.time())
+                monitor.on_request_complete(url, request_id, t_end)
+            if tracer is not None:
+                # Routing decision -> backend connect -> first token ->
+                # stream end (the span set the ISSUE names; router.queue +
+                # router.backend_connect are the non-overlapping phases
+                # the /debug join scores against engine spans).
+                tracer.add_span(
+                    request_id, "router.queue", in_router_time, first_connect0
+                )
+                if attempt > 0:
+                    # Time burned on dead backends before this one; keeps
+                    # the timeline honest without blaming router.queue.
+                    tracer.add_span(
+                        request_id, "router.failover", first_connect0,
+                        t_connect0, attempts=attempt,
+                    )
+                tracer.add_span(
+                    request_id, "router.backend_connect", t_connect0,
+                    t_connected, server=url,
+                )
+                if t_first is not None:
+                    tracer.add_span(
+                        request_id, "router.first_token", t_connected, t_first
+                    )
+                    tracer.add_span(
+                        request_id, "router.stream", t_first, t_end
+                    )
+                tracer.finish(
+                    request_id, end=t_end, server=url,
+                    status=response.status,
+                )
         except asyncio.CancelledError:
             # Client disconnected (or server shutdown): release in-flight
             # stats, then propagate — cancellation must not be swallowed.
             if monitor:
                 monitor.on_request_failed(url, request_id, time.time())
+            if tracer is not None:
+                _fail_spans()
+                tracer.finish(request_id, error="client_disconnect", server=url)
             raise
         except (aiohttp.ClientError, ConnectionResetError) as e:
             if monitor:
@@ -242,6 +376,11 @@ async def process_request(
                 # body; terminate the stream (reference behavior, SURVEY.md
                 # section 5 "no request retry/failover mid-stream").
                 logger.warning("Backend %s failed mid-stream: %s", url, e)
+                if tracer is not None:
+                    _fail_spans()
+                    tracer.finish(
+                        request_id, error="mid_stream_failure", server=url
+                    )
                 raise
             if attempt + 1 < len(candidates):
                 logger.warning(
@@ -250,6 +389,9 @@ async def process_request(
                 )
                 continue
             logger.warning("Backend %s failed before response: %s", url, e)
+            if tracer is not None:
+                _fail_spans()
+                tracer.finish(request_id, error="bad_gateway", server=url)
             return _error_response(
                 502, "All serving engines for this model are unreachable",
                 "bad_gateway",

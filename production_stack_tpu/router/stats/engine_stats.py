@@ -37,13 +37,26 @@ class EngineStats:
     prefix_cache_hit_rate: float = 0.0
     kv_offload_usage_perc: float = 0.0
     accelerator_utilization: float = 0.0
+    decode_host_gap_ms: float = 0.0
     scraped_at: float = 0.0
+
+    # Sample-name suffixes that belong to histogram/summary internals.
+    _SERIES_SUFFIXES = ("_bucket", "_sum", "_count", "_created")
 
     @classmethod
     def from_prometheus_text(cls, text: str, scraped_at: Optional[float] = None) -> "EngineStats":
         values: Dict[str, float] = {}
         for family in text_string_to_metric_families(text):
+            # The engine now exports histogram families alongside its
+            # gauges; their _bucket/_sum/_count samples must never enter
+            # the scalar map — "last sample wins" would let a same-prefix
+            # series shadow a real gauge.  Filter by family type AND
+            # sample suffix (suffix alone also guards untyped expositions).
+            if family.type in ("histogram", "summary"):
+                continue
             for sample in family.samples:
+                if sample.name.endswith(cls._SERIES_SUFFIXES):
+                    continue
                 # Last sample wins; engine gauges are unlabeled or
                 # single-labeled per engine, either is fine for a scalar read.
                 values[sample.name] = sample.value

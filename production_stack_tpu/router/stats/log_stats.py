@@ -36,14 +36,24 @@ def format_stats_block(registry) -> str:
             lines.append(
                 f"  [engine ] {url}: running={es.num_running_requests} "
                 f"waiting={es.num_queuing_requests} kv={es.kv_usage_perc:.1%} "
-                f"prefix_hit={es.prefix_cache_hit_rate:.1%}"
+                f"prefix_hit={es.prefix_cache_hit_rate:.1%} "
+                f"host_gap={es.decode_host_gap_ms:.2f}ms"
             )
     monitor = registry.get(REQUEST_STATS_MONITOR)
     if monitor:
+        # Tails alongside the means: averages hide p99 pain, so the dump
+        # carries the histogram-state p95s (same state /metrics exports
+        # as tpu_router:*_seconds histogram families).
+        hists = monitor.get_histograms()
         for url, rs in sorted(monitor.get_request_stats(time.time()).items()):
+            h = hists.get(url, {})
+            p95_ttft = h["ttft"].quantile(0.95) if "ttft" in h else 0.0
+            p95_itl = h["itl"].quantile(0.95) if "itl" in h else 0.0
             lines.append(
                 f"  [request] {url}: qps={rs.qps:.2f} ttft={rs.ttft * 1e3:.1f}ms "
+                f"p95_ttft={p95_ttft * 1e3:.1f}ms "
                 f"latency={rs.latency:.2f}s itl={rs.itl * 1e3:.1f}ms "
+                f"p95_itl={p95_itl * 1e3:.1f}ms "
                 f"prefill={rs.in_prefill_requests} decode={rs.in_decoding_requests} "
                 f"finished={rs.finished_requests}"
             )

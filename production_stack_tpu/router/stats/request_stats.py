@@ -23,6 +23,8 @@ import time
 from collections import deque
 from typing import Deque, Dict, Optional, Tuple
 
+from production_stack_tpu.obs.histogram import Histogram
+
 
 @dataclasses.dataclass
 class RequestStats:
@@ -87,6 +89,7 @@ class _EngineWindows:
         "finished",
         "in_prefill",
         "in_decoding",
+        "hists",
     )
 
     def __init__(self, window: float):
@@ -99,6 +102,15 @@ class _EngineWindows:
         self.finished = 0
         self.in_prefill = 0
         self.in_decoding = 0
+        # Cumulative latency histograms (Prometheus model: no window) —
+        # the tail-latency (p95/p99) counterpart of the averages above.
+        # Keys match vocabulary.ROUTER_HISTOGRAMS.
+        self.hists = {
+            "ttft": Histogram(),
+            "itl": Histogram(),
+            "latency": Histogram(),
+            "queueing": Histogram(),
+        }
 
 
 class RequestStatsMonitor:
@@ -143,7 +155,9 @@ class RequestStatsMonitor:
         with self._lock:
             arrived = self._arrived_at.get(key)
             if arrived is not None:
-                self._windows(engine_url).queueing.update(timestamp, timestamp - arrived)
+                w = self._windows(engine_url)
+                w.queueing.update(timestamp, timestamp - arrived)
+                w.hists["queueing"].observe(timestamp - arrived)
 
     def on_request_response(
         self, engine_url: str, request_id: str, timestamp: float
@@ -163,6 +177,7 @@ class RequestStatsMonitor:
             arrived = self._arrived_at.get(key)
             if arrived is not None:
                 w.ttft.update(timestamp, timestamp - arrived)
+                w.hists["ttft"].observe(timestamp - arrived)
             w.in_prefill = max(0, w.in_prefill - 1)
             w.in_decoding += 1
 
@@ -172,7 +187,9 @@ class RequestStatsMonitor:
         with self._lock:
             last = self._last_token_at.get(key)
             if last is not None:
-                self._windows(engine_url).itl.update(timestamp, timestamp - last)
+                w = self._windows(engine_url)
+                w.itl.update(timestamp, timestamp - last)
+                w.hists["itl"].observe(timestamp - last)
             self._last_token_at[key] = timestamp
             self._chunk_count[key] = self._chunk_count.get(key, 0) + 1
 
@@ -185,6 +202,7 @@ class RequestStatsMonitor:
             arrived = self._arrived_at.pop(key, None)
             if arrived is not None:
                 w.latency.update(timestamp, timestamp - arrived)
+                w.hists["latency"].observe(timestamp - arrived)
             if key in self._first_token_at:
                 w.in_decoding = max(0, w.in_decoding - 1)
             else:
@@ -212,6 +230,14 @@ class RequestStatsMonitor:
             self._chunk_count.pop(key, None)
 
     # -- read side ---------------------------------------------------------
+
+    def get_histograms(self) -> Dict[str, Dict[str, Histogram]]:
+        """Per-engine cumulative latency histograms
+        (keys: ttft / itl / latency / queueing).  The returned Histogram
+        objects are live — callers read quantiles or render them, never
+        mutate."""
+        with self._lock:
+            return {url: dict(w.hists) for url, w in self._engines.items()}
 
     def get_request_stats(self, current_time: Optional[float] = None) -> Dict[str, RequestStats]:
         now = time.time() if current_time is None else current_time

@@ -45,6 +45,10 @@ ENGINE_METRIC_CANDIDATES: Dict[str, List[str]] = {
     "accelerator_utilization": [
         "tpu:duty_cycle",
     ],
+    # Mean host-side serialization per decode step, ms (pipeline health).
+    "decode_host_gap_ms": [
+        "tpu:decode_host_gap_ms",
+    ],
 }
 
 # Names our own engine exports (used by the engine server and the fake
@@ -88,6 +92,44 @@ TPU_COUNTERS = frozenset({
     TPU_SPEC_TOKENS_DRAFTED,
     TPU_SPEC_TOKENS_ACCEPTED,
 })
+
+
+# -- latency histogram families (this PR's tracing layer) ------------------
+#
+# Every span duration the tracing subsystem records also feeds a Prometheus
+# HISTOGRAM (p50/p95/p99 queryable via histogram_quantile) alongside the
+# pre-existing gauges, which keep their names unchanged.
+
+# Engine request-level families, keyed by obs.EngineObs.REQUEST_HISTS names
+# (one observation per request — except itl, observed per token GAP, so
+# its _count is ~tokens not requests; detokenize_time is the request's
+# total accumulated host detokenize cost).
+TPU_REQUEST_HISTOGRAMS = {
+    "ttft": "tpu:ttft_seconds",
+    "itl": "tpu:itl_seconds",
+    "e2e_latency": "tpu:e2e_latency_seconds",
+    "queue_time": "tpu:queue_time_seconds",
+    "prefill_time": "tpu:prefill_time_seconds",
+    "decode_time": "tpu:decode_time_seconds",
+    "detokenize_time": "tpu:detokenize_time_seconds",
+}
+
+# Engine step-phase families, keyed by obs.EngineObs.STEP_PHASES names
+# (one observation per engine step — unit-comparable across phases).
+TPU_STEP_HISTOGRAMS = {
+    "schedule": "tpu:step_schedule_seconds",
+    "dispatch": "tpu:step_dispatch_seconds",
+    "collect": "tpu:step_collect_seconds",
+    "sample": "tpu:step_sample_seconds",
+}
+
+# Router families (labeled by backend server), fed by RequestStatsMonitor.
+ROUTER_HISTOGRAMS = {
+    "ttft": "tpu_router:ttft_seconds",
+    "itl": "tpu_router:itl_seconds",
+    "latency": "tpu_router:e2e_latency_seconds",
+    "queueing": "tpu_router:request_queueing_seconds",
+}
 
 
 def render_prometheus(pairs) -> str:

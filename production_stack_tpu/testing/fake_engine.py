@@ -23,6 +23,8 @@ import uuid
 
 from aiohttp import web
 
+from production_stack_tpu.obs.engine import EngineObs
+from production_stack_tpu.obs.trace import parse_traceparent
 from production_stack_tpu.router.stats import vocabulary as vocab
 
 
@@ -50,6 +52,12 @@ class FakeEngineState:
         self.prefix_queries = 0
         self._rng = random.Random(seed)
         self._seen_prefixes: set = set()
+        # Same obs contract as the real engine (EngineObs): tracing tests
+        # and the bench trace_report run against this in CI.
+        self.obs = EngineObs()
+        # Headers of the most recent completion request (trace-propagation
+        # assertions in tests).
+        self.last_headers: dict = {}
 
     def note_prompt(self, prompt_text: str) -> None:
         """Rough prefix-cache simulation so hit-rate metrics move in CI."""
@@ -109,7 +117,11 @@ def build_fake_engine_app(state: FakeEngineState | None = None) -> web.Applicati
         # Same serializer + same names as the real engine server
         # (engine/server/api_server.py) so the observability contract is
         # identical against fake and real engines.
-        text = vocab.render_prometheus([
+        text = _render_metrics_pairs(state)
+        return web.Response(text=text)
+
+    def _render_metrics_pairs(state: FakeEngineState) -> str:
+        return vocab.render_prometheus([
             (vocab.TPU_NUM_REQUESTS_RUNNING, state.num_running),
             (vocab.TPU_NUM_REQUESTS_WAITING, state.num_waiting),
             (vocab.TPU_HBM_KV_USAGE_PERC, state.kv_usage),
@@ -120,8 +132,18 @@ def build_fake_engine_app(state: FakeEngineState | None = None) -> web.Applicati
             (vocab.TPU_TOTAL_GENERATED_TOKENS, state.total_generated_tokens),
             (vocab.TPU_TOTAL_FINISHED_REQUESTS, state.total_finished),
             (vocab.TPU_NUM_PREEMPTIONS, 0),
-        ])
-        return web.Response(text=text)
+        ]) + state.obs.render_metrics()
+
+    async def debug_requests(_request: web.Request) -> web.Response:
+        return web.json_response(state.obs.debug_payload())
+
+    async def debug_request(request: web.Request) -> web.Response:
+        snap = state.obs.tracer.snapshot(request.match_info["request_id"])
+        if snap is None:
+            return web.json_response(
+                {"error": {"message": "unknown request id"}}, status=404
+            )
+        return web.json_response(snap)
 
     async def chat_completions(request: web.Request) -> web.StreamResponse:
         return await _completion_common(request, chat=True)
@@ -129,8 +151,30 @@ def build_fake_engine_app(state: FakeEngineState | None = None) -> web.Applicati
     async def completions(request: web.Request) -> web.StreamResponse:
         return await _completion_common(request, chat=False)
 
+    def _finish_trace(
+        request_id: str, t_recv: float, t_first: float, t_end: float
+    ) -> None:
+        """Simulated request timeline, partitioned exactly like the real
+        engine's span set: zero queue wait, prefill = TTFT sleep, decode =
+        token emission, zero detokenize."""
+        obs = state.obs
+        if not obs.enabled:
+            return
+        obs.request_hists["queue_time"].observe(0.0)
+        obs.request_hists["ttft"].observe(t_first - t_recv)
+        obs.request_hists["prefill_time"].observe(t_first - t_recv)
+        obs.request_hists["decode_time"].observe(t_end - t_first)
+        obs.request_hists["e2e_latency"].observe(t_end - t_recv)
+        obs.tracer.add_span(request_id, "engine.prefill", t_recv, t_first)
+        obs.tracer.add_span(request_id, "engine.decode", t_first, t_end)
+        obs.tracer.add_span(
+            request_id, "engine.detokenize", t_end, t_end, accumulated=True
+        )
+        obs.tracer.finish(request_id, end=t_end)
+
     async def _completion_common(request: web.Request, chat: bool) -> web.StreamResponse:
         body = await request.json()
+        state.last_headers = dict(request.headers)
         stream = bool(body.get("stream", False))
         max_tokens = int(
             body.get("max_tokens")
@@ -142,13 +186,24 @@ def build_fake_engine_app(state: FakeEngineState | None = None) -> web.Applicati
         else:
             prompt_text = str(body.get("prompt", ""))
         state.note_prompt(prompt_text)
-        request_id = f"cmpl-{uuid.uuid4().hex[:16]}"
-        created = int(time.time())
+        # Honor the router-assigned request id + trace context (the real
+        # engine does the same), so router and engine timelines join.
+        request_id = (
+            request.headers.get("x-request-id")
+            or f"cmpl-{uuid.uuid4().hex[:16]}"
+        )
+        t_recv = time.time()
+        state.obs.start_request(
+            request_id,
+            parse_traceparent(request.headers.get("traceparent")),
+            model=body.get("model", state.model), stream=stream,
+        )
+        state.obs.tracer.add_span(request_id, "engine.queue", t_recv, t_recv)
+        created = int(t_recv)
         state.total_requests += 1
         state.num_running += 1
         state.total_prompt_tokens += max(1, len(prompt_text) // 4)
         try:
-            await asyncio.sleep(state.ttft)
             interval = 1.0 / state.tokens_per_sec
             object_name = "chat.completion.chunk" if chat else "text_completion"
             if stream:
@@ -156,9 +211,16 @@ def build_fake_engine_app(state: FakeEngineState | None = None) -> web.Applicati
                     headers={
                         "Content-Type": "text/event-stream",
                         "Cache-Control": "no-cache",
+                        "X-Request-Id": request_id,
                     }
                 )
+                # Prepare BEFORE the TTFT sleep, like the real engine
+                # server: the router's backend_connect span must end at
+                # connect, not absorb prefill time.
                 await response.prepare(request)
+                await asyncio.sleep(state.ttft)
+                t_first = time.time()
+                t_last = t_first
                 for i in range(max_tokens):
                     token = _word(state._rng) + " "
                     if chat:
@@ -181,7 +243,12 @@ def build_fake_engine_app(state: FakeEngineState | None = None) -> web.Applicati
                     )
                     state.total_generated_tokens += 1
                     await asyncio.sleep(interval)
+                    now = time.time()
+                    if state.obs.enabled and i > 0:
+                        state.obs.request_hists["itl"].observe(now - t_last)
+                    t_last = now
                 state.total_finished += 1
+                _finish_trace(request_id, t_recv, t_first, time.time())
                 final_choice = (
                     {"index": 0, "delta": {}, "finish_reason": "length"}
                     if chat
@@ -206,10 +273,18 @@ def build_fake_engine_app(state: FakeEngineState | None = None) -> web.Applicati
                 await response.write(b"data: [DONE]\n\n")
                 await response.write_eof()
                 return response
+            await asyncio.sleep(state.ttft)
+            t_first = time.time()
             await asyncio.sleep(max_tokens * interval)
             text = " ".join(_word(state._rng) for _ in range(max_tokens))
             state.total_generated_tokens += max_tokens
             state.total_finished += 1
+            if state.obs.enabled:
+                # Same obs contract as the real engine: ITL is observed
+                # per token gap regardless of stream mode.
+                for _ in range(max(0, max_tokens - 1)):
+                    state.obs.request_hists["itl"].observe(interval)
+            _finish_trace(request_id, t_recv, t_first, time.time())
             if chat:
                 choice = {
                     "index": 0,
@@ -232,7 +307,8 @@ def build_fake_engine_app(state: FakeEngineState | None = None) -> web.Applicati
                         "completion_tokens": max_tokens,
                         "total_tokens": len(prompt_text) // 4 + max_tokens,
                     },
-                }
+                },
+                headers={"X-Request-Id": request_id},
             )
         finally:
             state.num_running -= 1
@@ -240,6 +316,8 @@ def build_fake_engine_app(state: FakeEngineState | None = None) -> web.Applicati
     app.router.add_get("/v1/models", models)
     app.router.add_get("/health", health)
     app.router.add_get("/metrics", metrics)
+    app.router.add_get("/debug/requests", debug_requests)
+    app.router.add_get("/debug/requests/{request_id}", debug_request)
     app.router.add_post("/v1/chat/completions", chat_completions)
     app.router.add_post("/v1/completions", completions)
     return app

@@ -59,6 +59,96 @@ def test_parse_empty_text_defaults():
     assert s.kv_usage_perc == 0.0
 
 
+HISTOGRAM_METRICS = TPU_METRICS + """\
+# TYPE tpu:decode_host_gap_ms gauge
+tpu:decode_host_gap_ms 1.25
+# TYPE tpu:ttft_seconds histogram
+tpu:ttft_seconds_bucket{le="0.1"} 2
+tpu:ttft_seconds_bucket{le="+Inf"} 3
+tpu:ttft_seconds_sum 1.5
+tpu:ttft_seconds_count 3
+# TYPE tpu:step_collect_seconds histogram
+tpu:step_collect_seconds_bucket{le="+Inf"} 9
+tpu:step_collect_seconds_sum 0.4
+tpu:step_collect_seconds_count 9
+"""
+
+
+def test_gauges_parse_unchanged_alongside_histograms():
+    """The engine now exports histogram families on the same /metrics
+    body; every scalar gauge must keep parsing to the same value."""
+    s = EngineStats.from_prometheus_text(HISTOGRAM_METRICS)
+    assert s.num_running_requests == 3
+    assert s.num_queuing_requests == 7
+    assert abs(s.kv_usage_perc - 0.42) < 1e-9
+    assert abs(s.decode_host_gap_ms - 1.25) < 1e-9
+
+
+def test_histogram_samples_never_resolve_as_gauges(monkeypatch):
+    """_bucket/_sum/_count series are histogram internals, not scrapeable
+    gauges: even a candidate name that textually matches one must not
+    resolve ("last sample wins" would otherwise shadow same-prefix
+    gauges once histograms ship)."""
+    from production_stack_tpu.router.stats import vocabulary
+
+    monkeypatch.setitem(
+        vocabulary.ENGINE_METRIC_CANDIDATES,
+        "accelerator_utilization",
+        ["tpu:ttft_seconds_count"],
+    )
+    s = EngineStats.from_prometheus_text(HISTOGRAM_METRICS)
+    assert s.accelerator_utilization == 0.0
+
+
+def test_untyped_series_suffixes_filtered(monkeypatch):
+    """Suffix filtering also guards untyped expositions (no # TYPE line),
+    where the parser cannot know the sample belongs to a histogram."""
+    from production_stack_tpu.router.stats import vocabulary
+
+    monkeypatch.setitem(
+        vocabulary.ENGINE_METRIC_CANDIDATES,
+        "accelerator_utilization",
+        ["tpu:anything_sum"],
+    )
+    s = EngineStats.from_prometheus_text("tpu:anything_sum 42\n")
+    assert s.accelerator_utilization == 0.0
+
+
+async def test_real_engine_exposition_scrapes_cleanly():
+    """End-to-end: the REAL engine server's /metrics (gauges + histogram
+    families) parses into EngineStats with values matching engine.stats()."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from production_stack_tpu.engine.config import config_from_preset
+    from production_stack_tpu.engine.server.api_server import build_engine_app
+    from production_stack_tpu.engine.server.async_engine import AsyncEngine
+
+    config = config_from_preset(
+        "tiny-llama", **{"cache.num_blocks": 64, "scheduler.max_num_seqs": 2,
+                         "scheduler.prefill_buckets": (16, 32)}
+    )
+    engine = AsyncEngine(config)
+    server = TestServer(build_engine_app(engine, "tiny-llama"))
+    await server.start_server()
+    client = TestClient(server)
+    try:
+        resp = await client.post(
+            "/v1/completions",
+            json={"model": "tiny-llama", "prompt": "hi", "max_tokens": 3,
+                  "ignore_eos": True},
+        )
+        assert resp.status == 200
+        text = await (await client.get("/metrics")).text()
+        assert "# TYPE tpu:ttft_seconds histogram" in text
+        s = EngineStats.from_prometheus_text(text)
+        stats = engine.stats()
+        assert s.num_running_requests == stats["num_requests_running"]
+        assert abs(s.kv_usage_perc - stats["hbm_kv_usage_perc"]) < 1e-9
+        assert abs(s.accelerator_utilization - stats["duty_cycle"]) < 0.5
+    finally:
+        await client.close()
+
+
 async def test_decode_host_gap_ms_exported():
     """The pipeline-observability gauge must flow engine.stats() ->
     /metrics under its vocabulary name (the bench and serving harness

@@ -149,6 +149,302 @@ def test_hpa_example_consistent_with_adapter_and_chart():
     assert re.fullmatch(r".+-deployment-engine", target["name"])
 
 
+async def test_trace_propagation_and_debug_join():
+    """Acceptance criterion: a request served through router + engine
+    yields a joined /debug/requests/{id} timeline covering >= 6 phases
+    whose durations sum to within 10% of wall-clock e2e latency; the
+    trace context (x-request-id + traceparent) flows router -> engine."""
+    import time
+
+    from tests.test_router_e2e import start_fake_engine, start_router
+
+    state, engine = await start_fake_engine(ttft=0.1, tokens_per_sec=100.0)
+    try:
+        app, server, client = await start_router(
+            [str(engine.make_url("")).rstrip("/")], ["fake/llama-3-8b"]
+        )
+        try:
+            trace_id = "ab" * 16
+            t0 = time.time()
+            resp = await client.post(
+                "/v1/completions",
+                json={"model": "fake/llama-3-8b", "prompt": "hello",
+                      "max_tokens": 30, "stream": True},
+                headers={"x-request-id": "req-trace-1",
+                         "traceparent": f"00-{trace_id}-{'cd' * 8}-01"},
+            )
+            await resp.read()
+            wall_e2e = time.time() - t0
+            assert resp.status == 200
+            # Request id echoed on the streaming response.
+            assert resp.headers["x-request-id"] == "req-trace-1"
+            # Context propagated to the engine: same id, same trace id.
+            assert state.last_headers["x-request-id"] == "req-trace-1"
+            assert state.last_headers["traceparent"].split("-")[1] == trace_id
+
+            dresp = await client.get("/debug/requests/req-trace-1")
+            assert dresp.status == 200
+            joined = await dresp.json()
+            assert joined["trace_id"] == trace_id
+            assert joined["engine"] is not None
+            assert joined["engine"]["trace_id"] == trace_id
+            # >= 6 phases covered.
+            assert set(joined["phase_s"]) >= {
+                "router.queue", "router.backend_connect", "engine.queue",
+                "engine.prefill", "engine.decode", "engine.detokenize",
+            }
+            # Attribution closes: phase sum within 10% of e2e.
+            assert joined["total_s"] > 0
+            assert (
+                abs(joined["phase_sum_s"] - joined["total_s"])
+                <= 0.10 * joined["total_s"]
+            ), joined["phase_s"]
+            # The debug total is the router's own e2e measurement; it must
+            # agree with the client-observed wall clock too.
+            assert abs(joined["total_s"] - wall_e2e) <= 0.10 * wall_e2e
+
+            # The list endpoint shows the completed timeline.
+            lresp = await client.get("/debug/requests")
+            listing = await lresp.json()
+            assert listing["enabled"] is True
+            assert any(
+                t["request_id"] == "req-trace-1" for t in listing["requests"]
+            )
+        finally:
+            await client.close()
+    finally:
+        await engine.close()
+
+
+async def test_request_id_echoed_on_all_paths():
+    """Inbound X-Request-Id honored and echoed on success, error, and
+    non-proxy paths; one is minted when absent."""
+    from tests.test_router_e2e import start_fake_engine, start_router
+
+    state, engine = await start_fake_engine()
+    try:
+        app, server, client = await start_router(
+            [str(engine.make_url("")).rstrip("/")], ["fake/llama-3-8b"]
+        )
+        try:
+            # Non-streaming success.
+            resp = await client.post(
+                "/v1/completions",
+                json={"model": "fake/llama-3-8b", "prompt": "x",
+                      "max_tokens": 1},
+                headers={"x-request-id": "rid-ok"},
+            )
+            assert resp.headers["x-request-id"] == "rid-ok"
+            # Error path (unknown model).
+            resp = await client.post(
+                "/v1/completions",
+                json={"model": "nope", "prompt": "x"},
+                headers={"x-request-id": "rid-err"},
+            )
+            assert resp.status == 400
+            assert resp.headers["x-request-id"] == "rid-err"
+            # Non-proxy endpoint.
+            resp = await client.get(
+                "/health", headers={"x-request-id": "rid-health"}
+            )
+            assert resp.headers["x-request-id"] == "rid-health"
+            # Minted when absent.
+            resp = await client.get("/v1/models")
+            assert resp.headers.get("x-request-id")
+        finally:
+            await client.close()
+    finally:
+        await engine.close()
+
+
+async def test_histogram_families_on_both_metrics():
+    """Router and engine /metrics both expose the TTFT/ITL/e2e histogram
+    families (and engine step phases) with sane bucket counts, while the
+    pre-existing gauge names stay present."""
+    import re as _re
+
+    from production_stack_tpu.router.stats import vocabulary as vocab
+
+    engine_text = await scrape_engine_metrics()
+    router_text = await scrape_router_metrics()
+
+    def bucket_counts(text, family):
+        rows = []
+        for line in text.splitlines():
+            if line.startswith(f"{family}_bucket"):
+                rows.append(float(line.rsplit(" ", 1)[1]))
+        return rows
+
+    for family in list(vocab.TPU_REQUEST_HISTOGRAMS.values()) + list(
+        vocab.TPU_STEP_HISTOGRAMS.values()
+    ):
+        assert f"# TYPE {family} histogram" in engine_text, family
+        rows = bucket_counts(engine_text, family)
+        assert rows and rows == sorted(rows), family  # cumulative monotone
+        count = float(
+            _re.search(
+                rf"^{_re.escape(family)}_count (\S+)$", engine_text, _re.M
+            ).group(1)
+        )
+        assert rows[-1] == count  # +Inf bucket == count
+
+    for family in vocab.ROUTER_HISTOGRAMS.values():
+        assert f"# TYPE {family} histogram" in router_text, family
+        rows = bucket_counts(router_text, family)
+        assert rows and rows == sorted(rows), family
+    # The proxied requests actually landed samples in the router's TTFT
+    # and e2e families (not just empty renders).
+    assert bucket_counts(router_text, "tpu_router:ttft_seconds")[-1] > 0
+    assert bucket_counts(router_text, "tpu_router:e2e_latency_seconds")[-1] > 0
+    # Pre-existing gauges unchanged alongside.
+    for gauge in ("tpu_router:avg_ttft", "tpu_router:avg_itl",
+                  "tpu_router:queueing_delay_seconds"):
+        assert gauge in router_text
+    assert "tpu:decode_host_gap_ms" in engine_text
+
+
+async def test_engine_debug_requests_real_engine():
+    """The REAL JAX engine records a per-request span timeline: queue,
+    prefill, decode, detokenize — served at /debug/requests/{id}."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from production_stack_tpu.engine.config import config_from_preset
+    from production_stack_tpu.engine.server.api_server import build_engine_app
+    from production_stack_tpu.engine.server.async_engine import AsyncEngine
+
+    config = config_from_preset(
+        "tiny-llama", **{"cache.num_blocks": 64, "scheduler.max_num_seqs": 2,
+                         "scheduler.prefill_buckets": (16, 32)}
+    )
+    engine = AsyncEngine(config)
+    server = TestServer(build_engine_app(engine, "tiny-llama"))
+    await server.start_server()
+    client = TestClient(server)
+    try:
+        resp = await client.post(
+            "/v1/completions",
+            json={"model": "tiny-llama", "prompt": "hi", "max_tokens": 4,
+                  "ignore_eos": True},
+            headers={"x-request-id": "eng-trace-1",
+                     "traceparent": f"00-{'ef' * 16}-{'12' * 8}-01"},
+        )
+        assert resp.status == 200
+        assert resp.headers["x-request-id"] == "eng-trace-1"
+        dresp = await client.get("/debug/requests/eng-trace-1")
+        assert dresp.status == 200
+        trace = await dresp.json()
+        assert trace["trace_id"] == "ef" * 16
+        names = {s["name"] for s in trace["spans"]}
+        assert {"engine.queue", "engine.prefill", "engine.decode",
+                "engine.detokenize"} <= names
+        # Spans nest inside the request window and carry sane durations.
+        for span in trace["spans"]:
+            assert span["duration_s"] >= 0
+        assert trace["attrs"]["num_output_tokens"] == 4
+        listing = await (await client.get("/debug/requests")).json()
+        assert listing["enabled"] is True and listing["requests"]
+    finally:
+        await client.close()
+
+
+def test_tracing_off_restores_fast_path():
+    """obs.tracing=off: identical token streams, and ZERO observability
+    state accrued per step — no histogram observations, no traces, no
+    per-sequence obs bookkeeping (the no-new-allocations-style check the
+    config gate promises)."""
+    from production_stack_tpu.engine.config import config_from_preset
+    from production_stack_tpu.engine.core.engine import LLMEngine
+    from production_stack_tpu.engine.core.sequence import SamplingParams
+
+    def run(tracing: bool):
+        config = config_from_preset(
+            "tiny-llama",
+            **{"cache.num_blocks": 64, "scheduler.max_num_seqs": 2,
+               "scheduler.prefill_buckets": (16, 32),
+               "obs.tracing": tracing},
+        )
+        eng = LLMEngine(config)
+        for i in range(2):
+            eng.add_request(
+                f"r{i}", prompt_token_ids=[3 + i, 5, 7, 11],
+                sampling_params=SamplingParams(max_tokens=6, ignore_eos=True),
+            )
+        tokens = []
+        while eng.has_unfinished():
+            tokens.extend(
+                (o.seq_id, o.new_token_id) for o in eng.step()
+            )
+        return eng, tokens
+
+    eng_on, tokens_on = run(True)
+    eng_off, tokens_off = run(False)
+    # Greedy parity: the gate changes observability only, never outputs.
+    assert tokens_on == tokens_off
+    # Tracing on: state accrued.
+    assert sum(h.count for h in eng_on.obs.step_hists.values()) > 0
+    assert sum(h.count for h in eng_on.obs.request_hists.values()) > 0
+    # Tracing off: nothing accrued anywhere.
+    assert not eng_off.obs.enabled
+    assert sum(h.count for h in eng_off.obs.step_hists.values()) == 0
+    assert sum(h.count for h in eng_off.obs.request_hists.values()) == 0
+    assert eng_off.obs.tracer.completed() == []
+    assert eng_off.obs.tracer.active_count() == 0
+
+
+async def test_idle_router_renders_histogram_family_headers():
+    """Scrape-name stability: an idle router (no traffic yet) still
+    exposes every tpu_router:*_seconds family header, so alert rules can
+    tell 'no traffic' from 'metric gone'."""
+    from production_stack_tpu.router.stats import vocabulary as vocab
+    from tests.test_router_e2e import start_fake_engine, start_router
+
+    state, engine = await start_fake_engine()
+    try:
+        app, server, client = await start_router(
+            [str(engine.make_url("")).rstrip("/")], ["fake/llama-3-8b"]
+        )
+        try:
+            text = await (await client.get("/metrics")).text()
+            for family in vocab.ROUTER_HISTOGRAMS.values():
+                assert f"# TYPE {family} histogram" in text, family
+        finally:
+            await client.close()
+    finally:
+        await engine.close()
+
+
+async def test_router_no_tracing_flag():
+    """--no-tracing: /debug/requests reports disabled, per-id lookups 404,
+    but proxying, request-id echo, and histograms keep working."""
+    from tests.test_router_e2e import start_fake_engine, start_router
+
+    state, engine = await start_fake_engine()
+    try:
+        app, server, client = await start_router(
+            [str(engine.make_url("")).rstrip("/")], ["fake/llama-3-8b"],
+            extra_args=["--no-tracing"],
+        )
+        try:
+            resp = await client.post(
+                "/v1/completions",
+                json={"model": "fake/llama-3-8b", "prompt": "x",
+                      "max_tokens": 1},
+                headers={"x-request-id": "rid-notrace"},
+            )
+            assert resp.status == 200
+            assert resp.headers["x-request-id"] == "rid-notrace"
+            listing = await (await client.get("/debug/requests")).json()
+            assert listing == {"enabled": False, "requests": []}
+            dresp = await client.get("/debug/requests/rid-notrace")
+            assert dresp.status == 404
+            text = await (await client.get("/metrics")).text()
+            assert "tpu_router:ttft_seconds_bucket" in text
+        finally:
+            await client.close()
+    finally:
+        await engine.close()
+
+
 def test_servicemonitors_match_chart_ports_and_labels():
     with open(os.path.join(OBS_DIR, "kube-prom-stack.yaml")) as f:
         prom = yaml.safe_load(f)

@@ -83,6 +83,42 @@ def test_failed_request_drops_inflight_without_latency_sample():
     assert s.latency == 0.0
 
 
+def test_latency_histograms_fed_by_lifecycle():
+    """Every lifecycle measurement also lands in the cumulative histogram
+    state that /metrics exports as tpu_router:*_seconds families and the
+    log dump reads p95s from."""
+    m = RequestStatsMonitor()
+    for i in range(100):
+        rid = f"r{i}"
+        t0 = float(i)
+        m.on_new_request(URL, rid, timestamp=t0)
+        m.on_backend_connected(URL, rid, timestamp=t0 + 0.005)
+        # 90 fast TTFTs, 10 slow ones: p95 must land in the slow tail.
+        ttft = 0.02 if i < 90 else 2.0
+        m.on_request_response(URL, rid, timestamp=t0 + ttft)
+        m.on_token_chunk(URL, rid, timestamp=t0 + ttft + 0.03)
+        m.on_request_complete(URL, rid, timestamp=t0 + ttft + 0.06)
+    hists = m.get_histograms()[URL]
+    assert hists["ttft"].count == 100
+    assert hists["itl"].count == 100
+    assert hists["latency"].count == 100
+    assert hists["queueing"].count == 100
+    # Mean TTFT hides the tail; the histogram p95 reveals it.
+    mean = hists["ttft"].sum / hists["ttft"].count
+    assert mean < 0.25
+    assert hists["ttft"].quantile(0.95) > 0.25
+    assert 0.01 < hists["itl"].quantile(0.50) <= 0.05
+
+
+def test_failed_requests_leave_no_latency_histogram_sample():
+    m = RequestStatsMonitor()
+    m.on_new_request(URL, "r1", timestamp=0.0)
+    m.on_request_failed(URL, "r1", timestamp=1.0)
+    hists = m.get_histograms()[URL]
+    assert hists["latency"].count == 0
+    assert hists["ttft"].count == 0
+
+
 def test_multiple_engines_isolated():
     m = RequestStatsMonitor()
     m.on_new_request("http://a", "r1", timestamp=0.0)
