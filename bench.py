@@ -494,6 +494,129 @@ def bench_engine_pipeline_ab(args, preset: str) -> dict:
     }
 
 
+def bench_engine_mixed_ab(args, preset: str) -> dict:
+    """Mixed-batch vs alternating A/B through the REAL engine
+    (scheduler.mixed_batch on/off): a Poisson stream of chunk-forcing
+    long prompts arrives while a persistent decode batch streams tokens.
+    The alternating scheduler stalls every decoder for a full prefill
+    bucket per arrival — the head-of-line ITL spike; the fused mixed
+    step prefills the same prompts in budgeted chunks beside the
+    decodes.  Reports each mode's p95/max decoder ITL, long-prompt mean
+    TTFT, aggregate throughput, and the chunk-token counter.  Arrivals
+    are a SEEDED step-indexed Poisson process, so both modes replay the
+    identical workload."""
+    import dataclasses as _dc
+    import gc
+
+    from production_stack_tpu.engine.config import (
+        CacheConfig,
+        EngineConfig,
+        PRESETS,
+        SchedulerConfig,
+    )
+    from production_stack_tpu.engine.core.engine import LLMEngine
+    from production_stack_tpu.engine.core.sequence import SamplingParams
+
+    S_dec = max(2, min(args.batch, 8) // 2)  # persistent decoders
+    n_long = 8
+    long_len = 1536  # > largest chunk bucket several times over
+    decoder_tokens = 128
+    rng = np.random.RandomState(0)
+    arrival_steps = sorted(
+        (int(s), i)
+        for i, s in enumerate(np.cumsum(rng.exponential(8.0, n_long)) + 4)
+    )
+
+    def run(mixed: bool) -> dict:
+        num_blocks = (
+            S_dec * (96 + decoder_tokens) + n_long * (long_len + 64)
+        ) // 16 + 64
+        eng = LLMEngine(EngineConfig(
+            model=_dc.replace(PRESETS[preset]),
+            cache=CacheConfig(num_blocks=num_blocks),
+            scheduler=SchedulerConfig(
+                max_num_seqs=S_dec + 1,
+                prefill_buckets=(128, 256, 2048),
+                prefill_chunk_buckets=(128, 256),
+                max_model_len=2048,
+                mixed_batch=mixed,
+            ),
+        ))
+        for i in range(S_dec):
+            eng.add_request(
+                f"dec{i}",
+                prompt_token_ids=[(7 * i + j) % 101 for j in range(96)],
+                sampling_params=SamplingParams(
+                    max_tokens=decoder_tokens, ignore_eos=True
+                ),
+            )
+        for _ in range(8):  # compile + pipeline fill before measuring
+            eng.step()
+        arrivals = list(arrival_steps)
+        token_times: dict = {}
+        ttft: dict = {}
+        step = 0
+        produced = 0
+        t0 = time.perf_counter()
+        while eng.has_unfinished() or arrivals:
+            while arrivals and arrivals[0][0] <= step:
+                _, i = arrivals.pop(0)
+                eng.add_request(
+                    f"long{i}",
+                    prompt_token_ids=[
+                        (11 * i + j) % 101 for j in range(long_len)
+                    ],
+                    sampling_params=SamplingParams(max_tokens=8),
+                )
+                ttft[f"long{i}"] = [time.perf_counter(), None]
+            step += 1
+            if step > 5000:
+                break
+            outs = eng.step()
+            now = time.perf_counter()
+            for out in outs:
+                produced += 1
+                if out.seq_id.startswith("dec"):
+                    token_times.setdefault(out.seq_id, []).append(now)
+                elif out.seq_id in ttft and ttft[out.seq_id][1] is None:
+                    ttft[out.seq_id][1] = now
+        wall = time.perf_counter() - t0
+        gaps = sorted(
+            b - a
+            for times in token_times.values()
+            for a, b in zip(times, times[1:])
+        )
+        ttfts = [b - a for a, b in ttft.values() if b is not None]
+        result = {
+            "itl_p95_ms": round(
+                gaps[int(0.95 * (len(gaps) - 1))] * 1e3, 3
+            ) if gaps else 0.0,
+            "itl_max_ms": round(gaps[-1] * 1e3, 3) if gaps else 0.0,
+            "long_ttft_mean_ms": round(
+                sum(ttfts) / len(ttfts) * 1e3, 2
+            ) if ttfts else 0.0,
+            "tokens_per_s": round(produced / wall, 1),
+            "prefill_chunk_tokens": eng.prefill_chunk_tokens,
+        }
+        del eng
+        gc.collect()
+        return result
+
+    alternating = run(False)
+    mixed = run(True)
+    return {
+        "alternating": alternating,
+        "mixed": mixed,
+        # > 1.0 = the fused path cut the decoder ITL tail.
+        "itl_p95_speedup": round(
+            alternating["itl_p95_ms"] / max(mixed["itl_p95_ms"], 1e-9), 3
+        ),
+        "throughput_ratio": round(
+            mixed["tokens_per_s"] / max(alternating["tokens_per_s"], 1e-9), 3
+        ),
+    }
+
+
 # -- trace report ----------------------------------------------------------
 
 
@@ -959,6 +1082,32 @@ def main() -> None:
         except Exception as e:
             log(f"pipeline A/B failed: {e}")
             detail["pipeline_ab_error"] = str(e)[:200]
+
+    if not args.quick and budget_left("mixed_ab"):
+        # Mixed-batch A/B: chunked-prefill-integrated batching vs the
+        # alternating scheduler under a Poisson mixed workload — the
+        # ITL-under-load claim, measured.  Boots its own engines, so the
+        # bench's raw params/kv must be freed (pipeline_ab may already
+        # have done so).
+        try:
+            try:
+                del params, kv
+            except NameError:
+                pass
+            import gc as _gc
+
+            _gc.collect()
+            detail["mixed_ab"] = bench_engine_mixed_ab(args, preset)
+            ab = detail["mixed_ab"]
+            log(f"mixed A/B: alternating p95 ITL "
+                f"{ab['alternating']['itl_p95_ms']} ms vs mixed "
+                f"{ab['mixed']['itl_p95_ms']} ms "
+                f"({ab['itl_p95_speedup']}x tail cut, throughput "
+                f"{ab['throughput_ratio']}x, "
+                f"{ab['mixed']['prefill_chunk_tokens']} chunk tokens)")
+        except Exception as e:
+            log(f"mixed A/B failed: {e}")
+            detail["mixed_ab_error"] = str(e)[:200]
 
     result = {
         "metric": f"decode_throughput_{preset}_b{S}_ctx{ctx}",

@@ -340,6 +340,26 @@ class SchedulerConfig:
     max_prefill_tokens: int = 2048  # prefill bucket ceiling
     prefill_buckets: Tuple[int, ...] = (128, 256, 512, 1024, 2048)
     max_model_len: int = 2048
+    # Fused mixed prefill+decode steps (Sarathi-Serve / vLLM chunked-
+    # prefill-integrated batching, TPU twist: static chunk buckets).  When
+    # running sequences exist AND a prompt waits, one step packs every
+    # running sequence's decode token plus a bounded prefill chunk of the
+    # head waiting sequence into ONE model invocation, so arriving prompts
+    # no longer stall all decoders for a full prefill bucket (the ITL
+    # spike the tpu:itl_seconds histogram shows under load).  None = auto
+    # (ON whenever the classic single-step path is active and the mesh has
+    # no dp/sp axis); False restores the alternating one-plan-per-step
+    # scheduler exactly.
+    mixed_batch: Optional[bool] = None
+    # Per-step token budget for mixed steps (vLLM --max-num-batched-tokens
+    # analogue): decode tokens (== running batch size) count first, the
+    # prefill chunk gets the remainder.  None = auto: always admits the
+    # largest chunk bucket beside a full decode batch.
+    max_num_batched_tokens: Optional[int] = None
+    # Chunk-length buckets for the prefill segment of a mixed step.  Kept
+    # deliberately small: the compiled-shape space for mixed executables
+    # is |prefill_chunk_buckets| x |decode batch buckets|.
+    prefill_chunk_buckets: Tuple[int, ...] = (128, 256, 512)
     # "recompute" (drop + re-prefill) or "offload" (page out to host DRAM)
     preemption_mode: str = "offload"
     # Decode iterations fused into ONE device dispatch (lax.scan over the
@@ -384,6 +404,31 @@ class SchedulerConfig:
                 "num_scheduler_steps > 1 and speculative_ngram (all three "
                 "restructure the per-step dispatch; pick one)"
             )
+        if self.mixed_batch and (
+            self.num_scheduler_steps > 1 or self.speculative_ngram
+        ):
+            raise ValueError(
+                "mixed_batch is mutually exclusive with "
+                "num_scheduler_steps > 1 and speculative_ngram (mixed steps "
+                "assume one decode token per sequence per dispatch)"
+            )
+        if not self.prefill_chunk_buckets:
+            raise ValueError("prefill_chunk_buckets must be non-empty")
+        if tuple(sorted(self.prefill_chunk_buckets)) != tuple(
+            self.prefill_chunk_buckets
+        ):
+            raise ValueError("prefill_chunk_buckets must be sorted ascending")
+        if (
+            self.max_num_batched_tokens is not None
+            and self.max_num_batched_tokens
+            < self.max_num_seqs + self.prefill_chunk_buckets[0]
+        ):
+            raise ValueError(
+                f"max_num_batched_tokens={self.max_num_batched_tokens} can "
+                "never admit a prefill chunk beside a full decode batch; "
+                f"needs >= max_num_seqs + smallest chunk bucket "
+                f"({self.max_num_seqs} + {self.prefill_chunk_buckets[0]})"
+            )
 
     @property
     def pipeline_enabled(self) -> bool:
@@ -392,6 +437,23 @@ class SchedulerConfig:
         if self.pipeline_decode is None:
             return self.num_scheduler_steps == 1 and not self.speculative_ngram
         return self.pipeline_decode
+
+    @property
+    def mixed_enabled(self) -> bool:
+        """Resolved mixed-step gate: auto (None) turns on exactly when the
+        classic single-step non-speculative path is active.  The engine
+        additionally clears ``mixed_batch`` when the mesh has a dp/sp axis
+        (the packed mixed batch is not dp/sp-shardable)."""
+        if self.mixed_batch is None:
+            return self.num_scheduler_steps == 1 and not self.speculative_ngram
+        return self.mixed_batch
+
+    @property
+    def batched_tokens_budget(self) -> int:
+        """Resolved per-step token budget for mixed steps."""
+        if self.max_num_batched_tokens is not None:
+            return self.max_num_batched_tokens
+        return self.max_num_seqs + self.prefill_chunk_buckets[-1]
 
 
 @dataclasses.dataclass

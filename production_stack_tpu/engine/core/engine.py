@@ -1,9 +1,12 @@
 """LLMEngine: the serving engine core.
 
 Owns params + paged KV caches on device, the block pool, the scheduler and
-the jitted step functions.  Each step executes exactly one scheduler plan
-(one bucketed prefill or one padded decode batch) — every plan shape maps to
-a cached XLA executable, so steady-state serving never recompiles.
+the jitted step functions.  Each step executes exactly one scheduler plan —
+a bucketed prefill, a bucket-padded decode batch, or a fused MIXED step
+(every running sequence's decode token plus a bounded prefill chunk of the
+head waiting sequence in one packed invocation, so arriving prompts no
+longer stall the decoders).  Every plan shape maps to a cached XLA
+executable, so steady-state serving never recompiles.
 
 Stepping is split into a ``dispatch()``/``collect()`` pair wired as an
 async one-step-lookahead pipeline: decode step N+1 is dispatched to the
@@ -110,6 +113,28 @@ class LLMEngine:
                 f"max_num_seqs={config.scheduler.max_num_seqs} must be "
                 f"divisible by data_parallel={par.data_parallel}"
             )
+        # Mixed prefill+decode steps pack one [S+T] token batch; that row
+        # axis is neither dp- nor sp-shardable (its two segments shard
+        # differently), so a dp/sp mesh turns the auto gate off and
+        # rejects an explicit request rather than serving a silently
+        # different schedule.
+        if par.data_parallel > 1 or par.sequence_parallel > 1:
+            if config.scheduler.mixed_batch:
+                raise ValueError(
+                    "mixed_batch=True requires data_parallel == "
+                    "sequence_parallel == 1 (the packed mixed token batch "
+                    "cannot be dp/sp-sharded); drop the flag or the mesh "
+                    "axis"
+                )
+            config.scheduler.mixed_batch = False
+        if config.scheduler.mixed_enabled:
+            for bucket in config.scheduler.prefill_chunk_buckets:
+                if bucket % config.cache.block_size:
+                    raise ValueError(
+                        f"prefill chunk bucket {bucket} not divisible by "
+                        f"block_size={config.cache.block_size} (non-final "
+                        "chunks must leave the cached prefix block-aligned)"
+                    )
         if par.sequence_parallel > 1:
             if cfg.sliding_window is not None:
                 raise ValueError(
@@ -201,6 +226,19 @@ class LLMEngine:
             partial(self.model.decode, cfg=cfg, mesh=self.mesh),
             donate_argnames=("kv_caches",),
         )
+        # Fused mixed prefill+decode step (scheduler MixedPlan): one
+        # executable per (decode bucket, chunk bucket) pair — jit retraces
+        # per shape, and both axes come from small bucket sets.
+        self._mixed_fn = None
+        if config.scheduler.mixed_enabled and hasattr(self.model, "mixed_step"):
+            self._mixed_fn = jax.jit(
+                partial(self.model.mixed_step, cfg=cfg, mesh=self.mesh),
+                donate_argnames=("kv_caches",),
+            )
+        elif config.scheduler.mixed_enabled:
+            # Model without a fused entry point: fall back to alternating
+            # plans rather than failing at the first mixed dispatch.
+            config.scheduler.mixed_batch = False
         self._sample_fn = jax.jit(sample_tokens)
 
         # Multi-step decode (vLLM --num-scheduler-steps analogue): scan N
@@ -300,6 +338,10 @@ class LLMEngine:
         self.total_prompt_tokens = 0
         self.total_generated_tokens = 0
         self.total_finished = 0
+        # Prompt tokens prefilled INSIDE mixed steps (the interference-
+        # removal signal: nonzero means prompts are chunking alongside
+        # live decodes instead of stalling them).
+        self.prefill_chunk_tokens = 0
         self._step_time_accum = 0.0
         # (end_time, duration) of recent steps; duty_cycle = busy fraction
         # of the trailing window (the HPA/dashboard signal, vocabulary.py).
@@ -632,6 +674,17 @@ class LLMEngine:
                 _PendingStep(outputs=outputs, host_s=time.time() - t0)
             )
             return True
+        if plan.mixed is not None:
+            # Fused decode+prefill-chunk step: synchronous (the chunk's
+            # admission/finalization needs collected state), so the
+            # lookahead pipeline pauses for the step and resumes on the
+            # next pure-decode plan.
+            outputs = self._run_mixed(plan.mixed)
+            self._step_counter += 1
+            self._pending.append(_PendingStep(
+                outputs=outputs, is_decode=True, host_s=time.time() - t0,
+            ))
+            return True
         seqs = plan.decode.seqs
         if self._can_pipeline(seqs):
             self._pending.append(self._dispatch_decode_async(seqs, False))
@@ -708,26 +761,20 @@ class LLMEngine:
         steady "same batch, +1 token" path (one packed [4, S] delta,
         tokens chained from the in-flight sample)."""
         t0 = time.time()
-        S = self._smax
-        bs = self.block_pool.block_size
+        # Rebuilds pad to the decode batch-size bucket; lookahead steps
+        # reuse the device-resident state, whose row count is by
+        # construction the same bucket (identical running set).
+        S = (
+            self._decode_bucket(len(seqs))
+            if not lookahead
+            else self._pipe_tables.shape[0]
+        )
 
         if not lookahead:
-            tokens = np.zeros((S,), np.int32)
-            positions = np.zeros((S,), np.int32)
-            ctx_lens = np.zeros((S,), np.int32)
-            slot_blocks = np.zeros((S,), np.int32)
-            slot_offsets = np.zeros((S,), np.int32)
+            (tokens, positions, tables, ctx_lens, slot_blocks,
+             slot_offsets) = self._decode_batch_arrays(seqs, S)
             adapter = np.zeros((S,), np.int32)
-            tables = np.zeros((S, self._bmax), np.int32)
             for i, seq in enumerate(seqs):
-                pos = seq.num_tokens - 1
-                tokens[i] = seq.all_token_ids[-1]
-                positions[i] = pos
-                ctx_lens[i] = seq.num_tokens
-                table = seq.block_table[: self._bmax]
-                tables[i, : len(table)] = table
-                slot_blocks[i] = seq.block_table[pos // bs]
-                slot_offsets[i] = pos % bs
                 adapter[i] = seq.adapter_idx
             temps, top_ps, top_ks, min_ps, seeds = self._sampling_arrays(
                 seqs, S
@@ -904,6 +951,19 @@ class LLMEngine:
         start = cached_len // bs
         if start >= len(hashes):
             return prefix_blocks, cached_len
+        # Defense in depth: clamp the extension so >= 1 prompt token is
+        # ALWAYS left to prefill.  Today the fetch keys come from
+        # prefix_block_hashes, which stops at num_prompt_tokens - 1 like
+        # the local match_prefix, so this bound is not reachable through
+        # the local chain — but nothing else pins the invariant that a
+        # PrefillPlan must have num_new_tokens >= 1 (a full-prompt
+        # extension would leave no valid last-token logits to sample),
+        # and the hash helper is shared code a refactor could loosen.
+        # Enforce it where the extension happens, not by construction
+        # three modules away.
+        max_ext_blocks = (seq.num_prompt_tokens - 1 - cached_len) // bs
+        if max_ext_blocks <= 0:
+            return prefix_blocks, cached_len
         # Don't fetch what admission can't hold: the whole remaining
         # prompt (fetched + still-to-prefill blocks) must fit, or the
         # scheduler would free the fetch and re-issue it every step.
@@ -915,7 +975,7 @@ class LLMEngine:
         key_prefix = self._px_key_prefix()
         try:
             fetched: List = []
-            for digest in hashes[start:]:
+            for digest in hashes[start : start + max_ext_blocks]:
                 entry = client.get_blocks(key_prefix + digest.hex())
                 if entry is None:
                     break
@@ -1042,18 +1102,8 @@ class LLMEngine:
         if self.obs.enabled and seq.first_scheduled_time is None:
             seq.first_scheduled_time = time.time()
             self.obs.on_first_scheduled(seq, seq.first_scheduled_time)
-        bs = self.block_pool.block_size
         T = plan.bucket_len
-        new_tokens = seq.prompt_token_ids[
-            plan.cached_len : plan.cached_len + plan.num_new_tokens
-        ]
-        tokens = np.zeros((T,), np.int32)
-        tokens[: len(new_tokens)] = new_tokens
-        new_block_ids = np.zeros((T // bs,), np.int32)
-        new_block_ids[: len(plan.new_block_ids)] = plan.new_block_ids
-        pmax = max(self._bmax, 1)
-        prefix_ids = np.zeros((pmax,), np.int32)
-        prefix_ids[: len(plan.prefix_block_ids)] = plan.prefix_block_ids
+        tokens, new_block_ids, prefix_ids = self._prefill_plan_arrays(plan)
 
         lora_kwargs = {}
         if self.lora_registry is not None:
@@ -1107,27 +1157,7 @@ class LLMEngine:
             # Non-final chunk of a long prompt: KV is written, but the
             # logits are mid-prompt — nothing to sample yet.
             return []
-        if self._exports:
-            self._export_prefix_blocks(seq)
-        if sp.max_tokens == 0:
-            # Scoring-only request (echo+logprobs with max_tokens=0):
-            # nothing to sample — finish at prefill with the text-free
-            # sentinel the server already understands.
-            seq.first_token_time = time.time()
-            self._finish_seq_now(seq, FinishReason.LENGTH)
-            outputs = [StepOutput(
-                seq_id=seq.seq_id,
-                new_token_id=-1,
-                finished=True,
-                finish_reason=FinishReason.LENGTH,
-                num_prompt_tokens=seq.num_prompt_tokens,
-                num_output_tokens=0,
-            )]
-        else:
-            token_ids, logprob_info = self._sample_batch(logits[None, :], [seq])
-            outputs = self._append_and_check(
-                [seq], token_ids, first_token=True, logprob_info=logprob_info
-            )
+        outputs = self._finalize_final_prefill(seq, logits)
         if want_plp and outputs and seq.prompt_lp is not None:
             # Attach the assembled per-position entries to the request's
             # FIRST token event (position 0 has no predictor -> None).
@@ -1158,10 +1188,160 @@ class LLMEngine:
             )
             seq.prompt_lp[pos] = (float(tlp[t]), pairs)
 
+    def _prefill_plan_arrays(self, plan: PrefillPlan):
+        """Padded (tokens [T], new_block_ids [T//bs], prefix_ids [pmax])
+        host arrays for one PrefillPlan — shared by the dedicated prefill
+        executable and the mixed step's chunk segment, so the plan->array
+        layout can never diverge between them."""
+        seq = plan.seq
+        bs = self.block_pool.block_size
+        T = plan.bucket_len
+        new_tokens = seq.prompt_token_ids[
+            plan.cached_len : plan.cached_len + plan.num_new_tokens
+        ]
+        tokens = np.zeros((T,), np.int32)
+        tokens[: len(new_tokens)] = new_tokens
+        new_block_ids = np.zeros((T // bs,), np.int32)
+        new_block_ids[: len(plan.new_block_ids)] = plan.new_block_ids
+        pmax = max(self._bmax, 1)
+        prefix_ids = np.zeros((pmax,), np.int32)
+        prefix_ids[: len(plan.prefix_block_ids)] = plan.prefix_block_ids
+        return tokens, new_block_ids, prefix_ids
+
+    def _finalize_final_prefill(self, seq: Sequence, last_logits) -> List[StepOutput]:
+        """Shared tail of every FINAL prefill — dedicated executable or
+        mixed-step chunk: prefix export, the max_tokens==0 scoring
+        sentinel, or sampling the request's first token from the last
+        valid row's logits [V]."""
+        if self._exports:
+            self._export_prefix_blocks(seq)
+        if seq.sampling_params.max_tokens == 0:
+            # Scoring-only request (echo+logprobs with max_tokens=0):
+            # nothing to sample — finish at prefill with the text-free
+            # sentinel the server already understands.
+            seq.first_token_time = time.time()
+            self._finish_seq_now(seq, FinishReason.LENGTH)
+            return [StepOutput(
+                seq_id=seq.seq_id,
+                new_token_id=-1,
+                finished=True,
+                finish_reason=FinishReason.LENGTH,
+                num_prompt_tokens=seq.num_prompt_tokens,
+                num_output_tokens=0,
+            )]
+        token_ids, logprob_info = self._sample_batch(last_logits[None, :], [seq])
+        return self._append_and_check(
+            [seq], token_ids, first_token=True, logprob_info=logprob_info
+        )
+
+    def _decode_batch_arrays(self, seqs: List[Sequence], S: int):
+        """Padded decode-row host arrays ([S] x5 + [S, bmax] tables) for
+        one single-token step — shared by the synchronous decode path,
+        the pipeline's batch rebuild, and the mixed step's decode
+        segment.  Padding rows keep null block 0 / ctx 0 (masked)."""
+        bs = self.block_pool.block_size
+        tokens = np.zeros((S,), np.int32)
+        positions = np.zeros((S,), np.int32)
+        block_tables = np.zeros((S, self._bmax), np.int32)
+        ctx_lens = np.zeros((S,), np.int32)
+        slot_blocks = np.zeros((S,), np.int32)
+        slot_offsets = np.zeros((S,), np.int32)
+        for i, seq in enumerate(seqs):
+            pos = seq.num_tokens - 1
+            tokens[i] = seq.all_token_ids[-1]
+            positions[i] = pos
+            table = seq.block_table[: self._bmax]
+            block_tables[i, : len(table)] = table
+            ctx_lens[i] = seq.num_tokens
+            slot_blocks[i] = seq.block_table[pos // bs]
+            slot_offsets[i] = pos % bs
+        return tokens, positions, block_tables, ctx_lens, slot_blocks, slot_offsets
+
+    def _decode_bucket(self, n: int) -> int:
+        """Static decode batch sizes: the smallest bucket of the
+        (dp, 2dp, 4dp, ...) set holding ``n`` rows, capped at
+        max_num_seqs.  Replaces the old unconditional max_num_seqs
+        padding — a single-sequence stream stops paying full-batch
+        attention, KV scatter and sampling; the executable inventory
+        grows by one decode variant per power of two."""
+        b = max(1, self.config.parallel.data_parallel)
+        while b < n:
+            b *= 2
+        return min(b, self._smax)
+
+    def _run_mixed(self, mixed) -> List[StepOutput]:
+        """One fused step over the packed [decode bucket + chunk bucket]
+        token batch: every running sequence decodes exactly as in
+        _run_decode (paged attention, then the full host sampling
+        surface), and the head waiting sequence's prefill chunk rides
+        along paying only its attention/KV-write cost — the projection
+        and MLP weight streaming is shared.  Only a FINAL chunk samples
+        the prefill tail row (mid-prompt logits have no consumer),
+        mirroring _run_prefill's chunked contract."""
+        t_start = time.time()
+        plan = mixed.prefill_chunk
+        seq = plan.seq
+        seqs = mixed.decode.seqs
+        if self.obs.enabled and seq.first_scheduled_time is None:
+            seq.first_scheduled_time = t_start
+            self.obs.on_first_scheduled(seq, t_start)
+        S = self._decode_bucket(len(seqs))
+        T = plan.bucket_len
+        (tokens, positions, block_tables, ctx_lens, slot_blocks,
+         slot_offsets) = self._decode_batch_arrays(seqs, S)
+        pf_tokens, pf_new_blocks, pf_prefix = self._prefill_plan_arrays(plan)
+
+        batch_spec = shardings_lib.decode_batch_spec()
+        lora_kwargs = {}
+        if self.lora_registry is not None:
+            # Not _lora_kwargs: the mixed row layout is [S decode rows +
+            # T chunk rows sharing ONE adapter], not a per-seq width
+            # repeat, and the packed axis is replicated (dp/sp are gated
+            # to 1 for mixed), so P() is the right spec.
+            adapter_idx = np.zeros((S + T,), np.int32)
+            for i, s in enumerate(seqs):
+                adapter_idx[i] = s.adapter_idx
+            adapter_idx[S:] = seq.adapter_idx
+            lora_kwargs = {
+                "lora": self.lora_registry.params,
+                "adapter_idx": self._put(adapter_idx, P()),
+            }
+
+        self._note_decode_launch()
+        logits, self.kv_caches = self._mixed_fn(
+            self.params,
+            dec_tokens=self._put(tokens, batch_spec),
+            dec_positions=self._put(positions, batch_spec),
+            dec_block_tables=self._put(block_tables, P(AXES.DP, None)),
+            dec_ctx_lens=self._put(ctx_lens, batch_spec),
+            dec_slot_block_ids=self._put(slot_blocks, batch_spec),
+            dec_slot_offsets=self._put(slot_offsets, batch_spec),
+            pf_tokens=self._put(pf_tokens, P(AXES.SP)),
+            pf_cached_len=jnp.int32(plan.cached_len),
+            pf_prefix_block_ids=self._put(pf_prefix, P(AXES.SP)),
+            pf_new_block_ids=self._put(pf_new_blocks, P(AXES.SP)),
+            pf_valid_len=jnp.int32(plan.num_new_tokens),
+            kv_caches=self.kv_caches,
+            **lora_kwargs,
+        )
+        self.prefill_chunk_tokens += plan.num_new_tokens
+        # Decode rows first (logits rows 0..len(seqs)-1).
+        token_ids, logprob_info = self._sample_batch(logits[: len(seqs)], seqs)
+        outputs = self._append_and_check(
+            seqs, token_ids, first_token=False, logprob_info=logprob_info
+        )
+        if plan.is_final:
+            # Row -1 is the chunk's last valid token: the request's
+            # first sampled token (same finalize contract as the
+            # dedicated prefill executable).
+            outputs.extend(self._finalize_final_prefill(seq, logits[-1]))
+        if self.obs.enabled:
+            self.obs.step_phase("mixed", time.time() - t_start)
+        return outputs
+
     def _run_decode(self, plan: DecodePlan) -> List[StepOutput]:
         seqs = plan.seqs
-        S = self._smax
-        bs = self.block_pool.block_size
+        S = self._decode_bucket(len(seqs))
 
         # Speculative path first — it builds its own (wider) batch, so
         # deciding here avoids assembling the S-sized arrays only to
@@ -1181,22 +1361,8 @@ class LLMEngine:
         ):
             return self._run_decode_speculative(plan, spec_k)
 
-        tokens = np.zeros((S,), np.int32)
-        positions = np.zeros((S,), np.int32)
-        block_tables = np.zeros((S, self._bmax), np.int32)
-        ctx_lens = np.zeros((S,), np.int32)
-        slot_blocks = np.zeros((S,), np.int32)
-        slot_offsets = np.zeros((S,), np.int32)
-        for i, seq in enumerate(seqs):
-            last = seq.all_token_ids[-1]
-            pos = seq.num_tokens - 1
-            tokens[i] = last
-            positions[i] = pos
-            table = seq.block_table[: self._bmax]
-            block_tables[i, : len(table)] = table
-            ctx_lens[i] = seq.num_tokens
-            slot_blocks[i] = seq.block_table[pos // bs]
-            slot_offsets[i] = pos % bs
+        (tokens, positions, block_tables, ctx_lens, slot_blocks,
+         slot_offsets) = self._decode_batch_arrays(seqs, S)
 
         batch_spec = shardings_lib.decode_batch_spec()
         lora_kwargs = self._lora_kwargs(seqs, S, 1, batch_spec)
@@ -1322,7 +1488,7 @@ class LLMEngine:
         (the same argument as multi-step overruns, and the same
         full-block prefix-registration boundary protects the cache)."""
         seqs = plan.seqs
-        S = self._smax
+        S = self._decode_bucket(len(seqs))
         W = k + 1  # rows per sequence
         R = S * W
         bs = self.block_pool.block_size
@@ -1892,6 +2058,9 @@ class LLMEngine:
             "total_prompt_tokens": self.total_prompt_tokens,
             "total_generated_tokens": self.total_generated_tokens,
             "total_finished": self.total_finished,
+            # Prompt tokens prefilled inside fused mixed steps (decode
+            # never stalled for them).
+            "prefill_chunk_tokens": self.prefill_chunk_tokens,
             "num_preemptions": self.scheduler.num_preemptions,
             # Mean host-side serialization per decode step (ms): time the
             # device sat idle between decode steps.  ≈0 when the lookahead

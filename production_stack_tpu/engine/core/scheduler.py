@@ -1,10 +1,14 @@
 """Continuous-batching scheduler (TPU twist: static-shape step plans).
 
-Each call to :meth:`schedule` emits one *step plan*: either a single
-sequence's prefill (bucketed length) or one batched decode over all running
-sequences (padded to ``max_num_seqs``).  Every plan maps to a pre-compiled
-XLA executable — no shape escapes the bucket set, so steady-state serving
-never recompiles.
+Each call to :meth:`schedule` emits one *step plan*: a single sequence's
+prefill (bucketed length), one batched decode over all running sequences
+(padded to a batch-size bucket), or — with ``mixed_batch`` on — a fused
+MIXED plan packing every running sequence's decode token plus a bounded
+prefill chunk of the head waiting sequence under the
+``max_num_batched_tokens`` budget (chunked-prefill-integrated batching:
+arriving prompts stop stalling the decoders for a full prefill bucket).
+Every plan maps to a pre-compiled XLA executable — no shape escapes the
+bucket set, so steady-state serving never recompiles.
 
 Preemption: when the block pool cannot back a decode step, the youngest
 running sequence is preempted.  With ``preemption_mode="offload"`` its KV
@@ -53,13 +57,28 @@ class DecodePlan:
 
 
 @dataclasses.dataclass
+class MixedPlan:
+    """One fused step: every running sequence's decode token PLUS a
+    bounded prefill chunk of the head waiting sequence, packed into a
+    single model invocation under the scheduler's token budget
+    (chunked-prefill-integrated batching; Sarathi-Serve / vLLM
+    ``max_num_batched_tokens``).  The chunk length comes from the small
+    ``prefill_chunk_buckets`` set so the compiled-shape space stays
+    bounded at |chunk_buckets| x |decode batch buckets|."""
+
+    decode: DecodePlan
+    prefill_chunk: Optional[PrefillPlan] = None
+
+
+@dataclasses.dataclass
 class StepPlan:
     prefill: Optional[PrefillPlan] = None
     decode: Optional[DecodePlan] = None
+    mixed: Optional[MixedPlan] = None
 
     @property
     def is_empty(self) -> bool:
-        return self.prefill is None and self.decode is None
+        return self.prefill is None and self.decode is None and self.mixed is None
 
 
 class Scheduler:
@@ -161,8 +180,15 @@ class Scheduler:
         return None
 
     def schedule(self) -> StepPlan:
-        """Prefer admitting a prefill when a batch slot is open; otherwise
-        decode every running sequence."""
+        """With ``mixed_batch`` on and sequences decoding, emit a fused
+        decode+prefill-chunk plan so arriving prompts never stall the
+        decoders; otherwise prefer admitting a prefill when a batch slot
+        is open, else decode every running sequence (the classic
+        alternating path — also what ``mixed_batch=False`` restores)."""
+        if self.config.mixed_enabled and self.running:
+            plan = self._try_schedule_mixed()
+            if plan is not None:
+                return plan
         plan = self._try_schedule_prefill()
         if plan is not None:
             return StepPlan(prefill=plan)
@@ -190,7 +216,16 @@ class Scheduler:
         ]
         if not partials:
             return False
-        seq = max(partials, key=lambda s: s.arrival_time)
+        # Victim key mirrors _preempt_youngest: lowest priority loses,
+        # youngest ADMISSION among equals.  Never wall-clock arrival_time —
+        # clocks diverge across lockstep multi-host replicas, and a
+        # replica-dependent victim desyncs every subsequent plan (the same
+        # reason admission ordering uses _admit_idx).
+        seq = max(
+            partials,
+            key=lambda s: (s.sampling_params.priority,
+                           getattr(s, "_admit_idx", 0)),
+        )
         logger.debug("Rolling back partial prefill of %s (pool pressure)", seq.seq_id)
         self.block_pool.free(seq.block_table)
         seq.block_table = []
@@ -218,13 +253,61 @@ class Scheduler:
             return self.waiting
         return self.preempted
 
-    def _try_schedule_prefill(self) -> Optional[PrefillPlan]:
+    def _try_schedule_mixed(self) -> Optional[StepPlan]:
+        """Fused step: decode every running sequence AND, when the token
+        budget and a batch slot allow, a bounded prefill chunk of the
+        admission head.  Returns None to fall back to the classic
+        alternating path — used when the head needs the full prefill
+        machinery (echo+logprobs wants per-position prompt logprobs,
+        which only the dedicated prefill executable computes), so such
+        requests keep today's prefill-first latency instead of waiting
+        behind a decode-forever batch."""
+        queue = self._admission_queue()
+        head = queue[0] if queue else None
+        if (
+            head is not None
+            and head.sampling_params.echo
+            and head.sampling_params.logprobs
+            and len(self.running) < self.config.max_num_seqs
+        ):
+            return None
+        decode = self._try_schedule_decode()
+        if decode is None:
+            # Pool pressure emptied the running set: the classic path's
+            # prefill-first + rollback machinery handles recovery.
+            return None
+        chunk = None
+        if self.num_waiting and len(self.running) < self.config.max_num_seqs:
+            budget = self.config.batched_tokens_budget - len(decode.seqs)
+            chunk = self._try_schedule_prefill(chunk_budget=budget)
+        if chunk is None:
+            return StepPlan(decode=decode)
+        return StepPlan(mixed=MixedPlan(decode=decode, prefill_chunk=chunk))
+
+    def _try_schedule_prefill(
+        self, chunk_budget: Optional[int] = None
+    ) -> Optional[PrefillPlan]:
+        """Plan one prefill step.  ``chunk_budget`` switches to mixed-step
+        chunk mode: the padded length comes from ``prefill_chunk_buckets``
+        (not ``prefill_buckets``) and may not exceed the budget."""
         if len(self.running) >= self.config.max_num_seqs:
             return None
         queue = self._admission_queue()
         if not queue:
             return None
         seq = queue[0]
+        if chunk_budget is not None:
+            chunk_buckets = [
+                b for b in self.config.prefill_chunk_buckets
+                if b <= chunk_budget
+            ]
+            sp = seq.sampling_params
+            if not chunk_buckets or (sp.echo and sp.logprobs):
+                # No chunk fits the budget, or the head needs the
+                # prompt-logprobs prefill executable: no chunk this step
+                # (the mixed caller degrades to decode-only; the classic
+                # path serves echo+logprobs heads prefill-first).
+                return None
 
         if seq.offloaded:
             # Page the KV snapshot back in; on "restored" the engine has
@@ -257,14 +340,24 @@ class Scheduler:
                     seq, prefix_blocks, cached_len
                 )
         num_new = seq.num_prompt_tokens - cached_len
-        bucket = self._bucket_for(num_new)
-        is_final = bucket is not None
-        if bucket is None:
-            # Prompt longer than the largest bucket: chunked prefill — run
-            # one full-bucket chunk now, keep the sequence at the queue
-            # head, and continue next step from the accumulated prefix.
-            bucket = self.config.prefill_buckets[-1]
-            num_new = bucket
+        if chunk_budget is not None:
+            # Mixed-step chunk: pad to the chunk-bucket set so the fused
+            # executable inventory stays |chunk_buckets| x |decode buckets|.
+            fit = [b for b in chunk_buckets if b >= num_new]
+            is_final = bool(fit)
+            bucket = fit[0] if fit else chunk_buckets[-1]
+            if not is_final:
+                num_new = bucket
+        else:
+            bucket = self._bucket_for(num_new)
+            is_final = bucket is not None
+            if bucket is None:
+                # Prompt longer than the largest bucket: chunked prefill —
+                # run one full-bucket chunk now, keep the sequence at the
+                # queue head, and continue next step from the accumulated
+                # prefix.
+                bucket = self.config.prefill_buckets[-1]
+                num_new = bucket
         bs = self.block_pool.block_size
         blocks_needed = (num_new + bs - 1) // bs
         if not self.block_pool.can_allocate(blocks_needed):

@@ -454,6 +454,107 @@ def encode(
     return pooled / jnp.maximum(jnp.linalg.norm(pooled), 1e-9)
 
 
+def mixed_step(
+    params: Params,
+    cfg: ModelConfig,
+    dec_tokens: jax.Array,  # [S] int32, one token per decoding sequence
+    dec_positions: jax.Array,  # [S] int32 (=ctx_len-1)
+    dec_block_tables: jax.Array,  # [S, Bmax] int32
+    dec_ctx_lens: jax.Array,  # [S] int32 incl. the new token
+    dec_slot_block_ids: jax.Array,  # [S] int32 block receiving the token
+    dec_slot_offsets: jax.Array,  # [S] int32 offset within that block
+    pf_tokens: jax.Array,  # [T] int32 prefill chunk (padded to a bucket)
+    pf_cached_len: jax.Array,  # scalar int32: prefix tokens already cached
+    pf_prefix_block_ids: jax.Array,  # [P] int32 (0-padded)
+    pf_new_block_ids: jax.Array,  # [T // block_size] int32 (null-padded)
+    pf_valid_len: jax.Array,  # scalar int32: true number of chunk tokens
+    kv_caches: KVCaches,
+    mesh: Optional[Mesh] = None,  # tp-only mesh (engine gates dp/sp to 1)
+    lora: Optional[Dict] = None,
+    adapter_idx: Optional[jax.Array] = None,  # [S+T] row-aligned slots
+) -> Tuple[jax.Array, KVCaches]:
+    """Fused mixed step: S decoding sequences' next tokens AND one
+    sequence's prefill chunk in a single forward over the packed
+    ``[S + T]`` token batch.  Returns (logits [S+1, V], new caches):
+    rows 0..S-1 are the decode batch, row S is the chunk's last valid
+    token (only meaningful on a final chunk).
+
+    The win is shared weight streaming: every projection/MLP matmul runs
+    once over S+T rows, so the decode batch — which would otherwise sit
+    idle for a whole prefill bucket when a prompt arrives — pays zero
+    extra HBM weight traffic for riding along.  Attention splits by
+    segment: decode rows use paged attention over their block tables
+    exactly like :func:`decode`; the chunk runs flash/dense prefill
+    attention against its accumulated prefix blocks exactly like
+    :func:`prefill`.  The two segments touch disjoint KV slots (decode
+    appends land in each sequence's own tail block; the chunk writes its
+    freshly allocated blocks and reads its ref-counted prefix), so the
+    within-layer update order is immaterial.
+
+    lm_head runs on S+1 rows only — the full [T, V] chunk logits are
+    never materialized (mid-prompt rows have no consumer)."""
+    S = dec_tokens.shape[0]
+    T = pf_tokens.shape[0]
+    scale = cfg.head_dim**-0.5
+    positions = jnp.concatenate(
+        [dec_positions, pf_cached_len + jnp.arange(T, dtype=jnp.int32)]
+    )
+    cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta,
+                            cfg.rope_scaling)
+
+    x = _embed(params, cfg, jnp.concatenate([dec_tokens, pf_tokens]))
+    lora_scale = lora["scale"] if lora is not None else None
+    new_caches: KVCaches = []
+    for li, (layer, (k_cache, v_cache)) in enumerate(
+        zip(params["layers"], kv_caches)
+    ):
+        lora_layer = lora["layers"][li] if lora is not None else None
+        residual = x
+        x_n = _norm(x, layer["input_layernorm"], cfg)
+        q, k, v = _project_qkv(
+            layer, x_n, cfg, lora_layer, adapter_idx, lora_scale
+        )
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        # Decode segment: write-then-attend, like decode().
+        k_cache, v_cache = attn_ops.append_decode_kv(
+            k_cache, v_cache, k[:S], v[:S],
+            dec_slot_block_ids, dec_slot_offsets,
+        )
+        out_dec = attn_ops.decode_attention(
+            q[:S], k_cache, v_cache, dec_block_tables, dec_ctx_lens,
+            scale=scale, sliding_window=cfg.sliding_window, mesh=mesh,
+        )
+        # Prefill segment: attend over prefix + chunk, then scatter the
+        # chunk's KV into its new blocks.
+        k_prefix, v_prefix = attn_ops.gather_prefix_kv(
+            k_cache, v_cache, pf_prefix_block_ids, dtype=k.dtype
+        )
+        out_pf = attn_ops.prefill_attention(
+            q[S:], k[S:], v[S:], k_prefix, v_prefix,
+            pf_cached_len, pf_valid_len,
+            scale=scale, sliding_window=cfg.sliding_window, mesh=mesh,
+        )
+        k_cache, v_cache = attn_ops.write_prefill_kv(
+            k_cache, v_cache, k[S:], v[S:], pf_new_block_ids
+        )
+        new_caches.append((k_cache, v_cache))
+        out = jnp.concatenate([out_dec, out_pf]).reshape(
+            S + T, cfg.num_heads * cfg.head_dim
+        )
+        x = residual + _o_proj(
+            layer, out, lora_layer, adapter_idx, lora_scale
+        ).astype(x.dtype)
+        residual = x
+        x_n = _norm(x, layer["post_attention_layernorm"], cfg)
+        x = residual + _mlp(layer, x_n, lora_layer, adapter_idx, lora_scale, cfg)
+
+    x = _norm(x, params["norm"], cfg)
+    tail = x[S + jnp.maximum(pf_valid_len - 1, 0)]  # chunk's last valid row
+    head_rows = jnp.concatenate([x[:S], tail[None, :]], axis=0)  # [S+1, h]
+    return _lm_head(params, cfg, head_rows), new_caches
+
+
 def decode(
     params: Params,
     cfg: ModelConfig,

@@ -250,6 +250,7 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
              s["remote_prefix_blocks_exported"]),
             (vocab.TPU_SPEC_TOKENS_DRAFTED, s["spec_tokens_drafted"]),
             (vocab.TPU_SPEC_TOKENS_ACCEPTED, s["spec_tokens_accepted"]),
+            (vocab.TPU_PREFILL_CHUNK_TOKENS, s["prefill_chunk_tokens"]),
         ]
         # Latency histogram families (TTFT/ITL/e2e + step phases) ride the
         # same exposition; rendered even at zero observations so the
@@ -1413,6 +1414,22 @@ def main(argv=None) -> None:
         "recovered host serialization).  Auto-disabled by "
         "--num-scheduler-steps > 1 and --speculative-ngram",
     )
+    parser.add_argument(
+        "--no-mixed-batch",
+        action="store_true",
+        help="disable fused mixed prefill+decode steps (arriving prompts "
+        "then stall all decoders for a full prefill bucket per step — "
+        "the pre-mixed alternating scheduler).  Auto-disabled by "
+        "--num-scheduler-steps > 1, --speculative-ngram, and dp/sp meshes",
+    )
+    parser.add_argument(
+        "--max-num-batched-tokens",
+        type=int,
+        default=None,
+        help="token budget per fused mixed step (decode tokens count "
+        "first, the prefill chunk gets the remainder); default admits "
+        "the largest chunk bucket beside a full decode batch",
+    )
     parser.add_argument("--host-offload-gb", type=float, default=0.0)
     parser.add_argument("--remote-kv-url", default=None)
     parser.add_argument(
@@ -1493,6 +1510,14 @@ def main(argv=None) -> None:
             **(
                 {"scheduler.pipeline_decode": False}
                 if args.no_pipeline_decode else {}
+            ),
+            **(
+                {"scheduler.mixed_batch": False}
+                if args.no_mixed_batch else {}
+            ),
+            **(
+                {"scheduler.max_num_batched_tokens": args.max_num_batched_tokens}
+                if args.max_num_batched_tokens is not None else {}
             ),
             "cache.block_size": args.block_size,
             "cache.num_blocks": args.num_blocks,

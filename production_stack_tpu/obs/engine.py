@@ -28,11 +28,17 @@ from production_stack_tpu.obs.trace import Tracer
 #   dispatch - host work launching device execution (array build + H2D)
 #   collect  - blocking device compute + sample readback
 #   sample   - host sampling post-process (append, finish checks, guided)
+#   mixed    - one fused decode+prefill-chunk step, wall time end to end
+#              (array build + blocking device compute + both segments'
+#              sampling); its _count is the number of mixed steps, so
+#              rate(mixed_count)/rate(all step counts) is the fraction of
+#              steps where a prompt chunked alongside live decodes.
 # schedule covers every step; dispatch/collect/sample are the PIPELINED
 # decode split (the steady-state hot path) — synchronous steps (prefill,
 # host-state fallbacks) fuse those stages into one blocking call and
-# cannot be split without lying about where the time went.
-STEP_PHASES = ("schedule", "dispatch", "collect", "sample")
+# cannot be split without lying about where the time went.  Mixed steps
+# are synchronous by design and get their own family instead.
+STEP_PHASES = ("schedule", "dispatch", "collect", "sample", "mixed")
 
 # Request-level engine histograms -> ``tpu:*_seconds`` families; one
 # observation per request, EXCEPT itl which observes every token gap (its

@@ -82,6 +82,11 @@ TPU_REMOTE_PREFIX_BLOCKS_EXPORTED = "tpu:remote_prefix_blocks_exported"
 # accepted/drafted; a low rate means the drafter wastes verify FLOPs).
 TPU_SPEC_TOKENS_DRAFTED = "tpu:spec_tokens_drafted"
 TPU_SPEC_TOKENS_ACCEPTED = "tpu:spec_tokens_accepted"
+# Prompt tokens prefilled inside fused mixed decode+prefill steps
+# (scheduler mixed_batch): nonzero means arriving prompts are chunking
+# alongside live decodes instead of stalling them (the prefill/decode
+# interference signal, read beside tpu:itl_seconds).
+TPU_PREFILL_CHUNK_TOKENS = "tpu:prefill_chunk_tokens"
 TPU_COUNTERS = frozenset({
     TPU_TOTAL_PROMPT_TOKENS,
     TPU_TOTAL_GENERATED_TOKENS,
@@ -91,6 +96,7 @@ TPU_COUNTERS = frozenset({
     TPU_REMOTE_PREFIX_BLOCKS_EXPORTED,
     TPU_SPEC_TOKENS_DRAFTED,
     TPU_SPEC_TOKENS_ACCEPTED,
+    TPU_PREFILL_CHUNK_TOKENS,
 })
 
 
@@ -121,6 +127,10 @@ TPU_STEP_HISTOGRAMS = {
     "dispatch": "tpu:step_dispatch_seconds",
     "collect": "tpu:step_collect_seconds",
     "sample": "tpu:step_sample_seconds",
+    # Fused mixed decode+prefill-chunk steps, end-to-end wall time per
+    # step (its _count / all-step counts = fraction of steps a prompt
+    # chunked alongside live decodes).
+    "mixed": "tpu:step_mixed_seconds",
 }
 
 # Router families (labeled by backend server), fed by RequestStatsMonitor.

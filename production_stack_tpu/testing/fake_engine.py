@@ -132,6 +132,10 @@ def build_fake_engine_app(state: FakeEngineState | None = None) -> web.Applicati
             (vocab.TPU_TOTAL_GENERATED_TOKENS, state.total_generated_tokens),
             (vocab.TPU_TOTAL_FINISHED_REQUESTS, state.total_finished),
             (vocab.TPU_NUM_PREEMPTIONS, 0),
+            # The fake engine serves every prompt instantly, so no mixed
+            # chunking ever happens — but the counter must exist so the
+            # scrape contract matches the real engine.
+            (vocab.TPU_PREFILL_CHUNK_TOKENS, 0),
         ]) + state.obs.render_metrics()
 
     async def debug_requests(_request: web.Request) -> web.Response:

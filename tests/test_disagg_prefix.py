@@ -278,3 +278,73 @@ def test_disagg_role_requires_remote_url():
         CacheConfig(disagg_role="prefill")
     with pytest.raises(ValueError, match="disagg_role"):
         CacheConfig(disagg_role="weird", remote_kv_url="kv://x:1")
+
+
+class _InfiniteStoreClient:
+    """Stub remote client serving a valid block entry for EVERY key —
+    the adversarial store whose hash chain covers the whole prompt."""
+
+    def __init__(self, engine):
+        cfg = engine.config.model
+        bs = engine.block_pool.block_size
+        import numpy as np
+
+        blk = np.zeros((1, bs, cfg.num_kv_heads, cfg.head_dim), np.float32)
+        self._entry = (
+            [(blk, blk) for _ in range(cfg.num_layers)],
+            bs,
+        )
+        self.gets = 0
+
+    def get_blocks(self, key):
+        self.gets += 1
+        return self._entry
+
+
+def test_remote_prefix_extension_clamped_to_prompt_minus_one(kv_port):
+    """The local match_prefix leaves >= 1 token uncached by
+    construction, and today the fetch keys (prefix_block_hashes) carry
+    the same bound — so this exercises fetch_remote_prefix's OWN
+    defense-in-depth clamp by injecting the state a future loosening of
+    the shared hash helper would produce: a chain covering the ENTIRE
+    prompt, which unclamped would yield a PrefillPlan with
+    num_new_tokens == 0 and no valid last-token logits.
+    fetch_remote_prefix must cap the extension at
+    num_prompt_tokens - 1 regardless of what the chain covers."""
+    from production_stack_tpu.engine.kv.block_pool import _chain_hash
+
+    engine = make_engine("decode", kv_port)
+    engine.offload.remote_client.close()
+    engine.offload.remote_client = _InfiniteStoreClient(engine)
+    bs = engine.block_pool.block_size
+    # Prompt an exact multiple of the block size: an unclamped chain of
+    # len(prompt)/bs blocks covers every token.
+    prompt_ids = [(5 * i + 1) % 101 for i in range(4 * bs)]
+    engine.add_request("r", prompt_token_ids=prompt_ids,
+                       sampling_params=SamplingParams(max_tokens=2))
+    seq = engine.scheduler.waiting[0]
+    # Simulate the peer's unclamped chain: one digest per FULL block of
+    # the whole prompt (local prefix_block_hashes stops at len-1).
+    prev = None
+    full_chain = []
+    for start in range(0, len(prompt_ids), bs):
+        prev = _chain_hash(prev, prompt_ids[start : start + bs])
+        full_chain.append(prev)
+    seq._px_hashes = full_chain
+    seq._px_hashes_key = len(prompt_ids)
+
+    blocks, cached = engine.fetch_remote_prefix(seq, [], 0)
+    assert cached <= len(prompt_ids) - 1
+    assert cached == ((len(prompt_ids) - 1) // bs) * bs
+    assert len(blocks) == cached // bs
+    # The plan built from this extension always has work to prefill.
+    engine.block_pool.free(blocks)
+    seq._px_hashes = full_chain  # memo survives the free
+    tokens = []
+    steps = 0
+    while engine.has_unfinished():
+        steps += 1
+        assert steps < 100
+        for out in engine.step():
+            tokens.append(out.new_token_id)
+    assert len(tokens) == 2

@@ -1,0 +1,341 @@
+"""Fused mixed prefill+decode steps (SchedulerConfig.mixed_batch).
+
+The head-of-line problem under test: the alternating scheduler emits ONE
+plan per step, so an arriving prompt stalls every decoding sequence for a
+full prefill bucket — spiking ITL exactly when load rises.  Mixed batching
+(chunked-prefill-integrated batching; Sarathi-Serve, vLLM
+max_num_batched_tokens) packs every running sequence's decode token plus a
+bounded prefill chunk of the head waiting sequence into one model
+invocation under a token budget, with chunk lengths drawn from a small
+bucket set so the TPU static-shape invariant holds.
+
+Contracts asserted here:
+- greedy outputs are byte-identical to the alternating path, across
+  workloads whose long prompts force chunking;
+- while a long prompt prefills, running sequences receive a decode token
+  EVERY step (no interference);
+- mixed_batch=False restores the alternating one-plan-per-step scheduler
+  exactly;
+- the budget caps the chunk beside the decode batch, and the rollback
+  victim choice is replica-deterministic.
+"""
+
+from production_stack_tpu.engine.config import (
+    CacheConfig,
+    EngineConfig,
+    ModelConfig,
+    ParallelConfig,
+    SchedulerConfig,
+)
+from production_stack_tpu.engine.core.engine import LLMEngine
+from production_stack_tpu.engine.core.scheduler import Scheduler
+from production_stack_tpu.engine.core.sequence import SamplingParams, Sequence
+from production_stack_tpu.engine.kv.block_pool import BlockPool
+
+import pytest
+
+
+def make_engine(mixed, **overrides):
+    cfg = EngineConfig(
+        model=ModelConfig(dtype="float32"),
+        cache=CacheConfig(block_size=4,
+                          num_blocks=overrides.pop("num_blocks", 256)),
+        scheduler=SchedulerConfig(
+            max_num_seqs=overrides.pop("max_num_seqs", 4),
+            prefill_buckets=overrides.pop("prefill_buckets", (16, 32, 64)),
+            prefill_chunk_buckets=overrides.pop(
+                "prefill_chunk_buckets", (16, 32)
+            ),
+            max_model_len=overrides.pop("max_model_len", 512),
+            mixed_batch=mixed,
+            **overrides,
+        ),
+    )
+    return LLMEngine(cfg)
+
+
+def run_workload(engine, reqs, arrivals=None, max_steps=1000):
+    """Drive the engine over a workload; ``arrivals`` maps step index ->
+    requests injected before that step (index 0 = before stepping)."""
+    arrivals = dict(arrivals or {})
+    outputs = {}
+    for rid, prompt_ids, params in reqs:
+        engine.add_request(rid, prompt_token_ids=prompt_ids,
+                           sampling_params=params)
+    step = 0
+    while engine.has_unfinished() or arrivals:
+        for rid, prompt_ids, params in arrivals.pop(step, []):
+            engine.add_request(rid, prompt_token_ids=prompt_ids,
+                               sampling_params=params)
+        step += 1
+        assert step < max_steps, "engine did not drain"
+        for out in engine.step():
+            outputs.setdefault(out.seq_id, []).append(out.new_token_id)
+    return outputs
+
+
+# Prompts: long ones exceed the largest chunk bucket (32) several times
+# over, forcing multi-chunk prefills through the mixed path.
+LONG_A = [(7 * i) % 101 for i in range(90)]
+LONG_B = [(11 * i + 3) % 101 for i in range(77)]
+SHORT = [5, 9, 2, 44, 17, 8]
+MID = [(3 * i + 1) % 101 for i in range(25)]
+
+
+def test_scheduler_emits_mixed_plans_under_budget():
+    pool = BlockPool(num_blocks=256, block_size=4)
+    cfg = SchedulerConfig(
+        max_num_seqs=4, prefill_buckets=(16, 32, 64),
+        prefill_chunk_buckets=(16, 32), max_model_len=512,
+        max_num_batched_tokens=36,
+    )
+    sched = Scheduler(cfg, pool)
+    running = Sequence("run", list(SHORT), SamplingParams(max_tokens=64))
+    sched.add_seq(running)
+    assert sched.schedule().prefill is not None  # no running yet: classic
+    running.output_token_ids.append(1)
+
+    waiting = Sequence("wait", list(LONG_A), SamplingParams(max_tokens=4))
+    sched.add_seq(waiting)
+    plan = sched.schedule()
+    assert plan.mixed is not None
+    assert [s.seq_id for s in plan.mixed.decode.seqs] == ["run"]
+    chunk = plan.mixed.prefill_chunk
+    assert chunk.seq is waiting
+    # Budget 36 minus 1 decode token leaves 35: the 32 bucket fits, and
+    # 90 remaining tokens > 32 makes this a non-final chunk.
+    assert chunk.bucket_len == 32 and not chunk.is_final
+    assert chunk.num_new_tokens == 32
+    assert waiting.partial_prefill
+
+    # Tighten the budget below the smallest chunk + decode: decode-only.
+    cfg.max_num_batched_tokens = 16
+    running.output_token_ids.append(2)
+    plan = sched.schedule()
+    assert plan.mixed is None and plan.decode is not None
+    # Restore and finish the chunking: final chunk joins running.
+    cfg.max_num_batched_tokens = None
+    for _ in range(10):
+        running.output_token_ids.append(3)
+        plan = sched.schedule()
+        if plan.mixed is None:
+            break
+        chunk = plan.mixed.prefill_chunk
+    assert not waiting.partial_prefill
+    assert waiting in sched.running
+
+
+def test_mixed_off_restores_alternating_plans():
+    """mixed_batch=False: schedule() never emits a mixed plan and follows
+    today's prefill-first alternation exactly."""
+    pool = BlockPool(num_blocks=256, block_size=4)
+    sched = Scheduler(SchedulerConfig(
+        max_num_seqs=4, prefill_buckets=(16, 32, 64),
+        max_model_len=512, mixed_batch=False,
+    ), pool)
+    a = Sequence("a", list(SHORT), SamplingParams(max_tokens=8))
+    b = Sequence("b", list(MID), SamplingParams(max_tokens=8))
+    sched.add_seq(a)
+    plan1 = sched.schedule()
+    assert plan1.prefill is not None and plan1.mixed is None
+    a.output_token_ids.append(1)
+    sched.add_seq(b)
+    # Alternating path admits the waiting prefill FIRST (decode stalls).
+    plan2 = sched.schedule()
+    assert plan2.prefill is not None and plan2.prefill.seq is b
+    assert plan2.mixed is None
+
+
+def test_greedy_parity_mixed_vs_alternating():
+    """Byte-identical greedy outputs across a multi-request workload with
+    long prompts that force chunking, staggered arrivals included."""
+    reqs = [
+        ("short", list(SHORT), SamplingParams(max_tokens=24)),
+        ("long_a", list(LONG_A), SamplingParams(max_tokens=8)),
+    ]
+    arrivals = {
+        3: [("mid", list(MID), SamplingParams(max_tokens=10))],
+        6: [("long_b", list(LONG_B), SamplingParams(max_tokens=6))],
+    }
+    got = run_workload(make_engine(True), reqs, arrivals)
+    want = run_workload(make_engine(False), reqs, arrivals)
+    assert set(got) == {"short", "long_a", "mid", "long_b"}
+    assert got == want
+
+
+def test_decode_continues_every_step_while_long_prompt_prefills():
+    """The interference assertion: once a >1024-token prompt starts
+    chunking, every engine step until its first token still yields a
+    decode token for the already-running sequence."""
+    engine = make_engine(
+        True,
+        num_blocks=1024,
+        prefill_buckets=(16, 32, 64, 128, 2048),
+        prefill_chunk_buckets=(128, 256),
+        max_model_len=4096,
+    )
+    engine.add_request("run", prompt_token_ids=list(SHORT),
+                       sampling_params=SamplingParams(max_tokens=256,
+                                                      ignore_eos=True))
+    # Let the running sequence prefill + emit its first token.
+    first = engine.step()
+    assert [o.seq_id for o in first] == ["run"]
+    long_prompt = [(13 * i) % 101 for i in range(1500)]
+    engine.add_request("long", prompt_token_ids=long_prompt,
+                       sampling_params=SamplingParams(max_tokens=4))
+    steps_until_first_token = 0
+    long_started = False
+    while True:
+        outs = engine.step()
+        ids = [o.seq_id for o in outs]
+        steps_until_first_token += 1
+        assert steps_until_first_token < 100
+        # THE invariant: no decode step is skipped while "long" prefills.
+        assert "run" in ids, "decode stalled during chunked prefill"
+        if engine.prefill_chunk_tokens:
+            long_started = True
+        if "long" in ids:
+            break
+    assert long_started
+    # 1500 tokens / 256-token chunks: several fused steps were needed.
+    assert steps_until_first_token >= 5
+    assert engine.prefill_chunk_tokens == 1500
+
+
+def test_mixed_respects_batch_slot_cap():
+    """A full decode batch admits no chunk (no slot for the sequence to
+    finish into); the prompt waits, decode keeps stepping."""
+    # Scheduler level: with the batch at max_num_seqs, schedule() emits a
+    # plain decode plan (no mixed, no chunk) even though a prompt waits.
+    pool = BlockPool(num_blocks=256, block_size=4)
+    sched = Scheduler(SchedulerConfig(
+        max_num_seqs=2, prefill_buckets=(16, 32, 64),
+        prefill_chunk_buckets=(16, 32), max_model_len=512,
+    ), pool)
+    sched.add_seq(Sequence("a", list(SHORT), SamplingParams(max_tokens=8)))
+    assert sched.schedule().prefill is not None  # no running yet: classic
+    sched.running[-1].output_token_ids.append(1)
+    sched.add_seq(Sequence("b", list(SHORT), SamplingParams(max_tokens=8)))
+    plan = sched.schedule()  # open slot: "b" chunks in through a mixed plan
+    assert plan.mixed is not None
+    assert plan.mixed.prefill_chunk.seq.seq_id == "b"
+    for s in sched.running:
+        s.output_token_ids.append(1)
+    sched.add_seq(Sequence("c", list(LONG_A), SamplingParams(max_tokens=4)))
+    plan = sched.schedule()
+    assert plan.mixed is None and plan.prefill is None
+    assert plan.decode is not None and len(plan.decode.seqs) == 2
+    assert sched.num_waiting == 1  # "c" admitted nothing, not even blocks
+
+    # Engine level: the capped workload still drains with parity — "c"
+    # waits out the full batch, then chunks into the freed slot.
+    reqs = [
+        ("a", list(SHORT), SamplingParams(max_tokens=6)),
+        ("b", list(MID), SamplingParams(max_tokens=6)),
+    ]
+    arrivals = {4: [("c", list(LONG_A), SamplingParams(max_tokens=4))]}
+    outputs = run_workload(make_engine(True, max_num_seqs=2), reqs, arrivals)
+    baseline = run_workload(make_engine(False, max_num_seqs=2), reqs, arrivals)
+    assert outputs == baseline
+    assert len(outputs["c"]) == 4
+
+
+def test_mixed_prefill_reuses_prefix_cache():
+    """Chunks admitted through mixed steps hit the prefix cache like any
+    prefill, and finished mixed-prefilled sequences register prefixes."""
+    engine = make_engine(True)
+    run_workload(engine, [
+        ("keep", list(SHORT), SamplingParams(max_tokens=40, ignore_eos=True)),
+    ], arrivals={1: [("a", list(LONG_A), SamplingParams(max_tokens=2))]})
+    hits_before = engine.block_pool.hit_tokens
+    run_workload(engine, [
+        ("keep2", list(SHORT) + [33], SamplingParams(max_tokens=40,
+                                                     ignore_eos=True)),
+    ], arrivals={1: [("b", list(LONG_A), SamplingParams(max_tokens=2))]})
+    assert engine.block_pool.hit_tokens > hits_before
+
+
+def test_echo_logprobs_head_falls_back_to_alternating():
+    """echo+logprobs needs per-position prompt logprobs, which only the
+    dedicated prefill executable computes: such a head prefills through
+    the classic path (stalling decode one step, today's behavior) and its
+    prompt logprob surface stays intact."""
+    engine = make_engine(True)
+    engine.add_request("run", prompt_token_ids=list(SHORT),
+                       sampling_params=SamplingParams(max_tokens=64,
+                                                      ignore_eos=True))
+    engine.step()
+    engine.add_request(
+        "score", prompt_token_ids=list(MID),
+        sampling_params=SamplingParams(max_tokens=0, echo=True,
+                                       logprobs=True, top_logprobs=2),
+    )
+    outputs = {}
+    for _ in range(200):
+        for out in engine.step():
+            outputs.setdefault(out.seq_id, []).append(out)
+        if "score" in outputs:
+            break
+    score = outputs["score"][0]
+    assert score.finished and score.prompt_logprobs is not None
+    assert len(score.prompt_logprobs) == len(MID)
+    # Mixed steps never carried this request's chunks.
+    assert engine.prefill_chunk_tokens == 0
+
+
+def test_rollback_victim_is_admission_deterministic():
+    """_rollback_youngest_partial picks its victim by (priority,
+    _admit_idx), NOT wall-clock arrival_time — two partials with
+    adversarially swapped arrival clocks (replica clock skew) must
+    yield the same victim on every replica."""
+    pool = BlockPool(num_blocks=256, block_size=4)
+    sched = Scheduler(SchedulerConfig(
+        max_num_seqs=4, prefill_buckets=(16, 32), max_model_len=512,
+    ), pool)
+    first = Sequence("first", list(range(50)), SamplingParams(max_tokens=4))
+    second = Sequence("second", list(range(60)), SamplingParams(max_tokens=4))
+    # Clock skew: the LATER admission carries the EARLIER wall time.
+    first.arrival_time = 200.0
+    second.arrival_time = 100.0
+    sched.add_seq(first)
+    sched.add_seq(second)
+    for s in (first, second):
+        s.partial_prefill = True
+        s.block_table = pool.allocate(2)
+        s.num_cached_tokens = 8
+    assert sched._rollback_youngest_partial()
+    # Admission order decides: "second" (younger _admit_idx-wise) rolls
+    # back; under the old arrival_time key "first" would have (its clock
+    # reads later) — a replica-divergent choice.
+    assert second.block_table == [] and not second.partial_prefill
+    assert first.partial_prefill and first.block_table != []
+    # Priority dominates: a lower-priority partial loses regardless of
+    # admission order.
+    third = Sequence("third", list(range(40)),
+                     SamplingParams(max_tokens=4, priority=9))
+    sched.add_seq(third)
+    third.partial_prefill = True
+    third.block_table = pool.allocate(2)
+    assert sched._rollback_youngest_partial()
+    assert third.block_table == [] and first.partial_prefill
+
+
+def test_mixed_rejected_on_dp_mesh():
+    with pytest.raises(ValueError, match="mixed_batch"):
+        LLMEngine(EngineConfig(
+            model=ModelConfig(dtype="float32"),
+            cache=CacheConfig(block_size=4, num_blocks=64),
+            scheduler=SchedulerConfig(max_num_seqs=4, mixed_batch=True),
+            parallel=ParallelConfig(data_parallel=2),
+        ))
+
+
+def test_mixed_auto_disables_on_dp_mesh():
+    engine = LLMEngine(EngineConfig(
+        model=ModelConfig(dtype="float32"),
+        cache=CacheConfig(block_size=4, num_blocks=64),
+        scheduler=SchedulerConfig(max_num_seqs=4),  # mixed_batch=None auto
+        parallel=ParallelConfig(data_parallel=2),
+    ))
+    assert engine.config.scheduler.mixed_batch is False
+    assert not engine.config.scheduler.mixed_enabled

@@ -62,7 +62,9 @@ def test_decode_extends_block_table_when_needed():
 
 
 def test_prefill_admission_respects_batch_cap():
-    sched, pool = make_scheduler(max_num_seqs=2)
+    # Alternating (mixed_batch=False) semantics; the fused path's
+    # admission behavior is covered in test_mixed_batch.py.
+    sched, pool = make_scheduler(max_num_seqs=2, mixed_batch=False)
     for i in range(3):
         sched.add_seq(seq(f"s{i}", 4))
     assert sched.schedule().prefill is not None
@@ -80,6 +82,7 @@ def test_preemption_when_pool_exhausted():
     sched, pool = make_scheduler(
         num_blocks=7,  # 6 usable
         max_num_seqs=2,
+        mixed_batch=False,  # alternating semantics under test
         offload_cb=lambda s, blocks: offloaded.append(s.seq_id) or True,
     )
     s1 = seq("old", 8, t=1.0)  # 2 blocks
