@@ -15,10 +15,20 @@
 //
 // Wire protocol (little-endian):
 //   request:  magic u32 (0x54505543) | op u8 | key_len u16 | key
-//             [PUT only: val_len u64 | value]
+//             [PUT and MPUT only: val_len u64 | value]
 //   response: magic u32 | status u8 | val_len u64 | value
-//   ops:    1=PUT 2=GET 3=DEL 4=STAT 5=PING
+//   ops:    1=PUT 2=GET 3=DEL 4=STAT 5=PING 6=MGET 7=MPUT
 //   status: 0=OK 1=NOT_FOUND 2=ERROR
+//
+// Batched ops (one framed round-trip per KV hash chain; protocol.py):
+//   MGET: key field = packed key list (u16 count, then per key u16 len +
+//   bytes), no value field; OK response value = packed value list
+//   (u32 count, then per value u64 len + bytes) holding the PRESENT
+//   PREFIX of the requested keys (a chain consumer cannot use blocks
+//   past the first miss).  MPUT: key field = packed key list, value
+//   field = packed value list of the same count; bare OK/ERROR reply.
+//   Malformed packed lists answer ST_ERROR with the frame fully
+//   consumed, so the connection stays usable.
 
 #include <arpa/inet.h>
 #include <cerrno>
@@ -40,8 +50,29 @@
 namespace {
 
 constexpr uint32_t kMagic = 0x54505543;  // "TPUC"
-enum Op : uint8_t { OP_PUT = 1, OP_GET = 2, OP_DEL = 3, OP_STAT = 4, OP_PING = 5 };
+enum Op : uint8_t {
+  OP_PUT = 1,
+  OP_GET = 2,
+  OP_DEL = 3,
+  OP_STAT = 4,
+  OP_PING = 5,
+  OP_MGET = 6,
+  OP_MPUT = 7,
+};
 enum Status : uint8_t { ST_OK = 0, ST_NOT_FOUND = 1, ST_ERROR = 2 };
+
+const char* OpName(uint8_t op) {
+  switch (op) {
+    case OP_PUT: return "put";
+    case OP_GET: return "get";
+    case OP_DEL: return "del";
+    case OP_STAT: return "stat";
+    case OP_PING: return "ping";
+    case OP_MGET: return "mget";
+    case OP_MPUT: return "mput";
+    default: return "unknown";
+  }
+}
 
 // ---------------------------------------------------------------------------
 // LRU store
@@ -89,15 +120,26 @@ class KVStore {
     map_.erase(it);
   }
 
+  void CountOp(uint8_t op) { ++ops_[OpName(op)]; }
+
   std::string StatsJson() const {
     char buf[256];
     snprintf(buf, sizeof(buf),
              "{\"keys\": %zu, \"used_bytes\": %zu, \"capacity_bytes\": %zu, "
-             "\"hits\": %llu, \"misses\": %llu}",
+             "\"hits\": %llu, \"misses\": %llu, \"ops\": {",
              map_.size(), used_, capacity_,
              static_cast<unsigned long long>(hits_),
              static_cast<unsigned long long>(misses_));
-    return buf;
+    std::string out = buf;
+    bool first = true;
+    for (const auto& [name, count] : ops_) {
+      snprintf(buf, sizeof(buf), "%s\"%s\": %llu", first ? "" : ", ",
+               name.c_str(), static_cast<unsigned long long>(count));
+      out += buf;
+      first = false;
+    }
+    out += "}}";
+    return out;
   }
 
  private:
@@ -108,6 +150,10 @@ class KVStore {
   size_t capacity_;
   size_t used_ = 0;
   uint64_t hits_ = 0, misses_ = 0;
+  // Per-op frame counts: one entry per network round-trip, so a client
+  // can prove MGET batching cut its RTTs (same field as the Python
+  // server's stats()["ops"]).
+  std::unordered_map<std::string, uint64_t> ops_;
   std::list<std::string> lru_;  // front = most recently used
   std::unordered_map<std::string, Entry> map_;
 };
@@ -140,6 +186,40 @@ uint64_t ReadU64(const uint8_t* p) {
   return v;
 }
 
+// Packed MGET/MPUT payload parsing (strict: truncation or trailing
+// garbage fails, the caller answers ST_ERROR with the frame consumed).
+
+bool ParseKeyList(const uint8_t* p, size_t len, std::vector<std::string>* keys) {
+  if (len < 2) return false;
+  uint16_t count = ReadU16(p);
+  size_t off = 2;
+  for (uint16_t i = 0; i < count; ++i) {
+    if (off + 2 > len) return false;
+    uint16_t klen = ReadU16(p + off);
+    off += 2;
+    if (klen > len - off) return false;
+    keys->emplace_back(reinterpret_cast<const char*>(p + off), klen);
+    off += klen;
+  }
+  return off == len;
+}
+
+bool ParseValueList(const uint8_t* p, size_t len,
+                    std::vector<std::string>* values) {
+  if (len < 4) return false;
+  uint32_t count = ReadU32(p);
+  size_t off = 4;
+  for (uint32_t i = 0; i < count; ++i) {
+    if (off + 8 > len) return false;
+    uint64_t vlen = ReadU64(p + off);
+    off += 8;
+    if (vlen > len - off) return false;
+    values->emplace_back(reinterpret_cast<const char*>(p + off), vlen);
+    off += vlen;
+  }
+  return off == len;
+}
+
 void AppendResponse(Conn& c, uint8_t status, const std::string* value = nullptr) {
   uint32_t magic = kMagic;
   uint64_t len = value ? value->size() : 0;
@@ -167,7 +247,7 @@ bool ParseFrames(Conn& c, KVStore& store, size_t max_value_bytes) {
     uint8_t op = p[4];
     uint16_t key_len = ReadU16(p + 5);
     size_t need = 7 + key_len;
-    if (op == OP_PUT) {
+    if (op == OP_PUT || op == OP_MPUT) {
       if (n - pos < need + 8) break;
       uint64_t val_len = ReadU64(p + need);
       // Reject values the store could never hold: otherwise a single
@@ -182,6 +262,7 @@ bool ParseFrames(Conn& c, KVStore& store, size_t max_value_bytes) {
       need += 8 + val_len;
     }
     if (n - pos < need) break;
+    store.CountOp(op);
     std::string key(reinterpret_cast<const char*>(p + 7), key_len);
     switch (op) {
       case OP_PUT: {
@@ -199,6 +280,46 @@ bool ParseFrames(Conn& c, KVStore& store, size_t max_value_bytes) {
         } else {
           AppendResponse(c, ST_OK, value);
         }
+        break;
+      }
+      case OP_MGET: {
+        // Batched chain fetch: answer the PRESENT PREFIX of the
+        // requested keys in one reply (protocol.py OP_MGET).
+        std::vector<std::string> keys;
+        if (!ParseKeyList(p + 7, key_len, &keys)) {
+          AppendResponse(c, ST_ERROR);
+          break;
+        }
+        std::string body(4, '\0');
+        uint32_t found = 0;
+        for (const std::string& k : keys) {
+          const std::string* value = store.Get(k);
+          if (value == nullptr) break;
+          uint64_t vlen = value->size();
+          char head[8];
+          memcpy(head, &vlen, 8);
+          body.append(head, 8);
+          body.append(*value);
+          ++found;
+        }
+        memcpy(body.data(), &found, 4);
+        AppendResponse(c, ST_OK, &body);
+        break;
+      }
+      case OP_MPUT: {
+        uint64_t val_len = ReadU64(p + 7 + key_len);
+        std::vector<std::string> keys;
+        std::vector<std::string> values;
+        if (!ParseKeyList(p + 7, key_len, &keys) ||
+            !ParseValueList(p + 7 + key_len + 8, val_len, &values) ||
+            keys.size() != values.size()) {
+          AppendResponse(c, ST_ERROR);
+          break;
+        }
+        for (size_t k = 0; k < keys.size(); ++k) {
+          store.Put(keys[k], std::move(values[k]));
+        }
+        AppendResponse(c, ST_OK);
         break;
       }
       case OP_DEL:

@@ -275,6 +275,18 @@ class CacheConfig:
     # TPU-native analogue of LMCache's shared-store prefill reuse.
     # Requires remote_kv_url.
     disagg_role: Optional[str] = None
+    # Asynchronous batched KV transfer plane (kv/prefetch.py +
+    # kv/offload.py OffloadStager): admission-time remote-prefix prefetch
+    # on fetcher threads (one MGET round-trip per hash chain), off-step
+    # preemption offload staging, and async restore page-in — no kvserver
+    # RPC or host-DMA wait ever runs inside Scheduler.schedule() or the
+    # step thread's critical section.  None = auto (ON whenever
+    # remote_kv_url is set); False restores the legacy synchronous
+    # in-schedule transfers (A/B baseline; debugging).
+    remote_prefetch: Optional[bool] = None
+    # Background fetcher threads for the prefetch plane (each issues
+    # independent RPCs through the client connection pool).
+    prefetch_threads: int = 2
     # KV cache precision (vLLM --kv-cache-dtype analogue).  "int8" stores
     # each cached K/V vector as int8 with a per-(token, head) fp32 scale:
     # KV HBM traffic and pool bytes roughly halve (decode is
@@ -298,6 +310,16 @@ class CacheConfig:
                 f"Unknown kv_cache_dtype {self.kv_cache_dtype!r} "
                 "(auto | int8)"
             )
+        if self.prefetch_threads < 1:
+            raise ValueError("prefetch_threads must be >= 1")
+
+    @property
+    def remote_prefetch_enabled(self) -> bool:
+        """Resolved async-transfer gate: auto (None) turns on exactly
+        when a remote store is configured."""
+        if self.remote_prefetch is None:
+            return self.remote_kv_url is not None
+        return bool(self.remote_prefetch)
 
 
 @dataclasses.dataclass

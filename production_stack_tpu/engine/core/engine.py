@@ -56,7 +56,8 @@ from production_stack_tpu.engine.kv.block_pool import (
     prefix_block_hashes,
 )
 from production_stack_tpu.engine.kv import quant as kv_quant
-from production_stack_tpu.engine.kv.offload import HostOffloadManager
+from production_stack_tpu.engine.kv.offload import HostOffloadManager, OffloadStager
+from production_stack_tpu.engine.kv.prefetch import PrefetchedChain, PrefetchManager
 from production_stack_tpu.engine.models import get_model
 from production_stack_tpu.engine.models.weights import load_params
 from production_stack_tpu.obs.engine import EngineObs
@@ -177,6 +178,7 @@ class LLMEngine:
         self._disagg_role = config.cache.disagg_role
         self._exports = self._disagg_role in ("prefill", "both")
         imports = self._disagg_role in ("decode", "both")
+        self._imports = imports
         # digest -> export expiry: entries re-export after the TTL so a
         # store-side eviction doesn't silently end sharing forever.
         self._exported_hashes: "OrderedDict[bytes, float]" = OrderedDict()
@@ -207,6 +209,44 @@ class LLMEngine:
 
             remote_client = RemoteKVClient(config.cache.remote_kv_url)
         self.offload = HostOffloadManager(offload_bytes, remote_client)
+        # Asynchronous batched KV transfer plane (cache.remote_prefetch):
+        # admission-time remote-prefix prefetch on fetcher threads,
+        # off-step offload staging, async restore page-in.  None when no
+        # remote store (or the legacy synchronous path was requested) —
+        # every consumer falls back to today's blocking behavior.
+        self.kv_prefetch: Optional[PrefetchManager] = None
+        self._offload_stager: Optional[OffloadStager] = None
+        # The prefetch plane delivers through the prefix cache
+        # (match_prefix over adopted blocks); with caching disabled it
+        # could never serve a fetched block, so that config keeps the
+        # legacy sync extension, which works per-request without the
+        # cache.
+        if (
+            remote_client is not None
+            and config.cache.remote_prefetch_enabled
+            and config.cache.enable_prefix_caching
+        ):
+            self.kv_prefetch = PrefetchManager(
+                remote_client,
+                restore_sink=self.offload,
+                num_threads=config.cache.prefetch_threads,
+                observe_fetch=lambda s: self.obs.kv_phase(
+                    "remote_kv_fetch", s
+                ),
+            )
+        # The stager also covers host-DRAM-only offload (no remote tier):
+        # the D2H snapshot wait is a step-thread stall either way.  Only
+        # an explicit remote_prefetch=False keeps the blocking save.
+        if offload_bytes > 0 and config.cache.remote_prefetch is not False:
+            self._offload_stager = OffloadStager(
+                self.offload,
+                observe_stage=lambda s: self.obs.kv_phase(
+                    "offload_stage", s
+                ),
+            )
+        # Completed prefetches awaiting import into the prefix cache
+        # (kept across steps under transient pool pressure).
+        self._pending_prefetch_imports: List[PrefetchedChain] = []
 
         # Fixed shape constants.
         self._bmax = config.scheduler.max_model_len // config.cache.block_size
@@ -559,12 +599,24 @@ class LLMEngine:
         self._seqs[request_id] = seq
         self.scheduler.add_seq(seq)
         self.total_prompt_tokens += len(prompt_token_ids)
+        # Admission-time prefetch: start resolving the local prefix-cache
+        # miss tail against the remote store NOW, so by the time the
+        # scheduler considers this prompt the blocks are (often) already
+        # in host staging — and never fetched inside schedule().
+        if self.kv_prefetch is not None and self._imports:
+            self._submit_prefix_prefetch(seq)
 
     def abort_request(self, request_id: str) -> None:
         seq = self.scheduler.abort_seq(request_id)
         if seq is not None:
             seq.status = SequenceStatus.FINISHED
             seq.finish_reason = FinishReason.ABORT
+        if self.kv_prefetch is not None:
+            self.kv_prefetch.cancel(request_id)
+        if self._offload_stager is not None:
+            # Tombstone BEFORE offload.discard: a snapshot still staging
+            # must never be inserted (or remote-PUT) after the DEL.
+            self._offload_stager.discard(request_id)
         self.offload.discard(request_id)
         self._seqs.pop(request_id, None)
         self.obs.on_abort(request_id)
@@ -661,11 +713,23 @@ class LLMEngine:
         """Dispatch with nothing in flight: full scheduler knowledge
         (admission, preemption, partial-prefill rollback) — the only
         place synchronous plans run."""
+        # Land completed remote-prefix prefetches in the prefix cache
+        # BEFORE planning, so this very schedule()'s match_prefix can
+        # serve them (copy-in is an async device dispatch, not a wait).
+        self._drain_prefetched()
         t0 = time.time()
         plan = self.scheduler.schedule()
         if self.obs.enabled:
             self.obs.step_phase("schedule", time.time() - t0)
         if plan.is_empty:
+            # Nothing schedulable.  If that is because the async transfer
+            # plane is mid-flight (a restore page-in or offload stage the
+            # scheduler answered "retry" for), yield a tick so a tight
+            # caller loop doesn't busy-spin through its step budget
+            # faster than the worker threads can land the bytes.  The
+            # device is idle here — this is backoff, not a data wait.
+            if self._transfer_inflight():
+                time.sleep(0.001)
             return False
         if plan.prefill is not None:
             outputs = self._run_prefill(plan.prefill)
@@ -869,8 +933,47 @@ class LLMEngine:
             return result
         return self._restore_seq_blocks(seq)
 
+    # Sentinel: a remote restore page-in is in flight — schedule again
+    # next pass instead of blocking (async analogue of pool-pressure
+    # "retry").
+    _RESTORE_PENDING = object()
+
+    def _restore_entry(self, seq_id: str):
+        """Snapshot lookup for restore: local host-DRAM tier first; a
+        remote-tier miss triggers an ASYNC page-in (prefetch worker lands
+        it in the local tier) and returns the pending sentinel — the
+        scheduler re-checks readiness instead of blocking on the RPC.
+        Legacy mode (remote_prefetch=False) keeps the blocking fetch."""
+        if (
+            self._offload_stager is not None
+            and self._offload_stager.is_inflight(seq_id)
+        ):
+            # The snapshot is still between device and host: re-check
+            # next pass rather than concluding "gone" and recomputing.
+            return self._RESTORE_PENDING
+        if self.kv_prefetch is None:
+            return self.offload.restore(seq_id)
+        entry = self.offload.restore_local(seq_id)
+        if entry is not None:
+            # Consume a completed page-in job, if one fed this entry.
+            self.kv_prefetch.poll_restore(seq_id)
+            return entry
+        if self.offload.remote_client is None:
+            return None
+        state = self.kv_prefetch.poll_restore(seq_id)
+        if state == "absent":
+            self.kv_prefetch.submit_restore(seq_id)
+            return self._RESTORE_PENDING
+        if state == "inflight":
+            return self._RESTORE_PENDING
+        if state == "ready":
+            return self.offload.restore_local(seq_id)
+        return None  # "missing": recompute
+
     def _restore_seq_blocks(self, seq: Sequence) -> str:
-        entry = self.offload.restore(seq.seq_id)
+        entry = self._restore_entry(seq.seq_id)
+        if entry is self._RESTORE_PENDING:
+            return "retry"
         if entry is None:
             return "gone"  # fall back to recompute via normal prefill
         bs = self.block_pool.block_size
@@ -937,15 +1040,152 @@ class LLMEngine:
             seq._px_hashes_key = key
         return seq._px_hashes
 
+    def _transfer_inflight(self) -> bool:
+        """Any async KV transfer the scheduler may be waiting out."""
+        if self._offload_stager is not None and self._offload_stager.busy:
+            return True
+        return self.kv_prefetch is not None and self.kv_prefetch.inflight > 0
+
+    # -- admission-time remote-prefix prefetch (cache.remote_prefetch) -----
+
+    def _submit_prefix_prefetch(self, seq) -> None:
+        """Queue a background fetch of the sequence's local prefix-cache
+        miss tail (called at admission, and again from the scheduler
+        callback after recompute-preemption grows the prompt).  Pure host
+        hashing + a queue put — no RPC, no device work."""
+        hashes = self._seq_prefix_hashes(seq)
+        if not hashes:
+            return
+        start = self.block_pool.count_cached_prefix(hashes)
+        if start >= len(hashes):
+            return
+        # One fetch per distinct miss tail: without this memo a store-MISS
+        # chain (submitted, completed empty) would re-fetch on every
+        # scheduling pass the sequence spends waiting.  The key changes
+        # when recompute-preemption grows the prompt or the local cache
+        # absorbs more of the chain.  Set only on an ACCEPTED submit: a
+        # decline (e.g. the same-head dedupe against another request's
+        # in-flight job) must stay retryable, or an abort of that other
+        # request would strand this one without a fetch forever.
+        memo = (len(hashes), start)
+        if getattr(seq, "_px_prefetch_memo", None) == memo:
+            return
+        key_prefix = self._px_key_prefix()
+        if self.kv_prefetch.submit_chain(
+            seq.seq_id,
+            [key_prefix + d.hex() for d in hashes[start:]],
+            hashes[start:],
+            start,
+        ):
+            seq._px_prefetch_memo = memo
+
+    def _drain_prefetched(self) -> None:
+        """Step-thread landing point for completed prefetches: import the
+        staged host blocks into freshly allocated pool blocks (async
+        device copy-in via set_blocks) and bind them to their chain
+        digests in the prefix cache, then park them in the reclaimable
+        cached-free tier — the next match_prefix serves them exactly like
+        a local hit.  Transient pool pressure keeps a chain pending for a
+        bounded number of retries; anything undeliverable counts as
+        prefetch waste."""
+        if self.kv_prefetch is None:
+            return
+        self._pending_prefetch_imports.extend(self.kv_prefetch.pop_completed())
+        if not self._pending_prefetch_imports:
+            return
+        keep: List[PrefetchedChain] = []
+        for chain in self._pending_prefetch_imports:
+            outcome = self._import_prefetch_to_cache(chain)
+            if outcome == "retry":
+                chain.attempts += 1
+                if chain.attempts < 16:
+                    keep.append(chain)
+                else:
+                    self.kv_prefetch.note_waste(len(chain.blocks))
+        self._pending_prefetch_imports = keep
+
+    def _import_prefetch_to_cache(self, chain: PrefetchedChain) -> str:
+        """Returns "done" (imported / nothing left to do), "retry"
+        (pool pressure), or "drop" (malformed entries — degrade)."""
+        # A chain is only usable as a PREFIX: stop at the first digest the
+        # cache already holds a block for (earlier digests were local
+        # hits at submit time; a digest appearing mid-chain means a
+        # concurrent prefill registered it and our copy is redundant from
+        # that point on).
+        ready = []
+        for digest, layers in zip(chain.hashes, chain.blocks):
+            if self.block_pool.has_digest(digest):
+                if not ready:
+                    continue  # leading blocks already cached: skip them
+                break
+            ready.append((digest, layers))
+        dropped = len(chain.blocks) - len(ready)
+        if not ready:
+            if dropped:
+                self.kv_prefetch.note_waste(dropped)
+            return "done"
+        if not self.block_pool.can_allocate(len(ready)):
+            return "retry"
+        ids = self.block_pool.allocate(len(ready))
+        try:
+            idx = jnp.asarray(ids, jnp.int32)
+            for layer_idx, (k_cache, v_cache) in enumerate(self.kv_caches):
+                k_host = np.stack([b[layer_idx][0][0] for _, b in ready])
+                v_host = np.stack([b[layer_idx][1][0] for _, b in ready])
+                self.kv_caches[layer_idx] = (
+                    kv_quant.set_blocks(k_cache, idx, k_host),
+                    kv_quant.set_blocks(v_cache, idx, v_host),
+                )
+        except Exception:
+            # Malformed store entry (wrong layer count / block shape):
+            # free and degrade — unreferenced cache lines are harmless.
+            self.block_pool.free(ids)
+            self.kv_prefetch.note_waste(len(chain.blocks))
+            logger.exception("prefetched block import failed; continuing")
+            return "drop"
+        for (digest, _), block in zip(ready, ids):
+            self.block_pool.adopt_prefix_block(digest, block)
+        # Freeing parks the adopted blocks in the reclaimable cached-free
+        # tier; match_prefix re-claims them by digest.
+        self.block_pool.free(ids)
+        self.kv_prefetch.note_hit(len(ids))
+        if dropped:
+            self.kv_prefetch.note_waste(dropped)
+        self.remote_prefix_blocks_fetched += len(ids)
+        return "done"
+
+    def flush_prefix_imports(self, timeout: float = 10.0) -> None:
+        """Block until in-flight prefetches have resolved (tests;
+        graceful drain).  The actual cache import still happens on the
+        step thread at the next dispatch."""
+        if self.kv_prefetch is not None:
+            self.kv_prefetch.wait_idle(timeout)
+
     def fetch_remote_prefix(self, seq, prefix_blocks, cached_len):
-        """Scheduler remote_prefix_cb: extend a local prefix-cache match
-        with blocks fetched from the shared store by content key (the same
-        hash chain the local prefix cache uses).  Returns the possibly
-        extended (prefix_blocks, cached_len); never raises — a store
-        outage (or a malformed entry) degrades to local-only prefill."""
+        """Scheduler remote_prefix_cb.  With the async transfer plane
+        (cache.remote_prefetch, default): NEVER blocks — completed
+        prefetches were already imported into the prefix cache before
+        schedule() ran (so the match_prefix result this call receives
+        already includes them), and all this does is make sure a fetch is
+        in flight for any remaining miss tail (admission covers the
+        common case; this covers recompute-preemption prompt growth).
+        With remote_prefetch=False: the legacy synchronous per-block GET
+        loop, kept as the A/B baseline."""
         client = self.offload.remote_client
         if client is None:
             return prefix_blocks, cached_len
+        if self.kv_prefetch is not None:
+            if not self.kv_prefetch.has_job(seq.seq_id):
+                self._submit_prefix_prefetch(seq)
+            return prefix_blocks, cached_len
+        return self._fetch_remote_prefix_sync(seq, prefix_blocks, cached_len)
+
+    def _fetch_remote_prefix_sync(self, seq, prefix_blocks, cached_len):
+        """Legacy synchronous remote-prefix extension: one blocking GET
+        per block INSIDE the scheduler callback.  Returns the possibly
+        extended (prefix_blocks, cached_len); never raises — a store
+        outage (or a malformed entry) degrades to local-only prefill."""
+        client = self.offload.remote_client
         bs = self.block_pool.block_size
         hashes = self._seq_prefix_hashes(seq)
         start = cached_len // bs
@@ -1014,16 +1254,32 @@ class LLMEngine:
         client = self.offload.remote_client
         while True:
             item = self._export_queue.get()
+            if item is None:
+                self._export_queue.task_done()
+                return
+            # Coalesce the queue backlog into ONE batched MPUT: a final
+            # prefill enqueues its whole chain at once, so the common
+            # case is one round-trip per exported prompt instead of one
+            # per block.
+            batch = [item]
+            while len(batch) < 32:
+                try:
+                    nxt = self._export_queue.get_nowait()
+                except Exception:
+                    break
+                if nxt is None:
+                    self._export_queue.task_done()
+                    self._export_queue.put(None)  # re-arm shutdown
+                    break
+                batch.append(nxt)
             try:
-                if item is None:
-                    return
-                key, layers, bs = item
-                client.put_blocks(key, layers, bs)
-                self.remote_prefix_blocks_exported += 1
+                client.mput_blocks(batch)
+                self.remote_prefix_blocks_exported += len(batch)
             except Exception:
                 logger.exception("remote prefix export failed; continuing")
             finally:
-                self._export_queue.task_done()
+                for _ in batch:
+                    self._export_queue.task_done()
 
     def flush_prefix_exports(self, timeout: float = 10.0) -> None:
         """Block until queued exports have been written (tests; graceful
@@ -1927,6 +2183,12 @@ class LLMEngine:
                 reason = FinishReason.GUIDED_INVALID
         seq.finish_reason = reason
         self.scheduler.finish_seq(seq)
+        if self.kv_prefetch is not None:
+            # Release any still-staged prefetch for this request (its
+            # prefix is registered locally now anyway).
+            self.kv_prefetch.cancel(seq.seq_id)
+        if self._offload_stager is not None:
+            self._offload_stager.discard(seq.seq_id)
         self.offload.discard(seq.seq_id)
         self.total_finished += 1
         self._seqs.pop(seq.seq_id, None)
@@ -1950,6 +2212,48 @@ class LLMEngine:
     # -- preemption hook (called by scheduler via engine wrapper) ----------
 
     def offload_seq_blocks(self, seq: Sequence, block_ids: List[int]) -> bool:
+        """Scheduler offload_cb.  Async plane (default with a remote
+        store): dispatch the device-side gather (a fresh buffer — the
+        pool reuses the source blocks immediately) and hand the D2H wait
+        + host insert + optional remote PUT to the stager's writer
+        thread; the step thread never blocks.  A True return only
+        promises a BEST-EFFORT snapshot: if staging later fails (host
+        pool full), restore finds nothing and falls back to recompute —
+        the same contract a failed synchronous save has.  Legacy mode
+        blocks through offload.save as before."""
+        if self._offload_stager is None or self.offload.capacity_bytes <= 0:
+            return self._offload_seq_blocks_sync(seq, block_ids)
+        if not block_ids:
+            return False
+        if not self._offload_stager.reserve(seq.seq_id):
+            return False  # slot busy: recompute fallback (double-buffer)
+        t0 = time.time()
+        try:
+            ids = jnp.asarray(block_ids, jnp.int32)
+            device_layers = [
+                (kv_quant.gather_blocks_device(k_cache, ids),
+                 kv_quant.gather_blocks_device(v_cache, ids))
+                for k_cache, v_cache in self.kv_caches
+            ]
+        except Exception:
+            self._offload_stager.release(seq.seq_id)
+            logger.exception("offload gather dispatch failed; recomputing")
+            return False
+        self._offload_stager.commit(
+            seq.seq_id, device_layers, seq.num_tokens
+        )
+        if self.obs.enabled:
+            # Step-thread cost only (gather DISPATCH): the D2H wait lives
+            # in tpu:offload_stage_seconds, observed by the writer.
+            self.obs.tracer.add_span(
+                seq.seq_id, "engine.kv_offload", t0, time.time(),
+                blocks=len(block_ids), staged=True,
+            )
+        return True
+
+    def _offload_seq_blocks_sync(
+        self, seq: Sequence, block_ids: List[int]
+    ) -> bool:
         if not self.obs.enabled:
             return self.offload.save(
                 seq.seq_id, self.kv_caches, block_ids,
@@ -2072,6 +2376,17 @@ class LLMEngine:
             "loaded_loras": len(self.loaded_adapters()),
             "remote_prefix_blocks_fetched": self.remote_prefix_blocks_fetched,
             "remote_prefix_blocks_exported": self.remote_prefix_blocks_exported,
+            # Async KV transfer plane (kv/prefetch.py): blocks imported /
+            # dropped by admission-time prefetch, and fetches in flight.
+            "kv_prefetch_hit": (
+                self.kv_prefetch.hit_blocks if self.kv_prefetch else 0
+            ),
+            "kv_prefetch_waste": (
+                self.kv_prefetch.waste_blocks if self.kv_prefetch else 0
+            ),
+            "kv_prefetch_inflight": (
+                self.kv_prefetch.inflight if self.kv_prefetch else 0
+            ),
             "spec_tokens_drafted": self.spec_tokens_drafted,
             "spec_tokens_accepted": self.spec_tokens_accepted,
         }

@@ -92,18 +92,29 @@ class Scheduler:
     ):
         self.config = config
         self.block_pool = block_pool
-        # offload_cb(seq, block_ids) -> bool: page blocks to host DRAM
-        # before they are freed (engine wires HostOffloadManager here).
+        # offload_cb(seq, block_ids) -> bool: snapshot blocks before they
+        # are freed (engine wires offload_seq_blocks).  With the async
+        # transfer plane (cache.remote_prefetch) the callback only
+        # DISPATCHES a device-side gather and returns — the D2H wait and
+        # any remote PUT complete on a writer thread, so schedule() never
+        # blocks on DMA or the network here.
         self.offload_cb = offload_cb
-        # restore_cb(seq) -> bool: page an offloaded sequence's KV back in;
-        # on success the engine sets seq.block_table/num_cached_tokens/
-        # partial_prefill so the plan below resumes as a held prefix.
+        # restore_cb(seq) -> "restored" | "gone" | "retry": page an
+        # offloaded sequence's KV back in; on "restored" the engine sets
+        # seq.block_table/num_cached_tokens/partial_prefill so the plan
+        # below resumes as a held prefix.  "retry" covers transient pool
+        # pressure AND an in-flight async remote page-in — schedule again
+        # next pass instead of waiting.
         self.restore_cb = restore_cb
         # remote_prefix_cb(seq, prefix_blocks, cached_len) ->
-        # (prefix_blocks, cached_len): extend a local prefix-cache match
-        # with content-keyed blocks fetched from the shared remote store
-        # (cross-engine prefix reuse / disaggregated prefill; engine wires
-        # fetch_remote_prefix when cache.disagg_role imports).
+        # (prefix_blocks, cached_len): cross-engine prefix reuse through
+        # the shared remote store (engine wires fetch_remote_prefix when
+        # cache.disagg_role imports).  Async mode returns the inputs
+        # unchanged, only ensuring a background prefetch is in flight —
+        # completed fetches were already imported into the prefix cache
+        # before schedule() ran, so match_prefix above saw them; legacy
+        # mode (remote_prefetch=False) extends in place with blocking
+        # GETs.
         self.remote_prefix_cb = remote_prefix_cb
         self.waiting: Deque[Sequence] = deque()
         self.running: List[Sequence] = []

@@ -166,6 +166,36 @@ class BlockPool:
         self.hit_tokens += cached
         return blocks, cached
 
+    def count_cached_prefix(self, digests: Sequence[bytes]) -> int:
+        """How many LEADING chain digests the cache currently holds,
+        WITHOUT claiming them (no refcount change) — the admission-time
+        prefetch planner uses this to size the remote miss tail."""
+        if not self.enable_prefix_caching:
+            return 0
+        n = 0
+        for digest in digests:
+            if digest not in self._hash_to_block:
+                break
+            n += 1
+        return n
+
+    def has_digest(self, digest: bytes) -> bool:
+        return digest in self._hash_to_block
+
+    def adopt_prefix_block(self, digest: bytes, block: int) -> bool:
+        """Bind an imported (remote-prefetched) block's content to its
+        chain digest so match_prefix can serve it.  The caller owns the
+        block (allocated, refcount 1) and frees it right after adoption,
+        parking it in the reclaimable cached-free tier.  False when the
+        digest is already mapped (a concurrent local prefill won the
+        race): the caller's block frees as plain storage."""
+        if not self.enable_prefix_caching or digest in self._hash_to_block:
+            return False
+        self._evict_hash(block)  # block may have held older content
+        self._hash_to_block[digest] = block
+        self._block_to_hash[block] = digest
+        return True
+
     def register_prefix(
         self,
         token_ids: Sequence[int],

@@ -11,12 +11,25 @@ FLOPs.
 Tiering: host DRAM first; optional remote shared KV store
 (kvserver/, ``kv://host:port``) as the cross-replica tier, mirroring the
 reference's cacheserver (`lm://`) layer.
+
+Threading: the manager is shared between the engine step thread
+(save/restore/discard) and the async transfer plane's worker threads
+(OffloadStager's writer completing a staged snapshot, the prefetch
+manager's restore fetcher inserting a remote hit) — every mutation of
+the entry map runs under one lock.  ``OffloadStager`` is the OFF-STEP
+half of preemption offload: the step thread only dispatches the
+device-side gather (async, a fresh buffer — the pool can reuse the
+source blocks immediately) and hands the D2H wait + host bookkeeping +
+optional remote PUT to a writer thread, so no host-DMA or network byte
+is ever waited on inside the scheduler callback.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import logging
+import queue
+import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -42,6 +55,7 @@ class HostOffloadManager:
         self.capacity_bytes = int(capacity_bytes)
         self.used_bytes = 0
         self._entries: Dict[str, OffloadEntry] = {}
+        self._lock = threading.RLock()
         self.remote_client = remote_client  # kvserver client (optional tier)
         # seq_ids known to have a snapshot in the remote store (local put
         # or remote fetch): bounds discard() to one DEL for those only —
@@ -64,15 +78,16 @@ class HostOffloadManager:
         block_ids: List[int],
         num_tokens: int,
     ) -> bool:
-        """Page a sequence's blocks out to host DRAM.  Returns False when it
-        does not fit (caller falls back to recompute)."""
+        """Page a sequence's blocks out to host DRAM, synchronously (the
+        legacy path; the async plane stages through OffloadStager
+        instead).  Returns False when it does not fit (caller falls back
+        to recompute)."""
         if not block_ids or self.capacity_bytes <= 0:
             return False
         from production_stack_tpu.engine.kv import quant as kv_quant
 
         ids = np.asarray(block_ids, dtype=np.int32)
         layers: List[Tuple[np.ndarray, np.ndarray]] = []
-        nbytes = 0
         for k_cache, v_cache in kv_caches:
             # Device-side gather then one contiguous DMA per layer
             # (int8 caches dequantize to the dense host/wire format —
@@ -80,29 +95,58 @@ class HostOffloadManager:
             k_host = kv_quant.gather_blocks_host(k_cache, ids)
             v_host = kv_quant.gather_blocks_host(v_cache, ids)
             layers.append((k_host, v_host))
-            nbytes += k_host.nbytes + v_host.nbytes
-        while self.used_bytes + nbytes > self.capacity_bytes and self._entries:
-            self._evict_oldest()
-        if self.used_bytes + nbytes > self.capacity_bytes:
-            return False
-        self._entries[seq_id] = OffloadEntry(
-            seq_id=seq_id, num_tokens=num_tokens, layers=layers, nbytes=nbytes
-        )
-        self.used_bytes += nbytes
-        self.saves += 1
+        return self.insert_saved(seq_id, layers, num_tokens)
+
+    def insert_saved(
+        self,
+        seq_id: str,
+        layers: List[Tuple[np.ndarray, np.ndarray]],
+        num_tokens: int,
+    ) -> bool:
+        """Record an already-gathered host snapshot (step thread via
+        save(), or the OffloadStager writer thread) and mirror it to the
+        remote tier when configured."""
+        nbytes = sum(k.nbytes + v.nbytes for k, v in layers)
+        with self._lock:
+            while (
+                self.used_bytes + nbytes > self.capacity_bytes
+                and self._entries
+            ):
+                self._evict_oldest()
+            if self.used_bytes + nbytes > self.capacity_bytes:
+                return False
+            self._entries[seq_id] = OffloadEntry(
+                seq_id=seq_id, num_tokens=num_tokens, layers=layers,
+                nbytes=nbytes,
+            )
+            self.used_bytes += nbytes
+            self.saves += 1
         if self.remote_client is not None:
             try:
                 self.remote_client.put_blocks(seq_id, layers, num_tokens)
-                self._remote_keys.add(seq_id)
+                with self._lock:
+                    self._remote_keys.add(seq_id)
             except Exception:
                 logger.warning("remote KV put failed for %s", seq_id, exc_info=True)
         return True
 
+    def restore_local(self, seq_id: str) -> Optional[OffloadEntry]:
+        """Pop a snapshot from host DRAM only — never a network RPC, so
+        it is safe inside the scheduler callback.  The async restore path
+        (engine + prefetch.PrefetchManager.submit_restore) fills this
+        tier from the remote store off-step and retries."""
+        with self._lock:
+            entry = self._entries.pop(seq_id, None)
+            if entry is not None:
+                self.used_bytes -= entry.nbytes
+                self.restores += 1
+            return entry
+
     def restore(self, seq_id: str) -> Optional[OffloadEntry]:
-        entry = self._entries.pop(seq_id, None)
+        """Local tier first, then a BLOCKING remote fetch (legacy path;
+        kept for remote_prefetch=False compatibility)."""
+        entry = self.restore_local(seq_id)
         if entry is not None:
-            self.used_bytes -= entry.nbytes
-            self.restores += 1
             return entry
         if self.remote_client is not None:
             try:
@@ -112,8 +156,9 @@ class HostOffloadManager:
                 return None
             if fetched is not None:
                 layers, num_tokens = fetched
-                self.restores += 1
-                self._remote_keys.add(seq_id)
+                with self._lock:
+                    self.restores += 1
+                    self._remote_keys.add(seq_id)
                 return OffloadEntry(
                     seq_id=seq_id,
                     num_tokens=num_tokens,
@@ -122,29 +167,63 @@ class HostOffloadManager:
                 )
         return None
 
+    def insert_fetched(
+        self,
+        seq_id: str,
+        layers: List[Tuple[np.ndarray, np.ndarray]],
+        num_tokens: int,
+    ) -> bool:
+        """Cache a remote snapshot locally (the async restore fetcher's
+        landing point): the next restore_local() finds it without any
+        RPC.  Marks the seq as remote-resident so discard() still DELs."""
+        nbytes = sum(k.nbytes + v.nbytes for k, v in layers)
+        entry = OffloadEntry(
+            seq_id=seq_id, num_tokens=num_tokens, layers=layers, nbytes=nbytes
+        )
+        with self._lock:
+            self._remote_keys.add(seq_id)
+            while (
+                self.used_bytes + nbytes > self.capacity_bytes
+                and self._entries
+            ):
+                self._evict_oldest()
+            if self.used_bytes + nbytes > self.capacity_bytes:
+                return False
+            self._entries[seq_id] = entry
+            self.used_bytes += nbytes
+        return True
+
     def reinsert(self, entry: OffloadEntry) -> bool:
         """Put a restore()d-but-unused entry back (e.g. the pool could not
         host it yet); also caches remote fetches locally.  Evicts older
         entries like save() — the reinserted snapshot is the one about to
         be needed, so it outranks stale residents."""
-        self.restores -= 1  # the paired restore() did not take effect
-        while self.used_bytes + entry.nbytes > self.capacity_bytes and self._entries:
-            self._evict_oldest()
-        if self.used_bytes + entry.nbytes > self.capacity_bytes:
-            return False
-        self._entries[entry.seq_id] = entry
-        self.used_bytes += entry.nbytes
-        return True
+        with self._lock:
+            self.restores -= 1  # the paired restore() did not take effect
+            while (
+                self.used_bytes + entry.nbytes > self.capacity_bytes
+                and self._entries
+            ):
+                self._evict_oldest()
+            if self.used_bytes + entry.nbytes > self.capacity_bytes:
+                return False
+            self._entries[entry.seq_id] = entry
+            self.used_bytes += entry.nbytes
+            return True
 
     def discard(self, seq_id: str) -> None:
         """Drop a finished/aborted sequence's snapshot from every tier —
         including the remote store, or the shared cache leaks one snapshot
-        per finished sequence forever."""
-        entry = self._entries.pop(seq_id, None)
-        if entry is not None:
-            self.used_bytes -= entry.nbytes
-        if self.remote_client is not None and seq_id in self._remote_keys:
+        per finished sequence forever.  At most ONE remote DEL per seq:
+        _remote_keys membership is consumed under the lock before the
+        RPC."""
+        with self._lock:
+            entry = self._entries.pop(seq_id, None)
+            if entry is not None:
+                self.used_bytes -= entry.nbytes
+            known_remote = seq_id in self._remote_keys
             self._remote_keys.discard(seq_id)
+        if self.remote_client is not None and known_remote:
             try:
                 self.remote_client.delete(seq_id)
             except Exception:
@@ -155,3 +234,122 @@ class HostOffloadManager:
         del self._entries[oldest.seq_id]
         self.used_bytes -= oldest.nbytes
         self.evictions += 1
+
+
+class OffloadStager:
+    """Off-step completion of preemption snapshots.
+
+    The step thread calls ``reserve()`` -> dispatches the device-side
+    gathers (async, fresh buffers) -> ``commit()``s the device arrays;
+    a single writer thread then pays the D2H wait, inserts the host
+    snapshot into the HostOffloadManager (which mirrors to the remote
+    tier), and observes ``tpu:offload_stage_seconds``.  Double-buffered
+    by design: at most ONE snapshot is staged at a time — a preemption
+    arriving while the slot is busy returns False and the scheduler
+    falls back to recompute (preemptions are rare; blocking the step
+    thread to queue a second snapshot would reintroduce the stall this
+    class removes).
+
+    ``discard(seq_id)`` tombstones an in-flight snapshot (request
+    aborted/finished while staging): the writer drops the host copy
+    instead of inserting it, so no entry (or remote PUT) outlives the
+    sequence."""
+
+    def __init__(self, manager: HostOffloadManager, observe_stage=None):
+        self._manager = manager
+        self._observe = observe_stage  # callable(seconds) or None
+        self._q: "queue.Queue" = queue.Queue(maxsize=1)
+        self._lock = threading.Lock()
+        self._busy = False
+        self._inflight_id: Optional[str] = None
+        self._dead = False  # inflight snapshot tombstoned
+        self._thread: Optional[threading.Thread] = None
+        self.staged = 0
+        self.skipped = 0  # slot busy -> recompute fallback
+
+    def reserve(self, seq_id: str) -> bool:
+        """Claim the staging slot (step thread).  False = slot busy."""
+        with self._lock:
+            if self._busy:
+                self.skipped += 1
+                return False
+            self._busy = True
+            self._inflight_id = seq_id
+            self._dead = False
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._worker, name="kv-offload-stage", daemon=True
+            )
+            self._thread.start()
+        return True
+
+    def release(self, seq_id: str) -> None:
+        """Abandon a reservation (gather dispatch failed)."""
+        with self._lock:
+            if self._inflight_id == seq_id:
+                self._busy = False
+                self._inflight_id = None
+
+    def commit(self, seq_id: str, device_layers, num_tokens: int) -> None:
+        """Hand the dispatched device gathers to the writer thread."""
+        self.staged += 1
+        self._q.put((seq_id, device_layers, num_tokens, time.time()))
+
+    def discard(self, seq_id: str) -> None:
+        """Tombstone the in-flight snapshot for ``seq_id`` (no-op for
+        sequences that are not currently staging)."""
+        with self._lock:
+            if self._inflight_id == seq_id:
+                self._dead = True
+
+    def is_inflight(self, seq_id: str) -> bool:
+        """True while ``seq_id``'s snapshot is staged but not yet landed
+        in the manager — restore answers "retry" instead of "gone"."""
+        with self._lock:
+            return self._inflight_id == seq_id and not self._dead
+
+    @property
+    def busy(self) -> bool:
+        with self._lock:
+            return self._busy
+
+    def wait_idle(self, timeout: float = 10.0) -> bool:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            with self._lock:
+                if not self._busy:
+                    return True
+            time.sleep(0.005)
+        return False
+
+    def _worker(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            seq_id, device_layers, num_tokens, t0 = item
+            try:
+                layers = [
+                    (np.asarray(k), np.asarray(v)) for k, v in device_layers
+                ]
+                with self._lock:
+                    dead = self._dead
+                if not dead:
+                    self._manager.insert_saved(seq_id, layers, num_tokens)
+                    # An abort can land BETWEEN the check above and the
+                    # insert (its offload.discard then found nothing):
+                    # re-check and undo, so neither a host entry nor a
+                    # just-PUT remote snapshot outlives the sequence.
+                    with self._lock:
+                        dead = self._dead
+                    if dead:
+                        self._manager.discard(seq_id)
+                if self._observe is not None:
+                    self._observe(time.time() - t0)
+            except Exception:
+                logger.exception("offload staging failed for %s", seq_id)
+            finally:
+                with self._lock:
+                    self._busy = False
+                    self._inflight_id = None
+                    self._dead = False

@@ -82,12 +82,23 @@ def dequantize(data: jax.Array, scale: jax.Array, dtype=None) -> jax.Array:
 # module docstring).  These helpers are the single conversion boundary.
 
 
-def gather_blocks_host(side, ids: jax.Array) -> np.ndarray:
-    """Device gather of whole blocks -> dense host array [n, bs, K, D]."""
+def gather_blocks_device(side, ids: jax.Array) -> jax.Array:
+    """Device gather of whole blocks -> dense DEVICE array [n, bs, K, D].
+
+    Dispatches asynchronously and returns without a host sync: the result
+    is a fresh buffer, so the source cache blocks can be freed/reused
+    immediately while a writer thread later pays the D2H wait
+    (offload.OffloadStager) off the step thread."""
     if is_quantized(side):
         data, scale = side
-        return np.asarray(dequantize(data[ids], scale[ids]))
-    return np.asarray(side[ids])
+        return dequantize(data[ids], scale[ids])
+    return side[ids]
+
+
+def gather_blocks_host(side, ids: jax.Array) -> np.ndarray:
+    """Device gather of whole blocks -> dense host array [n, bs, K, D]
+    (blocks on the D2H transfer)."""
+    return np.asarray(gather_blocks_device(side, ids))
 
 
 def set_blocks(side, ids: jax.Array, host_blocks) -> object:

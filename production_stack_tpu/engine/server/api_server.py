@@ -248,6 +248,9 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
              s["remote_prefix_blocks_fetched"]),
             (vocab.TPU_REMOTE_PREFIX_BLOCKS_EXPORTED,
              s["remote_prefix_blocks_exported"]),
+            (vocab.TPU_KV_PREFETCH_HIT, s["kv_prefetch_hit"]),
+            (vocab.TPU_KV_PREFETCH_WASTE, s["kv_prefetch_waste"]),
+            (vocab.TPU_KV_PREFETCH_INFLIGHT, s["kv_prefetch_inflight"]),
             (vocab.TPU_SPEC_TOKENS_DRAFTED, s["spec_tokens_drafted"]),
             (vocab.TPU_SPEC_TOKENS_ACCEPTED, s["spec_tokens_accepted"]),
             (vocab.TPU_PREFILL_CHUNK_TOKENS, s["prefill_chunk_tokens"]),
@@ -1441,6 +1444,18 @@ def main(argv=None) -> None:
         "imports matching blocks instead of recomputing, 'both' shares "
         "symmetrically (requires --remote-kv-url)",
     )
+    parser.add_argument(
+        "--no-remote-prefetch",
+        action="store_true",
+        help="disable the asynchronous batched KV transfer plane "
+        "(admission-time remote-prefix prefetch, off-step offload "
+        "staging, async restore page-in) and restore the legacy "
+        "synchronous in-schedule transfers — A/B baseline / debugging",
+    )
+    parser.add_argument(
+        "--prefetch-threads", type=int, default=2,
+        help="background fetcher threads for the KV prefetch plane",
+    )
     parser.add_argument("--no-prefix-caching", action="store_true")
     parser.add_argument(
         "--kv-cache-dtype",
@@ -1524,6 +1539,11 @@ def main(argv=None) -> None:
             "cache.host_offload_gb": args.host_offload_gb,
             "cache.remote_kv_url": args.remote_kv_url,
             "cache.disagg_role": args.disagg_role,
+            **(
+                {"cache.remote_prefetch": False}
+                if args.no_remote_prefetch else {}
+            ),
+            "cache.prefetch_threads": args.prefetch_threads,
             "cache.enable_prefix_caching": not args.no_prefix_caching,
             **(
                 {"cache.kv_cache_dtype": args.kv_cache_dtype}
@@ -1551,6 +1571,18 @@ def main(argv=None) -> None:
     from production_stack_tpu.engine.parallel import distributed
 
     denv = distributed.maybe_initialize()
+    if denv is not None and config.cache.remote_prefetch is None:
+        # Async KV transfers are thread-timing-dependent (stager slot
+        # busy-ness, restore page-in readiness); inside a lockstep
+        # multi-host group a per-replica difference in offload/restore
+        # outcomes desyncs the step plans.  Auto mode therefore resolves
+        # to the deterministic synchronous path here; an EXPLICIT
+        # remote_prefetch=True is honored (operator's call).
+        logger.info(
+            "multi-host lockstep group: disabling async KV transfer "
+            "plane (cache.remote_prefetch auto -> False)"
+        )
+        config.cache.remote_prefetch = False
     if denv is not None and args.data_parallel > 1:
         # dp shards the decode batch; across PROCESSES the leader could
         # not read the non-addressable logit/token shards (and dp over

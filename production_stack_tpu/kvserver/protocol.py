@@ -3,10 +3,10 @@
 Frame (all little-endian):
 
     magic   u32  = 0x54505543 ("TPUC")
-    op      u8   (1=PUT, 2=GET, 3=DEL, 4=STAT, 5=PING)
+    op      u8   (1=PUT, 2=GET, 3=DEL, 4=STAT, 5=PING, 6=MGET, 7=MPUT)
     key_len u16
     key     bytes
-    val_len u64  (PUT only)
+    val_len u64  (PUT and MPUT only)
     value   bytes
 
 Response:
@@ -15,6 +15,22 @@ Response:
     status  u8   (0=OK, 1=NOT_FOUND, 2=ERROR)
     val_len u64
     value   bytes
+
+Batched ops (one framed round-trip for a whole hash chain):
+
+    MGET: the key field carries a packed KEY LIST (u16 count, then per key
+    u16 len + bytes) and there is NO value field — a server that predates
+    the op parses the frame cleanly and answers ST_ERROR, so clients can
+    fall back to serial GETs without desyncing the stream.  The OK
+    response value is a packed VALUE LIST (u32 count, then per value
+    u64 len + bytes) holding the PRESENT PREFIX of the requested keys:
+    the server stops at the first missing key, mirroring how a prefix
+    hash chain is consumed (blocks after a miss are useless).
+
+    MPUT: key field = packed key list, value field = packed value list of
+    the same count.  Response is a bare ST_OK/ST_ERROR.  Unlike MGET the
+    frame has a value field an old server would misparse, so clients must
+    reset the connection after any MPUT error reply.
 
 The ``naive`` serde stores a sequence's KV snapshot as:
 
@@ -34,7 +50,17 @@ import numpy as np
 
 MAGIC = 0x54505543
 OP_PUT, OP_GET, OP_DEL, OP_STAT, OP_PING = 1, 2, 3, 4, 5
+OP_MGET, OP_MPUT = 6, 7
 ST_OK, ST_NOT_FOUND, ST_ERROR = 0, 1, 2
+
+OP_NAMES = {
+    OP_PUT: "put", OP_GET: "get", OP_DEL: "del", OP_STAT: "stat",
+    OP_PING: "ping", OP_MGET: "mget", OP_MPUT: "mput",
+}
+
+# The key field is a u16 length, so a packed key list can never exceed
+# 64 KiB — clients chunk longer chains into multiple batches.
+MAX_KEYS_PER_BATCH = 512
 
 _DTYPES = {0: np.float32, 2: np.float16, 3: np.int8}
 _DTYPE_CODES = {np.dtype(np.float32): 0, np.dtype(np.float16): 2, np.dtype(np.int8): 3}
@@ -100,10 +126,73 @@ def decode_kv_snapshot(data: bytes) -> Tuple[List[Tuple[np.ndarray, np.ndarray]]
 
 def pack_request(op: int, key: bytes, value: bytes = b"") -> bytes:
     head = struct.pack("<IBH", MAGIC, op, len(key)) + key
-    if op == OP_PUT:
+    if op in (OP_PUT, OP_MPUT):
         head += struct.pack("<Q", len(value)) + value
     return head
 
 
 def pack_response(status: int, value: bytes = b"") -> bytes:
     return struct.pack("<IBQ", MAGIC, status, len(value)) + value
+
+
+# -- batched-op payloads (MGET/MPUT) ----------------------------------------
+
+
+def pack_key_list(keys: List[bytes]) -> bytes:
+    if len(keys) > 0xFFFF:
+        raise ValueError(f"too many keys in one batch: {len(keys)}")
+    parts = [struct.pack("<H", len(keys))]
+    for key in keys:
+        parts.append(struct.pack("<H", len(key)) + key)
+    return b"".join(parts)
+
+
+def unpack_key_list(buf: bytes) -> List[bytes]:
+    """Strict parse: truncated or trailing-garbage payloads raise
+    ValueError (the server answers ST_ERROR instead of guessing)."""
+    view = memoryview(buf)
+    if len(view) < 2:
+        raise ValueError("key list shorter than its count header")
+    (count,) = struct.unpack_from("<H", view, 0)
+    offset = 2
+    keys: List[bytes] = []
+    for _ in range(count):
+        if offset + 2 > len(view):
+            raise ValueError("truncated key list")
+        (klen,) = struct.unpack_from("<H", view, offset)
+        offset += 2
+        if offset + klen > len(view):
+            raise ValueError("truncated key in key list")
+        keys.append(bytes(view[offset : offset + klen]))
+        offset += klen
+    if offset != len(view):
+        raise ValueError("trailing bytes after key list")
+    return keys
+
+
+def pack_value_list(values: List[bytes]) -> bytes:
+    parts = [struct.pack("<I", len(values))]
+    for value in values:
+        parts.append(struct.pack("<Q", len(value)) + value)
+    return b"".join(parts)
+
+
+def unpack_value_list(buf: bytes) -> List[bytes]:
+    view = memoryview(buf)
+    if len(view) < 4:
+        raise ValueError("value list shorter than its count header")
+    (count,) = struct.unpack_from("<I", view, 0)
+    offset = 4
+    values: List[bytes] = []
+    for _ in range(count):
+        if offset + 8 > len(view):
+            raise ValueError("truncated value list")
+        (vlen,) = struct.unpack_from("<Q", view, offset)
+        offset += 8
+        if offset + vlen > len(view):
+            raise ValueError("truncated value in value list")
+        values.append(bytes(view[offset : offset + vlen]))
+        offset += vlen
+    if offset != len(view):
+        raise ValueError("trailing bytes after value list")
+    return values

@@ -28,6 +28,10 @@ class KVStore:
         self._data: Dict[bytes, Tuple[bytes, float]] = {}
         self.hits = 0
         self.misses = 0
+        # Per-op frame counts: one entry per network round-trip, so a
+        # client can prove MGET batching cut its RTTs (bench
+        # remote_prefix_ab reads this through STAT).
+        self.ops: Dict[str, int] = {}
 
     def put(self, key: bytes, value: bytes) -> None:
         old = self._data.pop(key, None)
@@ -60,6 +64,7 @@ class KVStore:
             "capacity_bytes": self.capacity_bytes,
             "hits": self.hits,
             "misses": self.misses,
+            "ops": dict(self.ops),
         }
 
 
@@ -68,8 +73,14 @@ async def _recv_exact(reader: asyncio.StreamReader, n: int) -> bytes:
 
 
 async def handle_client(
-    store: KVStore, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    store: KVStore,
+    reader: asyncio.StreamReader,
+    writer: asyncio.StreamWriter,
+    latency_s: float = 0.0,
 ) -> None:
+    """``latency_s`` injects a per-frame service delay (tests and the
+    bench's remote_prefix_ab stage emulate a cross-datacenter store with
+    it; production serving never sets it)."""
     peer = writer.get_extra_info("peername")
     try:
         while True:
@@ -81,7 +92,12 @@ async def handle_client(
             if magic != proto.MAGIC:
                 writer.write(proto.pack_response(proto.ST_ERROR))
                 break
+            store.ops[proto.OP_NAMES.get(op, f"op{op}")] = (
+                store.ops.get(proto.OP_NAMES.get(op, f"op{op}"), 0) + 1
+            )
             key = await _recv_exact(reader, key_len) if key_len else b""
+            if latency_s > 0:
+                await asyncio.sleep(latency_s)
             if op == proto.OP_PUT:
                 (val_len,) = struct.unpack("<Q", await _recv_exact(reader, 8))
                 # Reject values the store could never hold before buffering
@@ -91,6 +107,45 @@ async def handle_client(
                     break
                 value = await _recv_exact(reader, val_len)
                 store.put(key, value)
+                writer.write(proto.pack_response(proto.ST_OK))
+            elif op == proto.OP_MGET:
+                # Batched chain fetch: answer the PRESENT PREFIX of the
+                # requested keys in one reply (a chain consumer cannot
+                # use blocks past the first miss anyway).
+                try:
+                    keys = proto.unpack_key_list(key)
+                except ValueError:
+                    writer.write(proto.pack_response(proto.ST_ERROR))
+                    await writer.drain()
+                    continue
+                values = []
+                for k in keys:
+                    value = store.get(k)
+                    if value is None:
+                        break
+                    values.append(value)
+                writer.write(
+                    proto.pack_response(
+                        proto.ST_OK, proto.pack_value_list(values)
+                    )
+                )
+            elif op == proto.OP_MPUT:
+                (val_len,) = struct.unpack("<Q", await _recv_exact(reader, 8))
+                if val_len > store.capacity_bytes:
+                    writer.write(proto.pack_response(proto.ST_ERROR))
+                    break
+                value = await _recv_exact(reader, val_len)
+                try:
+                    keys = proto.unpack_key_list(key)
+                    values = proto.unpack_value_list(value)
+                    if len(keys) != len(values):
+                        raise ValueError("key/value count mismatch")
+                except ValueError:
+                    writer.write(proto.pack_response(proto.ST_ERROR))
+                    await writer.drain()
+                    continue
+                for k, v in zip(keys, values):
+                    store.put(k, v)
                 writer.write(proto.pack_response(proto.ST_OK))
             elif op == proto.OP_GET:
                 value = store.get(key)
@@ -119,10 +174,13 @@ async def handle_client(
         logger.debug("client %s disconnected", peer)
 
 
-async def serve(host: str, port: int, capacity_bytes: int) -> None:
+async def serve(
+    host: str, port: int, capacity_bytes: int, latency_s: float = 0.0
+) -> None:
     store = KVStore(capacity_bytes)
     server = await asyncio.start_server(
-        lambda r, w: handle_client(store, r, w), host, port
+        lambda r, w: handle_client(store, r, w, latency_s=latency_s),
+        host, port,
     )
     logger.info("KV store serving on %s:%d (%.1f GiB)", host, port, capacity_bytes / 2**30)
     async with server:
@@ -134,10 +192,18 @@ def main(argv=None) -> None:
     parser.add_argument("--host", default="0.0.0.0")
     parser.add_argument("--port", type=int, default=9400)
     parser.add_argument("--capacity-gb", type=float, default=4.0)
+    parser.add_argument(
+        "--inject-latency-ms", type=float, default=0.0,
+        help="per-frame service delay for latency testing (never set in "
+        "production)",
+    )
     parser.add_argument("--log-level", default="info")
     args = parser.parse_args(argv)
     init_logger("production_stack_tpu", args.log_level)
-    asyncio.run(serve(args.host, args.port, int(args.capacity_gb * 2**30)))
+    asyncio.run(serve(
+        args.host, args.port, int(args.capacity_gb * 2**30),
+        latency_s=args.inject_latency_ms / 1e3,
+    ))
 
 
 if __name__ == "__main__":

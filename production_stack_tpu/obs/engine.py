@@ -49,6 +49,16 @@ STEP_PHASES = ("schedule", "dispatch", "collect", "sample", "mixed")
 REQUEST_HISTS = ("ttft", "itl", "e2e_latency", "queue_time", "prefill_time",
                  "decode_time", "detokenize_time")
 
+# Async KV transfer-plane phases -> ``tpu:*_seconds`` families
+# (vocabulary.TPU_KV_HISTOGRAMS).  Observed from the plane's BACKGROUND
+# threads (prefetch fetchers, offload stager writer), never the step
+# thread — that is the point: these families measure the store/DMA
+# latency the plane keeps OFF the step loop.
+#   remote_kv_fetch - one store round-trip (MGET chain fetch/restore GET)
+#   offload_stage   - one staged preemption snapshot, gather dispatch ->
+#                     host copy landed
+KV_PHASES = ("remote_kv_fetch", "offload_stage")
+
 # The span set a joined router+engine timeline is scored against
 # (/debug/requests/{id}: phase_sum_s vs total_s).  engine.detokenize is
 # accumulated host time interleaved WITH engine.decode (marked
@@ -78,6 +88,9 @@ class EngineObs:
         self.request_hists: Dict[str, Histogram] = {
             name: Histogram() for name in REQUEST_HISTS
         }
+        self.kv_hists: Dict[str, Histogram] = {
+            name: Histogram() for name in KV_PHASES
+        }
 
     # -- step phases (engine step thread) ----------------------------------
 
@@ -85,6 +98,13 @@ class EngineObs:
         if not self.enabled:
             return
         self.step_hists[phase].observe(seconds)
+
+    # -- KV transfer plane (prefetch/stager background threads) ------------
+
+    def kv_phase(self, phase: str, seconds: float) -> None:
+        if not self.enabled:
+            return
+        self.kv_hists[phase].observe(seconds)
 
     # -- request lifecycle (engine step thread) ----------------------------
 
@@ -173,6 +193,8 @@ class EngineObs:
             parts.append(render_histogram(vocab.TPU_REQUEST_HISTOGRAMS[name], hist))
         for phase, hist in self.step_hists.items():
             parts.append(render_histogram(vocab.TPU_STEP_HISTOGRAMS[phase], hist))
+        for phase, hist in self.kv_hists.items():
+            parts.append(render_histogram(vocab.TPU_KV_HISTOGRAMS[phase], hist))
         return "".join(parts)
 
     def debug_payload(self) -> Dict:

@@ -13,6 +13,17 @@ cumulative chunk-prefix hash is remembered in a bounded LRU mapping to the
 engine that served it.  Scoring an endpoint combines (matched prefix length)
 against (engine load), so a hot engine does not melt down just because it
 owns a popular prefix.
+
+Hash contract: with a ``tokenize`` callable the router derives its prefix
+keys from the ENGINE'S OWN chain — ``prefix_block_hashes`` over token-id
+blocks (engine/kv/block_pool.py, a pure-python module), byte-identical to
+the engine's ``_seq_prefix_hashes`` and therefore to the content keys
+under which engines export/import KV blocks through the shared store.  A
+silent divergence here would steer "affine" requests to replicas whose
+store entries never match (tests/test_kv_prefetch.py asserts the
+contract).  Without a tokenizer the router falls back to the text-chunk
+heuristic, which still captures affinity but makes no key-equality
+claim.
 """
 
 from __future__ import annotations
@@ -54,15 +65,34 @@ class KVAwareRouter(RoutingInterface):
         chunk_chars: int = 1024,
         max_tracked_prefixes: int = 65536,
         load_tradeoff: float = 2.0,
+        tokenize=None,
+        token_block_size: int = 16,
     ):
         self.chunk_chars = int(chunk_chars)
         self.max_tracked_prefixes = int(max_tracked_prefixes)
         # How many chunks of prefix-match one unit of queue depth is worth.
         self.load_tradeoff = float(load_tradeoff)
+        # Optional exact-contract mode: tokenize(text) -> List[int]; the
+        # prefix keys then ARE the engine's KV-block content-key chain
+        # (module docstring), so affinity scoring tracks real store/
+        # prefix-cache hits instead of a text heuristic.
+        self.tokenize = tokenize
+        self.token_block_size = int(token_block_size)
         self._lock = threading.Lock()
         self._prefix_owner: "OrderedDict[str, str]" = OrderedDict()
 
     def _prefix_hashes(self, text: str) -> List[str]:
+        if self.tokenize is not None:
+            from production_stack_tpu.engine.kv.block_pool import (
+                prefix_block_hashes,
+            )
+
+            return [
+                digest.hex()
+                for digest in prefix_block_hashes(
+                    self.tokenize(text), self.token_block_size
+                )
+            ]
         hashes = []
         h = hashlib.blake2b(digest_size=8)
         for start in range(0, len(text), self.chunk_chars):

@@ -65,6 +65,11 @@ TPU_LOADED_LORAS = "tpu:loaded_loras"
 # engine's one-step-lookahead decode pipeline is active.
 TPU_DECODE_HOST_GAP_MS = "tpu:decode_host_gap_ms"
 
+# Remote-prefix prefetches currently in flight on the async KV transfer
+# plane (gauge; a persistently high value beside a low hit rate means the
+# store is slower than admission).
+TPU_KV_PREFETCH_INFLIGHT = "tpu:kv_prefetch_inflight"
+
 # The custom metric the prometheus-adapter exposes for HPA (reference:
 # observability/prom-adapter.yaml:8-20 exposes vllm:num_requests_waiting).
 HPA_QUEUE_METRIC = TPU_NUM_REQUESTS_WAITING
@@ -87,6 +92,13 @@ TPU_SPEC_TOKENS_ACCEPTED = "tpu:spec_tokens_accepted"
 # alongside live decodes instead of stalling them (the prefill/decode
 # interference signal, read beside tpu:itl_seconds).
 TPU_PREFILL_CHUNK_TOKENS = "tpu:prefill_chunk_tokens"
+# Async KV transfer plane (kv/prefetch.py): blocks imported into the
+# prefix cache by admission-time remote prefetch (hit) vs fetched and
+# then dropped unused — cancelled, malformed, or undeliverable (waste).
+# hit/(hit+waste) is the prefetch efficiency; read beside
+# tpu:remote_kv_fetch_seconds for the latency the plane is hiding.
+TPU_KV_PREFETCH_HIT = "tpu:kv_prefetch_hit"
+TPU_KV_PREFETCH_WASTE = "tpu:kv_prefetch_waste"
 TPU_COUNTERS = frozenset({
     TPU_TOTAL_PROMPT_TOKENS,
     TPU_TOTAL_GENERATED_TOKENS,
@@ -97,6 +109,8 @@ TPU_COUNTERS = frozenset({
     TPU_SPEC_TOKENS_DRAFTED,
     TPU_SPEC_TOKENS_ACCEPTED,
     TPU_PREFILL_CHUNK_TOKENS,
+    TPU_KV_PREFETCH_HIT,
+    TPU_KV_PREFETCH_WASTE,
 })
 
 
@@ -131,6 +145,17 @@ TPU_STEP_HISTOGRAMS = {
     # step (its _count / all-step counts = fraction of steps a prompt
     # chunked alongside live decodes).
     "mixed": "tpu:step_mixed_seconds",
+}
+
+# Async KV transfer-plane families, keyed by obs.EngineObs.KV_PHASES
+# names.  remote_kv_fetch is one observation per store round-trip (MGET
+# chain fetch or restore GET, observed on the fetcher threads) — the
+# network latency the plane hides from the step loop; offload_stage is
+# one observation per staged preemption snapshot (device gather dispatch
+# -> host copy complete, observed on the stager's writer thread).
+TPU_KV_HISTOGRAMS = {
+    "remote_kv_fetch": "tpu:remote_kv_fetch_seconds",
+    "offload_stage": "tpu:offload_stage_seconds",
 }
 
 # Router families (labeled by backend server), fed by RequestStatsMonitor.

@@ -136,6 +136,13 @@ def build_fake_engine_app(state: FakeEngineState | None = None) -> web.Applicati
             # chunking ever happens — but the counter must exist so the
             # scrape contract matches the real engine.
             (vocab.TPU_PREFILL_CHUNK_TOKENS, 0),
+            # Async KV transfer plane: the fake engine has no remote
+            # store, but the families must exist for the scrape contract
+            # (obs.render_metrics below adds the matching
+            # tpu:remote_kv_fetch/offload_stage histograms).
+            (vocab.TPU_KV_PREFETCH_HIT, 0),
+            (vocab.TPU_KV_PREFETCH_WASTE, 0),
+            (vocab.TPU_KV_PREFETCH_INFLIGHT, 0),
         ]) + state.obs.render_metrics()
 
     async def debug_requests(_request: web.Request) -> web.Response:

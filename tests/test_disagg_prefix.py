@@ -53,7 +53,10 @@ def kv_port():
     t.join(timeout=5)
 
 
-def make_engine(role, port):
+def make_engine(role, port, prefetch=None):
+    """``prefetch=False`` pins the legacy synchronous remote-prefix path
+    (cache.remote_prefetch) for the tests that unit-test it directly;
+    the default exercises the async admission-time prefetch plane."""
     return LLMEngine(EngineConfig(
         model=ModelConfig(dtype="float32"),
         cache=CacheConfig(
@@ -61,6 +64,7 @@ def make_engine(role, port):
             num_blocks=64,
             remote_kv_url=f"kv://127.0.0.1:{port}",
             disagg_role=role,
+            remote_prefetch=prefetch,
         ),
         scheduler=SchedulerConfig(
             max_num_seqs=2, prefill_buckets=(16, 32, 64), max_model_len=128
@@ -74,6 +78,11 @@ PROMPT = "the quick brown fox jumps over the lazy dog again and again"
 def drain(engine, rid, max_tokens=6, close=True):
     engine.add_request(rid, prompt=PROMPT,
                        sampling_params=SamplingParams(max_tokens=max_tokens))
+    # The async prefetch plane resolves the store in the background; the
+    # data-plane assertions here are about WHAT is imported, not when, so
+    # let the in-flight fetch land before stepping (a real serving loop
+    # would simply import on a later pass).
+    engine.flush_prefix_imports()
     tokens = []
     steps = 0
     while engine.has_unfinished():
@@ -225,7 +234,10 @@ def test_malformed_store_entry_leaks_no_blocks(kv_port):
     validated before allocation (advisor r4 finding)."""
     import numpy as np
 
-    engine = make_engine("decode", kv_port)
+    # The sync path validates at the consume site; the async plane's
+    # equivalent (import-time validation) is covered in
+    # tests/test_kv_prefetch.py.
+    engine = make_engine("decode", kv_port, prefetch=False)
     engine.offload.remote_client.close()
 
     class PollutedClient:
@@ -313,7 +325,7 @@ def test_remote_prefix_extension_clamped_to_prompt_minus_one(kv_port):
     num_prompt_tokens - 1 regardless of what the chain covers."""
     from production_stack_tpu.engine.kv.block_pool import _chain_hash
 
-    engine = make_engine("decode", kv_port)
+    engine = make_engine("decode", kv_port, prefetch=False)
     engine.offload.remote_client.close()
     engine.offload.remote_client = _InfiniteStoreClient(engine)
     bs = engine.block_pool.block_size

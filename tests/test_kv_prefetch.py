@@ -1,0 +1,491 @@
+"""Asynchronous batched KV transfer plane (engine/kv/prefetch.py +
+OffloadStager): admission-time remote-prefix prefetch, off-step offload
+staging, async restore page-in, cancellation, and the cross-layer hash
+contract.
+
+The acceptance bar: no kvserver RPC or host-DMA wait is reachable from
+``Scheduler.schedule()`` or the step thread's critical section — a
+200 ms-latency store must not move per-step wall time while a remote
+prefix imports, and an unreachable store must degrade to local-only
+prefill with greedy parity vs ``remote_kv_url=None``.
+"""
+
+import asyncio
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from production_stack_tpu.engine.config import (
+    CacheConfig,
+    EngineConfig,
+    ModelConfig,
+    SchedulerConfig,
+)
+from production_stack_tpu.engine.core.engine import LLMEngine
+from production_stack_tpu.engine.core.sequence import SamplingParams
+from production_stack_tpu.kvserver.server import KVStore, handle_client
+
+
+@pytest.fixture()
+def kv_server_factory():
+    """Start asyncio KV servers on ephemeral ports (optionally with
+    injected per-frame latency) inside one daemon-thread event loop."""
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+
+    def run():
+        asyncio.set_event_loop(loop)
+        started.set()
+        loop.run_forever()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert started.wait(5)
+
+    servers = []
+
+    def start(latency_s: float = 0.0, capacity_bytes: int = 64 << 20):
+        store = KVStore(capacity_bytes)
+        state = {}
+        ready = threading.Event()
+
+        async def boot():
+            server = await asyncio.start_server(
+                lambda r, w: handle_client(store, r, w, latency_s=latency_s),
+                "127.0.0.1", 0,
+            )
+            state["port"] = server.sockets[0].getsockname()[1]
+            servers.append(server)
+            ready.set()
+
+        asyncio.run_coroutine_threadsafe(boot(), loop)
+        assert ready.wait(5)
+        return store, state["port"]
+
+    yield start
+    loop.call_soon_threadsafe(loop.stop)
+    t.join(timeout=5)
+
+
+def make_engine(port=None, role="decode", prefetch=None, num_blocks=96,
+                host_offload_gb=0.0, max_num_seqs=2):
+    return LLMEngine(EngineConfig(
+        model=ModelConfig(dtype="float32"),
+        cache=CacheConfig(
+            block_size=4,
+            num_blocks=num_blocks,
+            remote_kv_url=(
+                f"kv://127.0.0.1:{port}" if port is not None else None
+            ),
+            disagg_role=role if port is not None else None,
+            remote_prefetch=prefetch,
+            host_offload_gb=host_offload_gb,
+        ),
+        scheduler=SchedulerConfig(
+            max_num_seqs=max_num_seqs,
+            prefill_buckets=(16, 32, 64),
+            max_model_len=128,
+            mixed_batch=False,  # deterministic step pattern for timing
+        ),
+    ))
+
+
+PROMPT = "the quick brown fox jumps over the lazy dog again and again"
+
+
+def drain(engine, close=True):
+    tokens = {}
+    steps = 0
+    while engine.has_unfinished():
+        steps += 1
+        assert steps < 500
+        for out in engine.step():
+            tokens.setdefault(out.seq_id, []).append(out.new_token_id)
+    if close and engine.offload.remote_client is not None:
+        engine.offload.remote_client.close()
+    return tokens
+
+
+def fake_chain_entries(engine, num_keys):
+    """Valid wire-shaped snapshot entries for the engine's cache layout:
+    one [1, bs, K, D] block per layer per key."""
+    cfg = engine.config.model
+    bs = engine.block_pool.block_size
+    blk = np.full(
+        (1, bs, cfg.num_kv_heads, cfg.head_dim), 0.25, np.float32
+    )
+    layers = [(blk, blk) for _ in range(cfg.num_layers)]
+    return [(layers, bs) for _ in range(num_keys)]
+
+
+# -- acceptance: schedule() never waits on the store ------------------------
+
+
+def test_step_wall_time_flat_under_slow_store(kv_server_factory):
+    """A 200 ms-per-frame store must not move per-step wall time: the
+    chain fetch rides fetcher threads while admission proceeds
+    local-only, so every step stays well under one RTT."""
+    latency = 0.2
+    store, port = kv_server_factory(latency_s=latency)
+
+    # Warm the store through a prefill-role engine (writer-thread MPUT).
+    producer = make_engine(port, role="prefill")
+    producer.add_request("warm", prompt=PROMPT,
+                         sampling_params=SamplingParams(max_tokens=4))
+    drain(producer, close=False)
+    producer.flush_prefix_exports(timeout=30.0)
+    producer.offload.remote_client.close()
+    assert producer.remote_prefix_blocks_exported > 0
+
+    consumer = make_engine(port, role="decode")
+    # Compile every shape the measured phase touches (different content,
+    # same lengths/batch composition), so timing measures the schedule
+    # loop, not XLA compilation.
+    consumer.add_request(
+        "c0", prompt_token_ids=[(3 * j + 1) % 101 for j in range(48)],
+        sampling_params=SamplingParams(max_tokens=4, ignore_eos=True))
+    consumer.add_request(
+        "c1", prompt_token_ids=[(5 * j + 2) % 101 for j in range(59)],
+        sampling_params=SamplingParams(max_tokens=4, ignore_eos=True))
+    drain(consumer, close=False)
+
+    # Persistent decoder, then the store-warm shared-prefix prompt.
+    consumer.add_request(
+        "dec", prompt_token_ids=[(7 * j + 3) % 101 for j in range(48)],
+        sampling_params=SamplingParams(max_tokens=64, ignore_eos=True))
+    for _ in range(4):
+        consumer.step()
+    consumer.add_request("shared", prompt=PROMPT,
+                         sampling_params=SamplingParams(max_tokens=4))
+    assert consumer.kv_prefetch.inflight >= 1  # fetch is genuinely in flight
+    step_times = []
+    deadline = time.time() + 30.0
+    while consumer.has_unfinished() and time.time() < deadline:
+        t0 = time.perf_counter()
+        consumer.step()
+        step_times.append(time.perf_counter() - t0)
+    assert not consumer.has_unfinished()
+    # Every step (admission of the shared prompt included) finished in a
+    # fraction of one store round-trip: nothing in the loop waited.
+    assert max(step_times) < latency * 0.75, (
+        f"step stalled on the store: max {max(step_times):.3f}s"
+    )
+    consumer.offload.remote_client.close()
+
+
+def test_unreachable_store_matches_local_only_greedy(kv_server_factory):
+    baseline = make_engine(port=None)
+    baseline.add_request("r", prompt=PROMPT,
+                         sampling_params=SamplingParams(max_tokens=6))
+    want = drain(baseline)["r"]
+
+    engine = make_engine(port=9)  # nothing listens on port 9
+    engine.add_request("r", prompt=PROMPT,
+                       sampling_params=SamplingParams(max_tokens=6))
+    engine.flush_prefix_imports(timeout=30.0)
+    got = drain(engine)["r"]
+    assert got == want
+    assert engine.remote_prefix_blocks_fetched == 0
+    assert engine.stats()["kv_prefetch_hit"] == 0
+
+
+def test_prefetch_lands_in_prefix_cache_for_next_pass(kv_server_factory):
+    """An import that completes while its owner is still waiting is
+    consumed through the ordinary match_prefix path on the next
+    scheduling pass — and greedy output matches the no-store engine."""
+    store, port = kv_server_factory()
+    producer = make_engine(port, role="prefill")
+    producer.add_request("warm", prompt=PROMPT,
+                         sampling_params=SamplingParams(max_tokens=6))
+    want = drain(producer, close=False)["warm"]
+    producer.flush_prefix_exports(timeout=30.0)
+    producer.offload.remote_client.close()
+
+    consumer = make_engine(port, role="decode")
+    consumer.add_request("r", prompt=PROMPT,
+                         sampling_params=SamplingParams(max_tokens=6))
+    consumer.flush_prefix_imports(timeout=30.0)
+    got = drain(consumer)["r"]
+    assert got == want
+    assert consumer.remote_prefix_blocks_fetched > 0
+    assert consumer.stats()["kv_prefetch_hit"] > 0
+    # MGET batching: the whole chain moved in one framed round-trip.
+    assert store.ops.get("mget", 0) >= 1
+    assert store.ops.get("get", 0) == 0
+
+
+# -- cancellation -----------------------------------------------------------
+
+
+class _GatedClient:
+    """Chain-fetch stub that blocks until released, then returns valid
+    entries — lets tests abort/finish a request mid-flight."""
+
+    def __init__(self, entries):
+        self.entries = entries
+        self.started = threading.Event()
+        self.release = threading.Event()
+
+    def mget_blocks(self, keys):
+        self.started.set()
+        assert self.release.wait(10)
+        return self.entries[: len(keys)]
+
+
+def test_abort_mid_fetch_releases_staging_no_late_copy_in(kv_server_factory):
+    store, port = kv_server_factory()
+    engine = make_engine(port, role="decode")
+    engine.offload.remote_client.close()
+    gated = _GatedClient(fake_chain_entries(engine, 16))
+    engine.kv_prefetch._client = gated
+
+    free_before = engine.block_pool.num_free_blocks
+    engine.add_request("victim", prompt=PROMPT,
+                       sampling_params=SamplingParams(max_tokens=4))
+    assert gated.started.wait(10)
+    engine.abort_request("victim")
+    gated.release.set()
+    assert engine.kv_prefetch.wait_idle(10.0)
+    # The drain pass must import nothing: the result was cancelled.
+    engine._drain_prefetched()
+    assert engine.block_pool.num_free_blocks == free_before
+    assert engine.remote_prefix_blocks_fetched == 0
+    waste = engine.stats()["kv_prefetch_waste"]
+    assert waste > 0  # staging buffers released and accounted
+    assert engine.stats()["kv_prefetch_hit"] == 0
+
+
+def test_finish_mid_fetch_counts_waste_and_single_remote_del(
+    kv_server_factory,
+):
+    """Request finishes while its chain fetch is still in flight: the
+    late result is dropped, and offload.discard issues AT MOST one
+    remote DEL (none here — the sequence never had a remote snapshot)."""
+    store, port = kv_server_factory()
+    engine = make_engine(port, role="decode")
+    engine.offload.remote_client.close()
+
+    class CountingGated(_GatedClient):
+        def __init__(self, entries):
+            super().__init__(entries)
+            self.deletes = 0
+
+        def delete(self, seq_id):
+            self.deletes += 1
+
+    gated = CountingGated(fake_chain_entries(engine, 16))
+    engine.kv_prefetch._client = gated
+    engine.offload.remote_client = gated
+
+    engine.add_request("r", prompt=PROMPT,
+                       sampling_params=SamplingParams(max_tokens=2))
+    assert gated.started.wait(10)
+    tokens = drain(engine, close=False)["r"]  # finishes before release
+    assert len(tokens) == 2
+    gated.release.set()
+    assert engine.kv_prefetch.wait_idle(10.0)
+    engine._drain_prefetched()
+    assert engine.stats()["kv_prefetch_hit"] == 0
+    assert engine.stats()["kv_prefetch_waste"] > 0
+    # Never offloaded -> _remote_keys empty -> zero DELs; a second
+    # discard of the same id must not add one either.
+    engine.offload.discard("r")
+    assert gated.deletes == 0
+
+
+def test_malformed_prefetched_entry_imports_nothing(kv_server_factory):
+    """Async-plane twin of the sync-path pollution test: malformed store
+    entries are validated at import, freed, and counted as waste — no
+    pool leak, request served by local prefill."""
+    store, port = kv_server_factory()
+    engine = make_engine(port, role="decode")
+    engine.offload.remote_client.close()
+    bad = np.zeros((1, 2, 2), np.float32)
+
+    class Polluted:
+        def mget_blocks(self, keys):
+            return [([(bad, bad)], 4) for _ in keys]
+
+    engine.kv_prefetch._client = Polluted()
+    engine.add_request("r", prompt=PROMPT,
+                       sampling_params=SamplingParams(max_tokens=2))
+    engine.flush_prefix_imports(timeout=30.0)
+    free_before = engine.block_pool.num_free_blocks
+    engine._drain_prefetched()
+    assert engine.block_pool.num_free_blocks == free_before
+    assert engine.remote_prefix_blocks_fetched == 0
+    assert engine.stats()["kv_prefetch_waste"] > 0
+    assert len(drain(engine)["r"]) == 2
+
+
+# -- off-step offload staging ----------------------------------------------
+
+
+def test_offload_stage_completes_off_step(kv_server_factory):
+    """offload_seq_blocks dispatches the gather and returns; the writer
+    thread lands the snapshot (and the remote PUT) afterwards, and
+    restore answers "retry" until it has."""
+    store, port = kv_server_factory()
+    engine = make_engine(port, role=None, host_offload_gb=0.25)
+    engine.add_request("r", prompt=PROMPT,
+                       sampling_params=SamplingParams(max_tokens=8,
+                                                      ignore_eos=True))
+    for _ in range(3):
+        engine.step()
+    seq = engine.scheduler.running[0]
+    assert engine.offload_seq_blocks(seq, list(seq.block_table))
+    assert engine._offload_stager.wait_idle(10.0)
+    entry = engine.offload.restore_local("r")
+    assert entry is not None and entry.num_tokens == seq.num_tokens
+    # The remote tier got the mirrored PUT too.
+    assert engine.offload.remote_client.get_blocks("r") is not None
+    engine.abort_request("r")
+    drain(engine)
+
+
+class _Blocker:
+    """numpy-coercible array that blocks until released — pins the
+    stager's writer inside its D2H copy."""
+
+    def __init__(self, arr, release):
+        self._arr = arr
+        self._release = release
+
+    def __array__(self, dtype=None):
+        assert self._release.wait(10)
+        return np.asarray(self._arr, dtype=dtype)
+
+
+def test_offload_stager_tombstone_and_double_buffer():
+    from production_stack_tpu.engine.kv.offload import (
+        HostOffloadManager,
+        OffloadStager,
+    )
+
+    class CountingClient:
+        def __init__(self):
+            self.puts = 0
+            self.deletes = 0
+
+        def put_blocks(self, seq_id, layers, num_tokens):
+            self.puts += 1
+
+        def delete(self, seq_id):
+            self.deletes += 1
+
+    client = CountingClient()
+    mgr = HostOffloadManager(1 << 20, remote_client=client)
+    stager = OffloadStager(mgr)
+    release = threading.Event()
+    arr = np.zeros((1, 4, 2, 8), np.float32)
+
+    assert stager.reserve("a")
+    stager.commit("a", [(_Blocker(arr, release), _Blocker(arr, release))], 8)
+    assert stager.is_inflight("a")
+    # Double-buffer: the slot is busy, a second preemption falls back.
+    assert not stager.reserve("b")
+    assert stager.skipped == 1
+    # Abort mid-stage: tombstone -> the writer drops the snapshot, no
+    # insert, no remote PUT, and discard issued zero DELs (never stored).
+    stager.discard("a")
+    mgr.discard("a")
+    release.set()
+    assert stager.wait_idle(10.0)
+    assert mgr.restore_local("a") is None
+    assert client.puts == 0
+    assert client.deletes == 0
+
+    # Normal path afterwards: reserve -> commit -> landed + mirrored,
+    # and discard after landing issues exactly ONE remote DEL.
+    release2 = threading.Event()
+    release2.set()
+    assert stager.reserve("c")
+    stager.commit("c", [(arr, arr)], 8)
+    assert stager.wait_idle(10.0)
+    assert mgr.restore_local("c") is not None
+    assert client.puts == 1
+    mgr.discard("c")
+    mgr.discard("c")
+    assert client.deletes == 1
+
+
+def test_async_restore_pages_in_from_remote(kv_server_factory):
+    """A preemption snapshot that only exists in the remote store pages
+    in asynchronously: restore answers "retry" while the fetch is in
+    flight, then "restored" once the fetcher lands it locally."""
+    from production_stack_tpu.kvserver.client import RemoteKVClient
+
+    store, port = kv_server_factory()
+    engine = make_engine(port, role=None, host_offload_gb=0.25)
+    engine.add_request("r", prompt=PROMPT,
+                       sampling_params=SamplingParams(max_tokens=4))
+    seq = engine.scheduler.waiting[0]
+
+    # Fabricate a remote-only snapshot with the engine's cache layout.
+    cfg = engine.config.model
+    bs = engine.block_pool.block_size
+    nb = 3
+    blk = np.full((nb, bs, cfg.num_kv_heads, cfg.head_dim), 0.5, np.float32)
+    layers = [(blk, blk) for _ in range(cfg.num_layers)]
+    side = RemoteKVClient(f"kv://127.0.0.1:{port}")
+    side.put_blocks("r", layers, num_tokens=nb * bs)
+    side.close()
+
+    seq.offloaded = True
+    first = engine.restore_seq_blocks(seq)
+    assert first == "retry"  # fetch submitted, nothing blocked
+    assert engine.kv_prefetch.wait_idle(10.0)
+    second = engine.restore_seq_blocks(seq)
+    assert second == "restored"
+    assert seq.block_table and seq.partial_prefill
+    assert seq.num_cached_tokens == nb * bs
+    seq.offloaded = False
+    tokens = drain(engine)["r"]
+    assert len(tokens) == 4
+
+
+# -- cross-layer hash contract ---------------------------------------------
+
+
+def test_router_and_engine_prefix_keys_byte_identical():
+    """KVAwareRouter (token mode) and the engine's _seq_prefix_hashes
+    must produce byte-identical chains for the same prompt — a silent
+    divergence would steer KV-aware routing to replicas whose store
+    entries never match."""
+    from production_stack_tpu.router.routing.kv_aware import KVAwareRouter
+
+    engine = make_engine(port=None)
+    router = KVAwareRouter(
+        tokenize=engine.tokenizer.encode,
+        token_block_size=engine.block_pool.block_size,
+    )
+    prompt = PROMPT
+    engine.add_request("r", prompt=prompt,
+                       sampling_params=SamplingParams(max_tokens=1))
+    seq = engine.scheduler.waiting[0]
+    engine_chain = engine._seq_prefix_hashes(seq)
+    router_keys = router._prefix_hashes(prompt)
+    assert len(engine_chain) > 2
+    assert router_keys == [digest.hex() for digest in engine_chain]
+    assert [bytes.fromhex(k) for k in router_keys] == list(engine_chain)
+    engine.abort_request("r")
+
+
+def test_metrics_expose_transfer_plane_families(kv_server_factory):
+    """tpu:kv_prefetch_{hit,waste,inflight} + the fetch/stage histograms
+    reach the engine's /metrics exposition."""
+    store, port = kv_server_factory()
+    engine = make_engine(port, role="decode")
+    from production_stack_tpu.router.stats import vocabulary as vocab
+
+    s = engine.stats()
+    for key in ("kv_prefetch_hit", "kv_prefetch_waste",
+                "kv_prefetch_inflight"):
+        assert key in s
+    body = engine.obs.render_metrics()
+    assert "tpu:remote_kv_fetch_seconds_bucket" in body
+    assert "tpu:offload_stage_seconds_bucket" in body
+    assert vocab.TPU_KV_PREFETCH_HIT in vocab.TPU_COUNTERS
+    engine.offload.remote_client.close()

@@ -143,6 +143,169 @@ def test_server_oversize_put_rejected(kv_server):
         sock.close()
 
 
+# -- batched ops (MGET/MPUT) ------------------------------------------------
+
+
+def test_key_and_value_list_roundtrip():
+    keys = [b"a", b"", b"some-longer-key" * 3]
+    assert proto.unpack_key_list(proto.pack_key_list(keys)) == keys
+    values = [b"x" * 100, b"", b"\x00\xff" * 7]
+    assert proto.unpack_value_list(proto.pack_value_list(values)) == values
+
+
+def test_packed_list_rejects_truncation_and_trailing_garbage():
+    packed = proto.pack_key_list([b"alpha", b"beta"])
+    with pytest.raises(ValueError):
+        proto.unpack_key_list(packed[:-1])  # truncated
+    with pytest.raises(ValueError):
+        proto.unpack_key_list(packed + b"x")  # trailing garbage
+    with pytest.raises(ValueError):
+        proto.unpack_key_list(b"")  # shorter than the count header
+    vals = proto.pack_value_list([b"v1", b"v2"])
+    with pytest.raises(ValueError):
+        proto.unpack_value_list(vals[:-1])
+    with pytest.raises(ValueError):
+        proto.unpack_value_list(vals + b"x")
+
+
+def test_mput_mget_roundtrip_over_loopback(kv_server):
+    store, port = kv_server
+    client = RemoteKVClient(f"kv://127.0.0.1:{port}")
+    layers = make_layers(nb=1)
+    client.mput_blocks([(f"chain-{i}", layers, 4 * (i + 1)) for i in range(5)])
+    fetched = client.mget_blocks([f"chain-{i}" for i in range(5)])
+    assert [n for _, n in fetched] == [4, 8, 12, 16, 20]
+    np.testing.assert_array_equal(fetched[0][0][0][0], layers[0][0])
+    # One framed round-trip each way, not one per key.
+    ops = client.stat()["ops"]
+    assert ops["mput"] == 1 and ops["mget"] == 1
+    assert "put" not in ops and "get" not in ops
+    client.close()
+
+
+def test_mget_answers_present_prefix_only(kv_server):
+    """A chain consumer cannot use blocks past the first miss, so the
+    server stops there — even when later keys exist."""
+    store, port = kv_server
+    client = RemoteKVClient(f"kv://127.0.0.1:{port}")
+    layers = make_layers(nb=1)
+    client.mput_blocks([("k0", layers, 1), ("k2", layers, 3)])
+    fetched = client.mget_blocks(["k0", "k1", "k2"])
+    assert [n for _, n in fetched] == [1]
+    assert client.mget_blocks(["missing", "k0"]) == []
+    client.close()
+
+
+def test_mget_malformed_key_list_rejected(kv_server):
+    """A truncated packed key list is answered with ST_ERROR and the
+    connection stays usable for the next well-formed frame."""
+    import socket
+    import struct as _struct
+
+    store, port = kv_server
+    sock = socket.create_connection(("127.0.0.1", port), timeout=5)
+    try:
+        bad = proto.pack_key_list([b"alpha", b"beta"])[:-2]
+        sock.sendall(_struct.pack(
+            "<IBH", proto.MAGIC, proto.OP_MGET, len(bad)) + bad)
+        magic, status, _ = _struct.unpack("<IBQ", sock.recv(13))
+        assert magic == proto.MAGIC and status == proto.ST_ERROR
+        sock.sendall(proto.pack_request(proto.OP_PING, b""))
+        magic, status, _ = _struct.unpack("<IBQ", sock.recv(13))
+        assert magic == proto.MAGIC and status == proto.ST_OK
+    finally:
+        sock.close()
+
+
+def test_mput_oversize_frame_rejected(kv_server):
+    """Same DRAM guard as PUT: an MPUT claiming more than capacity is
+    refused before its bytes are buffered."""
+    import socket
+    import struct as _struct
+
+    store, port = kv_server
+    sock = socket.create_connection(("127.0.0.1", port), timeout=5)
+    try:
+        keys = proto.pack_key_list([b"k"])
+        sock.sendall(
+            _struct.pack("<IBH", proto.MAGIC, proto.OP_MPUT, len(keys))
+            + keys + _struct.pack("<Q", 1 << 41)
+        )
+        magic, status, _ = _struct.unpack("<IBQ", sock.recv(13))
+        assert magic == proto.MAGIC and status == proto.ST_ERROR
+    finally:
+        sock.close()
+
+
+def test_batched_ops_fall_back_against_legacy_server(kv_server):
+    """A server that answers ST_ERROR to MGET/MPUT (e.g. an un-rebuilt
+    native binary) degrades the client to serial per-key ops — same
+    results, support probed exactly once."""
+    store, port = kv_server
+    client = RemoteKVClient(f"kv://127.0.0.1:{port}")
+    real_call = client._call
+
+    def legacy_call(op, key, value=b"", **kwargs):
+        if op in (proto.OP_MGET, proto.OP_MPUT):
+            return proto.ST_ERROR, b""
+        return real_call(op, key, value, **kwargs)
+
+    client._call = legacy_call
+    layers = make_layers(nb=1)
+    client.mput_blocks([("f0", layers, 1), ("f1", layers, 2)])
+    assert not client._batch_ok
+    fetched = client.mget_blocks(["f0", "f1", "f2"])
+    assert [n for _, n in fetched] == [1, 2]
+    ops = client.stat()["ops"]
+    assert ops.get("put") == 2 and ops.get("get") == 3
+    client.close()
+
+
+def test_mput_capacity_rejection_keeps_batching_enabled(kv_server):
+    """An MPUT frame refused by the store's capacity guard is NOT
+    'server does not speak MPUT': the client retries that call serially
+    and keeps batched ops on (the MGET probe disambiguates)."""
+    store, port = kv_server  # capacity 1 MiB
+    client = RemoteKVClient(f"kv://127.0.0.1:{port}")
+    # ~256 KB each, ~1.5 MB aggregate: the batch frame trips the guard,
+    # the individual PUTs do not.
+    big = make_layers(num_layers=2, nb=16, bs=8, K=4, D=32)
+    client.mput_blocks([(f"cap{i}", big, i) for i in range(6)])
+    assert client._batch_ok  # capacity error did not disable batching
+    assert client.get_blocks("cap5") is not None  # serial retry landed
+    ops = client.stat()["ops"]
+    assert ops.get("put") == 6 and ops.get("mget") == 1  # the probe
+    client.close()
+
+
+def test_client_pool_serves_concurrent_threads(kv_server):
+    """The connection pool lets fetcher threads issue RPCs in parallel
+    without serializing on one mutex-guarded socket."""
+    import threading as _threading
+
+    store, port = kv_server
+    client = RemoteKVClient(f"kv://127.0.0.1:{port}", pool_size=4)
+    layers = make_layers(nb=1)
+    client.put_blocks("shared", layers, num_tokens=7)
+    errors = []
+
+    def worker():
+        try:
+            for _ in range(10):
+                assert client.get_blocks("shared") is not None
+        except Exception as e:  # pragma: no cover - failure path
+            errors.append(e)
+
+    threads = [_threading.Thread(target=worker) for _ in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not errors
+    assert client._live <= client.pool_size
+    client.close()
+
+
 # -- offload manager remote tier -------------------------------------------
 
 

@@ -195,6 +195,28 @@ def test_native_oversize_put_rejected_without_buffering(native_server):
 def test_native_stat_json_shape(native_server):
     client = RemoteKVClient(f"kv://127.0.0.1:{native_server}")
     stats = client.stat()
-    assert set(stats) == {"keys", "used_bytes", "capacity_bytes", "hits", "misses"}
+    assert set(stats) == {
+        "keys", "used_bytes", "capacity_bytes", "hits", "misses", "ops",
+    }
     assert json.dumps(stats)  # serializable round-trip
+    assert stats["ops"].get("stat") == 1
+    client.close()
+
+
+def test_native_mput_mget_roundtrip(native_server):
+    """Batched chain ops against the production C++ server: one framed
+    round-trip each way, present-prefix MGET semantics, and per-op frame
+    counters proving no serial fallback happened."""
+    client = RemoteKVClient(f"kv://127.0.0.1:{native_server}")
+    layers = make_layers(nb=1)
+    client.mput_blocks([(f"c{i}", layers, i + 1) for i in range(4)])
+    fetched = client.mget_blocks(["c0", "c1", "c2", "c3"])
+    assert [n for _, n in fetched] == [1, 2, 3, 4]
+    np.testing.assert_array_equal(fetched[0][0][0][0], layers[0][0])
+    # Present prefix: stop at the first missing key.
+    assert [n for _, n in client.mget_blocks(["c0", "nope", "c2"])] == [1]
+    ops = client.stat()["ops"]
+    assert ops.get("mput") == 1 and ops.get("mget") == 2
+    assert "put" not in ops and "get" not in ops
+    assert client._batch_ok  # never degraded to the serial path
     client.close()
