@@ -398,6 +398,25 @@ class SchedulerConfig:
     # bias/guided members fall back to classic stepping.  0 = off.
     # Mutually exclusive with num_scheduler_steps > 1.
     speculative_ngram: int = 0
+    # Bounded admission (overload protection): once the waiting queue
+    # holds this many requests (or prompt tokens), the API server rejects
+    # new work with a structured 429 + Retry-After instead of queueing it
+    # unboundedly (reject early and cheaply at the edge, not time out
+    # expensively in the middle — docs/robustness.md).  None = auto:
+    # max_queued_requests -> 4 x max_num_seqs,
+    # max_queued_tokens   -> 2 x max_num_seqs x max_model_len.
+    max_queued_requests: Optional[int] = None
+    max_queued_tokens: Optional[int] = None
+    # Master gate for bounded admission.  None = auto (ON);
+    # False (--no-admission-control) restores the unbounded legacy
+    # admission exactly (greedy parity asserted in tests/test_overload.py).
+    admission_control: Optional[bool] = None
+    # Step-loop watchdog: /health fails liveness when the engine step
+    # thread has not completed an iteration within this many seconds (a
+    # hung device dispatch otherwise serves a green probe forever).
+    # Generous default: the first XLA compile of a large bucket set can
+    # legitimately take minutes.  0 disables the check.
+    step_watchdog_s: float = 300.0
     # Async one-step-lookahead decode pipeline: dispatch decode step N+1
     # (input tokens = step N's still-in-flight device-resident sample)
     # before reading step N's result back, so host scheduling/detokenize
@@ -440,6 +459,12 @@ class SchedulerConfig:
             self.prefill_chunk_buckets
         ):
             raise ValueError("prefill_chunk_buckets must be sorted ascending")
+        if self.max_queued_requests is not None and self.max_queued_requests < 1:
+            raise ValueError("max_queued_requests must be >= 1")
+        if self.max_queued_tokens is not None and self.max_queued_tokens < 1:
+            raise ValueError("max_queued_tokens must be >= 1")
+        if self.step_watchdog_s < 0:
+            raise ValueError("step_watchdog_s must be >= 0 (0 disables)")
         if (
             self.max_num_batched_tokens is not None
             and self.max_num_batched_tokens
@@ -469,6 +494,27 @@ class SchedulerConfig:
         if self.mixed_batch is None:
             return self.num_scheduler_steps == 1 and not self.speculative_ngram
         return self.mixed_batch
+
+    @property
+    def admission_enabled(self) -> bool:
+        """Resolved bounded-admission gate: auto (None) means ON."""
+        if self.admission_control is None:
+            return True
+        return bool(self.admission_control)
+
+    @property
+    def queued_requests_cap(self) -> int:
+        """Resolved waiting-queue request bound."""
+        if self.max_queued_requests is not None:
+            return self.max_queued_requests
+        return 4 * self.max_num_seqs
+
+    @property
+    def queued_tokens_cap(self) -> int:
+        """Resolved waiting-queue prompt-token bound."""
+        if self.max_queued_tokens is not None:
+            return self.max_queued_tokens
+        return 2 * self.max_num_seqs * self.max_model_len
 
     @property
     def batched_tokens_budget(self) -> int:

@@ -382,6 +382,17 @@ class LLMEngine:
         # removal signal: nonzero means prompts are chunking alongside
         # live decodes instead of stalling them).
         self.prefill_chunk_tokens = 0
+        # Overload-protection counters (docs/robustness.md): requests the
+        # API server shed with a structured 429 (bounded admission), and
+        # requests shed or aborted because their client deadline expired.
+        # deadline_expired is written by the STEP THREAD (queued-expiry
+        # sweep) and deadline_expired_admission by the EVENT LOOP
+        # (admission sheds) — one writer each, because a shared `+= 1`
+        # across threads silently loses increments; stats() reports the
+        # sum.  admission_rejected is event-loop-only.
+        self.admission_rejected = 0
+        self.deadline_expired = 0
+        self.deadline_expired_admission = 0
         self._step_time_accum = 0.0
         # (end_time, duration) of recent steps; duty_cycle = busy fraction
         # of the trailing window (the HPA/dashboard signal, vocabulary.py).
@@ -623,6 +634,21 @@ class LLMEngine:
 
     def has_unfinished(self) -> bool:
         return self.scheduler.has_unfinished()
+
+    def scan_expired_deadlines(self, now: float) -> List[str]:
+        """Ids of WAITING/PREEMPTED sequences whose client deadline has
+        passed.  Pure scan (no aborts): the step loop folds the result
+        into its abort batch so lockstep followers replay the identical
+        aborts instead of evaluating wall clocks that diverge per
+        replica.  Running sequences are exempt — they are streaming
+        tokens, and cutting them is the client's call."""
+        expired = []
+        for queue in (self.scheduler.waiting, self.scheduler.preempted):
+            for seq in queue:
+                d = seq.sampling_params.deadline
+                if d is not None and now > d:
+                    expired.append(seq.seq_id)
+        return expired
 
     # -- stepping ----------------------------------------------------------
 
@@ -2366,6 +2392,14 @@ class LLMEngine:
             # never stalled for them).
             "prefill_chunk_tokens": self.prefill_chunk_tokens,
             "num_preemptions": self.scheduler.num_preemptions,
+            # Overload protection: structured 429s issued by bounded
+            # admission, and requests shed/aborted on an expired client
+            # deadline (docs/robustness.md).
+            "admission_rejected_total": self.admission_rejected,
+            "deadline_expired_total": (
+                self.deadline_expired + self.deadline_expired_admission
+            ),
+            "queued_prompt_tokens": self.scheduler.queued_prompt_tokens,
             # Mean host-side serialization per decode step (ms): time the
             # device sat idle between decode steps.  ≈0 when the lookahead
             # pipeline is feeding the device ahead of collection.

@@ -124,6 +124,11 @@ class Scheduler:
         # (unlike wall-clock arrival_time) the ordering is identical on
         # every lockstep replica of a multi-host group.
         self._admit_counter = 0
+        # Prompt tokens currently held by waiting+preempted sequences,
+        # maintained incrementally so bounded admission can read one int
+        # cross-thread instead of iterating a deque the step thread
+        # mutates (a mid-iteration mutation raises RuntimeError).
+        self.queued_prompt_tokens = 0
 
     # -- admission ---------------------------------------------------------
 
@@ -151,6 +156,7 @@ class Scheduler:
         # keep admission order).  Admission keys are monotone under FCFS,
         # so the all-default case stays a plain append.
         key = (seq.sampling_params.priority, seq._admit_idx)
+        self.queued_prompt_tokens += seq.num_prompt_tokens
         for i, other in enumerate(self.waiting):
             if (other.sampling_params.priority, other._admit_idx) > key:
                 self.waiting.insert(i, seq)
@@ -162,6 +168,7 @@ class Scheduler:
             for seq in list(queue):
                 if seq.seq_id == seq_id:
                     queue.remove(seq)
+                    self.queued_prompt_tokens -= seq.num_prompt_tokens
                     self._release(seq)
                     return seq
         for seq in self.running:
@@ -380,6 +387,7 @@ class Scheduler:
         seq.block_table = prefix_blocks + new_blocks
         if is_final:
             queue.popleft()
+            self.queued_prompt_tokens -= seq.num_prompt_tokens
             seq.status = SequenceStatus.RUNNING
             seq.partial_prefill = False
             self.running.append(seq)
@@ -518,6 +526,7 @@ class Scheduler:
         seq.outputs_absorbed += len(seq.output_token_ids)
         seq.prompt_token_ids = seq.all_token_ids
         seq.output_token_ids = []
+        self.queued_prompt_tokens += seq.num_prompt_tokens
         self.preempted.appendleft(seq)
         logger.debug("Preempted %s (mode=%s)", seq.seq_id, self.config.preemption_mode)
 

@@ -62,6 +62,16 @@ class SamplingParams:
     # guided decoding, engine/guided.py) | {"type": "json_schema",
     # "schema": {...}} (schema-constrained script, engine/guided_schema.py).
     response_format: Union[str, dict, None] = None
+    # Absolute wall-clock deadline (epoch seconds) propagated from the
+    # client (X-Request-Deadline header / `timeout` body field).  The
+    # server sheds at admission when the deadline is unmeetable; the
+    # engine step loop aborts expired WAITING/PREEMPTED sequences so they
+    # stop occupying queue slots and KV blocks (running sequences are
+    # already streaming and are left to the client to cancel).  Lives on
+    # SamplingParams so it rides the lockstep event broadcast unchanged —
+    # only the leader evaluates it, and the resulting aborts are published
+    # like any other (replica-deterministic).
+    deadline: Optional[float] = None
 
 
 @dataclasses.dataclass

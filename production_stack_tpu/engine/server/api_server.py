@@ -25,10 +25,15 @@ from aiohttp import web
 
 from production_stack_tpu.engine.config import EngineConfig, config_from_preset
 from production_stack_tpu.engine.core.sequence import FinishReason, SamplingParams
-from production_stack_tpu.engine.server.async_engine import AsyncEngine
+from production_stack_tpu.engine.server.async_engine import (
+    AsyncEngine,
+    DeadlineExceeded,
+)
 from production_stack_tpu.obs.trace import parse_traceparent
 from production_stack_tpu.router.stats import vocabulary as vocab
+from production_stack_tpu.utils.drain import DrainController
 from production_stack_tpu.utils.log import init_logger
+from production_stack_tpu.utils.net import parse_deadline
 
 logger = logging.getLogger(__name__)
 
@@ -206,9 +211,64 @@ class StopChecker:
         return 0
 
 
-def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
-    app = web.Application()
+def _is_engine_data_plane(request: web.Request) -> bool:
+    """Mutating model-serving work a draining engine must refuse (the
+    same contract as the router's drain middleware): completions,
+    embeddings/rerank/score, tokenize/detokenize, LoRA admin.  GET
+    control-plane surfaces (/health, /ready, /metrics, /debug...) and
+    POST /drain itself stay served throughout."""
+    if request.method not in ("POST", "DELETE") or request.path == "/drain":
+        return False
+    return (
+        request.path.startswith("/v1/")
+        or request.path in ("/rerank", "/score", "/tokenize", "/detokenize")
+        or request.path.startswith("/admin/")
+    )
+
+
+def build_engine_app(
+    engine: AsyncEngine, served_model: str, drain_grace_s: float = 30.0
+) -> web.Application:
+    # Graceful lifecycle: /drain (helm preStop) and SIGTERM (main) both
+    # converge here.  busy = any stream still attached to the engine OR
+    # sequences still decoding.  exit_cb stays None under tests; main()
+    # installs a SIGINT-to-self so the process exits 0 after the drain.
+    drain = DrainController(
+        grace_s=drain_grace_s,
+        busy_fn=lambda: bool(engine._queues) or engine.engine.has_unfinished(),
+    )
+
+    @web.middleware
+    async def drain_gate(request: web.Request, handler):
+        """503 + Connection: close for ALL data-plane work during a drain
+        — one gate instead of per-handler checks, so new endpoints cannot
+        forget it, and the connection is never reused for a pod about to
+        exit."""
+        if drain.draining and _is_engine_data_plane(request):
+            resp = web.json_response(
+                {"error": {"message": "server is draining for shutdown",
+                           "type": "shutting_down", "code": 503}},
+                status=503,
+            )
+            resp.force_close()
+            return resp
+        return await handler(request)
+
+    app = web.Application(middlewares=[drain_gate])
     app["engine"] = engine
+    app["drain"] = drain
+
+    def _watchdog_problem() -> Optional[str]:
+        if not engine.step_thread_healthy:
+            return "engine step thread died"
+        wd = engine.engine.config.scheduler.step_watchdog_s
+        age = engine.last_step_age_s
+        if wd and age > wd:
+            return (
+                f"step loop stalled: last iteration started {age:.1f}s ago "
+                f"(watchdog {wd:.0f}s)"
+            )
+        return None
 
     async def models(_req: web.Request) -> web.Response:
         def card(model_id: str) -> dict:
@@ -227,7 +287,47 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
         return web.json_response({"object": "list", "data": data})
 
     async def health(_req: web.Request) -> web.Response:
-        return web.json_response({"status": "ok"})
+        """Liveness: fails when the step loop is hung or dead (watchdog),
+        NOT during a drain — kubelet killing a draining pod would drop
+        the very streams the drain exists to finish."""
+        problem = _watchdog_problem()
+        if problem is not None:
+            return web.json_response(
+                {"status": "unhealthy", "problem": problem,
+                 "last_step_age_s": engine.last_step_age_s},
+                status=503,
+            )
+        return web.json_response(
+            {"status": "ok", "last_step_age_s": engine.last_step_age_s}
+        )
+
+    async def ready(_req: web.Request) -> web.Response:
+        """Readiness: additionally fails while draining, so k8s pulls the
+        pod from its Service (and the router's discovery drops it) while
+        in-flight streams finish."""
+        if drain.draining:
+            return web.json_response(
+                {"status": "draining", "in_flight_streams": len(engine._queues)},
+                status=503,
+            )
+        problem = _watchdog_problem()
+        if problem is not None:
+            return web.json_response(
+                {"status": "unhealthy", "problem": problem}, status=503
+            )
+        return web.json_response({"status": "ready"})
+
+    async def drain_endpoint(_req: web.Request) -> web.Response:
+        """POST /drain: flip readiness, stop admission, let in-flight
+        streams finish within the grace, then exit (helm preStop hook;
+        SIGTERM lands on the same controller)."""
+        drain.begin()
+        return web.json_response({
+            "draining": True,
+            "in_flight_streams": len(engine._queues),
+            "unfinished_sequences": engine.engine.has_unfinished(),
+            "grace_s": drain.grace_s,
+        })
 
     async def metrics(_req: web.Request) -> web.Response:
         s = engine.stats()
@@ -254,6 +354,11 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
             (vocab.TPU_SPEC_TOKENS_DRAFTED, s["spec_tokens_drafted"]),
             (vocab.TPU_SPEC_TOKENS_ACCEPTED, s["spec_tokens_accepted"]),
             (vocab.TPU_PREFILL_CHUNK_TOKENS, s["prefill_chunk_tokens"]),
+            # Overload protection + step-loop watchdog (docs/robustness.md).
+            (vocab.TPU_ADMISSION_REJECTED, s["admission_rejected_total"]),
+            (vocab.TPU_DEADLINE_EXPIRED, s["deadline_expired_total"]),
+            (vocab.TPU_QUEUED_PROMPT_TOKENS, s["queued_prompt_tokens"]),
+            (vocab.TPU_LAST_STEP_AGE, engine.last_step_age_s),
         ]
         # Latency histogram families (TTFT/ITL/e2e + step phases) ride the
         # same exposition; rendered even at zero observations so the
@@ -507,6 +612,80 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
                 status=400,
             )
 
+        # -- overload protection (docs/robustness.md) ----------------------
+        now = time.time()
+        try:
+            deadline = parse_deadline(request.headers, body, now)
+        except ValueError as e:
+            return web.json_response(
+                {"error": {"message": str(e),
+                           "type": "invalid_request_error"}},
+                status=400,
+            )
+        # Bounded admission: reject early and cheaply at the edge with a
+        # structured 429 instead of queueing unboundedly and timing out
+        # expensively in the middle.
+        rejection = engine.check_admission(
+            n_choices, n_choices * len(prompt_token_ids)
+        )
+        if rejection is not None:
+            engine.engine.admission_rejected += 1
+            return web.json_response(
+                {
+                    "error": {
+                        "message": (
+                            "engine overloaded: "
+                            f"{rejection.queued_requests} requests "
+                            f"({rejection.queued_tokens} prompt tokens) "
+                            "already queued; retry after "
+                            f"{rejection.retry_after_s}s"
+                        ),
+                        "type": "overloaded",
+                        "code": 429,
+                        "detail": dataclasses.asdict(rejection),
+                    }
+                },
+                status=429,
+                headers={"Retry-After": str(rejection.retry_after_s)},
+            )
+
+        def _shed_deadline(why: str, type_: str) -> web.Response:
+            # Event-loop-side counter: the step thread owns
+            # deadline_expired; sharing one attribute across threads
+            # would lose increments (non-atomic +=).
+            engine.engine.deadline_expired_admission += 1
+            return web.json_response(
+                {"error": {"message": why, "type": type_, "code": 504}},
+                status=504,
+            )
+
+        if deadline is not None:
+            params.deadline = deadline
+            if now >= deadline:
+                return _shed_deadline(
+                    "request deadline already expired at admission",
+                    "deadline_expired",
+                )
+            # "Would miss the deadline before first token -> shed now":
+            # conservative wait estimate from the observed median TTFT
+            # scaled by queue depth in batch units.  Only meaningful once
+            # the histogram has real observations; tracing-off engines
+            # skip the estimate and rely on the queued-expiry sweep.
+            ttft_hist = engine.engine.obs.request_hists["ttft"]
+            if ttft_hist.count >= 8:
+                sched_cfg = engine.engine.config.scheduler
+                est_wait = ttft_hist.quantile(0.5) * (
+                    1.0
+                    + engine.engine.scheduler.num_waiting
+                    / max(1, sched_cfg.max_num_seqs)
+                )
+                if now + est_wait > deadline:
+                    return _shed_deadline(
+                        f"deadline unmeetable: estimated {est_wait:.2f}s to "
+                        "first token exceeds the remaining budget",
+                        "deadline_unmeetable",
+                    )
+
         obs = engine.engine.obs
         if obs.enabled:
             # Start the trace only AFTER every validation 400 above: a
@@ -649,11 +828,22 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
             live = [True] * n_choices
             retired = [False] * n_choices  # manually removed from `remaining`
             total_out = 0
+            shed_on_deadline = False
             try:
                 remaining = n_choices
                 while remaining:
                     i, event, error = await queue.get()
                     if error is not None:
+                        if isinstance(error, DeadlineExceeded):
+                            # Expired while queued: the stream is already
+                            # prepared, so surface a structured SSE error
+                            # event (no [DONE] — the stream did not
+                            # complete) instead of a truncated body.
+                            shed_on_deadline = True
+                            await response.write(
+                                f"data: {json.dumps({'error': {'message': str(error), 'type': 'deadline_expired', 'code': 504}})}\n\n".encode()
+                            )
+                            break
                         raise error
                     if event is None:
                         # A choice retired on a stop match was already
@@ -711,7 +901,7 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
                         await response.write(
                             f"data: {json.dumps(final)}\n\n".encode()
                         )
-                if include_usage:
+                if include_usage and not shed_on_deadline:
                     # OpenAI stream_options.include_usage: one extra
                     # final chunk with empty choices carrying the usage
                     # (and no usage anywhere otherwise).
@@ -730,7 +920,8 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
                     await response.write(
                         f"data: {json.dumps(usage_chunk)}\n\n".encode()
                     )
-                await response.write(b"data: [DONE]\n\n")
+                if not shed_on_deadline:
+                    await response.write(b"data: [DONE]\n\n")
                 await response.write_eof()
             except ConnectionResetError:
                 pass  # cleanup below aborts every live choice
@@ -784,9 +975,31 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
             return ("".join(text_parts), logprob_entries, finish_reason,
                     out_tokens, prompt_lp)
 
-        drained = await asyncio.gather(
-            *[drain(i, g) for i, g in enumerate(gens)]
-        )
+        drain_tasks = [
+            asyncio.create_task(drain(i, g)) for i, g in enumerate(gens)
+        ]
+        try:
+            drained = await asyncio.gather(*drain_tasks)
+        except DeadlineExceeded as e:
+            # One choice expired while queued (the engine already released
+            # its state).  The deadline is a WHOLE-REQUEST contract: a
+            # non-streaming response must carry all n choices together,
+            # and past the deadline nobody is waiting for it — so cancel
+            # the sibling drains too (each cancellation closes its
+            # generator, whose finally aborts the choice in-engine, even
+            # ones already running) and shed with a clean 504.  The
+            # engine-side "running sequences are exempt" rule is about
+            # the SWEEP not killing independent streaming requests;
+            # sibling choices of a dead request are not independent.
+            for t in drain_tasks:
+                t.cancel()
+            if obs.enabled:
+                obs.on_abort(request_id)
+            return web.json_response(
+                {"error": {"message": str(e), "type": "deadline_expired",
+                           "code": 504}},
+                status=504,
+            )
         if obs.enabled:
             obs.record_detokenize(request_id, detok_s[0])
         choices = []
@@ -1165,6 +1378,8 @@ def build_engine_app(engine: AsyncEngine, served_model: str) -> web.Application:
 
     app.router.add_get("/v1/models", models)
     app.router.add_get("/health", health)
+    app.router.add_get("/ready", ready)
+    app.router.add_post("/drain", drain_endpoint)
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/debug/requests", debug_requests)
     app.router.add_get("/debug/requests/{request_id}", debug_request)
@@ -1485,6 +1700,35 @@ def main(argv=None) -> None:
     # Multi-LoRA slots (engine/lora.py); adapters load via POST /admin/lora.
     parser.add_argument("--max-loras", type=int, default=0)
     parser.add_argument("--max-lora-rank", type=int, default=16)
+    # Overload protection + graceful lifecycle (docs/robustness.md).
+    parser.add_argument(
+        "--no-admission-control",
+        action="store_true",
+        help="disable bounded admission (the waiting queue then grows "
+        "without bound, exactly the legacy behavior; overload times out "
+        "in the middle instead of being shed with a 429 at the edge)",
+    )
+    parser.add_argument(
+        "--max-queued-requests", type=int, default=None,
+        help="waiting-queue request bound for bounded admission "
+        "(default: 4 x --max-num-seqs)",
+    )
+    parser.add_argument(
+        "--max-queued-tokens", type=int, default=None,
+        help="waiting-queue prompt-token bound for bounded admission "
+        "(default: 2 x --max-num-seqs x --max-model-len)",
+    )
+    parser.add_argument(
+        "--step-watchdog-s", type=float, default=300.0,
+        help="fail /health liveness when the engine step loop has not "
+        "iterated in this many seconds (hung device dispatch); 0 disables",
+    )
+    parser.add_argument(
+        "--drain-grace-s", type=float, default=30.0,
+        help="on SIGTERM or POST /drain: stop admitting (503 + "
+        "Connection: close), flip /ready to 503, let in-flight streams "
+        "finish up to this many seconds, then exit 0",
+    )
     parser.add_argument(
         "--no-tracing",
         action="store_true",
@@ -1560,6 +1804,19 @@ def main(argv=None) -> None:
             "parallel.sequence_parallel_mode": args.sequence_parallel_mode,
             "lora.max_loras": args.max_loras,
             "lora.max_rank": args.max_lora_rank,
+            **(
+                {"scheduler.admission_control": False}
+                if args.no_admission_control else {}
+            ),
+            **(
+                {"scheduler.max_queued_requests": args.max_queued_requests}
+                if args.max_queued_requests is not None else {}
+            ),
+            **(
+                {"scheduler.max_queued_tokens": args.max_queued_tokens}
+                if args.max_queued_tokens is not None else {}
+            ),
+            "scheduler.step_watchdog_s": args.step_watchdog_s,
             "obs.tracing": not args.no_tracing,
             "obs.trace_ring_size": args.trace_ring_size,
         },
@@ -1617,7 +1874,33 @@ def main(argv=None) -> None:
             )
         logger.info("Chat template override: %s", args.chat_template)
     served = args.served_model_name or args.model
-    app = build_engine_app(engine, served)
+    app = build_engine_app(engine, served, drain_grace_s=args.drain_grace_s)
+
+    # Graceful SIGTERM (k8s pod termination): replace aiohttp's
+    # raise-GracefulExit handler with a drain — readiness flips, admission
+    # stops, in-flight streams finish within --drain-grace-s, and the
+    # drain's exit_cb re-enters aiohttp's graceful-exit path via SIGINT so
+    # cleanup_ctx (engine.close) still runs and the process exits 0.
+    # app.on_startup runs AFTER AppRunner.setup registered aiohttp's
+    # handlers, so add_signal_handler here wins.
+    import signal
+
+    async def _install_sigterm(app_: web.Application) -> None:
+        drain = app_["drain"]
+        drain.exit_cb = lambda: os.kill(os.getpid(), signal.SIGINT)
+        loop = asyncio.get_running_loop()
+        try:
+            loop.add_signal_handler(
+                signal.SIGTERM,
+                lambda: (
+                    logger.info("SIGTERM: beginning graceful drain"),
+                    drain.begin(),
+                ),
+            )
+        except (NotImplementedError, RuntimeError):  # non-main thread / win
+            pass
+
+    app.on_startup.append(_install_sigterm)
     logger.info("Starting tpu-engine (%s) on %s:%d", served, args.host, args.port)
     web.run_app(app, host=args.host, port=args.port, access_log=None)
 

@@ -34,6 +34,27 @@ from production_stack_tpu.engine.core.sequence import FinishReason, SamplingPara
 logger = logging.getLogger(__name__)
 
 
+class DeadlineExceeded(Exception):
+    """Raised into a request's event stream when its client deadline
+    expired while the sequence was still waiting/preempted (the step
+    loop's deadline sweep aborted it before it could occupy a batch
+    slot).  The API server maps this to a structured 504."""
+
+
+@dataclasses.dataclass
+class AdmissionRejection:
+    """Why bounded admission refused a request (serialized into the 429
+    body so clients and the router see queue/KV pressure, not a bare
+    status code)."""
+
+    queued_requests: int
+    queued_tokens: int
+    max_queued_requests: int
+    max_queued_tokens: int
+    kv_usage_perc: float
+    retry_after_s: int
+
+
 @dataclasses.dataclass
 class TokenEvent:
     token_id: int
@@ -63,6 +84,19 @@ class AsyncEngine:
         self._shutdown = threading.Event()
         self._wakeup = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # Prompt tokens submitted but not yet drained into the engine by
+        # the step thread (guarded by _lock); bounded admission counts
+        # these beside the scheduler's waiting queue so a burst between
+        # step-loop iterations cannot slip past the caps.
+        self._pending_tokens = 0
+        # True once any request carried a deadline: keeps the per-step
+        # deadline sweep off the hot path for deadline-free serving.
+        self._any_deadlines = False
+        # Watchdog: wall clock of the step loop's most recent iteration
+        # start.  A hung device dispatch (or a wedged collective) stops
+        # the stamp advancing, and /health turns that into a liveness
+        # failure instead of serving a green probe (tpu:last_step_age_seconds).
+        self._last_step_ts: Optional[float] = None
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -94,11 +128,14 @@ class AsyncEngine:
         self._queues[request_id] = queue
         if prompt_token_ids is None:
             prompt_token_ids = self.engine.tokenizer.encode(prompt or "")
+        params = sampling_params or SamplingParams()
+        if params.deadline is not None:
+            self._any_deadlines = True
         with self._lock:
             self._pending.append(
-                (request_id, prompt_token_ids,
-                 sampling_params or SamplingParams(), adapter)
+                (request_id, prompt_token_ids, params, adapter)
             )
+            self._pending_tokens += len(prompt_token_ids)
         self._wakeup.set()
         finished = False
         try:
@@ -130,15 +167,94 @@ class AsyncEngine:
     def stats(self) -> Dict[str, float]:
         return self.engine.stats()
 
+    # -- overload protection / lifecycle reads -----------------------------
+
+    def check_admission(
+        self, n_requests: int, n_tokens: int
+    ) -> Optional[AdmissionRejection]:
+        """Bounded admission (docs/robustness.md): None = admit; otherwise
+        the structured rejection the server turns into a 429.
+
+        Queue depth = scheduler waiting/preempted + submissions the step
+        thread has not drained yet.  The read is advisory (concurrent
+        handlers may interleave between check and submit), but the
+        overshoot is bounded by the handful of requests parsing bodies at
+        once — the queue cannot grow without bound either way."""
+        cfg = self.engine.config.scheduler
+        if not cfg.admission_enabled:
+            return None
+        with self._lock:
+            pending_n = len(self._pending)
+            pending_tok = self._pending_tokens
+        queued_requests = self.engine.scheduler.num_waiting + pending_n
+        queued_tokens = (
+            self.engine.scheduler.queued_prompt_tokens + pending_tok
+        )
+        if (
+            queued_requests + n_requests <= cfg.queued_requests_cap
+            and queued_tokens + n_tokens <= cfg.queued_tokens_cap
+        ):
+            return None
+        # Crude service-rate estimate: each batch generation drains up to
+        # max_num_seqs queued requests; tell the client to come back after
+        # roughly that many "turns".
+        retry_after = max(
+            1, -(-queued_requests // max(1, cfg.max_num_seqs))
+        )
+        return AdmissionRejection(
+            queued_requests=queued_requests,
+            queued_tokens=queued_tokens,
+            max_queued_requests=cfg.queued_requests_cap,
+            max_queued_tokens=cfg.queued_tokens_cap,
+            kv_usage_perc=float(self.engine.block_pool.usage),
+            retry_after_s=min(retry_after, 60),
+        )
+
+    @property
+    def last_step_age_s(self) -> float:
+        """Seconds since the step loop last started an iteration (0.0
+        before the loop boots).  Exported as tpu:last_step_age_seconds;
+        /health fails liveness past scheduler.step_watchdog_s."""
+        ts = self._last_step_ts
+        if ts is None:
+            return 0.0
+        return max(0.0, time.time() - ts)
+
+    @property
+    def step_thread_healthy(self) -> bool:
+        """False only when the step thread died unexpectedly (crashed out
+        of its loop without a shutdown request)."""
+        if self._thread is None or self._shutdown.is_set():
+            return True  # not started yet / clean shutdown in progress
+        return self._thread.is_alive()
+
     # -- engine thread -----------------------------------------------------
 
     def _run_loop(self) -> None:
         logger.info("engine step loop started")
         last_publish = time.time()
         while not self._shutdown.is_set():
+            self._last_step_ts = time.time()
             with self._lock:
                 pending, self._pending = self._pending, []
                 aborts, self._aborts = self._aborts, []
+                self._pending_tokens -= sum(len(p[1]) for p in pending)
+            # Deadline sweep (each scheduler pass): expired waiting/
+            # preempted sequences fold into this iteration's abort batch —
+            # published under lockstep like any client abort, so followers
+            # replay the leader's wall-clock decision instead of making
+            # their own.  The consumer sees DeadlineExceeded, not silence.
+            expired: List[str] = []
+            if self._any_deadlines and self.engine.has_unfinished():
+                expired = [
+                    rid
+                    for rid in self.engine.scan_expired_deadlines(
+                        self._last_step_ts
+                    )
+                    if rid not in aborts
+                ]
+                for rid in expired:
+                    aborts.append(rid)
             if self._lockstep is not None and (
                 pending or aborts or self.engine.has_unfinished()
                 # Idle heartbeat: followers detect a dead leader by event
@@ -160,6 +276,15 @@ class AsyncEngine:
                     aborts=list(aborts),
                 ))
                 last_publish = time.time()
+            for request_id in expired:
+                self.engine.deadline_expired += 1
+                self._emit(
+                    request_id,
+                    DeadlineExceeded(
+                        f"request {request_id} missed its deadline while "
+                        "queued; shed before occupying a batch slot"
+                    ),
+                )
             for request_id in aborts:
                 self.engine.abort_request(request_id)
             for request_id, token_ids, params, adapter in pending:

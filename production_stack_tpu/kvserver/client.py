@@ -19,9 +19,11 @@ serial per-key path — same results, just one RTT per key again.
 from __future__ import annotations
 
 import logging
+import random
 import socket
 import struct
 import threading
+import time
 from typing import List, Optional, Tuple
 from urllib.parse import urlparse
 
@@ -52,8 +54,23 @@ class RemoteKVClient:
 
     # -- socket plumbing ---------------------------------------------------
 
+    # One retry with jittered backoff for transient connect failures (a
+    # store pod mid-restart, a momentary accept-queue overflow): the
+    # jitter keeps a fleet of engines from re-dialing in lockstep.
+    _CONNECT_RETRY_BACKOFF_S = (0.05, 0.15)
+
     def _connect(self) -> socket.socket:
-        sock = socket.create_connection((self.host, self.port), self.timeout)
+        try:
+            sock = socket.create_connection((self.host, self.port), self.timeout)
+        except OSError as e:
+            lo, hi = self._CONNECT_RETRY_BACKOFF_S
+            delay = random.uniform(lo, hi)
+            logger.debug(
+                "KV store connect to %s:%d failed (%s); retrying once in "
+                "%.0f ms", self.host, self.port, e, delay * 1e3,
+            )
+            time.sleep(delay)
+            sock = socket.create_connection((self.host, self.port), self.timeout)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         return sock
 

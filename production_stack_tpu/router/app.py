@@ -17,16 +17,19 @@ from aiohttp import web
 
 from production_stack_tpu.obs.trace import Tracer
 from production_stack_tpu.router import parser as router_parser
+from production_stack_tpu.router.circuit_breaker import CircuitBreaker
 from production_stack_tpu.router.routing import initialize_routing_logic
 from production_stack_tpu.router.service_discovery import (
     DISCOVERY_SERVICE,
     build_service_discovery,
 )
 from production_stack_tpu.router.services.request_service.request import (
+    CIRCUIT_BREAKER,
     CLIENT_SESSION,
     ENGINE_STATS_SCRAPER,
     REQUEST_REWRITER,
     REQUEST_STATS_MONITOR,
+    RETRY_BUDGET,
     ROUTER_TRACER,
 )
 from production_stack_tpu.router.services.request_service.rewriter import (
@@ -35,6 +38,7 @@ from production_stack_tpu.router.services.request_service.rewriter import (
 from production_stack_tpu.router.stats.engine_stats import EngineStatsScraper
 from production_stack_tpu.router.stats.log_stats import log_stats_task
 from production_stack_tpu.router.stats.request_stats import RequestStatsMonitor
+from production_stack_tpu.utils.drain import DRAIN_CONTROLLER, DrainController
 from production_stack_tpu.utils.log import init_logger
 from production_stack_tpu.utils.net import parse_static_aliases, set_ulimit
 from production_stack_tpu.utils.registry import ServiceRegistry
@@ -73,6 +77,20 @@ def initialize_all(app: web.Application, args) -> ServiceRegistry:
     aliases = parse_static_aliases(args.model_aliases) if args.model_aliases else None
     registry.set(REQUEST_REWRITER, get_request_rewriter(args.request_rewriter, aliases))
 
+    # Overload protection + graceful lifecycle (docs/robustness.md).
+    # Breaker disabled (--no-circuit-breaker) leaves the key unset, which
+    # reproduces the pre-breaker proxy path exactly.
+    if not args.no_circuit_breaker:
+        registry.set(
+            CIRCUIT_BREAKER,
+            CircuitBreaker(
+                failure_threshold=args.breaker_failure_threshold,
+                open_base_s=args.breaker_open_s,
+            ),
+        )
+    registry.set(RETRY_BUDGET, args.retry_budget)
+    registry.set(DRAIN_CONTROLLER, DrainController(grace_s=args.drain_grace_s))
+
     # Optional subsystems -------------------------------------------------
     if args.enable_batch_api:
         try:
@@ -110,6 +128,39 @@ def _unavailable(feature: str, exc: ImportError):
     )
 
 
+def _is_data_plane(request: web.Request) -> bool:
+    """POSTed model-serving work (the streams a drain must not accept
+    more of); GET control-plane surfaces (/health, /metrics, /debug...)
+    and POST /drain itself stay served throughout."""
+    return request.method == "POST" and (
+        request.path.startswith("/v1/")
+        or request.path in ("/rerank", "/score", "/tokenize", "/detokenize")
+    )
+
+
+@web.middleware
+async def drain_middleware(request: web.Request, handler):
+    """Graceful lifecycle: reject new data-plane work with 503 +
+    Connection: close while draining, and count in-flight data-plane
+    requests so the drain knows when the last stream finished."""
+    drain = request.app["registry"].get(DRAIN_CONTROLLER)
+    if drain is None or not _is_data_plane(request):
+        return await handler(request)
+    if drain.draining:
+        resp = web.json_response(
+            {"error": {"message": "router is draining for shutdown",
+                       "type": "shutting_down", "code": 503}},
+            status=503,
+        )
+        resp.force_close()
+        return resp
+    drain.inc()
+    try:
+        return await handler(request)
+    finally:
+        drain.dec()
+
+
 @web.middleware
 async def request_id_middleware(request: web.Request, handler):
     """Honor an inbound X-Request-Id (mint one otherwise) and echo it on
@@ -130,7 +181,7 @@ async def request_id_middleware(request: web.Request, handler):
 
 
 def build_app(args, registry: Optional[ServiceRegistry] = None) -> web.Application:
-    app = web.Application(middlewares=[request_id_middleware])
+    app = web.Application(middlewares=[request_id_middleware, drain_middleware])
     app["registry"] = registry if registry is not None else ServiceRegistry()
     app["args"] = args
     initialize_all(app, args)
@@ -160,8 +211,18 @@ def _lifespan(args):
 
     async def ctx(app: web.Application):
         registry: ServiceRegistry = app["registry"]
+        # total=None: streamed responses legitimately run for minutes.
+        # sock_read bounds the gap BETWEEN reads instead: a stalled engine
+        # stream (no chunk for --stream-idle-timeout-s) is torn down and
+        # the teardown propagates to the engine as a disconnect-abort,
+        # instead of leaking the stream (and its engine-side sequence)
+        # forever.
+        idle = args.stream_idle_timeout_s
         session = aiohttp.ClientSession(
-            timeout=aiohttp.ClientTimeout(total=None, sock_connect=30),
+            timeout=aiohttp.ClientTimeout(
+                total=None, sock_connect=30,
+                sock_read=idle if idle and idle > 0 else None,
+            ),
             connector=aiohttp.TCPConnector(limit=0),
         )
         registry.set(CLIENT_SESSION, session)
@@ -202,6 +263,11 @@ def _lifespan(args):
         await scraper.close()
         await discovery.close()
         await session.close()
+        # Bounded sweep for anything still registered with a close()
+        # (dynamically added services, experimental subsystems): each gets
+        # at most the remaining grace instead of hanging shutdown
+        # (utils/registry.py close contract).
+        await registry.close(grace_s=args.drain_grace_s)
 
     return ctx
 
@@ -211,6 +277,34 @@ def main(argv=None) -> None:
     init_logger("production_stack_tpu", args.log_level)
     set_ulimit()
     app = build_app(args)
+
+    # Graceful SIGTERM (k8s pod termination): drain instead of aiohttp's
+    # immediate GracefulExit — /ready flips to 503, new data-plane work is
+    # rejected, in-flight streams finish within --drain-grace-s, then the
+    # drain's exit_cb re-enters aiohttp's graceful-exit path via SIGINT
+    # (cleanup_ctx still runs; exit code 0).  on_startup runs after
+    # AppRunner.setup registered aiohttp's handlers, so this wins SIGTERM.
+    import os
+    import signal
+
+    async def _install_sigterm(app_: web.Application) -> None:
+        drain = app_["registry"].get(DRAIN_CONTROLLER)
+        if drain is None:
+            return
+        drain.exit_cb = lambda: os.kill(os.getpid(), signal.SIGINT)
+        loop = asyncio.get_running_loop()
+        try:
+            loop.add_signal_handler(
+                signal.SIGTERM,
+                lambda: (
+                    logger.info("SIGTERM: beginning graceful drain"),
+                    drain.begin(),
+                ),
+            )
+        except (NotImplementedError, RuntimeError):
+            pass
+
+    app.on_startup.append(_install_sigterm)
     logger.info("Starting tpu-router on %s:%d", args.host, args.port)
     web.run_app(app, host=args.host, port=args.port, access_log=None)
 

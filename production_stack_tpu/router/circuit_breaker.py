@@ -1,0 +1,172 @@
+"""Per-backend circuit breaker for the router's proxy path.
+
+Standard three-state machine (docs/robustness.md "Circuit breaker"):
+
+* **closed** — traffic flows; consecutive connect/5xx failures count up.
+* **open** — entered after ``failure_threshold`` consecutive failures; the
+  backend receives NO traffic until the open window expires.  The window
+  grows exponentially (``open_base_s * 2^(opens-1)``, capped at
+  ``open_max_s``) across consecutive opens, so a persistently dead backend
+  is probed ever more rarely.
+* **half_open** — one probe request is allowed through after the window;
+  success closes the breaker, failure re-opens it with a doubled window.
+
+Engine 429s are *backpressure*, not failures: the engine is alive and
+explicitly shedding, so a 429 resets the failure count (the connect
+succeeded) and instead marks the backend backpressured for ``Retry-After``
+seconds — the routing layer deprioritizes it while alternatives exist, but
+the breaker never opens on it (opening would amplify the overload onto the
+remaining replicas).
+
+Single-event-loop use only (the router is one asyncio loop): no locking.
+Mutating transitions happen in ``on_attempt`` — ``available()`` is the
+pure read the endpoint filter uses, so filtering N candidates cannot burn
+the half-open probe slot of a backend routing then doesn't pick.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, Optional
+
+CLOSED, HALF_OPEN, OPEN = "closed", "half_open", "open"
+# tpu_router:circuit_state gauge encoding.
+STATE_VALUES = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}
+
+
+@dataclasses.dataclass
+class _BackendState:
+    state: str = CLOSED
+    failures: int = 0  # consecutive connect/5xx failures while closed
+    opens: int = 0  # consecutive opens -> exponential window
+    open_until: float = 0.0
+    # While half_open: when a lost probe (client vanished mid-flight)
+    # stops blocking the next one.
+    probe_retry_at: float = 0.0
+    backpressure_until: float = 0.0
+
+
+class CircuitBreaker:
+    def __init__(
+        self,
+        failure_threshold: int = 5,
+        open_base_s: float = 2.0,
+        open_max_s: float = 60.0,
+        probe_timeout_s: float = 30.0,
+        clock=time.time,
+    ):
+        if failure_threshold < 1:
+            raise ValueError("failure_threshold must be >= 1")
+        self.failure_threshold = int(failure_threshold)
+        self.open_base_s = float(open_base_s)
+        self.open_max_s = float(open_max_s)
+        self.probe_timeout_s = float(probe_timeout_s)
+        self._clock = clock
+        self._states: Dict[str, _BackendState] = {}
+
+    def _st(self, url: str) -> _BackendState:
+        st = self._states.get(url)
+        if st is None:
+            st = self._states[url] = _BackendState()
+        return st
+
+    # -- reads (endpoint filtering) ----------------------------------------
+
+    def available(self, url: str) -> bool:
+        """May this backend receive a request right now?  Pure read: an
+        open breaker whose window expired reports available (the probe
+        slot is consumed by on_attempt only if routing picks it)."""
+        st = self._states.get(url)
+        if st is None or st.state == CLOSED:
+            return True
+        now = self._clock()
+        if st.state == OPEN:
+            return now >= st.open_until
+        return now >= st.probe_retry_at  # half_open: probe slot in flight
+
+    def is_backpressured(self, url: str) -> bool:
+        st = self._states.get(url)
+        return st is not None and self._clock() < st.backpressure_until
+
+    def state_value(self, url: str) -> int:
+        st = self._states.get(url)
+        return STATE_VALUES[st.state] if st is not None else 0
+
+    def snapshot(self) -> Dict[str, int]:
+        """url -> state gauge value (tpu_router:circuit_state)."""
+        return {url: STATE_VALUES[st.state] for url, st in self._states.items()}
+
+    def prune(self, live_urls) -> list:
+        """Drop state for backends no longer in discovery; returns the
+        removed urls so the metrics layer can retire their gauge labels.
+        Without this, weeks of pod churn (every rolling update mints new
+        pod IPs) would grow _states and the circuit_state label set
+        without bound."""
+        live = set(live_urls)
+        gone = [url for url in self._states if url not in live]
+        for url in gone:
+            del self._states[url]
+        return gone
+
+    # -- transitions (proxy loop) ------------------------------------------
+
+    def on_attempt(self, url: str) -> bool:
+        """Claim permission to send one request.  Transitions an expired
+        open breaker to half_open and consumes its single probe slot.
+        False = the caller must skip this backend."""
+        st = self._st(url)
+        if st.state == CLOSED:
+            return True
+        now = self._clock()
+        if st.state == OPEN:
+            if now < st.open_until:
+                return False
+            st.state = HALF_OPEN
+            st.probe_retry_at = now + self.probe_timeout_s
+            return True
+        # half_open: one probe at a time, recoverable if the probe is lost.
+        if now < st.probe_retry_at:
+            return False
+        st.probe_retry_at = now + self.probe_timeout_s
+        return True
+
+    def on_success(self, url: str) -> None:
+        st = self._st(url)
+        st.state = CLOSED
+        st.failures = 0
+        st.opens = 0
+
+    def on_failure(self, url: str) -> None:
+        """A connect failure or 5xx response from this backend."""
+        st = self._st(url)
+        now = self._clock()
+        if st.state == HALF_OPEN:
+            self._open(st, now)
+            return
+        st.failures += 1
+        if st.failures >= self.failure_threshold:
+            self._open(st, now)
+
+    def on_backpressure(self, url: str, retry_after_s: Optional[float]) -> None:
+        """An engine 429: reachable but shedding.  Never opens the
+        breaker; clears the consecutive-failure count (the connect
+        succeeded) and deprioritizes the backend for the advertised
+        window (routing weight drop)."""
+        st = self._st(url)
+        if st.state != CLOSED:
+            # A half-open probe answered 429: the backend is back.
+            self.on_success(url)
+            st = self._st(url)
+        st.failures = 0
+        window = retry_after_s if retry_after_s and retry_after_s > 0 else 1.0
+        st.backpressure_until = self._clock() + float(window)
+
+    def _open(self, st: _BackendState, now: float) -> None:
+        st.opens += 1
+        window = min(
+            self.open_max_s, self.open_base_s * (2 ** (st.opens - 1))
+        )
+        st.state = OPEN
+        st.open_until = now + window
+        st.failures = 0

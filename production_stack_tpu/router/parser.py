@@ -74,6 +74,42 @@ def parse_args(argv=None) -> argparse.Namespace:
     parser.add_argument("--engine-stats-interval", type=float, default=10.0)
     parser.add_argument("--request-stats-window", type=float, default=60.0)
 
+    # Overload protection + graceful lifecycle (docs/robustness.md).
+    parser.add_argument(
+        "--no-circuit-breaker",
+        action="store_true",
+        help="disable the per-backend circuit breaker (every request then "
+        "re-probes dead backends at connect-timeout cost — the pre-breaker "
+        "behavior)",
+    )
+    parser.add_argument(
+        "--breaker-failure-threshold", type=int, default=5,
+        help="consecutive connect/5xx failures that open a backend's "
+        "circuit (engine 429s never count — they are backpressure)",
+    )
+    parser.add_argument(
+        "--breaker-open-s", type=float, default=2.0,
+        help="base open window before the first half-open probe; doubles "
+        "per consecutive open (capped at 60s)",
+    )
+    parser.add_argument(
+        "--retry-budget", type=int, default=3,
+        help="max connect-stage failover attempts per request beyond the "
+        "routed backend (bounds failover amplification under overload)",
+    )
+    parser.add_argument(
+        "--stream-idle-timeout-s", type=float, default=300.0,
+        help="tear down a backend stream that produces no bytes for this "
+        "long (stalled engine); the teardown aborts the engine-side "
+        "sequence via disconnect.  0 disables",
+    )
+    parser.add_argument(
+        "--drain-grace-s", type=float, default=30.0,
+        help="on SIGTERM or POST /drain: flip /ready to 503, reject new "
+        "data-plane work with 503 + Connection: close, let in-flight "
+        "streams finish up to this many seconds, then exit 0",
+    )
+
     # Request tracing (production_stack_tpu/obs): per-request span
     # timelines at GET /debug/requests, joined with the engine's at
     # /debug/requests/{id}.
@@ -173,3 +209,11 @@ def validate_args(args: argparse.Namespace) -> None:
         parse_static_aliases(args.model_aliases)
     if args.batch_processor not in ("local",):
         raise ValueError(f"Unknown batch processor {args.batch_processor!r}")
+    if args.breaker_failure_threshold < 1:
+        raise ValueError("--breaker-failure-threshold must be >= 1")
+    if args.breaker_open_s <= 0:
+        raise ValueError("--breaker-open-s must be > 0")
+    if args.retry_budget < 0:
+        raise ValueError("--retry-budget must be >= 0")
+    if args.drain_grace_s < 0:
+        raise ValueError("--drain-grace-s must be >= 0")

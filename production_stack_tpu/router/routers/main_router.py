@@ -14,6 +14,7 @@ from production_stack_tpu.router.services.request_service import route_general_r
 from production_stack_tpu.router.services.request_service.request import (
     ENGINE_STATS_SCRAPER,
 )
+from production_stack_tpu.utils.drain import DRAIN_CONTROLLER
 from production_stack_tpu.version import __version__
 
 routes = web.RouteTableDef()
@@ -76,6 +77,41 @@ async def show_models(request: web.Request) -> web.Response:
 @routes.get("/version")
 async def show_version(request: web.Request) -> web.Response:
     return web.json_response({"version": __version__})
+
+
+@routes.get("/ready")
+async def ready(request: web.Request) -> web.Response:
+    """Readiness: liveness checks PLUS the drain state — a draining
+    router must leave its Service endpoints (so the LB stops sending new
+    work) while /health keeps passing (kubelet must not kill it
+    mid-stream).  docs/robustness.md "Drain sequence"."""
+    registry = request.app["registry"]
+    drain = registry.get(DRAIN_CONTROLLER)
+    if drain is not None and drain.draining:
+        return web.json_response(
+            {"status": "draining", "in_flight": drain.in_flight}, status=503
+        )
+    return await health(request)
+
+
+@routes.post("/drain")
+async def drain_endpoint(request: web.Request) -> web.Response:
+    """Flip readiness, reject new data-plane work, finish in-flight
+    streams within the grace, then exit (helm preStop hook; SIGTERM lands
+    on the same controller)."""
+    registry = request.app["registry"]
+    drain = registry.get(DRAIN_CONTROLLER)
+    if drain is None:
+        return web.json_response(
+            {"error": {"message": "drain controller not initialized"}},
+            status=501,
+        )
+    drain.begin()
+    return web.json_response({
+        "draining": True,
+        "in_flight": drain.in_flight,
+        "grace_s": drain.grace_s,
+    })
 
 
 @routes.get("/health")

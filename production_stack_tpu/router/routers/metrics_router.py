@@ -16,6 +16,7 @@ from production_stack_tpu.obs.histogram import render_labeled_histograms
 from production_stack_tpu.router.service_discovery import DISCOVERY_SERVICE
 from production_stack_tpu.router.services import metrics_service as ms
 from production_stack_tpu.router.services.request_service.request import (
+    CIRCUIT_BREAKER,
     ENGINE_STATS_SCRAPER,
     REQUEST_STATS_MONITOR,
 )
@@ -65,6 +66,21 @@ async def metrics(request: web.Request) -> web.Response:
             ms.num_requests_uncompleted.labels(server=server).set(
                 stats.uncompleted_requests
             )
+
+    breaker = registry.get(CIRCUIT_BREAKER)
+    if breaker is not None:
+        discovery_svc = registry.get(DISCOVERY_SERVICE)
+        if discovery_svc is not None:
+            # Retire breaker state + gauge labels for backends that left
+            # discovery (pod churn would otherwise grow both unboundedly).
+            live = [ep.url for ep in discovery_svc.get_endpoint_info()]
+            for gone in breaker.prune(live):
+                try:
+                    ms.circuit_state.remove(gone)
+                except KeyError:
+                    pass
+        for server, state_value in breaker.snapshot().items():
+            ms.circuit_state.labels(server=server).set(state_value)
 
     scraper = registry.get(ENGINE_STATS_SCRAPER)
     if scraper is not None:

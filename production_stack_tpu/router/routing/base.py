@@ -41,6 +41,30 @@ def require_endpoints(endpoints: List[EndpointInfo]) -> List[EndpointInfo]:
     return endpoints
 
 
+def filter_circuit_available(endpoints: List[EndpointInfo], breaker) -> List[EndpointInfo]:
+    """Drop endpoints whose circuit breaker is open (docs/robustness.md):
+    an opened backend receives NO traffic until a half-open probe
+    succeeds.  When every endpoint is open the empty list propagates to
+    ``require_endpoints`` and the request is shed with a 503 instead of
+    burning connect timeouts on known-dead backends."""
+    if breaker is None:
+        return endpoints
+    return [ep for ep in endpoints if breaker.available(ep.url)]
+
+
+def deprioritize_backpressured(
+    endpoints: List[EndpointInfo], breaker
+) -> List[EndpointInfo]:
+    """Routing weight drop for engines that answered 429 recently: prefer
+    backends that are not shedding, but keep the backpressured set as the
+    candidate pool of last resort (an overloaded engine still beats no
+    engine — it sheds cheaply with another 429)."""
+    if breaker is None:
+        return endpoints
+    relieved = [ep for ep in endpoints if not breaker.is_backpressured(ep.url)]
+    return relieved if relieved else endpoints
+
+
 def lowest_qps_url(
     endpoints: List[EndpointInfo], request_stats: Dict[str, RequestStats]
 ) -> str:

@@ -6,7 +6,7 @@ Extended with the gauges the reference's dashboard charts but never exports
 rate) so one scrape of the router suffices for the whole stack.
 """
 
-from prometheus_client import Gauge
+from prometheus_client import Counter, Gauge
 
 current_qps = Gauge("tpu_router:current_qps", "Sliding-window QPS", ["server"])
 avg_ttft = Gauge("tpu_router:avg_ttft", "Average time-to-first-token (s)", ["server"])
@@ -46,4 +46,15 @@ engine_prefix_cache_hit_rate = Gauge(
 )
 engine_queue_depth = Gauge(
     "tpu_router:engine_num_requests_waiting", "Engine waiting-queue depth", ["server"]
+)
+# Overload protection (docs/robustness.md).
+circuit_state = Gauge(
+    "tpu_router:circuit_state",
+    "Per-backend circuit breaker state (0=closed, 1=half_open, 2=open)",
+    ["server"],
+)
+deadline_expired_total = Counter(
+    "tpu_router:deadline_expired_total",
+    "Requests shed by the router because their deadline expired before "
+    "(or during) backend connect",
 )

@@ -31,14 +31,34 @@ from aiohttp import web
 from production_stack_tpu.obs.trace import make_traceparent, parse_traceparent
 from production_stack_tpu.router.routing import ROUTING_SERVICE
 from production_stack_tpu.router.service_discovery import DISCOVERY_SERVICE
+from production_stack_tpu.utils.net import parse_deadline
 
 logger = logging.getLogger(__name__)
+
+# The read-side idle timeout (ClientSession sock_read tripping between
+# response reads).  ONLY this timeout is exempt from circuit-breaker
+# failure counting and connect-stage failover: the backend accepted the
+# connection and is (possibly slowly) computing.  Connect-stage timeouts
+# (aiohttp ConnectionTimeoutError, also a ServerTimeoutError subclass)
+# must keep counting — a black-holed host that drops SYNs without an RST
+# would otherwise never open its breaker.  getattr: SocketTimeoutError
+# appeared in aiohttp 3.10; older versions collapse both into
+# ServerTimeoutError, where we prefer the breaker-counting side.
+_READ_IDLE_TIMEOUT_EXC = getattr(aiohttp, "SocketTimeoutError", ())
 
 CLIENT_SESSION = "client_session"
 REQUEST_STATS_MONITOR = "request_stats_monitor"
 ENGINE_STATS_SCRAPER = "engine_stats_scraper"
 REQUEST_REWRITER = "request_rewriter"
 ROUTER_TRACER = "router_tracer"
+# Per-backend circuit breaker (router/circuit_breaker.py); absent/None =
+# breaker disabled, reproducing the pre-breaker proxy path exactly.
+CIRCUIT_BREAKER = "circuit_breaker"
+# Per-request connect-stage retry budget (int): at most 1 + budget
+# backends are tried, so failover cannot amplify an overload across the
+# whole fleet.  Absent = unbounded (legacy behavior, and what bare-registry
+# unit tests get).
+RETRY_BUDGET = "retry_budget"
 
 # Headers that must not be forwarded either direction: hop-by-hop headers,
 # plus encoding headers — aiohttp's client auto-decompresses the backend body
@@ -62,7 +82,13 @@ _HOP_BY_HOP = {
     # header twice (dict keys are case-sensitive, the wire is not).
     "x-request-id",
     "traceparent",
+    # Deadline header: normalized to absolute epoch seconds and re-stamped
+    # explicitly (the inbound value may be the one we minted from a
+    # `timeout` body field).
+    "x-request-deadline",
 }
+
+
 
 
 def _forward_headers(headers) -> Dict[str, str]:
@@ -137,6 +163,25 @@ async def route_general_request(
             tracer.finish(request_id, error=why, status=resp.status)
         return resp
 
+    # Deadline propagation: shed requests whose deadline already expired
+    # in the router's own queue — forwarding them would waste an engine
+    # batch slot on an answer nobody is waiting for.
+    try:
+        deadline = parse_deadline(request.headers, body_json, in_router_time)
+    except ValueError as e:
+        return _reject(_error_response(400, str(e)), "bad_deadline")
+    if deadline is not None and time.time() >= deadline:
+        from production_stack_tpu.router.services import metrics_service as ms
+
+        ms.deadline_expired_total.inc()
+        return _reject(
+            _error_response(
+                504, "request deadline expired in the router queue",
+                "deadline_expired",
+            ),
+            "deadline_expired",
+        )
+
     discovery = registry.require(DISCOVERY_SERVICE)
     endpoints = [ep for ep in discovery.get_endpoint_info() if not ep.sleep]
     scraper = registry.get(ENGINE_STATS_SCRAPER)
@@ -164,6 +209,30 @@ async def route_general_request(
             ),
             "model_not_found",
         )
+
+    # Circuit breaker: opened backends receive no traffic (a half-open
+    # probe-ready backend passes the filter; the probe slot is consumed in
+    # process_request when routing actually picks it).  Backpressured
+    # engines (recent 429) lose routing weight while alternatives exist.
+    breaker = registry.get(CIRCUIT_BREAKER)
+    if breaker is not None:
+        from production_stack_tpu.router.routing.base import (
+            deprioritize_backpressured,
+            filter_circuit_available,
+        )
+
+        available = filter_circuit_available(endpoints, breaker)
+        if not available:
+            return _reject(
+                _error_response(
+                    503,
+                    f"All serving engines for model '{requested_model}' "
+                    "have open circuit breakers",
+                    "circuit_open",
+                ),
+                "circuit_open",
+            )
+        endpoints = deprioritize_backpressured(available, breaker)
 
     engine_stats = scraper.get_engine_stats() if scraper else {}
     monitor = registry.get(REQUEST_STATS_MONITOR)
@@ -212,6 +281,7 @@ async def route_general_request(
         in_router_time=in_router_time,
         background=background,
         fallback_urls=fallback_urls,
+        deadline=deadline,
     )
 
 
@@ -226,16 +296,20 @@ async def process_request(
     in_router_time: float,
     background: Optional[Any] = None,
     fallback_urls: Optional[list] = None,
+    deadline: Optional[float] = None,
 ) -> web.StreamResponse:
     """Open one backend stream and relay chunks, feeding the stats lifecycle
     (reference process_request, request.py:44-117).
 
     ``fallback_urls``: tried in order when the routed backend fails at the
-    connect stage (before any response byte).  Mid-stream failures never
-    fail over — the client already holds partial state."""
+    connect stage (before any response byte), capped by the per-request
+    retry budget so failover cannot amplify an overload.  Mid-stream
+    failures never fail over — the client already holds partial state."""
     registry = request.app["registry"]
     monitor = registry.get(REQUEST_STATS_MONITOR)
     session: aiohttp.ClientSession = registry.require(CLIENT_SESSION)
+    breaker = registry.get(CIRCUIT_BREAKER)
+    retry_budget = registry.get(RETRY_BUDGET)
     tracer = registry.get(ROUTER_TRACER)
     if tracer is not None and not tracer.enabled:
         tracer = None
@@ -243,6 +317,11 @@ async def process_request(
 
     headers = _forward_headers(request.headers)
     headers["x-request-id"] = request_id
+    if deadline is not None:
+        # Normalized absolute form, whatever the client sent (header or
+        # `timeout` body field) — the engine enforces it at admission and
+        # in its scheduler-pass sweep.
+        headers["x-request-deadline"] = repr(float(deadline))
     if trace is not None:
         # Propagate the trace context so the engine's timeline joins this
         # one under the same trace id (/debug/requests/{id}).
@@ -253,6 +332,12 @@ async def process_request(
         headers["traceparent"] = request.headers["traceparent"]
 
     candidates = [server_url] + list(fallback_urls or [])
+    if retry_budget is not None:
+        # Retry budget: the routed backend + at most `retry_budget`
+        # failover attempts.  Under a fleet-wide brownout, unbounded
+        # failover would replay every request against every backend —
+        # multiplying the very load that caused the failures.
+        candidates = candidates[: 1 + max(0, int(retry_budget))]
     collected: list = []
     want_store = background is not None
     # First connect attempt's start: router.queue must end HERE, not at
@@ -261,6 +346,24 @@ async def process_request(
     first_connect0: Optional[float] = None
 
     for attempt, url in enumerate(candidates):
+        if deadline is not None and attempt > 0 and time.time() >= deadline:
+            # Failover burned the remaining budget: shed instead of
+            # handing a dead-on-arrival request to the next backend.
+            from production_stack_tpu.router.services import (
+                metrics_service as ms,
+            )
+
+            ms.deadline_expired_total.inc()
+            if tracer is not None:
+                tracer.finish(request_id, error="deadline_expired", server=url)
+            return _error_response(
+                504, "request deadline expired during connect-stage failover",
+                "deadline_expired",
+            )
+        if breaker is not None and not breaker.on_attempt(url):
+            # Open circuit (or a half-open probe already in flight):
+            # skip without counting a failure.
+            continue
         if monitor:
             monitor.on_new_request(url, request_id, in_router_time)
         first_chunk_seen = False
@@ -298,6 +401,21 @@ async def process_request(
                 headers=headers,
             ) as backend:
                 t_connected = time.time()
+                if breaker is not None:
+                    if backend.status == 429:
+                        # Engine shedding: backpressure, never a breaker
+                        # failure (routing weight drops instead).
+                        try:
+                            retry_after = float(
+                                backend.headers.get("Retry-After", "")
+                            )
+                        except (TypeError, ValueError):
+                            retry_after = None
+                        breaker.on_backpressure(url, retry_after)
+                    elif backend.status >= 500:
+                        breaker.on_failure(url)
+                    else:
+                        breaker.on_success(url)
                 if monitor:
                     monitor.on_backend_connected(url, request_id, t_connected)
                 resp_headers = _forward_headers(backend.headers)
@@ -371,6 +489,16 @@ async def process_request(
         except (aiohttp.ClientError, ConnectionResetError) as e:
             if monitor:
                 monitor.on_request_failed(url, request_id, time.time())
+            idle_timeout = isinstance(e, _READ_IDLE_TIMEOUT_EXC)
+            if breaker is not None and not idle_timeout:
+                # sock_read idle timeouts are deliberately NOT breaker
+                # failures: the backend accepted the connection — it may
+                # just be slow (first XLA compile of a bucket can take
+                # minutes with zero response bytes).  The per-stream
+                # teardown is the remedy; opening the circuit would cut
+                # ALL traffic to a healthy-but-compiling backend.
+                # Connect-stage timeouts DO count (see _READ_IDLE_TIMEOUT_EXC).
+                breaker.on_failure(url)
             if response is not None:
                 # Mid-stream failure: the client already has a partial
                 # body; terminate the stream (reference behavior, SURVEY.md
@@ -382,6 +510,27 @@ async def process_request(
                         request_id, error="mid_stream_failure", server=url
                     )
                 raise
+            if idle_timeout:
+                # The backend accepted the request and is mid-compute
+                # (headers not sent yet: a long non-streaming generation
+                # past --stream-idle-timeout-s).  Failing over would
+                # re-execute the WHOLE completion on another engine while
+                # the first keeps decoding until the disconnect-abort
+                # lands — duplicated generation load, not recovery.  Shed
+                # to the client instead.
+                logger.warning(
+                    "Backend %s idle-read timeout before response headers "
+                    "(%s); shedding instead of replaying", url, e,
+                )
+                if tracer is not None:
+                    _fail_spans()
+                    tracer.finish(request_id, error="backend_timeout", server=url)
+                return _error_response(
+                    504,
+                    "Serving engine produced no response bytes within the "
+                    "idle-read timeout",
+                    "backend_timeout",
+                )
             if attempt + 1 < len(candidates):
                 logger.warning(
                     "Backend %s unreachable (%s); failing over to %s",
@@ -412,3 +561,12 @@ async def process_request(
             except Exception:
                 logger.exception("post-response background hook failed")
         return response
+
+    # Every candidate was skipped without an attempt (circuit open on all
+    # of them, or the failover list ran dry on breaker skips alone).
+    if tracer is not None:
+        tracer.finish(request_id, error="circuit_open")
+    return _error_response(
+        503, "All serving engines for this model have open circuit breakers",
+        "circuit_open",
+    )

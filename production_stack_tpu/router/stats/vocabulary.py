@@ -70,6 +70,15 @@ TPU_DECODE_HOST_GAP_MS = "tpu:decode_host_gap_ms"
 # store is slower than admission).
 TPU_KV_PREFETCH_INFLIGHT = "tpu:kv_prefetch_inflight"
 
+# Step-loop watchdog (gauge): seconds since the engine step thread last
+# started an iteration.  A hung device dispatch stops it advancing; the
+# engine's /health fails liveness past scheduler.step_watchdog_s, so k8s
+# restarts a wedged engine instead of probing it green forever.
+TPU_LAST_STEP_AGE = "tpu:last_step_age_seconds"
+# Prompt tokens held by waiting+preempted sequences (gauge): the queue
+# depth bounded admission enforces, in tokens.
+TPU_QUEUED_PROMPT_TOKENS = "tpu:queued_prompt_tokens"
+
 # The custom metric the prometheus-adapter exposes for HPA (reference:
 # observability/prom-adapter.yaml:8-20 exposes vllm:num_requests_waiting).
 HPA_QUEUE_METRIC = TPU_NUM_REQUESTS_WAITING
@@ -99,6 +108,11 @@ TPU_PREFILL_CHUNK_TOKENS = "tpu:prefill_chunk_tokens"
 # tpu:remote_kv_fetch_seconds for the latency the plane is hiding.
 TPU_KV_PREFETCH_HIT = "tpu:kv_prefetch_hit"
 TPU_KV_PREFETCH_WASTE = "tpu:kv_prefetch_waste"
+# Overload protection (docs/robustness.md): requests shed by bounded
+# admission with a structured 429, and requests shed/aborted because
+# their client deadline expired before first token.
+TPU_ADMISSION_REJECTED = "tpu:admission_rejected_total"
+TPU_DEADLINE_EXPIRED = "tpu:deadline_expired_total"
 TPU_COUNTERS = frozenset({
     TPU_TOTAL_PROMPT_TOKENS,
     TPU_TOTAL_GENERATED_TOKENS,
@@ -111,6 +125,8 @@ TPU_COUNTERS = frozenset({
     TPU_PREFILL_CHUNK_TOKENS,
     TPU_KV_PREFETCH_HIT,
     TPU_KV_PREFETCH_WASTE,
+    TPU_ADMISSION_REJECTED,
+    TPU_DEADLINE_EXPIRED,
 })
 
 

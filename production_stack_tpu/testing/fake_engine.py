@@ -36,6 +36,9 @@ class FakeEngineState:
         ttft: float = 0.02,
         max_tokens_default: int = 100,
         seed: int = 0,
+        capacity: int | None = None,
+        max_queued: int = 0,
+        admission_control: bool = True,
     ):
         self.model = model
         self.tokens_per_sec = tokens_per_sec
@@ -58,6 +61,70 @@ class FakeEngineState:
         # Headers of the most recent completion request (trace-propagation
         # assertions in tests).
         self.last_headers: dict = {}
+        # -- overload / lifecycle model (docs/robustness.md) ---------------
+        # ``capacity`` models max_num_seqs: with it set, per-token
+        # intervals scale with in-flight/capacity (a deterministic
+        # oversubscription-degrades-ITL model — the signal the
+        # shed-vs-no-shed tier-1 test measures without a TPU), and
+        # bounded admission 429s once in-flight exceeds
+        # capacity + max_queued.  capacity=None keeps the legacy
+        # constant-rate fake exactly.
+        self.capacity = capacity
+        self.max_queued = max_queued
+        self.admission_control = admission_control
+        self.admission_rejected = 0  # tpu:admission_rejected_total
+        self.deadline_expired = 0  # tpu:deadline_expired_total
+        # Deterministic fault-injection surface (FakeEngineState.inject):
+        # kind -> params.  Counted kinds decrement per use; count=-1 means
+        # "until cleared".
+        self.injections: dict = {}
+        # Request ids whose handler was torn down mid-stream (client/router
+        # disconnect or cancellation) — the abort-propagation assertions.
+        self.aborted_requests: list = []
+        self.draining = False
+        # Completion-handler entries BEFORE any injection fires: counts
+        # every connection the router actually made (the breaker tests'
+        # "an open backend receives no traffic" assertion).
+        self.data_plane_hits = 0
+
+    def inject(self, kind: str, **params) -> None:
+        """Arm a fault: ``refuse`` (close the connection pre-response;
+        count=N or -1), ``error_5xx`` (status=503, count=N),
+        ``reject_429`` (retry_after=1, count=N), ``stall_stream``
+        (after_tokens=K: emit K chunks then hang until torn down),
+        ``slow_admission`` (delay_s before the first byte)."""
+        if kind not in (
+            "refuse", "error_5xx", "reject_429", "stall_stream",
+            "slow_admission",
+        ):
+            raise ValueError(f"unknown injection kind {kind!r}")
+        params.setdefault("count", -1)
+        self.injections[kind] = dict(params)
+
+    def clear_injection(self, kind: str) -> None:
+        self.injections.pop(kind, None)
+
+    def _take_injection(self, kind: str):
+        """Params if the fault is armed (consuming one count), else None."""
+        inj = self.injections.get(kind)
+        if inj is None or inj["count"] == 0:
+            return None
+        if inj["count"] > 0:
+            inj["count"] -= 1
+        return inj
+
+    @property
+    def in_flight(self) -> int:
+        return self.num_running + self.num_waiting
+
+    def token_interval(self) -> float:
+        """Current per-token interval: degrades linearly once in-flight
+        work oversubscribes capacity (the deterministic ITL model the
+        overload tests measure)."""
+        base = 1.0 / self.tokens_per_sec
+        if self.capacity:
+            return base * max(1.0, self.in_flight / self.capacity)
+        return base
 
     def note_prompt(self, prompt_text: str) -> None:
         """Rough prefix-cache simulation so hit-rate metrics move in CI."""
@@ -111,7 +178,21 @@ def build_fake_engine_app(state: FakeEngineState | None = None) -> web.Applicati
         )
 
     async def health(_request: web.Request) -> web.Response:
-        return web.json_response({"status": "ok"})
+        return web.json_response({"status": "ok", "last_step_age_s": 0.0})
+
+    async def ready(_request: web.Request) -> web.Response:
+        if state.draining:
+            return web.json_response(
+                {"status": "draining", "in_flight_streams": state.num_running},
+                status=503,
+            )
+        return web.json_response({"status": "ready"})
+
+    async def drain_endpoint(_request: web.Request) -> web.Response:
+        state.draining = True
+        return web.json_response(
+            {"draining": True, "in_flight_streams": state.num_running}
+        )
 
     async def metrics(_request: web.Request) -> web.Response:
         # Same serializer + same names as the real engine server
@@ -121,9 +202,15 @@ def build_fake_engine_app(state: FakeEngineState | None = None) -> web.Applicati
         return web.Response(text=text)
 
     def _render_metrics_pairs(state: FakeEngineState) -> str:
+        # With a capacity model, "waiting" is the oversubscription beyond
+        # capacity (queue-depth gauge the overload tests assert on).
+        waiting = (
+            max(0, state.num_running - state.capacity)
+            if state.capacity else state.num_waiting
+        )
         return vocab.render_prometheus([
             (vocab.TPU_NUM_REQUESTS_RUNNING, state.num_running),
-            (vocab.TPU_NUM_REQUESTS_WAITING, state.num_waiting),
+            (vocab.TPU_NUM_REQUESTS_WAITING, waiting),
             (vocab.TPU_HBM_KV_USAGE_PERC, state.kv_usage),
             (vocab.TPU_PREFIX_CACHE_HIT_RATE, state.prefix_hit_rate),
             (vocab.TPU_HOST_KV_USAGE_PERC, 0.0),
@@ -143,6 +230,13 @@ def build_fake_engine_app(state: FakeEngineState | None = None) -> web.Applicati
             (vocab.TPU_KV_PREFETCH_HIT, 0),
             (vocab.TPU_KV_PREFETCH_WASTE, 0),
             (vocab.TPU_KV_PREFETCH_INFLIGHT, 0),
+            # Overload protection + watchdog families (scrape contract
+            # parity with the real engine; the fake engine's "step loop"
+            # is the event loop, so its age is always fresh).
+            (vocab.TPU_ADMISSION_REJECTED, state.admission_rejected),
+            (vocab.TPU_DEADLINE_EXPIRED, state.deadline_expired),
+            (vocab.TPU_QUEUED_PROMPT_TOKENS, 0),
+            (vocab.TPU_LAST_STEP_AGE, 0.0),
         ]) + state.obs.render_metrics()
 
     async def debug_requests(_request: web.Request) -> web.Response:
@@ -184,6 +278,33 @@ def build_fake_engine_app(state: FakeEngineState | None = None) -> web.Applicati
         obs.tracer.finish(request_id, end=t_end)
 
     async def _completion_common(request: web.Request, chat: bool) -> web.StreamResponse:
+        # -- fault injection + overload surface (docs/robustness.md) ------
+        state.data_plane_hits += 1
+        if state.draining:
+            resp = web.json_response(
+                {"error": {"message": "server is draining for shutdown",
+                           "type": "shutting_down", "code": 503}},
+                status=503,
+            )
+            resp.force_close()
+            return resp
+        inj = state._take_injection("refuse")
+        if inj is not None:
+            # Connect-stage failure as the router sees it: the transport
+            # dies before any response byte (ServerDisconnectedError).
+            if request.transport is not None:
+                request.transport.close()
+            raise ConnectionResetError("injected connection refusal")
+        inj = state._take_injection("error_5xx")
+        if inj is not None:
+            return web.json_response(
+                {"error": {"message": "injected backend failure",
+                           "type": "internal_error"}},
+                status=int(inj.get("status", 503)),
+            )
+        inj = state._take_injection("slow_admission")
+        if inj is not None:
+            await asyncio.sleep(float(inj.get("delay_s", 0.2)))
         body = await request.json()
         state.last_headers = dict(request.headers)
         stream = bool(body.get("stream", False))
@@ -192,6 +313,56 @@ def build_fake_engine_app(state: FakeEngineState | None = None) -> web.Applicati
             or body.get("max_completion_tokens")
             or state.max_tokens_default
         )
+        # Deadline contract parity with the real engine server: an
+        # already-expired propagated deadline is shed with a 504.
+        deadline_hdr = request.headers.get("x-request-deadline")
+        if deadline_hdr is not None:
+            try:
+                deadline = float(deadline_hdr)
+            except (TypeError, ValueError):
+                deadline = None
+            if deadline is not None and time.time() >= deadline:
+                state.deadline_expired += 1
+                return web.json_response(
+                    {"error": {"message": "request deadline already "
+                               "expired at admission",
+                               "type": "deadline_expired", "code": 504}},
+                    status=504,
+                )
+        inj = state._take_injection("reject_429")
+        retry_after = int(inj.get("retry_after", 1)) if inj is not None else None
+        if retry_after is None and (
+            state.admission_control
+            and state.capacity
+            and state.in_flight >= state.capacity + state.max_queued
+        ):
+            retry_after = max(1, state.in_flight // state.capacity)
+        if retry_after is not None:
+            state.admission_rejected += 1
+            return web.json_response(
+                {
+                    "error": {
+                        "message": "engine overloaded: "
+                                   f"{state.in_flight} requests in flight",
+                        "type": "overloaded",
+                        "code": 429,
+                        "detail": {
+                            "queued_requests": max(
+                                0,
+                                state.in_flight - (state.capacity or 0),
+                            ),
+                            "max_queued_requests": state.max_queued,
+                            "kv_usage_perc": state.kv_usage,
+                        },
+                    }
+                },
+                status=429,
+                headers={"Retry-After": str(retry_after)},
+            )
+        stall_after = None
+        inj = state._take_injection("stall_stream")
+        if inj is not None:
+            stall_after = int(inj.get("after_tokens", 1))
         if chat:
             prompt_text = json.dumps(body.get("messages", ""))
         else:
@@ -215,7 +386,6 @@ def build_fake_engine_app(state: FakeEngineState | None = None) -> web.Applicati
         state.num_running += 1
         state.total_prompt_tokens += max(1, len(prompt_text) // 4)
         try:
-            interval = 1.0 / state.tokens_per_sec
             object_name = "chat.completion.chunk" if chat else "text_completion"
             if stream:
                 response = web.StreamResponse(
@@ -253,7 +423,13 @@ def build_fake_engine_app(state: FakeEngineState | None = None) -> web.Applicati
                         )
                     )
                     state.total_generated_tokens += 1
-                    await asyncio.sleep(interval)
+                    if stall_after is not None and i + 1 >= stall_after:
+                        # Injected stall: the stream hangs byte-less until
+                        # the peer (router sock_read timeout, client
+                        # disconnect) tears it down — the CancelledError
+                        # lands in the abort tracking below.
+                        await asyncio.Event().wait()
+                    await asyncio.sleep(state.token_interval())
                     now = time.time()
                     if state.obs.enabled and i > 0:
                         state.obs.request_hists["itl"].observe(now - t_last)
@@ -286,6 +462,7 @@ def build_fake_engine_app(state: FakeEngineState | None = None) -> web.Applicati
                 return response
             await asyncio.sleep(state.ttft)
             t_first = time.time()
+            interval = state.token_interval()
             await asyncio.sleep(max_tokens * interval)
             text = " ".join(_word(state._rng) for _ in range(max_tokens))
             state.total_generated_tokens += max_tokens
@@ -321,11 +498,22 @@ def build_fake_engine_app(state: FakeEngineState | None = None) -> web.Applicati
                 },
                 headers={"X-Request-Id": request_id},
             )
+        except (asyncio.CancelledError, ConnectionResetError):
+            # The peer tore the stream down (client disconnect, router
+            # idle-read timeout, proxy teardown): record the abort so
+            # propagation tests can assert the engine-side release
+            # happened, then re-raise — cancellation must not be eaten.
+            state.aborted_requests.append(request_id)
+            if state.obs.enabled:
+                state.obs.on_abort(request_id)
+            raise
         finally:
             state.num_running -= 1
 
     app.router.add_get("/v1/models", models)
     app.router.add_get("/health", health)
+    app.router.add_get("/ready", ready)
+    app.router.add_post("/drain", drain_endpoint)
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/debug/requests", debug_requests)
     app.router.add_get("/debug/requests/{request_id}", debug_request)

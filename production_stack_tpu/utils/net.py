@@ -74,3 +74,31 @@ def set_ulimit(target_soft_limit: int = 65535) -> None:
         logger.warning(
             "Could not raise RLIMIT_NOFILE from %d to %d: %s", soft, target_soft_limit, e
         )
+
+
+def parse_deadline(headers, body, now: float) -> Optional[float]:
+    """Per-request deadline contract, shared by the router and the engine
+    server (docs/robustness.md): an ``X-Request-Deadline`` header carries
+    absolute epoch seconds (what the router propagates) and wins over an
+    OpenAI ``timeout``-style body field (seconds from now).  Returns an
+    absolute epoch float or None; raises ValueError on malformed input.
+    One definition on purpose — two copies of this parsing would let the
+    router and engine silently diverge on the client-facing contract."""
+    hdr = headers.get("x-request-deadline") if headers is not None else None
+    if hdr is not None:
+        try:
+            return float(hdr)
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"X-Request-Deadline must be epoch seconds, got {hdr!r}"
+            ) from None
+    timeout = (body or {}).get("timeout")
+    if timeout is None:
+        return None
+    if isinstance(timeout, bool) or not isinstance(timeout, (int, float)):
+        raise ValueError(
+            f"'timeout' must be a number of seconds, got {timeout!r}"
+        )
+    if timeout <= 0:
+        raise ValueError(f"'timeout' must be > 0 seconds, got {timeout}")
+    return now + float(timeout)

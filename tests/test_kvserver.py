@@ -354,3 +354,85 @@ def test_offload_discard_skips_remote_when_unknown(kv_server):
 
     mgr = HostOffloadManager(capacity_bytes=1 << 20, remote_client=ExplodingClient())
     mgr.discard("nope")  # no snapshot anywhere: no RPC
+
+
+# -- client robustness (PR 5 satellites) -------------------------------------
+
+
+def test_client_connect_retries_once_with_jittered_backoff(kv_server, monkeypatch):
+    """A transient connect failure (store pod mid-restart) is retried
+    once after a jittered backoff instead of failing the whole op."""
+    import socket as socket_mod
+
+    from production_stack_tpu.kvserver import client as client_mod
+
+    store, port = kv_server
+    real_connect = socket_mod.create_connection
+    calls = []
+
+    def flaky_connect(addr, timeout=None):
+        calls.append(addr)
+        if len(calls) == 1:
+            raise ConnectionRefusedError("transient")
+        return real_connect(addr, timeout)
+
+    monkeypatch.setattr(client_mod.socket, "create_connection", flaky_connect)
+    client = RemoteKVClient(f"kv://127.0.0.1:{port}")
+    assert client.ping()  # first dial fails, the retry lands
+    assert len(calls) == 2
+    client.close()
+
+
+def test_client_connect_retry_exhausted_raises(monkeypatch):
+    """Both dials failing surfaces the error (no infinite retry loop)."""
+    from production_stack_tpu.kvserver import client as client_mod
+
+    calls = []
+
+    def dead_connect(addr, timeout=None):
+        calls.append(addr)
+        raise ConnectionRefusedError("down")
+
+    monkeypatch.setattr(client_mod.socket, "create_connection", dead_connect)
+    monkeypatch.setattr(client_mod.time, "sleep", lambda s: None)
+    client = RemoteKVClient("kv://127.0.0.1:9")
+    with pytest.raises(OSError):
+        client.get_blocks("k")
+    assert len(calls) == 2  # exactly one retry
+    assert not client.ping()
+
+
+def test_poisoned_pool_socket_discarded_not_reused(kv_server):
+    """A socket that errors mid-frame is closed and dropped from the
+    pool — the next op gets a FRESH connection instead of reading the
+    poisoned stream's leftovers."""
+    store, port = kv_server
+    client = RemoteKVClient(f"kv://127.0.0.1:{port}", pool_size=1)
+    layers = make_layers()
+    client.put_blocks("p1", layers, num_tokens=4)
+    assert client._live == 1 and len(client._idle) == 1
+    poisoned = client._idle[0]
+
+    real_recv = RemoteKVClient._recv_exact
+    state = {"armed": True}
+
+    def mid_frame_error(self, sock, n):
+        if state["armed"]:
+            state["armed"] = False
+            raise ConnectionError("mid-frame desync")
+        return real_recv(self, sock, n)
+
+    RemoteKVClient._recv_exact = mid_frame_error
+    try:
+        with pytest.raises(ConnectionError):
+            client.get_blocks("p1")
+    finally:
+        RemoteKVClient._recv_exact = real_recv
+    # Poisoned socket: closed, out of the pool, live count released.
+    assert poisoned.fileno() == -1
+    assert client._idle == [] and client._live == 0
+    # Next op transparently opens a fresh connection and succeeds.
+    fetched = client.get_blocks("p1")
+    assert fetched is not None and fetched[1] == 4
+    assert client._idle and client._idle[0] is not poisoned
+    client.close()
