@@ -30,7 +30,6 @@ import json
 import logging
 import re
 import statistics
-import sys
 import time
 from typing import Dict, List, Optional
 

@@ -259,6 +259,7 @@ class CacheConfig:
     block_size: int = 16  # tokens per block
     num_blocks: Optional[int] = None  # None -> sized from HBM fraction
     hbm_utilization: float = 0.90  # fraction of free HBM for weights+KV
+    # stackcheck: allow=SC401 reason=prefix caching has been the default-on contract since the seed; the safe rollback is the explicit opt-out (--no-prefix-caching), and the KV-transfer plane auto-disables itself when this is off
     enable_prefix_caching: bool = True
     # Host-DRAM offload tier (the reference's LMCache CPU-offload analogue,
     # deployment-vllm-multi.yaml:161-166).
@@ -551,6 +552,7 @@ class ObsConfig:
     trace allocations per step) — the pre-tracing hot path, verified by
     tests/test_observability.py."""
 
+    # stackcheck: allow=SC401 reason=tracing default-on is the PR-2 contract (--no-tracing restores the untraced fast path, verified by a zero-state + greedy-parity test)
     tracing: bool = True
     # Completed request timelines kept per component (bounds /debug memory).
     trace_ring_size: int = 256

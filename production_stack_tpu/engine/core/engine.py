@@ -665,6 +665,7 @@ class LLMEngine:
         """A dispatched step is awaiting collection."""
         return bool(self._pending)
 
+    # stackcheck: root=step-thread
     def dispatch(self) -> bool:
         """Launch device work without reading anything back, filling the
         pipeline to its depth (2 with lookahead, 1 otherwise).  Returns
@@ -682,6 +683,7 @@ class LLMEngine:
             launched = True
         return launched
 
+    # stackcheck: root=step-thread
     def collect(self) -> List[StepOutput]:
         """Block on the oldest dispatched step and finalize it: append
         sampled tokens, run finish checks, and roll back rows whose
@@ -732,6 +734,7 @@ class LLMEngine:
         self._step_time_accum += busy
         self._busy_window.append((now, busy))
         cutoff = now - self._busy_window_s
+        # stackcheck: allow=SC201 reason=duty-cycle window trim; feeds the tpu:duty_cycle metric only, never a plan (replicas may report different utilization, they may not schedule differently)
         self._busy_window = [(t, d) for (t, d) in self._busy_window if t > cutoff]
         return outputs
 
@@ -755,12 +758,14 @@ class LLMEngine:
             # faster than the worker threads can land the bytes.  The
             # device is idle here — this is backoff, not a data wait.
             if self._transfer_inflight():
+                # stackcheck: allow=SC101 reason=1ms idle backoff while async transfers land; the device is idle here by definition (nothing scheduled) so this is pacing, not a data wait
                 time.sleep(0.001)
             return False
         if plan.prefill is not None:
             outputs = self._run_prefill(plan.prefill)
             self._step_counter += 1
             self._pending.append(
+                # stackcheck: allow=SC201 reason=host_s is a stats field (host-gap metric); no plan state reads it
                 _PendingStep(outputs=outputs, host_s=time.time() - t0)
             )
             return True
@@ -771,6 +776,7 @@ class LLMEngine:
             # next pure-decode plan.
             outputs = self._run_mixed(plan.mixed)
             self._step_counter += 1
+            # stackcheck: allow=SC201 reason=host_s is a stats field (host-gap metric); no plan state reads it
             self._pending.append(_PendingStep(
                 outputs=outputs, is_decode=True, host_s=time.time() - t0,
             ))
@@ -781,6 +787,7 @@ class LLMEngine:
         else:
             outputs = self._run_decode(plan.decode)
             self._step_counter += 1
+            # stackcheck: allow=SC201 reason=host_s is a stats field (host-gap metric); no plan state reads it
             self._pending.append(_PendingStep(
                 outputs=outputs, is_decode=True, host_s=time.time() - t0,
             ))
@@ -837,6 +844,7 @@ class LLMEngine:
         retired with the device left idle.  Lookahead dispatches count a
         zero gap by construction (the device was still busy)."""
         if self._last_decode_end is not None:
+            # stackcheck: allow=SC201 reason=gap bookkeeping feeds tpu:decode_host_gap_ms only; no plan state reads it
             self._gap_total_s += max(0.0, time.time() - self._last_decode_end)
             self._gap_steps += 1
         self._last_decode_end = None
@@ -935,6 +943,7 @@ class LLMEngine:
             logits, temps, top_ps, top_ks, step_key, seeds, min_p=min_ps,
         )
         self._step_counter += 1
+        # stackcheck: allow=SC201 reason=host_s is a stats field (host-gap metric); no plan state reads it
         return _PendingStep(
             seqs=list(seqs), sampled=sampled, is_decode=True,
             host_s=time.time() - t0,
@@ -1105,6 +1114,7 @@ class LLMEngine:
         ):
             seq._px_prefetch_memo = memo
 
+    # stackcheck: root=step-thread
     def _drain_prefetched(self) -> None:
         """Step-thread landing point for completed prefetches: import the
         staged host blocks into freshly allocated pool blocks (async
@@ -1206,6 +1216,7 @@ class LLMEngine:
             return prefix_blocks, cached_len
         return self._fetch_remote_prefix_sync(seq, prefix_blocks, cached_len)
 
+    # stackcheck: boundary=step-thread reason=legacy sync fetch path, only reachable with cache.remote_prefetch=False (--no-remote-prefetch A/B baseline); blocking GETs inside the scheduler callback are its documented contract
     def _fetch_remote_prefix_sync(self, seq, prefix_blocks, cached_len):
         """Legacy synchronous remote-prefix extension: one blocking GET
         per block INSIDE the scheduler callback.  Returns the possibly
@@ -1318,6 +1329,7 @@ class LLMEngine:
                 return
             time.sleep(0.01)
 
+    # stackcheck: allow=SC201 reason=the TTL-keyed export dedupe gates only store-side export traffic; the local plan never reads it, and duplicate exports across replicas are idempotent content-keyed PUTs
     def _export_prefix_blocks(self, seq) -> None:
         """After a final prefill: push every full prompt block to the
         shared store under its chain-hash content key, so peer engines
@@ -1551,6 +1563,7 @@ class LLMEngine:
             b *= 2
         return min(b, self._smax)
 
+    # stackcheck: root=step-thread
     def _run_mixed(self, mixed) -> List[StepOutput]:
         """One fused step over the packed [decode bucket + chunk bucket]
         token batch: every running sequence decodes exactly as in
@@ -2277,6 +2290,7 @@ class LLMEngine:
             )
         return True
 
+    # stackcheck: boundary=step-thread reason=legacy sync offload path, only reachable with cache.remote_prefetch=False; the inline D2H wait + remote PUT is its documented A/B-baseline contract
     def _offload_seq_blocks_sync(
         self, seq: Sequence, block_ids: List[int]
     ) -> bool:

@@ -197,6 +197,7 @@ class Scheduler:
                 return bucket
         return None
 
+    # stackcheck: root=step-thread
     def schedule(self) -> StepPlan:
         """With ``mixed_batch`` on and sequences decoding, emit a fused
         decode+prefill-chunk plan so arriving prompts never stall the

@@ -22,7 +22,7 @@ choice.
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Optional, Tuple
+from typing import Optional, Tuple
 
 WS = b" \t\n\r"
 DIGITS = b"0123456789"

@@ -34,8 +34,6 @@ from typing import List, Optional, Tuple
 
 from production_stack_tpu.engine.guided import (
     DONE,
-    FSMState,
-    LIT,
     NUM,
     _N_TERMINAL,
     closure_cost as value_closure_cost,

@@ -61,6 +61,17 @@ class HostOffloadManager:
         # or remote fetch): bounds discard() to one DEL for those only —
         # never a blocking RPC for sequences that were never offloaded.
         self._remote_keys: set = set()
+        # Remote DELs run on a dedicated deleter thread: discard() is
+        # called from the step thread (abort/finish), and a synchronous
+        # DEL there pays a full kvserver round-trip while every decoder
+        # stalls — the exact PR-4 invariant (no kvserver RPC reachable
+        # from the step thread) stackcheck rule SC101 enforces.  At-most-
+        # one-DEL-per-seq is preserved: _remote_keys membership is still
+        # consumed under the lock before the enqueue.
+        self._del_queue: Optional[queue.Queue] = None
+        self._del_thread: Optional[threading.Thread] = None
+        self._del_pending = 0
+        self._del_cv = threading.Condition(self._lock)
         self.saves = 0
         self.restores = 0
         self.evictions = 0
@@ -142,6 +153,7 @@ class HostOffloadManager:
                 self.restores += 1
             return entry
 
+    # stackcheck: boundary=step-thread reason=legacy sync restore, only reachable with cache.remote_prefetch=False; the async plane pages in via restore_local + PrefetchManager.submit_restore instead
     def restore(self, seq_id: str) -> Optional[OffloadEntry]:
         """Local tier first, then a BLOCKING remote fetch (legacy path;
         kept for remote_prefetch=False compatibility)."""
@@ -216,7 +228,9 @@ class HostOffloadManager:
         including the remote store, or the shared cache leaks one snapshot
         per finished sequence forever.  At most ONE remote DEL per seq:
         _remote_keys membership is consumed under the lock before the
-        RPC."""
+        enqueue.  The DEL itself runs on the deleter thread (discard is
+        a step-thread call — see __init__); a DEL lost to process exit
+        leaks one store entry, which the store's own eviction reclaims."""
         with self._lock:
             entry = self._entries.pop(seq_id, None)
             if entry is not None:
@@ -224,10 +238,45 @@ class HostOffloadManager:
             known_remote = seq_id in self._remote_keys
             self._remote_keys.discard(seq_id)
         if self.remote_client is not None and known_remote:
+            self._enqueue_delete(seq_id)
+
+    def _enqueue_delete(self, seq_id: str) -> None:
+        with self._lock:
+            if self._del_thread is None:
+                self._del_queue = queue.Queue()
+                self._del_thread = threading.Thread(
+                    target=self._delete_worker, name="kv-remote-del",
+                    daemon=True,
+                )
+                self._del_thread.start()
+            self._del_pending += 1
+        self._del_queue.put(seq_id)
+
+    def _delete_worker(self) -> None:
+        while True:
+            seq_id = self._del_queue.get()
             try:
                 self.remote_client.delete(seq_id)
             except Exception:
-                logger.debug("remote KV delete failed for %s", seq_id, exc_info=True)
+                logger.debug(
+                    "remote KV delete failed for %s", seq_id, exc_info=True
+                )
+            finally:
+                with self._del_cv:
+                    self._del_pending -= 1
+                    self._del_cv.notify_all()
+
+    def wait_deletes(self, timeout: float = 10.0) -> bool:
+        """Block until queued remote DELs have resolved (tests; drain).
+        True when the queue went idle within the timeout."""
+        deadline = time.monotonic() + timeout
+        with self._del_cv:
+            while self._del_pending:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._del_cv.wait(remaining)
+            return True
 
     def _evict_oldest(self) -> None:
         oldest = min(self._entries.values(), key=lambda e: e.saved_at)
@@ -293,6 +342,7 @@ class OffloadStager:
     def commit(self, seq_id: str, device_layers, num_tokens: int) -> None:
         """Hand the dispatched device gathers to the writer thread."""
         self.staged += 1
+        # stackcheck: allow=SC201 reason=timestamp rides to the writer thread for the tpu:offload_stage_seconds histogram only
         self._q.put((seq_id, device_layers, num_tokens, time.time()))
 
     def discard(self, seq_id: str) -> None:

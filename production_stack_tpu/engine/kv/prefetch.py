@@ -37,7 +37,7 @@ import logging
 import queue
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List
 
 logger = logging.getLogger(__name__)
 

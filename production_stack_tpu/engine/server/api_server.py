@@ -23,7 +23,7 @@ from typing import Optional
 import numpy as np
 from aiohttp import web
 
-from production_stack_tpu.engine.config import EngineConfig, config_from_preset
+from production_stack_tpu.engine.config import config_from_preset
 from production_stack_tpu.engine.core.sequence import FinishReason, SamplingParams
 from production_stack_tpu.engine.server.async_engine import (
     AsyncEngine,

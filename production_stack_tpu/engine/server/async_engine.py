@@ -112,6 +112,17 @@ class AsyncEngine:
         self._wakeup.set()
         if self._thread is not None:
             await asyncio.to_thread(self._thread.join, 30)
+        # Remote KV DELs run on a daemon deleter thread (discard() only
+        # enqueues — see HostOffloadManager); flush them before exit or a
+        # drain that finishes the last stream drops the queued DELs and
+        # leaks one store snapshot per in-flight discard.
+        offload = getattr(self.engine, "offload", None)
+        if offload is not None and offload.remote_client is not None:
+            if not await asyncio.to_thread(offload.wait_deletes, 10.0):
+                logger.warning(
+                    "remote KV DELs still pending at shutdown; the store "
+                    "leaks those snapshots until its own eviction"
+                )
 
     # -- request API (event-loop side) ------------------------------------
 
@@ -230,6 +241,7 @@ class AsyncEngine:
 
     # -- engine thread -----------------------------------------------------
 
+    # stackcheck: root=step-thread
     def _run_loop(self) -> None:
         logger.info("engine step loop started")
         last_publish = time.time()
@@ -336,6 +348,7 @@ class AsyncEngine:
                     fatal_exit(1)
                     return  # unreachable except under monkeypatched exit
                 logger.exception("engine step failed")
+                # stackcheck: allow=SC101 reason=error backoff after a failed step; the device produced nothing to wait for and hammering a failing dispatch would spin the log
                 time.sleep(0.1)
                 continue
             for out in outputs:

@@ -44,7 +44,7 @@ dtype codes: 0=float32, 1=bfloat16(stored as u16), 2=float16, 3=int8.
 from __future__ import annotations
 
 import struct
-from typing import List, Optional, Tuple
+from typing import List, Tuple
 
 import numpy as np
 

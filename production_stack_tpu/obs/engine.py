@@ -108,6 +108,7 @@ class EngineObs:
 
     # -- request lifecycle (engine step thread) ----------------------------
 
+    # stackcheck: allow=SC201 reason=observability timeline math; the whole obs layer is plan-inert by contract (tracing=False removes it entirely and greedy parity is asserted in tests)
     def on_first_scheduled(self, seq, now: Optional[float] = None) -> None:
         """First prefill chunk launched: the queue-wait span ends here."""
         if not self.enabled:
@@ -130,6 +131,7 @@ class EngineObs:
             return
         self.request_hists["itl"].observe(gap)
 
+    # stackcheck: allow=SC201 reason=observability timeline math; the whole obs layer is plan-inert by contract (tracing=False removes it entirely and greedy parity is asserted in tests)
     def on_finish(self, seq, now: Optional[float] = None) -> None:
         """Single finish hook (called from _finish_seq_now): e2e + decode
         histograms, the decode span, and trace completion."""

@@ -1,0 +1,376 @@
+"""Metric-family registry: the single source of truth for every
+``tpu:`` / ``tpu_router:`` Prometheus family the stack exports.
+
+SURVEY §4 makes the stats plane the backbone of the serving stack: the
+router's scraper, the Grafana dashboard, the prometheus-adapter/HPA rule
+and the CI fake engine all key off these names.  Before this registry the
+contract lived in four places at once (vocabulary.py, fake_engine.py,
+observability/tpu-dashboard.json, docs/observability.md) and drifted
+silently — a renamed family broke dashboards without failing any test.
+
+stackcheck rule family SC3 (tools/stackcheck/rules_metrics.py) verifies
+this file against all four surfaces in both directions on every CI run:
+every entry must have an emit site, and every emitted/plotted/documented
+family must have an entry.  **Adding a metric family starts HERE** — see
+docs/static-analysis.md#adding-a-metric-family for the checklist.
+
+Entry shape (plain literals only; stackcheck AST-parses this file and
+never imports it, so the registry stays loadable in a bare CI venv):
+
+    "tpu:family_name": {
+        "kind": "gauge" | "counter" | "histogram",
+        "layer": "engine" | "router",
+        "mirrors": (surfaces that MUST reference the family:
+                    "fake_engine", "dashboard", "docs"),
+        "source_name": optional — the literal as written in source when
+                    it differs from the exposition name (prometheus_client
+                    exposes Counter("x") as x_total),
+        "labels": optional tuple of label names,
+        "help": one-line meaning,
+    }
+
+Histogram families expose ``<name>_bucket/_sum/_count`` series; the
+registry stores the base name and stackcheck normalizes suffixes.
+"""
+
+from __future__ import annotations
+
+REGISTRY = {
+    # -- engine gauges (vocabulary.py, rendered by api_server + fake) ------
+    "tpu:num_requests_running": {
+        "kind": "gauge", "layer": "engine",
+        "mirrors": ("fake_engine", "dashboard", "docs"),
+        "help": "Sequences in the running (decode) set",
+    },
+    "tpu:num_requests_waiting": {
+        "kind": "gauge", "layer": "engine",
+        "mirrors": ("fake_engine", "dashboard", "docs"),
+        "help": "Waiting + preempted queue depth (the HPA signal)",
+    },
+    "tpu:hbm_kv_usage_perc": {
+        "kind": "gauge", "layer": "engine",
+        "mirrors": ("fake_engine", "dashboard", "docs"),
+        "help": "Paged-KV HBM pool usage (0-1)",
+    },
+    "tpu:prefix_cache_hit_rate": {
+        "kind": "gauge", "layer": "engine",
+        "mirrors": ("fake_engine", "dashboard", "docs"),
+        "help": "Rolling prefix-cache hit rate (0-1)",
+    },
+    "tpu:host_kv_usage_perc": {
+        "kind": "gauge", "layer": "engine",
+        "mirrors": ("fake_engine", "dashboard", "docs"),
+        "help": "Host-DRAM offload tier usage (0-1)",
+    },
+    "tpu:duty_cycle": {
+        "kind": "gauge", "layer": "engine",
+        "mirrors": ("fake_engine", "dashboard", "docs"),
+        "help": "Busy fraction of the trailing window (TPU utilization)",
+    },
+    "tpu:decode_host_gap_ms": {
+        "kind": "gauge", "layer": "engine",
+        "mirrors": ("fake_engine", "dashboard", "docs"),
+        "help": "Mean host-side serialization per decode step (pipeline "
+                "health; ~0 with one-step lookahead active)",
+    },
+    "tpu:loaded_loras": {
+        "kind": "gauge", "layer": "engine",
+        "mirrors": ("fake_engine", "dashboard", "docs"),
+        "help": "Live LoRA adapters",
+    },
+    "tpu:kv_prefetch_inflight": {
+        "kind": "gauge", "layer": "engine",
+        "mirrors": ("fake_engine", "dashboard", "docs"),
+        "help": "Remote chain fetches currently in flight",
+    },
+    "tpu:last_step_age_seconds": {
+        "kind": "gauge", "layer": "engine",
+        "mirrors": ("fake_engine", "dashboard", "docs"),
+        "help": "Step-loop watchdog age; /health fails past step_watchdog_s",
+    },
+    "tpu:queued_prompt_tokens": {
+        "kind": "gauge", "layer": "engine",
+        "mirrors": ("fake_engine", "dashboard", "docs"),
+        "help": "Prompt tokens held by waiting+preempted sequences (the "
+                "bound admission enforces)",
+    },
+    # -- engine counters ---------------------------------------------------
+    "tpu:total_prompt_tokens": {
+        "kind": "counter", "layer": "engine",
+        "mirrors": ("fake_engine", "dashboard", "docs"),
+        "help": "Prompt tokens prefilled since boot",
+    },
+    "tpu:total_generated_tokens": {
+        "kind": "counter", "layer": "engine",
+        "mirrors": ("fake_engine", "dashboard", "docs"),
+        "help": "Tokens sampled since boot",
+    },
+    "tpu:total_finished_requests": {
+        "kind": "counter", "layer": "engine",
+        "mirrors": ("fake_engine", "dashboard", "docs"),
+        "help": "Requests finished since boot",
+    },
+    "tpu:num_preemptions": {
+        "kind": "counter", "layer": "engine",
+        "mirrors": ("fake_engine", "dashboard", "docs"),
+        "help": "Sequences preempted under pool pressure",
+    },
+    "tpu:remote_prefix_blocks_fetched": {
+        "kind": "counter", "layer": "engine",
+        "mirrors": ("fake_engine", "dashboard", "docs"),
+        "help": "KV blocks imported from the shared store (disagg_role)",
+    },
+    "tpu:remote_prefix_blocks_exported": {
+        "kind": "counter", "layer": "engine",
+        "mirrors": ("fake_engine", "dashboard", "docs"),
+        "help": "KV blocks pushed to the shared store (disagg_role)",
+    },
+    "tpu:spec_tokens_drafted": {
+        "kind": "counter", "layer": "engine",
+        "mirrors": ("fake_engine", "dashboard", "docs"),
+        "help": "N-gram speculative tokens drafted",
+    },
+    "tpu:spec_tokens_accepted": {
+        "kind": "counter", "layer": "engine",
+        "mirrors": ("fake_engine", "dashboard", "docs"),
+        "help": "N-gram speculative tokens accepted (rate = accepted/drafted)",
+    },
+    "tpu:prefill_chunk_tokens": {
+        "kind": "counter", "layer": "engine",
+        "mirrors": ("fake_engine", "dashboard", "docs"),
+        "help": "Prompt tokens prefilled inside fused mixed steps",
+    },
+    "tpu:kv_prefetch_hit": {
+        "kind": "counter", "layer": "engine",
+        "mirrors": ("fake_engine", "dashboard", "docs"),
+        "help": "KV blocks imported into the prefix cache by remote prefetch",
+    },
+    "tpu:kv_prefetch_waste": {
+        "kind": "counter", "layer": "engine",
+        "mirrors": ("fake_engine", "dashboard", "docs"),
+        "help": "Prefetched KV blocks fetched then dropped unused",
+    },
+    "tpu:admission_rejected_total": {
+        "kind": "counter", "layer": "engine",
+        "mirrors": ("fake_engine", "dashboard", "docs"),
+        "help": "Structured 429s from bounded admission",
+    },
+    "tpu:deadline_expired_total": {
+        "kind": "counter", "layer": "engine",
+        "mirrors": ("fake_engine", "dashboard", "docs"),
+        "help": "Requests shed/aborted on an expired client deadline",
+    },
+    # -- engine request-level histograms (obs layer) -----------------------
+    "tpu:ttft_seconds": {
+        "kind": "histogram", "layer": "engine",
+        "mirrors": ("fake_engine", "dashboard", "docs"),
+        "help": "Per-request time to first token",
+    },
+    "tpu:itl_seconds": {
+        "kind": "histogram", "layer": "engine",
+        "mirrors": ("fake_engine", "dashboard", "docs"),
+        "help": "Inter-token latency (one observation per token gap)",
+    },
+    "tpu:e2e_latency_seconds": {
+        "kind": "histogram", "layer": "engine",
+        "mirrors": ("fake_engine", "dashboard", "docs"),
+        "help": "Per-request end-to-end latency",
+    },
+    "tpu:queue_time_seconds": {
+        "kind": "histogram", "layer": "engine",
+        "mirrors": ("fake_engine", "dashboard", "docs"),
+        "help": "Admission -> first schedule",
+    },
+    "tpu:prefill_time_seconds": {
+        "kind": "histogram", "layer": "engine",
+        "mirrors": ("fake_engine", "dashboard", "docs"),
+        "help": "Prefill phase per request",
+    },
+    "tpu:decode_time_seconds": {
+        "kind": "histogram", "layer": "engine",
+        "mirrors": ("fake_engine", "dashboard", "docs"),
+        "help": "Decode phase per request",
+    },
+    "tpu:detokenize_time_seconds": {
+        "kind": "histogram", "layer": "engine",
+        "mirrors": ("fake_engine", "dashboard", "docs"),
+        "help": "Accumulated host detokenize cost per request",
+    },
+    # -- engine step-phase histograms --------------------------------------
+    "tpu:step_schedule_seconds": {
+        "kind": "histogram", "layer": "engine",
+        "mirrors": ("fake_engine", "dashboard", "docs"),
+        "help": "Scheduler planning time per step",
+    },
+    "tpu:step_dispatch_seconds": {
+        "kind": "histogram", "layer": "engine",
+        "mirrors": ("fake_engine", "dashboard", "docs"),
+        "help": "Host-side H2D dispatch time per pipelined step",
+    },
+    "tpu:step_collect_seconds": {
+        "kind": "histogram", "layer": "engine",
+        "mirrors": ("fake_engine", "dashboard", "docs"),
+        "help": "Device collect/readback wait per step",
+    },
+    "tpu:step_sample_seconds": {
+        "kind": "histogram", "layer": "engine",
+        "mirrors": ("fake_engine", "dashboard", "docs"),
+        "help": "Sample post-process time per step",
+    },
+    "tpu:step_mixed_seconds": {
+        "kind": "histogram", "layer": "engine",
+        "mirrors": ("fake_engine", "dashboard", "docs"),
+        "help": "End-to-end wall time of fused mixed decode+prefill steps",
+    },
+    # -- async KV transfer-plane histograms --------------------------------
+    "tpu:remote_kv_fetch_seconds": {
+        "kind": "histogram", "layer": "engine",
+        "mirrors": ("fake_engine", "dashboard", "docs"),
+        "help": "Shared-store round-trip per MGET chain fetch / restore GET "
+                "(observed on fetcher threads)",
+    },
+    "tpu:offload_stage_seconds": {
+        "kind": "histogram", "layer": "engine",
+        "mirrors": ("fake_engine", "dashboard", "docs"),
+        "help": "Preemption-snapshot staging, gather dispatch -> host copy "
+                "(observed on the stager's writer thread)",
+    },
+    # -- router gauges (prometheus_client, labeled by server) --------------
+    "tpu_router:current_qps": {
+        "kind": "gauge", "layer": "router", "labels": ("server",),
+        "mirrors": ("dashboard", "docs"),
+        "help": "Sliding-window QPS per backend",
+    },
+    "tpu_router:avg_ttft": {
+        "kind": "gauge", "layer": "router", "labels": ("server",),
+        "mirrors": ("dashboard", "docs"),
+        "help": "Average TTFT per backend (window)",
+    },
+    "tpu_router:avg_latency": {
+        "kind": "gauge", "layer": "router", "labels": ("server",),
+        "mirrors": ("dashboard", "docs"),
+        "help": "Average e2e latency per backend (window)",
+    },
+    "tpu_router:avg_itl": {
+        "kind": "gauge", "layer": "router", "labels": ("server",),
+        "mirrors": ("dashboard", "docs"),
+        "help": "Average inter-token latency per backend (window)",
+    },
+    "tpu_router:avg_decoding_length": {
+        "kind": "gauge", "layer": "router", "labels": ("server",),
+        "mirrors": ("dashboard", "docs"),
+        "help": "Average streamed chunks per request",
+    },
+    "tpu_router:queueing_delay_seconds": {
+        "kind": "gauge", "layer": "router", "labels": ("server",),
+        "mirrors": ("dashboard", "docs"),
+        "help": "Average router-side queueing delay (window)",
+    },
+    "tpu_router:num_prefill_requests": {
+        "kind": "gauge", "layer": "router", "labels": ("server",),
+        "mirrors": ("dashboard", "docs"),
+        "help": "Requests awaiting first token per backend",
+    },
+    "tpu_router:num_decoding_requests": {
+        "kind": "gauge", "layer": "router", "labels": ("server",),
+        "mirrors": ("dashboard", "docs"),
+        "help": "Requests streaming tokens per backend",
+    },
+    "tpu_router:num_requests_finished": {
+        "kind": "gauge", "layer": "router", "labels": ("server",),
+        "mirrors": ("dashboard", "docs"),
+        "help": "Completed requests per backend",
+    },
+    "tpu_router:num_requests_uncompleted": {
+        "kind": "gauge", "layer": "router", "labels": ("server",),
+        "mirrors": ("dashboard", "docs"),
+        "help": "In-flight requests per backend",
+    },
+    "tpu_router:healthy_pods_total": {
+        "kind": "gauge", "layer": "router", "labels": ("model",),
+        "mirrors": ("dashboard", "docs"),
+        "help": "Healthy serving-engine endpoints per model",
+    },
+    "tpu_router:engine_hbm_kv_usage_perc": {
+        "kind": "gauge", "layer": "router", "labels": ("server",),
+        "mirrors": ("docs",),
+        "help": "Scraped engine KV usage re-exported per backend",
+    },
+    "tpu_router:engine_prefix_cache_hit_rate": {
+        "kind": "gauge", "layer": "router", "labels": ("server",),
+        "mirrors": ("docs",),
+        "help": "Scraped engine prefix hit rate re-exported per backend",
+    },
+    "tpu_router:engine_num_requests_waiting": {
+        "kind": "gauge", "layer": "router", "labels": ("server",),
+        "mirrors": ("docs",),
+        "help": "Scraped engine queue depth re-exported per backend",
+    },
+    "tpu_router:circuit_state": {
+        "kind": "gauge", "layer": "router", "labels": ("server",),
+        "mirrors": ("dashboard", "docs"),
+        "help": "Per-backend breaker state (0=closed, 1=half-open, 2=open)",
+    },
+    "tpu_router:semantic_cache_size": {
+        "kind": "gauge", "layer": "router",
+        "mirrors": ("dashboard", "docs"),
+        "help": "Entries resident in the semantic cache",
+    },
+    # -- router counters (prometheus_client exposes Counter(x) as x_total) -
+    "tpu_router:deadline_expired_total": {
+        "kind": "counter", "layer": "router",
+        "mirrors": ("dashboard", "docs"),
+        "help": "Requests shed at the router on an expired deadline",
+    },
+    "tpu_router:semantic_cache_hits_total": {
+        "kind": "counter", "layer": "router",
+        "source_name": "tpu_router:semantic_cache_hits",
+        "mirrors": ("dashboard", "docs"),
+        "help": "Semantic cache hits served",
+    },
+    "tpu_router:semantic_cache_misses_total": {
+        "kind": "counter", "layer": "router",
+        "source_name": "tpu_router:semantic_cache_misses",
+        "mirrors": ("dashboard", "docs"),
+        "help": "Semantic cache lookups that missed",
+    },
+    "tpu_router:pii_requests_scanned_total": {
+        "kind": "counter", "layer": "router",
+        "source_name": "tpu_router:pii_requests_scanned",
+        "mirrors": ("dashboard", "docs"),
+        "help": "Requests scanned by the PII middleware",
+    },
+    "tpu_router:pii_requests_blocked_total": {
+        "kind": "counter", "layer": "router",
+        "source_name": "tpu_router:pii_requests_blocked",
+        "mirrors": ("dashboard", "docs"),
+        "help": "Requests blocked because PII was detected",
+    },
+    "tpu_router:pii_detections_total": {
+        "kind": "counter", "layer": "router", "labels": ("pii_type",),
+        "source_name": "tpu_router:pii_detections",
+        "mirrors": ("dashboard", "docs"),
+        "help": "PII entities detected in request bodies",
+    },
+    # -- router latency histograms (custom render, labeled by server) ------
+    "tpu_router:ttft_seconds": {
+        "kind": "histogram", "layer": "router", "labels": ("server",),
+        "mirrors": ("dashboard", "docs"),
+        "help": "Router-observed TTFT per backend",
+    },
+    "tpu_router:itl_seconds": {
+        "kind": "histogram", "layer": "router", "labels": ("server",),
+        "mirrors": ("dashboard", "docs"),
+        "help": "Router-observed inter-token latency per backend",
+    },
+    "tpu_router:e2e_latency_seconds": {
+        "kind": "histogram", "layer": "router", "labels": ("server",),
+        "mirrors": ("dashboard", "docs"),
+        "help": "Router-observed e2e latency per backend",
+    },
+    "tpu_router:request_queueing_seconds": {
+        "kind": "histogram", "layer": "router", "labels": ("server",),
+        "mirrors": ("dashboard", "docs"),
+        "help": "Router-side queueing before backend connect",
+    },
+}

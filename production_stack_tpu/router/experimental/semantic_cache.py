@@ -21,7 +21,6 @@ import logging
 import os
 import re
 import threading
-import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np

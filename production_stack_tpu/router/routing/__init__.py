@@ -15,9 +15,9 @@ Algorithms:
 
 from __future__ import annotations
 
-from typing import Any, Dict, Optional
+from typing import Any
 
-from production_stack_tpu.router.routing.base import Request, RoutingInterface
+from production_stack_tpu.router.routing.base import RoutingInterface
 from production_stack_tpu.router.routing.round_robin import RoundRobinRouter
 from production_stack_tpu.router.routing.session import SessionRouter
 from production_stack_tpu.router.routing.least_loaded import LeastLoadedRouter

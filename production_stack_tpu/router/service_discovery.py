@@ -22,7 +22,7 @@ import asyncio
 import dataclasses
 import logging
 import time
-from typing import Dict, List, Optional
+from typing import List, Optional
 
 import aiohttp
 

@@ -219,6 +219,18 @@ def build_fake_engine_app(state: FakeEngineState | None = None) -> web.Applicati
             (vocab.TPU_TOTAL_GENERATED_TOKENS, state.total_generated_tokens),
             (vocab.TPU_TOTAL_FINISHED_REQUESTS, state.total_finished),
             (vocab.TPU_NUM_PREEMPTIONS, 0),
+            # Pipeline-health + capability gauges: the fake engine has no
+            # device (zero host gap) and no adapters, but the families
+            # must exist for the scrape contract (metric_registry.py —
+            # stackcheck SC303 pins this mirror).
+            (vocab.TPU_DECODE_HOST_GAP_MS, 0.0),
+            (vocab.TPU_LOADED_LORAS, 0),
+            # Cross-engine prefix sharing + speculative decoding counters
+            # (no store and no drafter here; contract parity only).
+            (vocab.TPU_REMOTE_PREFIX_BLOCKS_FETCHED, 0),
+            (vocab.TPU_REMOTE_PREFIX_BLOCKS_EXPORTED, 0),
+            (vocab.TPU_SPEC_TOKENS_DRAFTED, 0),
+            (vocab.TPU_SPEC_TOKENS_ACCEPTED, 0),
             # The fake engine serves every prompt instantly, so no mixed
             # chunking ever happens — but the counter must exist so the
             # scrape contract matches the real engine.

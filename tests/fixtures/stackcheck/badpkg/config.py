@@ -1,0 +1,24 @@
+"""Fixture: SC4 gate-safety violations (default-on gate, missing flag
+parity, store_true default=True) and the compliant patterns."""
+
+import argparse
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass
+class FixtureConfig:
+    always_on: bool = True            # SC401: default-on gate
+    hidden_gate: bool = False         # SC402: no CLI flag below
+    good_gate: Optional[bool] = None  # fine: auto + --no-good-gate below
+    count: int = 4                    # not a gate: ints are ignored
+
+
+def parse_args(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--no-good-gate", action="store_true")
+    parser.add_argument("--always-on", action="store_true")
+    parser.add_argument(
+        "--broken-flag", action="store_true", default=True,  # SC403
+    )
+    return parser.parse_args(argv)

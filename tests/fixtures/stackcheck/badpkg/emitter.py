@@ -1,0 +1,10 @@
+"""Fixture: SC301 orphan emit (family absent from the fixture
+registry)."""
+
+
+def render(value):
+    return [
+        ("tpu:registered_family", value),
+        ("tpu:unplotted_family", value),
+        ("tpu:orphan_family", value),  # SC301: not in registry.py
+    ]

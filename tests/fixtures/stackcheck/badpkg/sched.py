@@ -1,0 +1,54 @@
+"""Fixture: seeded SC1 (blocking reachability) and SC2 (determinism)
+violations, plus the patterns that must NOT flag (annotation allow,
+boundary subtree, benign obs sink).  tests/test_stackcheck.py asserts
+exact rule ids and line anchors against this file — keep edits additive
+or update the assertions."""
+
+import random
+import time
+
+
+def fetch_bytes(sock):
+    # SC101: socket recv reachable from the root via helper().
+    return sock.recv(1024)
+
+
+def helper(sock):
+    return fetch_bytes(sock)
+
+
+# stackcheck: root=step-thread
+def schedule(state, sock):
+    data = helper(sock)           # -> SC101 inside fetch_bytes
+    time.sleep(0.5)               # SC101: direct sleep at the root
+    now = time.time()
+    if now > state.deadline:      # SC201: clock feeds a branch
+        return None
+    pick = random.random()        # SC202: unseeded randomness
+    if state.queue.empty():       # SC203: thread-progress query
+        return None
+    obs_stamp = time.time()
+    state.obs.record(obs_stamp)   # benign sink: must NOT flag
+    state.plan.set_deadline(obs_stamp + 5.0)  # SC201: clock escapes into a plan call
+    # stackcheck: allow=SC101 reason=fixture allowlist guard, intentional pacing sleep
+    time.sleep(0.001)             # allowed: must NOT flag
+    return data, pick
+
+
+def rpc_get(client):
+    # Contract-blocking by name (get_blocks) — but only reachable through
+    # the boundary below, so it must NOT flag.
+    return client.get_blocks("key")
+
+
+# stackcheck: boundary=step-thread reason=fixture legacy path guard, gated off by default
+def legacy_fetch(client):
+    time.sleep(9.9)  # inside a boundary subtree: must NOT flag
+    return rpc_get(client)
+
+
+# stackcheck: root=step-thread
+def dispatch(client, enabled):
+    if enabled:
+        return legacy_fetch(client)  # edge into a boundary: not expanded
+    return None

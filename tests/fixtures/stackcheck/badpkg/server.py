@@ -1,0 +1,20 @@
+"""Fixture: SC150 (sync-blocking inside async defs) violations and the
+nested-def exemption."""
+
+import time
+
+
+async def handler(request, client):
+    time.sleep(1.0)                  # SC150: sleep on the event loop
+    data = client.mget_blocks(["k"])  # SC150: kvserver RPC surface
+    return data
+
+
+async def clean_handler(request):
+    def worker():
+        # Nested sync def runs on a worker thread, not the loop: the
+        # blocking call inside it must NOT flag.
+        time.sleep(2.0)
+        return 1
+
+    return worker

@@ -1,0 +1,23 @@
+"""Fixture metric registry for the SC3 contract checks."""
+
+REGISTRY = {
+    # Emitted by badpkg/emitter.py, on the fixture dashboard and docs: OK.
+    "tpu:registered_family": {
+        "kind": "gauge", "layer": "engine",
+        "mirrors": ("dashboard", "docs"),
+        "help": "fixture family, fully mirrored",
+    },
+    # SC302: never emitted anywhere in badpkg.
+    "tpu:ghost_family": {
+        "kind": "counter", "layer": "engine",
+        "mirrors": (),
+        "help": "fixture family with no emit site",
+    },
+    # SC304 + SC306: emitted by emitter.py but flagged for dashboard and
+    # docs mirrors that don't reference it.
+    "tpu:unplotted_family": {
+        "kind": "gauge", "layer": "engine",
+        "mirrors": ("dashboard", "docs"),
+        "help": "fixture family missing from dashboard and docs",
+    },
+}

@@ -27,7 +27,6 @@ import pytest
 
 from production_stack_tpu.engine.parallel.distributed import (
     DistributedEnv,
-    StepEvents,
     detect_env,
 )
 

@@ -5,8 +5,6 @@
 import math
 
 import aiohttp
-import jax
-import numpy as np
 from aiohttp.test_utils import TestServer
 
 from production_stack_tpu.engine.config import (

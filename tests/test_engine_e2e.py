@@ -3,9 +3,7 @@ continuous batching, prefix-cache reuse, offload-preemption survival, and
 the OpenAI server surface.
 """
 
-import asyncio
 
-import numpy as np
 from aiohttp.test_utils import TestClient, TestServer
 
 from production_stack_tpu.engine.config import (

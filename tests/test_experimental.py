@@ -12,7 +12,6 @@ routers/main_router.py:44-51, services/request_service/request.py:113-117,
 experimental/pii/middleware.py:101-154.
 """
 
-import json
 
 import pytest
 from aiohttp.test_utils import TestClient, TestServer

@@ -292,6 +292,7 @@ def test_finish_mid_fetch_counts_waste_and_single_remote_del(
     # Never offloaded -> _remote_keys empty -> zero DELs; a second
     # discard of the same id must not add one either.
     engine.offload.discard("r")
+    assert engine.offload.wait_deletes(10.0)
     assert gated.deletes == 0
 
 
@@ -393,6 +394,7 @@ def test_offload_stager_tombstone_and_double_buffer():
     mgr.discard("a")
     release.set()
     assert stager.wait_idle(10.0)
+    assert mgr.wait_deletes(10.0)
     assert mgr.restore_local("a") is None
     assert client.puts == 0
     assert client.deletes == 0
@@ -408,7 +410,38 @@ def test_offload_stager_tombstone_and_double_buffer():
     assert client.puts == 1
     mgr.discard("c")
     mgr.discard("c")
+    # The DEL rides the deleter thread now (discard is a step-thread
+    # call and must never pay the RPC inline — stackcheck SC101).
+    assert mgr.wait_deletes(10.0)
     assert client.deletes == 1
+
+
+def test_async_engine_close_flushes_pending_remote_deletes():
+    """AsyncEngine.close() must drain the deleter thread: a DEL enqueued
+    by a step-thread discard just before shutdown still reaches the
+    store (regression: the daemon thread died with the DEL queued and
+    the store snapshot leaked)."""
+    from production_stack_tpu.engine.server.async_engine import AsyncEngine
+
+    class SlowDeleteClient:
+        def __init__(self):
+            self.deletes = []
+
+        def delete(self, seq_id):
+            time.sleep(0.2)
+            self.deletes.append(seq_id)
+
+        def close(self):
+            pass
+
+    aeng = AsyncEngine(make_engine().config)
+    client = SlowDeleteClient()
+    aeng.engine.offload.remote_client = client
+    with aeng.engine.offload._lock:
+        aeng.engine.offload._remote_keys.add("seq-1")
+    aeng.engine.offload.discard("seq-1")
+    asyncio.run(aeng.close())
+    assert client.deletes == ["seq-1"]
 
 
 def test_async_restore_pages_in_from_remote(kv_server_factory):

@@ -335,8 +335,11 @@ def test_offload_remote_tier_restore_and_discard(kv_server):
     entry = mgr.restore("s1")
     assert entry is not None and entry.num_tokens == 12
 
-    # discard() must delete the remote copy (leak fix).
+    # discard() must delete the remote copy (leak fix).  The DEL rides
+    # the deleter thread: discard is a step-thread call and must never
+    # pay the RPC inline (stackcheck SC101).
     mgr.discard("s1")
+    assert mgr.wait_deletes(10.0)
     assert client.get_blocks("s1") is None
 
     # Sequences that never touched the remote tier cost no RPC and no error.

@@ -6,7 +6,6 @@ be bit-identical to classic single-token stepping, stop conditions must
 truncate on the host, and block allocation must cover the whole budget.
 """
 
-import numpy as np
 
 from production_stack_tpu.engine.config import (
     CacheConfig,

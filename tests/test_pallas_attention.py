@@ -5,7 +5,6 @@ compiled path is exercised on real TPU by bench.py and by the engine on TPU
 backends (ops/attention.py:decode_attention dispatch).
 """
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest

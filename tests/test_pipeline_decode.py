@@ -8,7 +8,6 @@ and the engine must roll the in-flight successor's row back as a
 discarded overrun.
 """
 
-import numpy as np
 import pytest
 
 from production_stack_tpu.engine.config import (

@@ -6,7 +6,6 @@ localhost, .github/workflows/router-e2e-test.yml:49-96 and
 src/tests/perftest/) but runs fully in-process.
 """
 
-import asyncio
 import json
 
 from aiohttp.test_utils import TestClient, TestServer

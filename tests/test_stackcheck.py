@@ -1,0 +1,311 @@
+"""Tier-1 tests for the stackcheck invariant checker (tools/stackcheck).
+
+Three layers:
+
+1. Fixture assertions — every rule family fires with the exact rule id
+   and location on seeded violations (tests/fixtures/stackcheck), and
+   the patterns that must NOT fire (inline allow, boundary subtree,
+   benign obs sink, nested sync def) stay silent.
+2. Live-tree gate — the real package is clean against the checked-in
+   baseline.  This is the test that makes the prose invariants of
+   PRs 1–5 regressions instead of review lore.
+3. Synthetic injections (the ISSUE acceptance criteria) — a socket.recv
+   grafted into a scheduler-reachable helper and an unregistered metric
+   family grafted into an emit site are both caught on a copy of the
+   real tree, proving the pass exercises the real call graph, not just
+   fixtures.
+"""
+
+from __future__ import annotations
+
+import shutil
+from pathlib import Path
+
+import pytest
+
+from tools.stackcheck import Config, apply_baseline, run_checks, update_baseline
+from tools.stackcheck.core import load_baseline
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+FIXTURES = REPO_ROOT / "tests" / "fixtures" / "stackcheck"
+
+
+def fixture_config(root: Path) -> Config:
+    return Config(
+        repo_root=root,
+        package_dirs=("badpkg",),
+        async_dirs=("badpkg",),
+        extra_edges={},
+        leader_publish_qualnames=(),
+        registry_path="registry.py",
+        fake_engine_path=None,
+        dashboard_path="dashboard.json",
+        docs_path="docs.md",
+        gate_classes=(("badpkg/config.py", ("FixtureConfig",)),),
+        argparse_files=("badpkg/config.py",),
+        gate_flag_overrides={},
+    )
+
+
+@pytest.fixture(scope="module")
+def fixture_violations():
+    return run_checks(fixture_config(FIXTURES))
+
+
+def by_rule(violations, rule):
+    return [v for v in violations if v.rule == rule]
+
+
+# -- 1. fixture: every family fires with exact ids/locations ---------------
+
+def test_blocking_reachability_flags_socket_and_sleep(fixture_violations):
+    sc101 = by_rule(fixture_violations, "SC101")
+    details = {(v.file, v.detail) for v in sc101}
+    # socket.recv two hops from the root, via helper -> fetch_bytes.
+    assert ("badpkg/sched.py", "sock.recv") in details
+    # Direct sleep at the root.
+    assert ("badpkg/sched.py", "time.sleep") in details
+    recv = next(v for v in sc101 if v.detail == "sock.recv")
+    assert recv.qualname == "fetch_bytes"
+    assert recv.line == 13
+    assert "schedule" in recv.message  # path names the root
+
+
+def test_allowlisted_sleep_and_boundary_subtree_do_not_flag(fixture_violations):
+    sc101 = by_rule(fixture_violations, "SC101")
+    # The annotated sleep (line 35-36 pair) is suppressed: exactly one
+    # time.sleep violation in sched.py (the unannotated one).
+    sched_sleeps = [
+        v for v in sc101
+        if v.file == "badpkg/sched.py" and v.detail == "time.sleep"
+    ]
+    assert len(sched_sleeps) == 1
+    assert sched_sleeps[0].qualname == "schedule"
+    # Nothing inside the boundary subtree (legacy_fetch/rpc_get) fires.
+    assert not [
+        v for v in fixture_violations
+        if v.qualname in ("legacy_fetch", "rpc_get")
+    ]
+    assert not by_rule(fixture_violations, "SC102")
+
+
+def test_async_blocking_flags_sleep_and_rpc_but_not_nested_def(
+    fixture_violations,
+):
+    sc150 = by_rule(fixture_violations, "SC150")
+    assert {(v.qualname, v.detail) for v in sc150} == {
+        ("handler", "time.sleep"),
+        ("handler", "client.mget_blocks"),
+    }
+    lines = sorted(v.line for v in sc150)
+    assert lines == [8, 9]
+
+
+def test_determinism_flags_clock_random_and_queue_probe(fixture_violations):
+    # Line 25: clock feeds a branch.  Line 32: clock escapes into a
+    # non-sink call argument (the benign obs.record on line 31 must not
+    # appear between them).
+    assert [(v.qualname, v.line) for v in by_rule(fixture_violations, "SC201")] \
+        == [("schedule", 25), ("schedule", 32)]
+    assert [(v.qualname, v.detail) for v in by_rule(fixture_violations, "SC202")] \
+        == [("schedule", "random.random")]
+    assert [(v.qualname, v.detail) for v in by_rule(fixture_violations, "SC203")] \
+        == [("schedule", "state.queue.empty")]
+
+
+def test_gate_safety_flags(fixture_violations):
+    assert {v.detail for v in by_rule(fixture_violations, "SC401")} \
+        == {"always_on"}
+    assert {v.detail for v in by_rule(fixture_violations, "SC402")} \
+        == {"hidden_gate"}
+    assert {v.detail for v in by_rule(fixture_violations, "SC403")} \
+        == {"--broken-flag"}
+
+
+def test_metrics_contract_flags_all_directions(fixture_violations):
+    assert {v.detail for v in by_rule(fixture_violations, "SC301")} \
+        == {"tpu:orphan_family"}
+    assert {v.detail for v in by_rule(fixture_violations, "SC302")} \
+        == {"tpu:ghost_family"}
+    assert {v.detail for v in by_rule(fixture_violations, "SC304")} \
+        == {"tpu:unplotted_family"}
+    assert {v.detail for v in by_rule(fixture_violations, "SC305")} \
+        == {"tpu:stale_panel_family"}
+    assert {v.detail for v in by_rule(fixture_violations, "SC306")} \
+        == {"tpu:unplotted_family"}
+    assert {v.detail for v in by_rule(fixture_violations, "SC307")} \
+        == {"tpu:undocumented_unknown"}
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    # The module CLI wires the same checks: exit 0 on the live tree,
+    # nonzero (with the violation rendered) on a seeded copy.
+    from tools.stackcheck.__main__ import main
+
+    assert main(["--root", str(REPO_ROOT)]) == 0
+
+    root = _copy_tree(tmp_path)
+    _seed_socket_recv_into_scheduler(root)
+    capsys.readouterr()
+    assert main(["--root", str(root)]) != 0
+    assert "SC101" in capsys.readouterr().out
+
+
+# -- 2. live tree is clean against the checked-in baseline -----------------
+
+def test_live_tree_clean_or_baselined():
+    violations = run_checks(Config(repo_root=REPO_ROOT))
+    baseline = load_baseline(REPO_ROOT / "tools/stackcheck/baseline.json")
+    new = [v for v in violations if v.key not in baseline]
+    assert not new, "new stackcheck violations:\n" + "\n".join(
+        v.render() for v in new
+    )
+
+
+def test_live_tree_roots_are_annotated():
+    """The reachability pass is only as good as its roots: the five
+    step/scheduler entry points PR 4's invariant names must carry the
+    root annotation, or the blocking rule silently checks nothing."""
+    from tools.stackcheck.callgraph import CallGraph
+    from tools.stackcheck.core import load_sources
+
+    sources = load_sources(REPO_ROOT, ["production_stack_tpu"])
+    graph = CallGraph(sources)
+    roots = set(graph.find_roots("step"))
+    expected = {
+        "production_stack_tpu.engine.core.scheduler:Scheduler.schedule",
+        "production_stack_tpu.engine.core.engine:LLMEngine.dispatch",
+        "production_stack_tpu.engine.core.engine:LLMEngine.collect",
+        "production_stack_tpu.engine.core.engine:LLMEngine._run_mixed",
+        "production_stack_tpu.engine.core.engine:LLMEngine._drain_prefetched",
+        "production_stack_tpu.engine.server.async_engine:AsyncEngine._run_loop",
+    }
+    assert expected <= roots
+
+
+# -- 3. synthetic injections against a copy of the real tree ---------------
+
+def _copy_tree(tmp_path: Path) -> Path:
+    root = tmp_path / "repo"
+    (root / "observability").mkdir(parents=True)
+    (root / "docs").mkdir()
+    shutil.copytree(
+        REPO_ROOT / "production_stack_tpu", root / "production_stack_tpu",
+        ignore=shutil.ignore_patterns("__pycache__"),
+    )
+    shutil.copy(
+        REPO_ROOT / "observability/tpu-dashboard.json",
+        root / "observability/tpu-dashboard.json",
+    )
+    shutil.copy(
+        REPO_ROOT / "docs/observability.md", root / "docs/observability.md"
+    )
+    return root
+
+
+def _seed_socket_recv_into_scheduler(root: Path) -> None:
+    """Graft a socket.recv into a helper reachable from
+    Scheduler.schedule() on a tree copy."""
+    sched = root / "production_stack_tpu/engine/core/scheduler.py"
+    text = sched.read_text()
+    text = text.replace(
+        "    def _try_schedule_decode(self",
+        "    def _peek_store(self):\n"
+        "        import socket\n"
+        "        s = socket.socket()\n"
+        "        return s.recv(16)\n"
+        "\n"
+        "    def _try_schedule_decode(self",
+    )
+    text = text.replace(
+        "        if not self.running:\n            return None\n        bs = self.block_pool.block_size",
+        "        if not self.running:\n            return None\n"
+        "        self._peek_store()\n"
+        "        bs = self.block_pool.block_size",
+    )
+    sched.write_text(text)
+
+
+def test_synthetic_socket_recv_in_scheduler_helper_is_flagged(tmp_path):
+    """ISSUE acceptance: a socket.recv grafted into a helper reachable
+    from Scheduler.schedule() must fail the pass."""
+    root = _copy_tree(tmp_path)
+    _seed_socket_recv_into_scheduler(root)
+    violations = run_checks(Config(repo_root=root), families=["blocking"])
+    hits = [
+        v for v in violations
+        if v.rule == "SC101" and v.qualname == "Scheduler._peek_store"
+    ]
+    assert hits, "injected socket.recv was not flagged"
+    assert any("recv" in v.detail for v in hits)
+
+
+def test_synthetic_unregistered_metric_family_is_flagged(tmp_path):
+    """ISSUE acceptance: an emitted family absent from the registry must
+    fail the pass."""
+    root = _copy_tree(tmp_path)
+    vocab = root / "production_stack_tpu/router/stats/vocabulary.py"
+    vocab.write_text(
+        vocab.read_text()
+        + '\nTPU_SYNTHETIC = "tpu:synthetic_not_in_registry"\n'
+    )
+    violations = run_checks(Config(repo_root=root), families=["metrics"])
+    assert any(
+        v.rule == "SC301" and v.detail == "tpu:synthetic_not_in_registry"
+        for v in violations
+    )
+
+
+def test_removing_legacy_boundary_reexposes_the_rpc(tmp_path):
+    """False-positive guard inverted: _fetch_remote_prefix_sync is only
+    quiet because of its boundary annotation (gated legacy path), not
+    because the checker cannot see through it."""
+    root = _copy_tree(tmp_path)
+    eng = root / "production_stack_tpu/engine/core/engine.py"
+    lines = [
+        ln for ln in eng.read_text().splitlines()
+        if "stackcheck: boundary" not in ln
+        or "_fetch_remote_prefix_sync" not in ln and "legacy sync fetch" not in ln
+    ]
+    eng.write_text("\n".join(lines) + "\n")
+    violations = run_checks(Config(repo_root=root), families=["blocking"])
+    assert any(
+        v.qualname.endswith("_fetch_remote_prefix_sync")
+        or "_fetch_remote_prefix_sync" in v.message
+        for v in violations
+    ), "boundary removal did not re-expose the legacy sync RPC"
+
+
+# -- baseline ratchet -------------------------------------------------------
+
+def test_baseline_ratchet_refuses_growth(tmp_path):
+    fix_cfg = fixture_config(FIXTURES)
+    violations = run_checks(fix_cfg)
+    assert violations
+    baseline_path = tmp_path / "baseline.json"
+    # First write: allowed (no previous baseline).
+    assert update_baseline(violations[:2], baseline_path) is None
+    split = apply_baseline(violations, baseline_path)
+    assert len(split["baselined"]) == 2
+    assert len(split["new"]) == len(violations) - 2
+    # Growing any rule's count is refused.
+    err = update_baseline(violations, baseline_path)
+    assert err is not None and "ratchet" in err
+    # Shrinking is fine.
+    assert update_baseline(violations[:1], baseline_path) is None
+    assert len(load_baseline(baseline_path)) == 1
+
+
+def test_malformed_annotation_is_itself_a_violation(tmp_path):
+    root = tmp_path / "r"
+    (root / "badpkg").mkdir(parents=True)
+    (root / "badpkg" / "m.py").write_text(
+        "import time\n"
+        "# stackcheck: allow=SC101\n"   # missing reason=
+        "def f():\n"
+        "    time.sleep(1)\n"
+    )
+    cfg = fixture_config(root)
+    violations = run_checks(cfg, families=["annotations"])
+    assert [v.rule for v in violations] == ["SC001"]
+    assert violations[0].line == 2

@@ -1,0 +1,2 @@
+# Namespace for repo tooling (tools.stackcheck).  Not part of the
+# installed package (pyproject packages.find only picks production_stack_tpu*).

@@ -1,0 +1,87 @@
+"""stackcheck — AST/call-graph invariant checker for the TPU stack.
+
+Turns the prose invariants PRs 1–5 established (no blocking under the
+scheduler/step thread, lockstep determinism, the three-way metrics
+contract, default-off gate safety) into a static-analysis pass that
+fails CI.  Pure stdlib; never imports the code under analysis.
+
+Entry points:
+    python -m tools.stackcheck            # CLI (CI lint job)
+    tools.stackcheck.run_checks(cfg)      # library (tier-1 tests)
+
+See docs/static-analysis.md for the invariant catalog and annotation
+syntax.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Dict, List, Optional
+
+from tools.stackcheck.callgraph import CallGraph
+from tools.stackcheck.config import Config
+from tools.stackcheck.core import (
+    Violation,
+    annotation_violations,
+    load_baseline,
+    load_sources,
+    write_baseline,
+)
+from tools.stackcheck.rules_blocking import check_async_blocking, check_blocking
+from tools.stackcheck.rules_determinism import check_determinism
+from tools.stackcheck.rules_gates import check_gates
+from tools.stackcheck.rules_metrics import check_metrics
+
+RULE_FAMILIES = {
+    "annotations": ("SC001",),
+    "blocking": ("SC101", "SC102", "SC150"),
+    "determinism": ("SC201", "SC202", "SC203"),
+    "metrics": ("SC301", "SC302", "SC303", "SC304", "SC305", "SC306", "SC307"),
+    "gates": ("SC401", "SC402", "SC403"),
+}
+
+__all__ = ["Config", "Violation", "run_checks", "RULE_FAMILIES"]
+
+
+def run_checks(
+    cfg: Config, families: Optional[List[str]] = None
+) -> List[Violation]:
+    """Run the selected rule families (default: all) and return every
+    violation NOT suppressed by an inline annotation.  Baseline
+    filtering is the caller's business (the CLI applies it; tests
+    usually want the raw list)."""
+    wanted = set(families or RULE_FAMILIES)
+    sources = load_sources(cfg.repo_root, list(cfg.package_dirs))
+    violations: List[Violation] = []
+    if "annotations" in wanted:
+        violations += annotation_violations(sources)
+    if wanted & {"blocking", "determinism"}:
+        graph = CallGraph(sources)
+        if "blocking" in wanted:
+            violations += check_blocking(graph, cfg)
+            violations += check_async_blocking(graph, cfg)
+        if "determinism" in wanted:
+            violations += check_determinism(graph, cfg)
+    if "metrics" in wanted:
+        violations += check_metrics(sources, cfg)
+    if "gates" in wanted:
+        violations += check_gates(sources, cfg)
+    violations.sort(key=lambda v: (v.file, v.line, v.rule, v.detail))
+    return violations
+
+
+def apply_baseline(
+    violations: List[Violation], baseline_path: Path
+) -> Dict[str, List[Violation]]:
+    """Split violations into {'new': [...], 'baselined': [...]}."""
+    baseline = load_baseline(baseline_path)
+    new = [v for v in violations if v.key not in baseline]
+    old = [v for v in violations if v.key in baseline]
+    return {"new": new, "baselined": old}
+
+
+def update_baseline(
+    violations: List[Violation], baseline_path: Path
+) -> Optional[str]:
+    previous = load_baseline(baseline_path)
+    return write_baseline(baseline_path, violations, previous)

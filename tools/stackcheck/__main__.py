@@ -1,0 +1,106 @@
+"""CLI: ``python -m tools.stackcheck [options]``.
+
+Exit status: 0 = clean (or every violation baselined), 1 = new
+violations (or a baseline-ratchet refusal).  Run from the repo root;
+``--root`` points elsewhere for fixture trees.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from tools.stackcheck import (
+    RULE_FAMILIES,
+    Config,
+    apply_baseline,
+    run_checks,
+    update_baseline,
+)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.stackcheck",
+        description="AST/call-graph invariant checker (docs/static-analysis.md)",
+    )
+    parser.add_argument(
+        "--root", default=".",
+        help="repo root to analyze (default: cwd)",
+    )
+    parser.add_argument(
+        "--rules", default=None,
+        help=f"comma-separated rule families (default: all of "
+             f"{','.join(RULE_FAMILIES)})",
+    )
+    parser.add_argument(
+        "--baseline", default=None,
+        help="baseline JSON path (default: tools/stackcheck/baseline.json "
+             "under --root when present)",
+    )
+    parser.add_argument(
+        "--update-baseline", action="store_true",
+        help="rewrite the baseline from current violations; refuses to "
+             "GROW any rule's count (the ratchet)",
+    )
+    parser.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="machine-readable output",
+    )
+    args = parser.parse_args(argv)
+
+    root = Path(args.root).resolve()
+    cfg = Config(repo_root=root)
+    families = args.rules.split(",") if args.rules else None
+    if families:
+        unknown = set(families) - set(RULE_FAMILIES)
+        if unknown:
+            parser.error(f"unknown rule families: {sorted(unknown)}")
+
+    violations = run_checks(cfg, families)
+
+    baseline_path = (
+        Path(args.baseline) if args.baseline else root / cfg.baseline_path
+    )
+    if args.update_baseline:
+        err = update_baseline(violations, baseline_path)
+        if err:
+            print(f"stackcheck: {err}", file=sys.stderr)
+            return 1
+        print(f"stackcheck: baseline written to {baseline_path} "
+              f"({len(violations)} entries)")
+        return 0
+
+    split = apply_baseline(violations, baseline_path)
+    new, old = split["new"], split["baselined"]
+
+    if args.as_json:
+        print(json.dumps({
+            "new": [vars(v) for v in new],
+            "baselined": [vars(v) for v in old],
+        }, indent=2))
+    else:
+        for v in new:
+            print(v.render())
+        if old:
+            print(f"stackcheck: {len(old)} baselined violation(s) "
+                  "suppressed (pay the debt down: tools/stackcheck/"
+                  "baseline.json)")
+    if new:
+        print(
+            f"stackcheck: {len(new)} new violation(s).  Fix them, or "
+            "annotate intentional ones with "
+            "`# stackcheck: allow=<rule> reason=...` "
+            "(docs/static-analysis.md)",
+            file=sys.stderr,
+        )
+        return 1
+    print(f"stackcheck: clean ({len(violations)} total, "
+          f"{len(old)} baselined)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,223 @@
+"""Intra-package call graph over the AST (no imports executed).
+
+Resolution is deliberately conservative-by-overapproximation: when an
+attribute call ``obj.method(...)`` cannot be typed, an edge is added to
+EVERY package definition of ``method`` (capped — past the cap the name is
+treated as too generic to mean anything, e.g. ``get``/``items``).  For a
+reachability analysis that feeds deny-list rules this errs toward false
+positives, which the inline-annotation mechanism then forces a human to
+justify — the failure mode we want for invariants like "no RPC under the
+scheduler" (a silent false NEGATIVE is the expensive one).
+
+Dynamic indirections the AST cannot see (callbacks stored on attributes)
+are closed over by ``extra_edges`` — e.g. the scheduler's
+``offload_cb``/``restore_cb``/``remote_prefix_cb`` wiring, declared in
+tools/stackcheck/config.py right next to the rule that needs them.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from tools.stackcheck.core import SourceFile
+
+# Attribute-call basenames too generic to resolve by name alone.
+_MAX_AMBIGUOUS_TARGETS = 4
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    qualname: str            # module:Class.func or module:func
+    module: str              # dotted module path
+    cls: Optional[str]
+    name: str
+    node: ast.AST            # FunctionDef | AsyncFunctionDef
+    src: SourceFile
+    is_async: bool
+
+    @property
+    def def_line(self) -> int:
+        return self.node.lineno
+
+    @property
+    def end_line(self) -> int:
+        return getattr(self.node, "end_lineno", self.node.lineno)
+
+
+def _module_name(rel: str) -> str:
+    mod = rel[:-3] if rel.endswith(".py") else rel
+    mod = mod.replace("/", ".")
+    if mod.endswith(".__init__"):
+        mod = mod[: -len(".__init__")]
+    return mod
+
+
+class CallGraph:
+    def __init__(self, sources: List[SourceFile]):
+        self.sources = sources
+        self.functions: Dict[str, FuncInfo] = {}
+        # method name -> qualnames defining it (for attribute resolution)
+        self.by_name: Dict[str, List[str]] = {}
+        # class name -> {method name -> qualname}
+        self.by_class: Dict[str, Dict[str, str]] = {}
+        self.edges: Dict[str, Set[str]] = {}
+        # per-module import alias maps: module -> {alias: dotted target}
+        self._imports: Dict[str, Dict[str, str]] = {}
+        self._index()
+        self._build_edges()
+
+    # -- indexing ----------------------------------------------------------
+
+    def _index(self) -> None:
+        for src in self.sources:
+            mod = _module_name(src.rel)
+            imports: Dict[str, str] = {}
+            for node in ast.walk(src.tree):
+                if isinstance(node, ast.Import):
+                    for a in node.names:
+                        imports[a.asname or a.name.split(".")[0]] = a.name
+                elif isinstance(node, ast.ImportFrom) and node.module:
+                    for a in node.names:
+                        imports[a.asname or a.name] = f"{node.module}.{a.name}"
+            self._imports[mod] = imports
+
+            def add(node, cls: Optional[str]):
+                q = (
+                    f"{mod}:{cls}.{node.name}" if cls else f"{mod}:{node.name}"
+                )
+                info = FuncInfo(
+                    qualname=q, module=mod, cls=cls, name=node.name,
+                    node=node, src=src,
+                    is_async=isinstance(node, ast.AsyncFunctionDef),
+                )
+                self.functions[q] = info
+                self.by_name.setdefault(node.name, []).append(q)
+                if cls:
+                    self.by_class.setdefault(cls, {})[node.name] = q
+
+            for node in src.tree.body:
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    add(node, None)
+                elif isinstance(node, ast.ClassDef):
+                    for sub in node.body:
+                        if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                            add(sub, node.name)
+
+    # -- edges -------------------------------------------------------------
+
+    def _resolve_call(self, call: ast.Call, info: FuncInfo) -> List[str]:
+        fn = call.func
+        targets: List[str] = []
+        if isinstance(fn, ast.Name):
+            name = fn.id
+            # Same-module function first.
+            q = f"{info.module}:{name}"
+            if q in self.functions:
+                return [q]
+            # from-import of a package function.
+            imported = self._imports.get(info.module, {}).get(name)
+            if imported:
+                dotted_mod, _, attr = imported.rpartition(".")
+                q = f"{dotted_mod}:{attr}"
+                if q in self.functions:
+                    return [q]
+            # Class constructor -> __init__.
+            init = self.by_class.get(name, {}).get("__init__")
+            if init:
+                return [init]
+            return []
+        if not isinstance(fn, ast.Attribute):
+            return []
+        attr = fn.attr
+        base = fn.value
+        # self.method() -> same class.
+        if isinstance(base, ast.Name) and base.id in ("self", "cls") and info.cls:
+            q = self.by_class.get(info.cls, {}).get(attr)
+            if q:
+                return [q]
+            # Fall through: attribute may be a callback or inherited.
+        # module.func() via import alias.
+        if isinstance(base, ast.Name):
+            imported = self._imports.get(info.module, {}).get(base.id)
+            if imported:
+                # Covers both `import pkg.module as m; m.func()` and
+                # `from pkg import module; module.func()` — the import
+                # table stores the full dotted module either way.
+                q = f"{imported}:{attr}"
+                if q in self.functions:
+                    return [q]
+        # Unknown receiver: by-name over-approximation.
+        candidates = self.by_name.get(attr, [])
+        if 0 < len(candidates) <= _MAX_AMBIGUOUS_TARGETS:
+            targets.extend(candidates)
+        return targets
+
+    def _build_edges(self) -> None:
+        for q, info in self.functions.items():
+            outs: Set[str] = set()
+            for node in ast.walk(info.node):
+                if isinstance(node, ast.Call):
+                    outs.update(self._resolve_call(node, info))
+            outs.discard(q)
+            self.edges[q] = outs
+
+    # -- queries -----------------------------------------------------------
+
+    def reachable(
+        self,
+        roots: Iterable[str],
+        extra_edges: Optional[Dict[str, List[str]]] = None,
+        exclude: Optional[Set[str]] = None,
+    ) -> Dict[str, Tuple[str, ...]]:
+        """BFS from ``roots``; returns {qualname: path-from-root} (path
+        includes the qualname itself, root first).  ``extra_edges``
+        injects callback edges the AST cannot see.  ``exclude`` qualnames
+        (boundary annotations: legacy/gated subtrees) are never entered."""
+        extra = extra_edges or {}
+        excl = exclude or set()
+        out: Dict[str, Tuple[str, ...]] = {}
+        queue: List[Tuple[str, Tuple[str, ...]]] = [
+            (r, (r,)) for r in roots if r in self.functions and r not in excl
+        ]
+        while queue:
+            q, path = queue.pop(0)
+            if q in out:
+                continue
+            out[q] = path
+            nxt = set(self.edges.get(q, ()))
+            nxt.update(extra.get(q, ()))
+            for callee in sorted(nxt):
+                if (
+                    callee in self.functions
+                    and callee not in out
+                    and callee not in excl
+                ):
+                    queue.append((callee, path + (callee,)))
+        return out
+
+    def _annotated(self, table_name: str, kind_prefix: str) -> List[str]:
+        found = []
+        for q, info in self.functions.items():
+            table = getattr(info.src, table_name)
+            first = min(
+                [info.def_line]
+                + [d.lineno for d in getattr(info.node, "decorator_list", [])]
+            )
+            for ln in range(first - 2, info.def_line + 1):
+                kind = table.get(ln)
+                if kind is not None and kind.startswith(kind_prefix):
+                    found.append(q)
+                    break
+        return sorted(found)
+
+    def find_roots(self, kind_prefix: str = "") -> List[str]:
+        """Functions annotated ``# stackcheck: root=<kind>`` on or
+        directly above their def (decorator lines included)."""
+        return self._annotated("roots", kind_prefix)
+
+    def find_boundaries(self, kind_prefix: str = "") -> List[str]:
+        """Functions annotated ``# stackcheck: boundary=<kind>``: gated
+        legacy subtrees the reachability rules must not descend into."""
+        return self._annotated("boundaries", kind_prefix)

@@ -1,0 +1,177 @@
+"""stackcheck configuration: what is checked, against what contract.
+
+Everything path-like is relative to ``repo_root`` so the same checker
+runs over the live tree (tests/test_stackcheck.py, CI) and over fixture
+trees (tests/fixtures/stackcheck/*) by swapping the Config.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+
+# -- SC1: blocking-call deny list -------------------------------------------
+
+# Dotted call prefixes that block the calling thread on I/O or sleep.
+BLOCKING_DOTTED_PREFIXES: Tuple[str, ...] = (
+    "time.sleep",
+    "socket.",
+    "requests.",
+    "urllib.request.",
+    "http.client.",
+    "subprocess.run",
+    "subprocess.call",
+    "subprocess.check_call",
+    "subprocess.check_output",
+    "shutil.rmtree",
+)
+
+# Attribute-call basenames that are high-confidence blocking regardless of
+# receiver: raw socket I/O and JAX device-to-host synchronization.
+# (`accept`/`connect` are deliberately absent: too many non-socket
+# meanings — guided-decoding Guide.accept, breaker connect bookkeeping.
+# Server accept loops are covered by reachability through socket.*.)
+BLOCKING_ATTR_NAMES: Tuple[str, ...] = (
+    "recv",
+    "recv_into",
+    "recvfrom",
+    "sendall",
+    "makefile",
+    "block_until_ready",
+    "device_get",
+)
+
+# Package functions that are blocking BY CONTRACT even though their bodies
+# may hide the I/O behind helpers the graph cannot fully resolve (the
+# kvserver client's public RPC surface).  Qualname suffixes.
+BLOCKING_CONTRACT_SUFFIXES: Tuple[str, ...] = (
+    "kvserver.client:RemoteKVClient.get_blocks",
+    "kvserver.client:RemoteKVClient.put_blocks",
+    "kvserver.client:RemoteKVClient.mget_blocks",
+    "kvserver.client:RemoteKVClient.mput_blocks",
+    "kvserver.client:RemoteKVClient.delete",
+    "kvserver.client:RemoteKVClient.stat",
+)
+
+# Method basenames distinctive enough to flag inside async defs without
+# receiver typing (the kvserver RPC surface minus names that collide
+# with stdlib/web idioms like `delete`/`stat`).
+ASYNC_CONTRACT_NAMES: Tuple[str, ...] = (
+    "get_blocks",
+    "put_blocks",
+    "mget_blocks",
+    "mput_blocks",
+)
+
+# -- SC2: determinism --------------------------------------------------------
+
+WALL_CLOCK_CALLS: Tuple[str, ...] = (
+    "time.time",
+    "time.time_ns",
+    "time.monotonic",
+    "time.monotonic_ns",
+    "time.perf_counter",
+    "datetime.now",
+    "datetime.utcnow",
+    "datetime.today",
+    "datetime.datetime.now",
+    "datetime.datetime.utcnow",
+)
+
+# random-module functions whose call without an explicit seeded generator
+# diverges across lockstep replicas.  (jax.random is keyed, so exempt;
+# numpy default_rng(seed)/Generator instances are resolved separately.)
+UNSEEDED_RANDOM_PREFIXES: Tuple[str, ...] = (
+    "random.",
+    "np.random.random",
+    "np.random.rand",
+    "np.random.randint",
+    "np.random.choice",
+    "np.random.shuffle",
+    "numpy.random.random",
+    "numpy.random.rand",
+    "numpy.random.randint",
+    "numpy.random.choice",
+    "numpy.random.shuffle",
+)
+
+# Thread-timing observation points: querying another thread's progress in
+# plan-deciding code makes the plan depend on thread interleaving.
+TIMING_QUERY_ATTRS: Tuple[str, ...] = ("empty", "qsize", "get_nowait")
+
+# Calls that are benign SINKS for a wall-clock value: passing a timestamp
+# into observability/trace/logging machinery never affects the plan.
+BENIGN_SINK_SUBSTRINGS: Tuple[str, ...] = (
+    "obs.", "tracer", "add_span", "step_phase", "observe", "record",
+    "log", "debug", "info", "warning", "error", "exception", "_observe",
+    "note_", "histogram", "append",
+)
+
+
+@dataclasses.dataclass
+class Config:
+    repo_root: Path
+    # Directories (or single files) scanned for source rules.
+    package_dirs: Tuple[str, ...] = ("production_stack_tpu",)
+    # async-blocking scope (rule SC150): packages whose async defs must
+    # not call sync-blocking APIs (the event loop serves every request).
+    async_dirs: Tuple[str, ...] = (
+        "production_stack_tpu/router",
+        "production_stack_tpu/engine/server",
+    )
+    # Dynamic callback edges the AST cannot see: caller -> callees.
+    extra_edges: Dict[str, List[str]] = dataclasses.field(
+        default_factory=lambda: dict(DEFAULT_EXTRA_EDGES)
+    )
+    # SC2 named allow: the PR-5 leader-publish pattern.  Wall-clock
+    # evaluation is structurally confined to the lockstep LEADER, whose
+    # decision is broadcast as an event batch that followers REPLAY —
+    # replicas therefore never evaluate wall clocks independently even
+    # though this function does.  (docs/static-analysis.md#leader-publish)
+    leader_publish_qualnames: Tuple[str, ...] = (
+        "production_stack_tpu.engine.server.async_engine:AsyncEngine._run_loop",
+    )
+    # -- metrics contract (SC3) -------------------------------------------
+    registry_path: str = "production_stack_tpu/obs/metric_registry.py"
+    vocabulary_path: str = "production_stack_tpu/router/stats/vocabulary.py"
+    fake_engine_path: str = "production_stack_tpu/testing/fake_engine.py"
+    dashboard_path: str = "observability/tpu-dashboard.json"
+    docs_path: str = "docs/observability.md"
+    # -- gate safety (SC4) -------------------------------------------------
+    # (config file, class names) whose bool/Optional[bool] fields are gates.
+    gate_classes: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
+        (
+            "production_stack_tpu/engine/config.py",
+            ("SchedulerConfig", "CacheConfig", "ObsConfig"),
+        ),
+    )
+    # argparse surfaces checked for gate flag parity and store_true sanity.
+    argparse_files: Tuple[str, ...] = (
+        "production_stack_tpu/engine/server/api_server.py",
+        "production_stack_tpu/router/parser.py",
+    )
+    # Gate field name -> CLI flag, where kebab-casing isn't mechanical.
+    gate_flag_overrides: Dict[str, str] = dataclasses.field(
+        default_factory=lambda: {"enable_prefix_caching": "--no-prefix-caching"}
+    )
+    baseline_path: str = "tools/stackcheck/baseline.json"
+
+    def resolve(self, rel: Optional[str]) -> Optional[Path]:
+        return None if rel is None else self.repo_root / rel
+
+
+# Scheduler callbacks are wired at engine construction
+# (engine/core/engine.py LLMEngine.__init__) and invoked through
+# ``self.offload_cb``/``restore_cb``/``remote_prefix_cb`` — invisible to
+# static call resolution, but exactly the edges PR 4's invariant is about.
+_SCHED = "production_stack_tpu.engine.core.scheduler:Scheduler"
+_ENG = "production_stack_tpu.engine.core.engine:LLMEngine"
+DEFAULT_EXTRA_EDGES: Dict[str, List[str]] = {
+    f"{_SCHED}._preempt_youngest": [f"{_ENG}.offload_seq_blocks"],
+    f"{_SCHED}._try_schedule_prefill": [
+        f"{_ENG}.restore_seq_blocks",
+        f"{_ENG}.fetch_remote_prefix",
+    ],
+}

@@ -1,0 +1,215 @@
+"""stackcheck core: source model, annotations, violations, baseline.
+
+The checker is pure stdlib and NEVER imports the code under analysis —
+every fact comes from ``ast`` over the source tree, so it runs in the
+lint CI job without jax/aiohttp installed and cannot be confused by
+import-time side effects.
+
+Annotation grammar (docs/static-analysis.md):
+
+    # stackcheck: root=step-thread
+        On the line(s) directly above a ``def`` (or on the def line):
+        marks the function as a reachability ROOT for the blocking (SC1)
+        and determinism (SC2) rule families.
+
+    # stackcheck: allow=SC101 reason=<free text to end of line>
+        Suppresses the named rule(s) (comma-separated) on the same line,
+        the line above the flagged statement, or — when placed on/above a
+        ``def`` — for the whole function body.  A reason is mandatory:
+        an allow without one is itself a violation (SC001), so every
+        suppression records WHY the invariant legitimately bends there.
+
+Baseline (``tools/stackcheck/baseline.json``): the escape hatch for
+pre-existing debt.  Keys are ``rule::file::qualname::detail`` (no line
+numbers, so unrelated edits don't churn it).  The ratchet is one-way:
+``--update-baseline`` refuses to grow any rule's count — debt may only
+be paid down or explicitly annotated in source.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import re
+from pathlib import Path
+from typing import Dict, List, Optional, Set, Tuple
+
+ANNOTATION_RE = re.compile(
+    r"#\s*stackcheck:\s*(?P<body>.+?)\s*$"
+)
+ALLOW_RE = re.compile(
+    r"allow=(?P<rules>[A-Z0-9,]+)(?:\s+reason=(?P<reason>.+))?"
+)
+ROOT_RE = re.compile(r"root=(?P<kind>[a-z-]+)")
+BOUNDARY_RE = re.compile(
+    r"boundary=(?P<kind>[a-z-]+)(?:\s+reason=(?P<reason>.+))?"
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    rule: str       # e.g. "SC101"
+    file: str       # repo-relative posix path
+    line: int
+    qualname: str   # dotted location, e.g. "engine.core.scheduler:Scheduler.schedule"
+    message: str
+    detail: str = ""  # stable discriminator for the baseline key
+
+    @property
+    def key(self) -> str:
+        return f"{self.rule}::{self.file}::{self.qualname}::{self.detail}"
+
+    def render(self) -> str:
+        return f"{self.file}:{self.line}: {self.rule} [{self.qualname}] {self.message}"
+
+
+@dataclasses.dataclass
+class Allow:
+    rules: Tuple[str, ...]
+    reason: Optional[str]
+    line: int
+
+
+class SourceFile:
+    """One parsed module: AST + per-line annotation maps."""
+
+    def __init__(self, path: Path, rel: str, text: str):
+        self.path = path
+        self.rel = rel
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=str(path))
+        # line -> Allow entries whose comment sits ON that line.
+        self.allows: Dict[int, List[Allow]] = {}
+        self.roots: Dict[int, str] = {}  # line -> root kind
+        # line -> boundary kind: the annotated function is a legacy/
+        # gated subtree the reachability rules must not descend into.
+        # A reason is mandatory (same rationale as allow=).
+        self.boundaries: Dict[int, str] = {}
+        self.bad_annotations: List[int] = []
+        for i, raw in enumerate(self.lines, start=1):
+            m = ANNOTATION_RE.search(raw)
+            if not m:
+                continue
+            body = m.group("body")
+            rm = ROOT_RE.search(body)
+            if rm:
+                self.roots[i] = rm.group("kind")
+                continue
+            bm = BOUNDARY_RE.search(body)
+            if bm:
+                reason = bm.group("reason")
+                if not reason or not reason.strip():
+                    self.bad_annotations.append(i)
+                else:
+                    self.boundaries[i] = bm.group("kind")
+                continue
+            am = ALLOW_RE.search(body)
+            if am:
+                rules = tuple(
+                    r for r in am.group("rules").split(",") if r
+                )
+                reason = am.group("reason")
+                if not rules or not reason or not reason.strip():
+                    self.bad_annotations.append(i)
+                else:
+                    self.allows.setdefault(i, []).append(
+                        Allow(rules=rules, reason=reason.strip(), line=i)
+                    )
+                continue
+            # Unrecognized stackcheck directive.
+            self.bad_annotations.append(i)
+
+    def allowed_at(self, line: int, rule: str,
+                   func_lines: Optional[Tuple[int, int]] = None) -> bool:
+        """True when ``rule`` is suppressed at ``line``: an allow on the
+        same line, the line directly above, or one covering the whole
+        enclosing function (annotation on/above its ``def``)."""
+        for ln in (line, line - 1):
+            for al in self.allows.get(ln, ()):
+                if rule in al.rules or "ALL" in al.rules:
+                    return True
+        if func_lines is not None:
+            def_line, _ = func_lines
+            for ln in (def_line, def_line - 1, def_line - 2):
+                for al in self.allows.get(ln, ()):
+                    if rule in al.rules or "ALL" in al.rules:
+                        return True
+        return False
+
+
+def load_sources(root: Path, package_dirs: List[str],
+                 exclude: Tuple[str, ...] = ("__pycache__",)) -> List[SourceFile]:
+    out: List[SourceFile] = []
+    for pkg in package_dirs:
+        base = root / pkg
+        if base.is_file():
+            out.append(SourceFile(base, base.relative_to(root).as_posix(),
+                                  base.read_text()))
+            continue
+        for path in sorted(base.rglob("*.py")):
+            if any(part in exclude for part in path.parts):
+                continue
+            rel = path.relative_to(root).as_posix()
+            out.append(SourceFile(path, rel, path.read_text()))
+    return out
+
+
+def annotation_violations(sources: List[SourceFile]) -> List[Violation]:
+    out = []
+    for src in sources:
+        for line in src.bad_annotations:
+            out.append(Violation(
+                rule="SC001",
+                file=src.rel,
+                line=line,
+                qualname=src.rel,
+                message="malformed stackcheck annotation (allow= needs "
+                        "comma-separated rule ids AND a reason=...)",
+                detail=f"line{line}",
+            ))
+    return out
+
+
+# -- baseline ----------------------------------------------------------------
+
+def load_baseline(path: Path) -> Set[str]:
+    if not path.exists():
+        return set()
+    data = json.loads(path.read_text())
+    return set(data.get("entries", []))
+
+
+def write_baseline(path: Path, violations: List[Violation],
+                   previous: Set[str]) -> Optional[str]:
+    """Write the baseline from the current violation set.  Ratchet: any
+    rule whose entry count would GROW vs the previous baseline is an
+    error (returns the message; nothing written)."""
+    new_entries = sorted({v.key for v in violations})
+
+    def counts(entries) -> Dict[str, int]:
+        c: Dict[str, int] = {}
+        for e in entries:
+            rule = e.split("::", 1)[0]
+            c[rule] = c.get(rule, 0) + 1
+        return c
+
+    prev_c, new_c = counts(previous), counts(new_entries)
+    grew = [
+        f"{rule}: {prev_c.get(rule, 0)} -> {n}"
+        for rule, n in sorted(new_c.items())
+        if n > prev_c.get(rule, 0) and previous
+    ]
+    if grew:
+        return (
+            "baseline ratchet: per-rule counts may only decrease "
+            "(fix or annotate new violations instead): "
+            + "; ".join(grew)
+        )
+    path.write_text(json.dumps({
+        "version": 1,
+        "counts": counts(new_entries),
+        "entries": new_entries,
+    }, indent=2) + "\n")
+    return None

@@ -1,0 +1,158 @@
+"""Rule family SC1 — blocking-call reachability.
+
+Invariant (PR 4, CHANGES.md): *no kvserver RPC or host-DMA wait is
+reachable from ``Scheduler.schedule()`` or the step thread.*  The step
+thread is the engine's only lane to the device: one blocking call under
+it stalls every running sequence's decode for the full wait (the 5x
+cold-replica ITL cliff PR 4 removed).
+
+SC101  blocking call (socket/RPC/sleep/D2H-wait) reachable from a
+       ``# stackcheck: root=step-thread`` function.
+SC102  call into a contract-blocking package function (kvserver client
+       RPC surface) reachable from a step root.
+SC150  sync-blocking call inside an ``async def`` in router/ or
+       engine/server/ — the event loop serves EVERY request; one blocked
+       coroutine head-of-line-blocks all of them.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Tuple
+
+from tools.stackcheck import config as C
+from tools.stackcheck.callgraph import CallGraph
+from tools.stackcheck.core import Violation
+
+
+def dotted_name(node: ast.AST) -> str:
+    """Best-effort dotted rendering of a call target ('time.sleep',
+    'sock.recv', '<expr>.attr' for computed receivers)."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return f"{dotted_name(node.value)}.{node.attr}"
+    return "<expr>"
+
+
+def _blocking_reason(call: ast.Call) -> str:
+    """Why this call is considered blocking; '' = not blocking."""
+    name = dotted_name(call.func)
+    for prefix in C.BLOCKING_DOTTED_PREFIXES:
+        if name == prefix or name.startswith(prefix):
+            return name
+    if isinstance(call.func, ast.Attribute):
+        if call.func.attr in C.BLOCKING_ATTR_NAMES:
+            return name
+    return ""
+
+
+def _path_str(graph: CallGraph, path: Tuple[str, ...]) -> str:
+    return " -> ".join(p.split(":", 1)[-1] for p in path)
+
+
+def check_blocking(graph: CallGraph, cfg: C.Config) -> List[Violation]:
+    out: List[Violation] = []
+    roots = graph.find_roots("step")
+    reach = graph.reachable(
+        roots,
+        extra_edges=cfg.extra_edges,
+        exclude=set(graph.find_boundaries("step")),
+    )
+    contract = {
+        q for q in graph.functions
+        if any(q.endswith(sfx) for sfx in C.BLOCKING_CONTRACT_SUFFIXES)
+    }
+    for q, path in reach.items():
+        info = graph.functions[q]
+        func_span = (info.def_line, info.end_line)
+        for node in ast.walk(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            why = _blocking_reason(node)
+            if why:
+                if info.src.allowed_at(node.lineno, "SC101", func_span):
+                    continue
+                out.append(Violation(
+                    rule="SC101", file=info.src.rel, line=node.lineno,
+                    qualname=q.split(":", 1)[-1],
+                    message=(
+                        f"blocking call `{why}` reachable from step root "
+                        f"via {_path_str(graph, path)}"
+                    ),
+                    detail=why,
+                ))
+        # Contract-blocking package calls: flag at the CALLER edge into
+        # the RPC surface (the kvserver client itself is allowed to
+        # block — it runs on fetcher/writer threads everywhere legal).
+        for callee in graph.edges.get(q, set()):
+            if callee in contract and q not in contract:
+                line = info.def_line
+                # Find the call line for a usable location.
+                mname = callee.rsplit(".", 1)[-1]
+                for node in ast.walk(info.node):
+                    if (
+                        isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr == mname
+                    ):
+                        line = node.lineno
+                        break
+                if info.src.allowed_at(line, "SC102", func_span):
+                    continue
+                out.append(Violation(
+                    rule="SC102", file=info.src.rel, line=line,
+                    qualname=q.split(":", 1)[-1],
+                    message=(
+                        f"kvserver RPC `{callee.split(':', 1)[-1]}` "
+                        f"reachable from step root via "
+                        f"{_path_str(graph, path)}"
+                    ),
+                    detail=callee.split(":", 1)[-1],
+                ))
+    return out
+
+
+def check_async_blocking(graph: CallGraph, cfg: C.Config) -> List[Violation]:
+    """SC150: sync-blocking calls inside async defs under async_dirs."""
+    out: List[Violation] = []
+    scopes = tuple(d.rstrip("/") + "/" for d in cfg.async_dirs)
+    contract_names = set(C.ASYNC_CONTRACT_NAMES)
+    for q, info in graph.functions.items():
+        if not info.is_async:
+            continue
+        if not any(info.src.rel.startswith(s) for s in scopes):
+            continue
+        func_span = (info.def_line, info.end_line)
+        # Nested defs inside the async function run on whatever thread
+        # calls them, not necessarily the event loop — scan only the
+        # async function's own statements.
+        nested: set = set()
+        for node in ast.walk(info.node):
+            if node is not info.node and isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                for sub in ast.walk(node):
+                    nested.add(id(sub))
+        for node in ast.walk(info.node):
+            if id(node) in nested or not isinstance(node, ast.Call):
+                continue
+            why = _blocking_reason(node)
+            if not why and isinstance(node.func, ast.Attribute):
+                if node.func.attr in contract_names:
+                    why = dotted_name(node.func)
+            if not why:
+                continue
+            if info.src.allowed_at(node.lineno, "SC150", func_span):
+                continue
+            out.append(Violation(
+                rule="SC150", file=info.src.rel, line=node.lineno,
+                qualname=q.split(":", 1)[-1],
+                message=(
+                    f"sync-blocking call `{why}` inside async def "
+                    f"{info.name} (event-loop stall: every in-flight "
+                    "request waits)"
+                ),
+                detail=why,
+            ))
+    return out

@@ -1,0 +1,227 @@
+"""Rule family SC2 — lockstep determinism.
+
+Invariant (PRs 3/5, CHANGES.md): *lockstep replicas never evaluate wall
+clocks.*  Under multi-host SPMD every replica must produce the byte-
+identical sequence of jitted launches; a plan decision keyed on a wall
+clock (or unseeded randomness, or another thread's progress) diverges
+replicas and wedges the group in mismatched collectives.
+
+SC201  wall-clock read whose value feeds a BRANCH or a scheduler/plan
+       call in code reachable from scheduler/step roots.  Reads that
+       only flow into observability sinks (span/histogram/log calls)
+       are fine — metrics may disagree across replicas, plans may not.
+SC202  unseeded randomness (random.*, np.random module functions)
+       reachable from scheduler/step roots.  jax.random is keyed and
+       np.random.default_rng(seed)/Generator instances are exempt.
+SC203  thread-progress query (.empty()/.qsize()/.get_nowait()) in
+       reachable code — the plan would depend on worker-thread timing.
+
+The one sanctioned exception is the *leader-publish* pattern
+(cfg.leader_publish_qualnames): the lockstep LEADER evaluates the clock
+(deadline sweep, idle heartbeat) and publishes the resulting event batch;
+followers replay it verbatim.  Replicas still never *independently*
+evaluate wall clocks — the decision is made once and broadcast.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from tools.stackcheck import config as C
+from tools.stackcheck.callgraph import CallGraph
+from tools.stackcheck.core import Violation
+from tools.stackcheck.rules_blocking import dotted_name
+
+
+def _is_wall_clock(call: ast.Call) -> bool:
+    name = dotted_name(call.func)
+    if name in C.WALL_CLOCK_CALLS:
+        # datetime.now(tz) with an argument is still a wall clock read.
+        return True
+    return False
+
+
+def _is_unseeded_random(call: ast.Call) -> bool:
+    name = dotted_name(call.func)
+    for prefix in C.UNSEEDED_RANDOM_PREFIXES:
+        if name == prefix.rstrip(".") or name.startswith(prefix):
+            return True
+    return False
+
+
+def _is_benign_sink(call: ast.Call) -> bool:
+    name = dotted_name(call.func).lower()
+    return any(s in name for s in C.BENIGN_SINK_SUBSTRINGS)
+
+
+class _ClockTaint(ast.NodeVisitor):
+    """Intra-function taint: which local names hold wall-clock-derived
+    values, and does any tainted value reach a branch condition, a
+    comparison, or a non-sink call argument that is a plan/scheduler
+    call?  Deliberately shallow (no attribute or inter-procedural
+    tracking): the step loop stamps clocks into attributes for metrics
+    constantly, and chasing those would drown the signal.  The rule's
+    teeth come from the branch/comparison check, which is where a clock
+    becomes a *decision*."""
+
+    def __init__(self):
+        self.tainted: Set[str] = set()
+        self.flagged: List[ast.AST] = []
+
+    # -- taint sources / propagation ------------------------------------
+
+    def _expr_tainted(self, node: ast.AST) -> bool:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call) and _is_wall_clock(sub):
+                return True
+            if isinstance(sub, ast.Name) and sub.id in self.tainted:
+                return True
+        return False
+
+    def visit_Assign(self, node: ast.Assign):
+        if self._expr_tainted(node.value):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    self.tainted.add(tgt.id)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign):
+        if self._expr_tainted(node.value) and isinstance(node.target, ast.Name):
+            self.tainted.add(node.target.id)
+        self.generic_visit(node)
+
+    # -- decision sinks --------------------------------------------------
+
+    def _check_condition(self, test: ast.AST):
+        if self._expr_tainted(test):
+            self.flagged.append(test)
+
+    def visit_If(self, node: ast.If):
+        self._check_condition(node.test)
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While):
+        self._check_condition(node.test)
+        self.generic_visit(node)
+
+    def visit_IfExp(self, node: ast.IfExp):
+        self._check_condition(node.test)
+        self.generic_visit(node)
+
+    def visit_Assert(self, node: ast.Assert):
+        self._check_condition(node.test)
+        self.generic_visit(node)
+
+    def visit_comprehension(self, node: ast.comprehension):
+        for cond in node.ifs:
+            self._check_condition(cond)
+        self.generic_visit(node)
+
+    def visit_Compare(self, node: ast.Compare):
+        # A comparison on a clock value is a decision even outside an
+        # `if` (sorted keys, filters, min/max selection).
+        if self._expr_tainted(node):
+            self.flagged.append(node)
+        # Don't recurse: the If visitor already flagged enclosing tests;
+        # flagging both would double-report.
+
+    def visit_Call(self, node: ast.Call):
+        # A tainted value handed to a non-sink call is a decision input
+        # escaping this function (e.g. scheduler.set_deadline(now + b)).
+        # Sinks (spans/histograms/logs) are fine; args containing a
+        # comparison are left to visit_Compare to avoid double-reports.
+        if not _is_benign_sink(node) and not _is_wall_clock(node):
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if any(isinstance(s, ast.Compare) for s in ast.walk(arg)):
+                    continue
+                if self._expr_tainted(arg):
+                    self.flagged.append(node)
+                    break
+        self.generic_visit(node)
+
+    def run(self, func_node: ast.AST) -> List[ast.AST]:
+        # Two passes so taint assigned below its first decision use in
+        # source order (loops) still propagates.
+        for _ in range(2):
+            self.flagged = []
+            self.visit(func_node)
+        # De-duplicate by location.
+        seen = set()
+        uniq = []
+        for n in self.flagged:
+            key = (getattr(n, "lineno", 0), getattr(n, "col_offset", 0))
+            if key not in seen:
+                seen.add(key)
+                uniq.append(n)
+        return uniq
+
+
+def check_determinism(graph: CallGraph, cfg: C.Config) -> List[Violation]:
+    out: List[Violation] = []
+    roots = graph.find_roots("step")
+    reach = graph.reachable(
+        roots,
+        extra_edges=cfg.extra_edges,
+        exclude=set(graph.find_boundaries("step")),
+    )
+    leader_ok = set(cfg.leader_publish_qualnames)
+    for q in reach:
+        info = graph.functions[q]
+        func_span = (info.def_line, info.end_line)
+        where = q.split(":", 1)[-1]
+
+        if q not in leader_ok:
+            taint = _ClockTaint()
+            for node in taint.run(info.node):
+                line = getattr(node, "lineno", info.def_line)
+                if info.src.allowed_at(line, "SC201", func_span):
+                    continue
+                out.append(Violation(
+                    rule="SC201", file=info.src.rel, line=line,
+                    qualname=where,
+                    message=(
+                        "wall-clock value feeds a decision in scheduler/"
+                        "step-reachable code (lockstep replicas would "
+                        "diverge); publish the decision from the leader "
+                        "or key it on deterministic state"
+                    ),
+                    # Baseline keys must stay line-number-free (core.py);
+                    # the flagged expression's own source is the stable
+                    # discriminator between multiple hits in one function.
+                    detail=ast.unparse(node)[:80],
+                ))
+
+        for node in ast.walk(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            if _is_unseeded_random(node):
+                if info.src.allowed_at(node.lineno, "SC202", func_span):
+                    continue
+                out.append(Violation(
+                    rule="SC202", file=info.src.rel, line=node.lineno,
+                    qualname=where,
+                    message=(
+                        f"unseeded randomness `{dotted_name(node.func)}` "
+                        "in scheduler/step-reachable code"
+                    ),
+                    detail=dotted_name(node.func),
+                ))
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in C.TIMING_QUERY_ATTRS
+                and q not in leader_ok
+            ):
+                if info.src.allowed_at(node.lineno, "SC203", func_span):
+                    continue
+                out.append(Violation(
+                    rule="SC203", file=info.src.rel, line=node.lineno,
+                    qualname=where,
+                    message=(
+                        f"thread-progress query `{dotted_name(node.func)}()` "
+                        "in scheduler/step-reachable code (plan would depend "
+                        "on worker-thread timing)"
+                    ),
+                    detail=dotted_name(node.func),
+                ))
+    return out

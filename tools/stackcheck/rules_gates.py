@@ -1,0 +1,164 @@
+"""Rule family SC4 — gate safety.
+
+Invariant (PR 5, CHANGES.md): *every gate is default-off-safe.*  A new
+behavior ships behind a gate whose default is ``False`` or ``None``
+(= auto, resolved to a safe value); rolling back is always "stop passing
+the flag".  And every gate must be REACHABLE from the CLI: a config
+field with no ``--X``/``--no-X`` argparse counterpart can't be turned
+off in production without a code change — which is how a "default-safe"
+gate quietly becomes mandatory.
+
+SC401  bool/Optional[bool] gate field whose default is True (annotate
+       with a reason when the always-on default is the established
+       contract, e.g. enable_prefix_caching).
+SC402  gate field with no matching argparse flag on the engine server
+       surface (``--<kebab>``, ``--no-<kebab>``, or a declared override).
+SC403  argparse ``store_true`` flag declared with ``default=True`` —
+       the flag can then never express False.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from tools.stackcheck import config as C
+from tools.stackcheck.core import SourceFile, Violation
+
+
+def _is_bool_annotation(node: Optional[ast.AST]) -> bool:
+    if node is None:
+        return False
+    if isinstance(node, ast.Name):
+        return node.id == "bool"
+    if isinstance(node, ast.Subscript):  # Optional[bool]
+        base = node.value
+        if isinstance(base, ast.Name) and base.id == "Optional":
+            inner = node.slice
+            return isinstance(inner, ast.Name) and inner.id == "bool"
+    return False
+
+
+def _gate_fields(src: SourceFile, classes: Tuple[str, ...]):
+    """Yield (class, field, default, line) for bool-ish dataclass fields."""
+    for node in src.tree.body:
+        if not isinstance(node, ast.ClassDef) or node.name not in classes:
+            continue
+        for stmt in node.body:
+            if not isinstance(stmt, ast.AnnAssign):
+                continue
+            if not isinstance(stmt.target, ast.Name):
+                continue
+            if not _is_bool_annotation(stmt.annotation):
+                continue
+            default: object = ...
+            if stmt.value is not None:
+                try:
+                    default = ast.literal_eval(stmt.value)
+                except (ValueError, SyntaxError):
+                    default = ...
+            yield node.name, stmt.target.id, default, stmt.lineno
+
+
+def _argparse_flags(src: SourceFile) -> Dict[str, dict]:
+    """flag string -> {line, store_true, default} from add_argument calls."""
+    out: Dict[str, dict] = {}
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if not (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "add_argument"
+        ):
+            continue
+        flags = [
+            a.value for a in node.args
+            if isinstance(a, ast.Constant) and isinstance(a.value, str)
+            and a.value.startswith("--")
+        ]
+        if not flags:
+            continue
+        kw = {}
+        for k in node.keywords:
+            if k.arg in ("action", "default"):
+                try:
+                    kw[k.arg] = ast.literal_eval(k.value)
+                except (ValueError, SyntaxError):
+                    kw[k.arg] = ...
+        info = {
+            "line": node.lineno,
+            "store_true": kw.get("action") == "store_true",
+            "default": kw.get("default", None),
+        }
+        for f in flags:
+            out[f] = info
+    return out
+
+
+def check_gates(sources: List[SourceFile], cfg: C.Config) -> List[Violation]:
+    out: List[Violation] = []
+    by_rel = {s.rel: s for s in sources}
+
+    all_flags: Dict[str, dict] = {}
+    for rel in cfg.argparse_files:
+        src = by_rel.get(rel)
+        if src is None:
+            continue
+        flags = _argparse_flags(src)
+        all_flags.update(flags)
+        for flag, info in sorted(flags.items()):
+            if info["store_true"] and info["default"] is True:
+                if src.allowed_at(info["line"], "SC403"):
+                    continue
+                out.append(Violation(
+                    rule="SC403", file=rel, line=info["line"],
+                    qualname="argparse",
+                    message=(
+                        f"store_true flag {flag} declared with default=True "
+                        "can never express False"
+                    ),
+                    detail=flag,
+                ))
+
+    for conf_rel, classes in cfg.gate_classes:
+        src = by_rel.get(conf_rel)
+        if src is None:
+            continue
+        for cls, field, default, line in _gate_fields(src, classes):
+            qual = f"{cls}.{field}"
+            if default is True:
+                if not src.allowed_at(line, "SC401"):
+                    out.append(Violation(
+                        rule="SC401", file=conf_rel, line=line,
+                        qualname=qual,
+                        message=(
+                            f"gate {qual} defaults to True — gates must be "
+                            "default-off (False) or auto-safe (None); if "
+                            "always-on IS the established contract, "
+                            "annotate with the reason"
+                        ),
+                        detail=field,
+                    ))
+            kebab = field.replace("_", "-")
+            candidates = {
+                f"--{kebab}",
+                f"--no-{kebab}",
+                cfg.gate_flag_overrides.get(field, ""),
+            }
+            if field.startswith("enable_"):
+                stem = field[len("enable_"):].replace("_", "-")
+                candidates.update({f"--{stem}", f"--no-{stem}"})
+            if not candidates & set(all_flags):
+                if src.allowed_at(line, "SC402"):
+                    continue
+                out.append(Violation(
+                    rule="SC402", file=conf_rel, line=line, qualname=qual,
+                    message=(
+                        f"gate {qual} has no CLI flag parity "
+                        f"(expected --{kebab} or --no-{kebab} on the "
+                        "argparse surface); an unreachable gate becomes "
+                        "mandatory in production"
+                    ),
+                    detail=field,
+                ))
+    return out
