@@ -185,6 +185,9 @@ class LLMEngine:
         self._export_ttl_s = 300.0
         self._export_queue = None
         self._export_thread = None
+        # Guards the export thread/queue handles: lazily started from
+        # the step thread, retired from the close path (asyncio loop).
+        self._export_lock = threading.Lock()
         self.remote_prefix_blocks_fetched = 0
         self.remote_prefix_blocks_exported = 0
         self.scheduler = Scheduler(
@@ -1287,6 +1290,7 @@ class LLMEngine:
         self.remote_prefix_blocks_fetched += len(ids)
         return prefix_blocks + ids, cached_len + len(ids) * bs
 
+    # stackcheck: thread=px-export
     def _export_worker(self) -> None:
         client = self.offload.remote_client
         while True:
@@ -1317,6 +1321,50 @@ class LLMEngine:
             finally:
                 for _ in batch:
                     self._export_queue.task_done()
+
+    def close(self, timeout: float = 10.0) -> None:
+        """Release every worker thread and socket the engine owns (the
+        SC6 lifecycle contract; AsyncEngine.close and the follower loop
+        land here).  Producers stop before their sinks: the prefetch
+        fetchers and the offload stager both write into the
+        HostOffloadManager (`insert_fetched`/`insert_saved`), and the
+        export worker reads `offload.remote_client` — so fetchers and
+        writers retire first, the manager flushes its deleter queue
+        second, and the remote client's sockets close last.
+
+        `timeout` is a shared budget across ALL stages, not per stage:
+        with the kvserver hung at drain time, per-stage budgets would
+        stack to minutes while helm's drainGraceSeconds is 30 — the
+        kubelet would SIGKILL the pod mid-close."""
+        deadline = time.monotonic() + timeout
+
+        def left() -> float:
+            return max(0.0, deadline - time.monotonic())
+
+        with self._export_lock:
+            export_thread, self._export_thread = self._export_thread, None
+        if export_thread is not None:
+            import queue as _queue
+
+            self.flush_prefix_exports(left())
+            try:
+                # The queue is bounded and full exactly when the writer
+                # is wedged mid-RPC against a hung store — an untimed
+                # put would block past the deadline this method promises.
+                self._export_queue.put(None, timeout=left())
+            except _queue.Full:
+                logger.warning(
+                    "prefix-export writer still wedged at shutdown; "
+                    "abandoning its daemon thread past the close deadline"
+                )
+            export_thread.join(left())
+        if self.kv_prefetch is not None:
+            self.kv_prefetch.shutdown(left())
+        if self._offload_stager is not None:
+            self._offload_stager.shutdown(left())
+        self.offload.close(left())
+        if self.offload.remote_client is not None:
+            self.offload.remote_client.close()
 
     def flush_prefix_exports(self, timeout: float = 10.0) -> None:
         """Block until queued exports have been written (tests; graceful
@@ -1353,14 +1401,15 @@ class LLMEngine:
         ]
         if not todo:
             return
-        if self._export_thread is None:
-            import queue as _queue
+        with self._export_lock:
+            if self._export_thread is None:
+                import queue as _queue
 
-            self._export_queue = _queue.Queue(maxsize=64)
-            self._export_thread = threading.Thread(
-                target=self._export_worker, name="px-export", daemon=True
-            )
-            self._export_thread.start()
+                self._export_queue = _queue.Queue(maxsize=64)
+                self._export_thread = threading.Thread(
+                    target=self._export_worker, name="px-export", daemon=True
+                )
+                self._export_thread.start()
         ids = jnp.asarray(
             [seq.block_table[i] for i, _ in todo], jnp.int32
         )

@@ -31,9 +31,12 @@ import logging
 import queue
 import threading
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import TYPE_CHECKING, Dict, List, Optional, Tuple
 
 import numpy as np
+
+if TYPE_CHECKING:
+    from production_stack_tpu.kvserver.client import RemoteKVClient
 
 logger = logging.getLogger(__name__)
 
@@ -51,7 +54,8 @@ class OffloadEntry:
 class HostOffloadManager:
     """Bounded host-DRAM pool of per-sequence KV block snapshots."""
 
-    def __init__(self, capacity_bytes: int, remote_client=None):
+    def __init__(self, capacity_bytes: int,
+                 remote_client: Optional["RemoteKVClient"] = None):
         self.capacity_bytes = int(capacity_bytes)
         self.used_bytes = 0
         self._entries: Dict[str, OffloadEntry] = {}
@@ -252,9 +256,12 @@ class HostOffloadManager:
             self._del_pending += 1
         self._del_queue.put(seq_id)
 
+    # stackcheck: thread=kv-remote-del
     def _delete_worker(self) -> None:
         while True:
             seq_id = self._del_queue.get()
+            if seq_id is None:
+                return
             try:
                 self.remote_client.delete(seq_id)
             except Exception:
@@ -277,6 +284,25 @@ class HostOffloadManager:
                     return False
                 self._del_cv.wait(remaining)
             return True
+
+    def close(self, timeout: float = 10.0) -> None:
+        """Flush queued remote DELs and retire the deleter thread (the
+        engine close path; SC601 lifecycle contract).  A DEL still
+        pending past the timeout leaks one store snapshot, which the
+        store's own eviction reclaims — warn, don't hang the drain.
+        The timeout is shared between the flush and the join so a hung
+        store costs at most one budget, not two."""
+        deadline = time.monotonic() + timeout
+        if not self.wait_deletes(timeout):
+            logger.warning(
+                "remote KV DELs still pending at shutdown; the store "
+                "leaks those snapshots until its own eviction"
+            )
+        with self._lock:
+            thread, self._del_thread = self._del_thread, None
+        if thread is not None:
+            self._del_queue.put(None)
+            thread.join(max(0.0, deadline - time.monotonic()))
 
     def _evict_oldest(self) -> None:
         oldest = min(self._entries.values(), key=lambda e: e.saved_at)
@@ -325,11 +351,11 @@ class OffloadStager:
             self._busy = True
             self._inflight_id = seq_id
             self._dead = False
-        if self._thread is None:
-            self._thread = threading.Thread(
-                target=self._worker, name="kv-offload-stage", daemon=True
-            )
-            self._thread.start()
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._worker, name="kv-offload-stage", daemon=True
+                )
+                self._thread.start()
         return True
 
     def release(self, seq_id: str) -> None:
@@ -372,6 +398,21 @@ class OffloadStager:
             time.sleep(0.005)
         return False
 
+    def shutdown(self, timeout: float = 10.0) -> None:
+        """Drain the in-flight snapshot and retire the writer thread
+        (engine close path).  wait_idle first: the writer owns staged
+        device buffers until it lands them, so a join-before-drain would
+        drop a snapshot mid-write.  The timeout is shared between the
+        drain and the join."""
+        deadline = time.monotonic() + timeout
+        self.wait_idle(timeout)
+        with self._lock:
+            thread, self._thread = self._thread, None
+        if thread is not None:
+            self._q.put(None)
+            thread.join(max(0.0, deadline - time.monotonic()))
+
+    # stackcheck: thread=kv-offload-stage
     def _worker(self) -> None:
         while True:
             item = self._q.get()

@@ -37,7 +37,11 @@ import logging
 import queue
 import threading
 import time
-from typing import Dict, List
+from typing import TYPE_CHECKING, Dict, List, Optional
+
+if TYPE_CHECKING:
+    from production_stack_tpu.engine.kv.offload import HostOffloadManager
+    from production_stack_tpu.kvserver.client import RemoteKVClient
 
 logger = logging.getLogger(__name__)
 
@@ -59,8 +63,8 @@ class PrefetchedChain:
 class PrefetchManager:
     def __init__(
         self,
-        client,
-        restore_sink=None,  # HostOffloadManager for restore page-ins
+        client: "RemoteKVClient",
+        restore_sink: Optional["HostOffloadManager"] = None,
         num_threads: int = 2,
         observe_fetch=None,  # callable(seconds) or None
     ):
@@ -203,15 +207,17 @@ class PrefetchManager:
     # -- worker ------------------------------------------------------------
 
     def _ensure_threads(self) -> None:
-        if self._threads:
-            return
-        for i in range(self._num_threads):
-            t = threading.Thread(
-                target=self._worker, name=f"kv-prefetch-{i}", daemon=True
-            )
-            t.start()
-            self._threads.append(t)
+        with self._lock:
+            if self._threads:
+                return
+            for i in range(self._num_threads):
+                t = threading.Thread(
+                    target=self._worker, name=f"kv-prefetch-{i}", daemon=True
+                )
+                t.start()
+                self._threads.append(t)
 
+    # stackcheck: thread=kv-prefetch
     def _worker(self) -> None:
         while True:
             key = self._q.get()
@@ -322,6 +328,15 @@ class PrefetchManager:
                 self._idle.wait(remaining)
         return True
 
-    def shutdown(self) -> None:
-        for _ in self._threads:
+    def shutdown(self, timeout: float = 5.0) -> None:
+        # One shared budget across every fetcher join: N hung fetchers
+        # must not stack N timeouts into the drain grace.  The handle
+        # list is swapped out under the lock (vs the lazy _ensure_threads
+        # start); the joins run outside it.
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            threads, self._threads = self._threads, []
+        for _ in threads:
             self._q.put(None)
+        for t in threads:
+            t.join(max(0.0, deadline - time.monotonic()))

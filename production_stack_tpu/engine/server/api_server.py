@@ -1538,6 +1538,39 @@ def _parse_buckets(args):
     return tuple(buckets)
 
 
+# stackcheck: thread=health-serve
+def _serve_health(health_loop, health_app, host, port) -> None:
+    """Follower health-probe server thread: own loop + AppRunner (not
+    web.run_app) so _run_follower can stop this thread and join it on
+    the way out — a bare run_app daemon thread would die with the
+    process holding a half-written probe response."""
+    asyncio.set_event_loop(health_loop)
+    runner = web.AppRunner(
+        health_app, handle_signals=False, access_log=None
+    )
+    try:
+        # The drain path's stop() can land while we are still inside
+        # a startup run_until_complete (follower_loop failing fast,
+        # e.g. unreachable leader): that raises "Event loop stopped
+        # before Future completed" — fall through to cleanup anyway
+        # so the listener socket is always released.
+        health_loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, host, port)
+        health_loop.run_until_complete(site.start())
+        health_loop.run_forever()
+    except RuntimeError:
+        pass
+    finally:
+        try:
+            if not health_loop.is_closed():
+                health_loop.run_until_complete(runner.cleanup())
+        except RuntimeError:
+            pass
+        finally:
+            if not health_loop.is_closed():
+                health_loop.close()
+
+
 def _run_follower(config, denv, args) -> None:
     """Follower process of a multi-host slice group: tiny /health app for
     k8s probes (the StatefulSet has one pod template, so every ordinal
@@ -1568,18 +1601,32 @@ def _run_follower(config, denv, args) -> None:
 
     health_app.router.add_get("/health", health)
 
-    def serve_health():
-        web.run_app(
-            health_app, host=args.host, port=args.port,
-            access_log=None, handle_signals=False,
-        )
+    health_loop = asyncio.new_event_loop()
 
-    threading.Thread(target=serve_health, daemon=True).start()
+    health_thread = threading.Thread(
+        target=_serve_health,
+        args=(health_loop, health_app, args.host, args.port),
+        name="health-serve", daemon=True,
+    )
+    health_thread.start()
     logger.info(
         "tpu-engine follower %d/%d ready (leader owns the HTTP surface)",
         denv.process_id, denv.num_processes,
     )
-    distributed.follower_loop(engine, channel)
+    try:
+        distributed.follower_loop(engine, channel)
+    finally:
+        # Drain path: stop the probe server and join it, then release
+        # the engine's worker threads (deleter queue included) so a
+        # follower restart never strands queued remote work.  The loop
+        # may already be closed (_serve_health died on a bind error);
+        # engine.close() must run regardless.
+        try:
+            health_loop.call_soon_threadsafe(health_loop.stop)
+        except RuntimeError:
+            pass
+        health_thread.join(10)
+        engine.close()
 
 
 def main(argv=None) -> None:

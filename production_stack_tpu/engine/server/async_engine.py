@@ -112,17 +112,12 @@ class AsyncEngine:
         self._wakeup.set()
         if self._thread is not None:
             await asyncio.to_thread(self._thread.join, 30)
-        # Remote KV DELs run on a daemon deleter thread (discard() only
-        # enqueues — see HostOffloadManager); flush them before exit or a
-        # drain that finishes the last stream drops the queued DELs and
-        # leaks one store snapshot per in-flight discard.
-        offload = getattr(self.engine, "offload", None)
-        if offload is not None and offload.remote_client is not None:
-            if not await asyncio.to_thread(offload.wait_deletes, 10.0):
-                logger.warning(
-                    "remote KV DELs still pending at shutdown; the store "
-                    "leaks those snapshots until its own eviction"
-                )
+        # Release the engine's own workers AFTER the step thread is gone
+        # (it is their producer): prefetch fetchers, offload stager
+        # writer, prefix exporter, the remote-KV deleter (whose queued
+        # DELs a drain must flush or the store leaks one snapshot per
+        # in-flight discard), and the kvserver sockets.
+        await asyncio.to_thread(self.engine.close)
 
     # -- request API (event-loop side) ------------------------------------
 
@@ -242,6 +237,7 @@ class AsyncEngine:
     # -- engine thread -----------------------------------------------------
 
     # stackcheck: root=step-thread
+    # stackcheck: thread=engine-step-loop
     def _run_loop(self) -> None:
         logger.info("engine step loop started")
         last_publish = time.time()

@@ -197,7 +197,11 @@ class RemoteKVClient:
                         "KV server does not speak MGET; falling back to "
                         "serial GETs"
                     )
-                    self._batch_ok = False
+                    # One-way False latch, but written under the pool
+                    # lock anyway: prefetch fetchers and the export
+                    # writer share this client (SC501).
+                    with self._cv:
+                        self._batch_ok = False
                     break
                 if status != proto.ST_OK:
                     raise RuntimeError(f"KV MGET failed with status {status}")
@@ -254,7 +258,8 @@ class RemoteKVClient:
                 logger.info(
                     "KV server does not speak MGET/MPUT; using serial ops"
                 )
-                self._batch_ok = False
+                with self._cv:
+                    self._batch_ok = False
         except Exception:
             pass  # transient: keep the current setting
 

@@ -1,0 +1,72 @@
+"""Seeded SC6 violations (resource lifecycle) plus the release patterns
+that must stay silent: a join reachable from the configured lifecycle
+root, ownership transfer by return, and `with`-scoped sockets."""
+
+import socket
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+
+class Spawner:
+    def start(self):
+        self._t = threading.Thread(target=self._loop, daemon=True)  # SC601
+        self._t.start()
+        self.pool = ThreadPoolExecutor(max_workers=1)               # SC603
+        self.sock = socket.create_connection(("127.0.0.1", 1))      # SC602
+
+    def _loop(self):
+        pass
+
+
+class Closer:
+    def start(self):
+        self._t = threading.Thread(target=self._loop)
+        self._t.start()
+
+    def _loop(self):
+        pass
+
+    def close(self):
+        # Configured lifecycle root for the fixture tree: the join is
+        # reachable, so Closer._t must NOT flag.
+        self._t.join(5)
+
+
+class Swapper:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._t = None
+        self._ts = []
+
+    def start(self):
+        self._t = threading.Thread(target=self._loop)
+        self._t.start()
+        t = threading.Thread(target=self._loop)
+        t.start()
+        self._ts.append(t)
+
+    def _loop(self):
+        pass
+
+    def close(self):
+        # Swap-under-lock idiom: the handle mutation is confined to the
+        # lock, the join runs on the local alias outside it.  Both the
+        # scalar and the list form must count as release sites.
+        with self._lock:
+            t, self._t = self._t, None
+        if t is not None:
+            t.join(5)
+        with self._lock:
+            ts, self._ts = self._ts, []
+        for x in ts:
+            x.join(5)
+
+
+class Transfer:
+    def dial(self):
+        sock = socket.create_connection(("127.0.0.1", 1))
+        return sock               # silent: ownership moves to the caller
+
+    def scoped(self):
+        with socket.create_connection(("127.0.0.1", 1)) as s:
+            return s.getsockname()  # silent: `with` releases it

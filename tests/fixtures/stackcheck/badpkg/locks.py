@@ -1,0 +1,98 @@
+"""Seeded SC5 violations (lock discipline / shared-state races) plus the
+patterns that must stay silent: common-lock guarded mutation, entry-lock
+propagation into a helper, and lock-releasing Condition waits."""
+
+import threading
+import time
+
+
+class Shared:
+    def __init__(self):
+        self.counter = 0          # SC501: two threads, no common lock
+        self.guarded = 0          # silent: both writers hold _lock
+        self.helper_guarded = 0   # silent: helper only called under _lock
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+
+    # stackcheck: thread=writer-a
+    def run_a(self):
+        self.counter += 1
+        with self._lock:
+            self.guarded += 1
+            self._bump_locked()
+
+    # stackcheck: thread=writer-b
+    def run_b(self):
+        self.counter += 1
+        with self._lock:
+            self.guarded += 1
+            self._bump_locked()
+
+    def _bump_locked(self):
+        # No `with` here, but every call site holds _lock: entry-lock
+        # propagation must keep this silent.
+        self.helper_guarded += 1
+
+    def slow_flush(self):
+        with self._lock:
+            time.sleep(0.1)       # SC502: blocking while _lock is held
+
+    def flush_outer(self):
+        with self._lock:
+            self._flush_locked()
+
+    def _flush_locked(self):
+        # No local `with`, but every call site holds _lock: the blocking
+        # call must still flag (SC502 via entry-lock propagation).
+        time.sleep(0.2)           # SC502: caller-held _lock
+
+    def patient_wait(self):
+        with self._cv:
+            self._cv.wait(1.0)    # silent: wait() releases the lock
+
+    def _retry_unlocked(self):
+        # Self-recursive with no call site outside the cycle: the
+        # entry-lock fixpoint's optimistic all_locks seed has no chain
+        # to drain through, so a naive intersection would pin every
+        # lock on this function forever — flagging this sleep as a
+        # phantom SC502 and treating any mutation here as guarded.
+        time.sleep(0.1)           # silent: no lock is ever held here
+        self.cycle_only = 1       # must not count as lock-guarded
+        self._retry_unlocked()
+
+
+class Annotated:
+    """A lock declared through an ANNOTATED assignment must register in
+    the class lock layout like the plain form — otherwise state it
+    correctly guards reads as a phantom SC501 race (and the lock is
+    silently exempt from SC502/SC503)."""
+
+    def __init__(self):
+        self._lock: threading.Lock = threading.Lock()
+        self.ann_guarded = 0      # silent: both writers hold the ann lock
+
+    # stackcheck: thread=writer-a
+    def bump_a(self):
+        with self._lock:
+            self.ann_guarded += 1
+
+    # stackcheck: thread=writer-b
+    def bump_b(self):
+        with self._lock:
+            self.ann_guarded += 1
+
+
+class Pair:
+    def __init__(self):
+        self.lock_a = threading.Lock()
+        self.lock_b = threading.Lock()
+
+    def fwd(self):
+        with self.lock_a:
+            with self.lock_b:     # order a -> b
+                pass
+
+    def rev(self):
+        with self.lock_b:
+            with self.lock_a:     # SC503: order b -> a closes the cycle
+                pass

@@ -1,0 +1,34 @@
+"""Fixture binary: identical argparse/route surface to the good chart —
+every seeded break lives on the chart side."""
+
+import argparse
+
+from aiohttp import web
+
+
+def build_parser():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--port", type=int, default=8000)
+    parser.add_argument("--max-num-seqs", type=int, default=8)
+    parser.add_argument("--drain-grace-s", type=float, default=30)
+    return parser
+
+
+async def ready(request):
+    return web.json_response({"status": "ok"})
+
+
+async def health(request):
+    return web.json_response({"status": "ok"})
+
+
+async def drain(request):
+    return web.json_response({"draining": True})
+
+
+def make_app():
+    app = web.Application()
+    app.router.add_get("/ready", ready)
+    app.router.add_get("/health", health)
+    app.router.add_post("/drain", drain)
+    return app

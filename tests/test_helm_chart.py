@@ -412,3 +412,113 @@ def test_router_dynamic_config_mount():
               if d["metadata"]["name"] == "dc-deployment-router"][0]
     container = router["spec"]["template"]["spec"]["containers"][0]
     assert "--dynamic-config-json" not in container["args"]
+
+
+# -- stackcheck SC7xx: the deployment-contract checker, end to end ----------
+#
+# A fixture chart pair drives tools/stackcheck's deployment rules the way
+# SC3xx is driven by the metrics fixtures: the GOOD chart renders (via the
+# in-repo helm_render, the clusterless `helm template` stand-in) and passes
+# clean; the BAD chart ALSO renders — every seeded break deploys fine and
+# only fails in production — and must flag all six rule kinds, including
+# the deliberately mismatched values default (maxNumSeqs 16 vs argparse 8).
+
+STACKCHECK_HELM = os.path.join(
+    os.path.dirname(__file__), "fixtures", "stackcheck_helm"
+)
+
+
+def _sc7_config(root):
+    from pathlib import Path
+
+    from tools.stackcheck import Config
+    from tools.stackcheck.config import DeploymentSurface
+
+    return Config(
+        repo_root=Path(root),
+        package_dirs=("binpkg",),
+        helm_values_path="helm/values.yaml",
+        helm_schema_path="helm/values.schema.json",
+        helm_overlay_paths=(),
+        robustness_docs_path="docs/robustness.md",
+        deployment_surfaces=(
+            DeploymentSurface(
+                template="helm/templates/deployment-engine.yaml",
+                argparse_file="binpkg/server.py",
+                route_files=("binpkg/server.py",),
+                values_spec="servingEngineSpec",
+                drain_values_spec="servingEngineSpec",
+            ),
+        ),
+    )
+
+
+def test_stackcheck_good_chart_renders_and_passes_sc7():
+    from tools.stackcheck import run_checks
+
+    root = os.path.join(STACKCHECK_HELM, "good")
+    rendered = render_chart(os.path.join(root, "helm"))
+    assert load_manifests(rendered), "good fixture chart must render"
+    assert run_checks(_sc7_config(root), families=["deployment"]) == []
+
+
+def test_stackcheck_bad_chart_renders_but_flags_every_seeded_break():
+    from tools.stackcheck import run_checks
+
+    root = os.path.join(STACKCHECK_HELM, "bad")
+    # The chart still template-renders: none of these breaks is a render
+    # error — that is exactly why the static cross-check exists.
+    assert load_manifests(render_chart(os.path.join(root, "helm")))
+
+    violations = run_checks(_sc7_config(root), families=["deployment"])
+    details = {(v.rule, v.detail) for v in violations}
+    # SC701: flag not on the binary's argparse surface.
+    assert ("SC701", "--log-level") in details
+    # SC702: the ISSUE-required mismatched values default (16 vs 8).
+    assert ("SC702", "servingEngineSpec.maxNumSeqs!=--max-num-seqs") in details
+    # SC703: probe paths that are not registered routes (values + template).
+    assert ("SC703", "/readyz") in details
+    assert ("SC703", "/healthz") in details
+    # SC703: /drain IS a route, but POST-only — kubelet probes GET.
+    assert ("SC703", "/drain") in details
+    # SC704: kubelet SIGKILL deadline inside the drain budget.
+    assert any(
+        r == "SC704" and "termination<=grace" in d for r, d in details
+    )
+    # SC705: template references a key the schema does not declare.
+    assert ("SC705", "servingEngineSpec.typoKey") in details
+    # SC706: docs table drifted from values.yaml (changed + removed key).
+    assert ("SC706", "servingEngineSpec.maxNumSeqs:default") in details
+    assert ("SC706", "servingEngineSpec.removedKey") in details
+
+
+def test_stackcheck_sc704_equality_flags_and_yaml_allow_suppresses(tmp_path):
+    """termination == grace must still flag — the termination countdown
+    also covers the preStop hook and teardown, so equality SIGKILLs a
+    drain that uses its full budget — and a values-side `# stackcheck:
+    allow=SC704 reason=...` records a deliberate divergence and
+    suppresses it."""
+    import shutil
+
+    from tools.stackcheck import run_checks
+
+    root = tmp_path / "tree"
+    shutil.copytree(os.path.join(STACKCHECK_HELM, "good"), root)
+    values = root / "helm" / "values.yaml"
+    equal = values.read_text().replace(
+        "terminationGracePeriodSeconds: 60",
+        "terminationGracePeriodSeconds: 30",
+    )
+    values.write_text(equal)
+    violations = run_checks(_sc7_config(root), families=["deployment"])
+    assert any(
+        v.rule == "SC704" and v.detail.endswith("termination<=grace")
+        for v in violations
+    ), violations
+
+    values.write_text(equal.replace(
+        "terminationGracePeriodSeconds: 30",
+        "terminationGracePeriodSeconds: 30"
+        "  # stackcheck: allow=SC704 reason=no preStop hook on this pod",
+    ))
+    assert run_checks(_sc7_config(root), families=["deployment"]) == []

@@ -44,6 +44,10 @@ def fixture_config(root: Path) -> Config:
         gate_classes=(("badpkg/config.py", ("FixtureConfig",)),),
         argparse_files=("badpkg/config.py",),
         gate_flag_overrides={},
+        lifecycle_roots=("lifecycle:Closer.close", "lifecycle:Swapper.close"),
+        lifecycle_extra_edges={},
+        helm_values_path=None,
+        robustness_docs_path=None,
     )
 
 
@@ -57,6 +61,92 @@ def by_rule(violations, rule):
 
 
 # -- 1. fixture: every family fires with exact ids/locations ---------------
+
+def test_lock_rules_flag_race_blocking_hold_and_cycle(fixture_violations):
+    # SC501: `counter` is written by writer-a and writer-b with no lock;
+    # the anchor is the first unlocked site.
+    sc501 = by_rule(fixture_violations, "SC501")
+    assert {v.detail for v in sc501} == {"Shared.counter"}
+    assert sc501[0].file == "badpkg/locks.py"
+    assert "writer-a" in sc501[0].message and "writer-b" in sc501[0].message
+    # SC502: time.sleep under a local `with _lock` AND under a caller-
+    # propagated (entry-held) lock; the Condition wait must not appear.
+    sc502 = by_rule(fixture_violations, "SC502")
+    assert {(v.qualname, v.detail) for v in sc502} \
+        == {("Shared.slow_flush", "time.sleep"),
+            ("Shared._flush_locked", "time.sleep")}
+    # SC503: lock_a->lock_b in fwd, lock_b->lock_a in rev.
+    sc503 = by_rule(fixture_violations, "SC503")
+    assert len(sc503) == 1
+    assert "lock_a" in sc503[0].detail and "lock_b" in sc503[0].detail
+
+
+def test_lock_rules_silent_on_guarded_and_entry_propagated_state(
+    fixture_violations,
+):
+    details = {v.detail for v in fixture_violations}
+    # Common-lock mutation and the helper only ever called under the
+    # lock (entry-lock propagation) must both stay silent.
+    assert "Shared.guarded" not in details
+    assert "Shared.helper_guarded" not in details
+    # A lock declared via AnnAssign (`self._lock: threading.Lock = ...`)
+    # registers like the plain form: no phantom race on guarded state.
+    assert "Annotated.ann_guarded" not in details
+    # A recursive helper with no call site outside its own cycle is
+    # entered lock-free: the optimistic entry-lock seed must not get
+    # stuck at all_locks and flag its sleep as blocking-under-lock.
+    sc502_quals = {v.qualname for v in fixture_violations if v.rule == "SC502"}
+    assert "Shared._retry_unlocked" not in sc502_quals
+
+
+def test_close_plane_is_thread_attributed_on_the_real_tree():
+    # AsyncEngine.close reaches LLMEngine.close via
+    # asyncio.to_thread(self.engine.close) — a function REFERENCE the
+    # AST cannot resolve — so SC5 thread attribution must consume the
+    # declared lifecycle edges or the whole close plane (exactly the
+    # concurrency-sensitive shutdown code) would belong to no thread
+    # and SC501/SC502 would go silent there.
+    from tools.stackcheck.callgraph import CallGraph
+    from tools.stackcheck.core import load_sources
+    from tools.stackcheck.rules_locks import thread_reach
+
+    cfg = Config(repo_root=REPO_ROOT)
+    graph = CallGraph(load_sources(cfg.repo_root, list(cfg.package_dirs)))
+    loop_fns = thread_reach(graph, cfg)["asyncio-loop"]
+    for sfx in (
+        "engine.core.engine:LLMEngine.close",
+        "engine.kv.offload:HostOffloadManager.close",
+        "engine.kv.offload:OffloadStager.shutdown",
+        "engine.kv.prefetch:PrefetchManager.shutdown",
+    ):
+        assert any(q.endswith(sfx) for q in loop_fns), sfx
+
+
+def test_lifecycle_rules_flag_thread_socket_and_pool(fixture_violations):
+    assert {(v.qualname, v.detail)
+            for v in by_rule(fixture_violations, "SC601")} \
+        == {("Spawner.start", "_t:threading.Thread")}
+    assert {v.detail for v in by_rule(fixture_violations, "SC602")} \
+        == {"sock:socket.create_connection"}
+    assert {v.detail for v in by_rule(fixture_violations, "SC603")} \
+        == {"pool:ThreadPoolExecutor"}
+
+
+def test_lifecycle_rules_silent_on_rooted_join_and_ownership_transfer(
+    fixture_violations,
+):
+    quals = {v.qualname for v in fixture_violations}
+    # Closer._t joins in close() (a configured lifecycle root); Transfer
+    # returns / `with`-scopes its sockets.
+    assert "Closer.start" not in quals
+    assert "Transfer.dial" not in quals
+    assert "Transfer.scoped" not in quals
+    # Swapper releases via the swap-under-lock idiom: the join runs on a
+    # local aliased from self._t / self._ts, which must count as a
+    # release site for both the scalar and the list form (and the
+    # lock-confined handle swap must not read as an SC501 race).
+    assert "Swapper.start" not in quals
+    assert not any(v.detail.startswith("Swapper.") for v in fixture_violations)
 
 def test_blocking_reachability_flags_socket_and_sleep(fixture_violations):
     sc101 = by_rule(fixture_violations, "SC101")
@@ -193,13 +283,13 @@ def _copy_tree(tmp_path: Path) -> Path:
         REPO_ROOT / "production_stack_tpu", root / "production_stack_tpu",
         ignore=shutil.ignore_patterns("__pycache__"),
     )
+    shutil.copytree(REPO_ROOT / "helm", root / "helm")
     shutil.copy(
         REPO_ROOT / "observability/tpu-dashboard.json",
         root / "observability/tpu-dashboard.json",
     )
-    shutil.copy(
-        REPO_ROOT / "docs/observability.md", root / "docs/observability.md"
-    )
+    for doc in ("observability.md", "robustness.md"):
+        shutil.copy(REPO_ROOT / "docs" / doc, root / "docs" / doc)
     return root
 
 
@@ -276,11 +366,111 @@ def test_removing_legacy_boundary_reexposes_the_rpc(tmp_path):
     ), "boundary removal did not re-expose the legacy sync RPC"
 
 
+def test_synthetic_unlocked_cross_thread_mutation_is_flagged(tmp_path, capsys):
+    """ISSUE-7 acceptance: an unlocked mutation of state the step thread
+    also writes (under its lock), grafted into the deleter thread, must
+    flag SC501 and fail the CLI."""
+    root = _copy_tree(tmp_path)
+    off = root / "production_stack_tpu/engine/kv/offload.py"
+    off.write_text(off.read_text().replace(
+        "            seq_id = self._del_queue.get()\n"
+        "            if seq_id is None:\n"
+        "                return\n",
+        "            seq_id = self._del_queue.get()\n"
+        "            if seq_id is None:\n"
+        "                return\n"
+        "            self._remote_keys.discard(seq_id)\n",
+    ))
+    violations = run_checks(Config(repo_root=root), families=["SC5"])
+    hits = [v for v in violations if v.rule == "SC501"]
+    assert any(v.detail == "HostOffloadManager._remote_keys" for v in hits), \
+        "injected unlocked cross-thread mutation was not flagged"
+    assert any("kv-remote-del" in v.message for v in hits)
+
+    from tools.stackcheck.__main__ import main
+
+    capsys.readouterr()
+    assert main(["--root", str(root), "--rules", "SC5"]) != 0
+    assert "SC501" in capsys.readouterr().out
+
+
+def test_synthetic_unjoined_thread_is_flagged(tmp_path, capsys):
+    """ISSUE-7 acceptance: a thread created with no join reachable from
+    any lifecycle root must flag SC601 and fail the CLI."""
+    root = _copy_tree(tmp_path)
+    pf = root / "production_stack_tpu/engine/kv/prefetch.py"
+    pf.write_text(pf.read_text().replace(
+        "    def _ensure_threads(self) -> None:\n",
+        "    def _start_watcher(self) -> None:\n"
+        "        self._watcher = threading.Thread(\n"
+        "            target=self._worker, daemon=True\n"
+        "        )\n"
+        "        self._watcher.start()\n"
+        "\n"
+        "    def _ensure_threads(self) -> None:\n",
+    ))
+    violations = run_checks(Config(repo_root=root), families=["SC6"])
+    assert any(
+        v.rule == "SC601" and v.detail == "_watcher:threading.Thread"
+        for v in violations
+    ), "injected unjoined thread was not flagged"
+
+    from tools.stackcheck.__main__ import main
+
+    capsys.readouterr()
+    assert main(["--root", str(root), "--rules", "SC6"]) != 0
+    assert "SC601" in capsys.readouterr().out
+
+
+def test_synthetic_helm_default_mismatch_is_flagged(tmp_path, capsys):
+    """ISSUE-7 acceptance: a values.yaml default diverging from the flag
+    default it is templated into must flag SC702 and fail the CLI."""
+    root = _copy_tree(tmp_path)
+    vals = root / "helm/values.yaml"
+    text = vals.read_text()
+    assert "  drainGraceSeconds: 30" in text
+    vals.write_text(
+        text.replace("  drainGraceSeconds: 30", "  drainGraceSeconds: 25", 1)
+    )
+    violations = run_checks(Config(repo_root=root), families=["SC7"])
+    assert any(
+        v.rule == "SC702"
+        and v.detail == "servingEngineSpec.drainGraceSeconds!=--drain-grace-s"
+        for v in violations
+    ), "injected chart/flag default mismatch was not flagged"
+
+    from tools.stackcheck.__main__ import main
+
+    capsys.readouterr()
+    assert main(["--root", str(root), "--rules", "SC7"]) != 0
+    assert "SC702" in capsys.readouterr().out
+
+
+def test_thread_roots_are_annotated():
+    """SC5 attribution is only as good as its thread map: every worker
+    thread the KV plane and servers spawn must carry a thread= annotation
+    (plus the implicit asyncio-loop root)."""
+    from tools.stackcheck.callgraph import CallGraph
+    from tools.stackcheck.core import load_sources
+
+    sources = load_sources(REPO_ROOT, ["production_stack_tpu"])
+    graph = CallGraph(sources)
+    threads = set(graph.find_thread_roots().values())
+    assert {
+        "engine-step-loop", "kv-prefetch", "kv-offload-stage",
+        "kv-remote-del", "px-export", "health-serve",
+    } <= threads
+
+
 # -- baseline ratchet -------------------------------------------------------
 
 def test_baseline_ratchet_refuses_growth(tmp_path):
     fix_cfg = fixture_config(FIXTURES)
-    violations = run_checks(fix_cfg)
+    # Legacy families only: SC5/SC6/SC7 keys are never auto-baselined
+    # (covered by test_update_baseline_refuses_to_grandfather...).
+    violations = run_checks(
+        fix_cfg, families=["blocking", "determinism", "metrics", "gates"]
+    )
     assert violations
     baseline_path = tmp_path / "baseline.json"
     # First write: allowed (no previous baseline).
@@ -294,6 +484,87 @@ def test_baseline_ratchet_refuses_growth(tmp_path):
     # Shrinking is fine.
     assert update_baseline(violations[:1], baseline_path) is None
     assert len(load_baseline(baseline_path)) == 1
+
+
+def test_baseline_sc5_entries_require_expiry(tmp_path):
+    """SC5/SC6/SC7 baseline entries only suppress with a live `expires`
+    date: a plain entry never suppresses, an expired one resurfaces."""
+    import datetime
+    import json as _json
+
+    key = "SC501::pkg/m.py::C.attr::C.attr"
+    path = tmp_path / "baseline.json"
+
+    path.write_text(_json.dumps({"version": 2, "entries": [key]}))
+    baseline = load_baseline(path)
+    assert key not in baseline
+    assert baseline.invalid_plain() == {key}
+
+    today = datetime.date(2026, 8, 3)
+    path.write_text(_json.dumps({
+        "version": 2, "entries": [],
+        "expiring": [{"key": key, "expires": "2026-09-01",
+                      "reason": "fix lands with the pool refactor"}],
+    }))
+    assert key in load_baseline(path, today=today)
+
+    path.write_text(_json.dumps({
+        "version": 2, "entries": [],
+        "expiring": [{"key": key, "expires": "2026-08-01", "reason": "x"}],
+    }))
+    expired = load_baseline(path, today=today)
+    assert key not in expired
+    assert expired.expired_keys() == {key}
+
+    # Legacy-family plain entries still suppress (no expiry needed).
+    legacy = "SC101::pkg/m.py::f::time.sleep"
+    path.write_text(_json.dumps({"version": 2, "entries": [legacy]}))
+    assert legacy in load_baseline(path)
+
+
+def test_update_baseline_refuses_to_grandfather_new_sc5_findings(tmp_path):
+    """--update-baseline never auto-writes an SC5/SC6/SC7 key: the
+    expiring entry must be added by hand (with a date and reason) — and
+    an EXPIRED entry must be renewed by hand, never silently re-written
+    with its stale date (the next plain run would still fail)."""
+    import datetime
+    import json as _json
+
+    from tools.stackcheck.core import write_baseline
+
+    fix_cfg = fixture_config(FIXTURES)
+    violations = [
+        v for v in run_checks(fix_cfg) if v.rule.startswith("SC5")
+    ]
+    assert violations
+    path = tmp_path / "baseline.json"
+    err = update_baseline(violations, path)
+    assert err is not None and "expiring" in err
+    assert not path.exists()
+
+    key = violations[0].key
+    path.write_text(_json.dumps({
+        "version": 2, "entries": [],
+        "expiring": [{"key": key, "expires": "2026-08-01", "reason": "x"}],
+    }))
+    expired = load_baseline(path, today=datetime.date(2026, 8, 3))
+    err = write_baseline(path, violations[:1], expired)
+    assert err is not None and "renewed" in err
+    # The same entry while still live re-writes fine.
+    live = load_baseline(path, today=datetime.date(2026, 7, 30))
+    assert write_baseline(path, violations[:1], live) is None
+    written = load_baseline(path, today=datetime.date(2026, 7, 30))
+    assert key in written
+
+
+def test_rule_family_aliases_resolve():
+    from tools.stackcheck import resolve_families
+
+    assert resolve_families(["SC5", "SC6", "SC7"]) \
+        == ["locks", "lifecycle", "deployment"]
+    assert resolve_families(["SC501", "blocking"]) == ["locks", "blocking"]
+    with pytest.raises(ValueError):
+        resolve_families(["SC9"])
 
 
 def test_malformed_annotation_is_itself_a_violation(tmp_path):

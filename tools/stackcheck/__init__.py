@@ -16,11 +16,12 @@ syntax.
 from __future__ import annotations
 
 from pathlib import Path
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Union
 
 from tools.stackcheck.callgraph import CallGraph
 from tools.stackcheck.config import Config
 from tools.stackcheck.core import (
+    Baseline,
     Violation,
     annotation_violations,
     load_baseline,
@@ -28,8 +29,11 @@ from tools.stackcheck.core import (
     write_baseline,
 )
 from tools.stackcheck.rules_blocking import check_async_blocking, check_blocking
+from tools.stackcheck.rules_deployment import check_deployment
 from tools.stackcheck.rules_determinism import check_determinism
 from tools.stackcheck.rules_gates import check_gates
+from tools.stackcheck.rules_lifecycle import check_lifecycle
+from tools.stackcheck.rules_locks import check_locks
 from tools.stackcheck.rules_metrics import check_metrics
 
 RULE_FAMILIES = {
@@ -38,9 +42,48 @@ RULE_FAMILIES = {
     "determinism": ("SC201", "SC202", "SC203"),
     "metrics": ("SC301", "SC302", "SC303", "SC304", "SC305", "SC306", "SC307"),
     "gates": ("SC401", "SC402", "SC403"),
+    "locks": ("SC501", "SC502", "SC503"),
+    "lifecycle": ("SC601", "SC602", "SC603"),
+    "deployment": ("SC701", "SC702", "SC703", "SC704", "SC705", "SC706"),
 }
 
-__all__ = ["Config", "Violation", "run_checks", "RULE_FAMILIES"]
+# `--rules SC5,SC6,SC7` style shorthands: rule-id prefix -> family name.
+FAMILY_ALIASES = {
+    "SC0": "annotations",
+    "SC1": "blocking",
+    "SC2": "determinism",
+    "SC3": "metrics",
+    "SC4": "gates",
+    "SC5": "locks",
+    "SC6": "lifecycle",
+    "SC7": "deployment",
+}
+
+__all__ = [
+    "Config", "Violation", "run_checks", "resolve_families",
+    "RULE_FAMILIES", "FAMILY_ALIASES",
+]
+
+
+def resolve_families(names: List[str]) -> List[str]:
+    """Map user-facing family selectors (family names, `SC5`-style
+    prefixes, or full rule ids like `SC501`) to family names.  Raises
+    ValueError on anything unknown."""
+    out: List[str] = []
+    for name in names:
+        if name in RULE_FAMILIES:
+            out.append(name)
+            continue
+        alias = FAMILY_ALIASES.get(name[:3]) if name.startswith("SC") else None
+        if alias is not None:
+            out.append(alias)
+            continue
+        raise ValueError(
+            f"unknown rule family {name!r} (families: "
+            f"{', '.join(RULE_FAMILIES)}; shorthands: "
+            f"{', '.join(FAMILY_ALIASES)})"
+        )
+    return out
 
 
 def run_checks(
@@ -50,31 +93,39 @@ def run_checks(
     violation NOT suppressed by an inline annotation.  Baseline
     filtering is the caller's business (the CLI applies it; tests
     usually want the raw list)."""
-    wanted = set(families or RULE_FAMILIES)
+    wanted = set(resolve_families(families) if families else RULE_FAMILIES)
     sources = load_sources(cfg.repo_root, list(cfg.package_dirs))
     violations: List[Violation] = []
     if "annotations" in wanted:
         violations += annotation_violations(sources)
-    if wanted & {"blocking", "determinism"}:
+    if wanted & {"blocking", "determinism", "locks", "lifecycle"}:
         graph = CallGraph(sources)
         if "blocking" in wanted:
             violations += check_blocking(graph, cfg)
             violations += check_async_blocking(graph, cfg)
         if "determinism" in wanted:
             violations += check_determinism(graph, cfg)
+        if "locks" in wanted:
+            violations += check_locks(graph, cfg)
+        if "lifecycle" in wanted:
+            violations += check_lifecycle(graph, cfg)
     if "metrics" in wanted:
         violations += check_metrics(sources, cfg)
     if "gates" in wanted:
         violations += check_gates(sources, cfg)
+    if "deployment" in wanted:
+        violations += check_deployment(cfg)
     violations.sort(key=lambda v: (v.file, v.line, v.rule, v.detail))
     return violations
 
 
 def apply_baseline(
-    violations: List[Violation], baseline_path: Path
+    violations: List[Violation], baseline: Union[Path, Baseline]
 ) -> Dict[str, List[Violation]]:
-    """Split violations into {'new': [...], 'baselined': [...]}."""
-    baseline = load_baseline(baseline_path)
+    """Split violations into {'new': [...], 'baselined': [...]}.  Accepts
+    a pre-loaded Baseline so the CLI parses the file only once."""
+    if isinstance(baseline, Path):
+        baseline = load_baseline(baseline)
     new = [v for v in violations if v.key not in baseline]
     old = [v for v in violations if v.key in baseline]
     return {"new": new, "baselined": old}

@@ -11,17 +11,20 @@ import argparse
 import json
 import sys
 from pathlib import Path
+from typing import List, Optional
 
 from tools.stackcheck import (
     RULE_FAMILIES,
     Config,
     apply_baseline,
+    resolve_families,
     run_checks,
     update_baseline,
 )
+from tools.stackcheck.core import load_baseline
 
 
-def main(argv=None) -> int:
+def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m tools.stackcheck",
         description="AST/call-graph invariant checker (docs/static-analysis.md)",
@@ -33,7 +36,8 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--rules", default=None,
         help=f"comma-separated rule families (default: all of "
-             f"{','.join(RULE_FAMILIES)})",
+             f"{','.join(RULE_FAMILIES)}; SC1..SC7 shorthands accepted, "
+             "e.g. --rules SC5,SC6,SC7)",
     )
     parser.add_argument(
         "--baseline", default=None,
@@ -55,9 +59,10 @@ def main(argv=None) -> int:
     cfg = Config(repo_root=root)
     families = args.rules.split(",") if args.rules else None
     if families:
-        unknown = set(families) - set(RULE_FAMILIES)
-        if unknown:
-            parser.error(f"unknown rule families: {sorted(unknown)}")
+        try:
+            families = resolve_families(families)
+        except ValueError as exc:
+            parser.error(str(exc))
 
     violations = run_checks(cfg, families)
 
@@ -73,8 +78,22 @@ def main(argv=None) -> int:
               f"({len(violations)} entries)")
         return 0
 
-    split = apply_baseline(violations, baseline_path)
+    baseline = load_baseline(baseline_path)
+    split = apply_baseline(violations, baseline)
     new, old = split["new"], split["baselined"]
+    for key in sorted(baseline.invalid_plain()):
+        print(
+            f"stackcheck: baseline entry {key} belongs to an "
+            "expiry-required family (SC5/SC6/SC7) but has no `expiring` "
+            "metadata — it does NOT suppress", file=sys.stderr,
+        )
+    for key in sorted(baseline.expired_keys()):
+        meta = baseline.expiring[key]
+        print(
+            f"stackcheck: baseline entry {key} expired on "
+            f"{meta.get('expires')} — the finding resurfaces below",
+            file=sys.stderr,
+        )
 
     if args.as_json:
         print(json.dumps({

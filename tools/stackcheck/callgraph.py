@@ -19,9 +19,11 @@ from __future__ import annotations
 
 import ast
 import dataclasses
+import re
 from typing import Dict, Iterable, List, Optional, Set, Tuple
 
 from tools.stackcheck.core import SourceFile
+from tools.stackcheck.core import self_attr_name as _self_attr_name
 
 # Attribute-call basenames too generic to resolve by name alone.
 _MAX_AMBIGUOUS_TARGETS = 4
@@ -33,7 +35,7 @@ class FuncInfo:
     module: str              # dotted module path
     cls: Optional[str]
     name: str
-    node: ast.AST            # FunctionDef | AsyncFunctionDef
+    node: "ast.FunctionDef | ast.AsyncFunctionDef"
     src: SourceFile
     is_async: bool
 
@@ -43,7 +45,7 @@ class FuncInfo:
 
     @property
     def end_line(self) -> int:
-        return getattr(self.node, "end_lineno", self.node.lineno)
+        return self.node.end_lineno or self.node.lineno
 
 
 def _module_name(rel: str) -> str:
@@ -55,7 +57,7 @@ def _module_name(rel: str) -> str:
 
 
 class CallGraph:
-    def __init__(self, sources: List[SourceFile]):
+    def __init__(self, sources: List[SourceFile]) -> None:
         self.sources = sources
         self.functions: Dict[str, FuncInfo] = {}
         # method name -> qualnames defining it (for attribute resolution)
@@ -63,9 +65,23 @@ class CallGraph:
         # class name -> {method name -> qualname}
         self.by_class: Dict[str, Dict[str, str]] = {}
         self.edges: Dict[str, Set[str]] = {}
+        # Edges resolved WITHOUT the by-name over-approximation: only
+        # same-module/import/self/typed-receiver resolutions.  Thread
+        # attribution (SC5) and lock-order analysis use these — a false
+        # edge there manufactures a race/deadlock out of nothing.
+        self.typed_edges: Dict[str, Set[str]] = {}
+        # (module, class) -> {self attr -> bare class name} inferred from
+        # `self.X = ClassName(...)` ctors and annotated params/attrs.
+        self.attr_types: Dict[Tuple[str, str], Dict[str, str]] = {}
         # per-module import alias maps: module -> {alias: dotted target}
         self._imports: Dict[str, Dict[str, str]] = {}
+        # Top-level package names of the analyzed sources ("production_
+        # stack_tpu", fixture roots): aliases outside these are external.
+        self._package_roots: Set[str] = {
+            _module_name(src.rel).split(".")[0] for src in sources
+        }
         self._index()
+        self._infer_attr_types()
         self._build_edges()
 
     # -- indexing ----------------------------------------------------------
@@ -83,7 +99,8 @@ class CallGraph:
                         imports[a.asname or a.name] = f"{node.module}.{a.name}"
             self._imports[mod] = imports
 
-            def add(node, cls: Optional[str]):
+            def add(node: "ast.FunctionDef | ast.AsyncFunctionDef",
+                    cls: Optional[str]) -> None:
                 q = (
                     f"{mod}:{cls}.{node.name}" if cls else f"{mod}:{node.name}"
                 )
@@ -105,9 +122,80 @@ class CallGraph:
                         if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
                             add(sub, node.name)
 
+    # -- attribute typing --------------------------------------------------
+
+    def _ann_class_name(self, ann: Optional[ast.expr]) -> Optional[str]:
+        """Bare class name out of an annotation expression: ``T``,
+        ``mod.T``, ``Optional[T]``, or the string forms of any of those.
+        Only names that are actually package classes count."""
+        name: Optional[str] = None
+        if isinstance(ann, ast.Name):
+            name = ann.id
+        elif isinstance(ann, ast.Attribute):
+            name = ann.attr
+        elif isinstance(ann, ast.Subscript):
+            return self._ann_class_name(ann.slice)
+        elif isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+            text = ann.value.strip()
+            inner = re.fullmatch(r"Optional\[(.+)\]", text)
+            if inner:
+                text = inner.group(1)
+            name = text.rsplit(".", 1)[-1]
+            if not name.isidentifier():
+                return None
+        if name is not None and name in self.by_class:
+            return name
+        return None
+
+    def _infer_attr_types(self) -> None:
+        for info in self.functions.values():
+            if info.cls is None:
+                continue
+            key = (info.module, info.cls)
+            types = self.attr_types.setdefault(key, {})
+            args = info.node.args
+            params: Dict[str, str] = {}
+            for a in list(args.args) + list(args.kwonlyargs):
+                t = self._ann_class_name(a.annotation)
+                if t is not None:
+                    params[a.arg] = t
+            for node in ast.walk(info.node):
+                attr: Optional[str] = None
+                t = None
+                if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                    attr = _self_attr_name(node.targets[0])
+                    value: Optional[ast.expr] = node.value
+                elif isinstance(node, ast.AnnAssign):
+                    attr = _self_attr_name(node.target)
+                    t = self._ann_class_name(node.annotation)
+                    value = node.value
+                else:
+                    continue
+                if attr is None:
+                    continue
+                if t is None and isinstance(value, ast.Call):
+                    ctor = value.func
+                    base = (
+                        ctor.id if isinstance(ctor, ast.Name)
+                        else ctor.attr if isinstance(ctor, ast.Attribute)
+                        else None
+                    )
+                    if base is not None and base in self.by_class:
+                        t = base
+                if t is None and isinstance(value, ast.Name):
+                    t = params.get(value.id)
+                if t is not None:
+                    types.setdefault(attr, t)
+
     # -- edges -------------------------------------------------------------
 
-    def _resolve_call(self, call: ast.Call, info: FuncInfo) -> List[str]:
+    def _resolve_call(self, call: ast.Call, info: FuncInfo,
+                      ambiguous: bool = True) -> List[str]:
+        """Resolve a call to package qualnames.  ``ambiguous=False``
+        disables the by-name over-approximation for unknown receivers —
+        right for rules where a false edge manufactures a violation out
+        of nothing (lock-order cycles), wrong for deny-list reachability
+        (where a missed edge is the expensive failure)."""
         fn = call.func
         targets: List[str] = []
         if isinstance(fn, ast.Name):
@@ -138,6 +226,30 @@ class CallGraph:
             if q:
                 return [q]
             # Fall through: attribute may be a callback or inherited.
+        # self.X.method() where self.X's class was inferred from a ctor
+        # assignment or an annotated param.
+        if (
+            isinstance(base, ast.Attribute)
+            and isinstance(base.value, ast.Name)
+            and base.value.id in ("self", "cls")
+            and info.cls is not None
+        ):
+            t = self.attr_types.get((info.module, info.cls), {}).get(base.attr)
+            if t is not None:
+                q = self.by_class.get(t, {}).get(attr)
+                if q:
+                    return [q]
+        # param.method() where the enclosing function annotates `param`.
+        if isinstance(base, ast.Name):
+            args = info.node.args
+            for a in list(args.args) + list(args.kwonlyargs):
+                if a.arg == base.id:
+                    t = self._ann_class_name(a.annotation)
+                    if t is not None:
+                        q = self.by_class.get(t, {}).get(attr)
+                        if q:
+                            return [q]
+                    break
         # module.func() via import alias.
         if isinstance(base, ast.Name):
             imported = self._imports.get(info.module, {}).get(base.id)
@@ -148,35 +260,68 @@ class CallGraph:
                 q = f"{imported}:{attr}"
                 if q in self.functions:
                     return [q]
+                if imported.split(".")[0] not in self._package_roots:
+                    # Known alias of an EXTERNAL module (logging, os.path
+                    # ...): definitively not a package call — never fall
+                    # through to the by-name guess (`logging.shutdown()`
+                    # must not resolve to every package `shutdown`).
+                    return targets
         # Unknown receiver: by-name over-approximation.
-        candidates = self.by_name.get(attr, [])
-        if 0 < len(candidates) <= _MAX_AMBIGUOUS_TARGETS:
-            targets.extend(candidates)
+        if ambiguous:
+            candidates = self.by_name.get(attr, [])
+            if 0 < len(candidates) <= _MAX_AMBIGUOUS_TARGETS:
+                targets.extend(candidates)
         return targets
 
     def _build_edges(self) -> None:
         for q, info in self.functions.items():
             outs: Set[str] = set()
+            typed: Set[str] = set()
             for node in ast.walk(info.node):
                 if isinstance(node, ast.Call):
                     outs.update(self._resolve_call(node, info))
+                    typed.update(
+                        self._resolve_call(node, info, ambiguous=False)
+                    )
             outs.discard(q)
+            typed.discard(q)
             self.edges[q] = outs
+            self.typed_edges[q] = typed
 
     # -- queries -----------------------------------------------------------
+
+    def expand_suffix_edges(
+        self, suffix_edges: Dict[str, List[str]]
+    ) -> Dict[str, List[str]]:
+        """Expand suffix-keyed dynamic edges (Config.lifecycle_extra_edges
+        style: caller suffix -> callee suffixes) into the full-qualname
+        form ``reachable`` consumes."""
+        out: Dict[str, List[str]] = {}
+        for caller_sfx, callees in suffix_edges.items():
+            for q in self.functions:
+                if q.endswith(caller_sfx):
+                    out.setdefault(q, []).extend(
+                        t for sfx in callees for t in self.functions
+                        if t.endswith(sfx)
+                    )
+        return out
 
     def reachable(
         self,
         roots: Iterable[str],
         extra_edges: Optional[Dict[str, List[str]]] = None,
         exclude: Optional[Set[str]] = None,
+        strict: bool = False,
     ) -> Dict[str, Tuple[str, ...]]:
         """BFS from ``roots``; returns {qualname: path-from-root} (path
         includes the qualname itself, root first).  ``extra_edges``
         injects callback edges the AST cannot see.  ``exclude`` qualnames
-        (boundary annotations: legacy/gated subtrees) are never entered."""
+        (boundary annotations: legacy/gated subtrees) are never entered.
+        ``strict=True`` walks ``typed_edges`` (no by-name guesses) — for
+        analyses where a phantom edge manufactures a violation."""
         extra = extra_edges or {}
         excl = exclude or set()
+        edges = self.typed_edges if strict else self.edges
         out: Dict[str, Tuple[str, ...]] = {}
         queue: List[Tuple[str, Tuple[str, ...]]] = [
             (r, (r,)) for r in roots if r in self.functions and r not in excl
@@ -186,7 +331,7 @@ class CallGraph:
             if q in out:
                 continue
             out[q] = path
-            nxt = set(self.edges.get(q, ()))
+            nxt = set(edges.get(q, ()))
             nxt.update(extra.get(q, ()))
             for callee in sorted(nxt):
                 if (
@@ -197,20 +342,24 @@ class CallGraph:
                     queue.append((callee, path + (callee,)))
         return out
 
-    def _annotated(self, table_name: str, kind_prefix: str) -> List[str]:
-        found = []
+    def _annotated_kinds(self, table_name: str,
+                         kind_prefix: str) -> Dict[str, str]:
+        found: Dict[str, str] = {}
         for q, info in self.functions.items():
-            table = getattr(info.src, table_name)
+            table: Dict[int, str] = getattr(info.src, table_name)
             first = min(
                 [info.def_line]
-                + [d.lineno for d in getattr(info.node, "decorator_list", [])]
+                + [d.lineno for d in info.node.decorator_list]
             )
             for ln in range(first - 2, info.def_line + 1):
                 kind = table.get(ln)
                 if kind is not None and kind.startswith(kind_prefix):
-                    found.append(q)
+                    found[q] = kind
                     break
-        return sorted(found)
+        return found
+
+    def _annotated(self, table_name: str, kind_prefix: str) -> List[str]:
+        return sorted(self._annotated_kinds(table_name, kind_prefix))
 
     def find_roots(self, kind_prefix: str = "") -> List[str]:
         """Functions annotated ``# stackcheck: root=<kind>`` on or
@@ -221,3 +370,9 @@ class CallGraph:
         """Functions annotated ``# stackcheck: boundary=<kind>``: gated
         legacy subtrees the reachability rules must not descend into."""
         return self._annotated("boundaries", kind_prefix)
+
+    def find_thread_roots(self) -> Dict[str, str]:
+        """qualname -> thread name for every function annotated
+        ``# stackcheck: thread=<name>`` (the entry point — target= — of a
+        named OS thread)."""
+        return self._annotated_kinds("threads", "")

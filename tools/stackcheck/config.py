@@ -110,6 +110,78 @@ BENIGN_SINK_SUBSTRINGS: Tuple[str, ...] = (
 )
 
 
+# -- SC6: lifecycle roots ----------------------------------------------------
+
+# Qualname suffixes of the functions a graceful shutdown runs: every
+# thread/socket/executor release site must be reachable from one of
+# these (rules_lifecycle.py).
+DEFAULT_LIFECYCLE_ROOTS: Tuple[str, ...] = (
+    "engine.server.async_engine:AsyncEngine.close",
+    "engine.core.engine:LLMEngine.close",
+    "utils.registry:ServiceRegistry.close",
+)
+
+# Dynamic close edges the AST cannot resolve (generic `close` attribute
+# calls are too ambiguous for by-name resolution): caller suffix ->
+# callee suffixes.
+DEFAULT_LIFECYCLE_EXTRA_EDGES: Dict[str, List[str]] = {
+    # AsyncEngine.close() -> self.engine.close() (attr call, untyped).
+    "engine.server.async_engine:AsyncEngine.close": [
+        "engine.core.engine:LLMEngine.close",
+    ],
+    # LLMEngine.close() walks the KV plane: prefetch fetchers, offload
+    # stager writer, deleter thread, export thread, remote client.
+    "engine.core.engine:LLMEngine.close": [
+        "engine.kv.prefetch:PrefetchManager.shutdown",
+        "engine.kv.offload:OffloadStager.shutdown",
+        "engine.kv.offload:HostOffloadManager.close",
+        "kvserver.client:RemoteKVClient.close",
+    ],
+}
+
+
+@dataclasses.dataclass
+class DeploymentSurface:
+    """One helm template <-> binary pairing for the SC7 contract."""
+
+    template: str                   # repo-relative template path
+    argparse_file: str              # the binary's argparse surface
+    route_files: Tuple[str, ...] = ()   # files registering HTTP routes
+    values_spec: str = ""           # values subtree, e.g. "routerSpec"
+    # values subtree whose drainGraceSeconds must thread into
+    # --drain-grace-s (None: the binary has no drain contract).
+    drain_values_spec: Optional[str] = None
+
+
+DEFAULT_DEPLOYMENT_SURFACES: Tuple[DeploymentSurface, ...] = (
+    DeploymentSurface(
+        template="helm/templates/deployment-engine.yaml",
+        argparse_file="production_stack_tpu/engine/server/api_server.py",
+        route_files=("production_stack_tpu/engine/server/api_server.py",),
+        values_spec="servingEngineSpec",
+        drain_values_spec="servingEngineSpec",
+    ),
+    DeploymentSurface(
+        template="helm/templates/deployment-router.yaml",
+        argparse_file="production_stack_tpu/router/parser.py",
+        route_files=(
+            "production_stack_tpu/router/routers/main_router.py",
+            "production_stack_tpu/router/routers/metrics_router.py",
+            "production_stack_tpu/router/routers/debug_router.py",
+        ),
+        values_spec="routerSpec",
+        drain_values_spec="routerSpec",
+    ),
+    DeploymentSurface(
+        template="helm/templates/deployment-cache-server.yaml",
+        argparse_file="production_stack_tpu/kvserver/server.py",
+        route_files=(),            # TCP framing protocol, no HTTP routes
+        values_spec="cacheserverSpec",
+        drain_values_spec=None,
+    ),
+)
+
+
 @dataclasses.dataclass
 class Config:
     repo_root: Path
@@ -155,6 +227,25 @@ class Config:
     # Gate field name -> CLI flag, where kebab-casing isn't mechanical.
     gate_flag_overrides: Dict[str, str] = dataclasses.field(
         default_factory=lambda: {"enable_prefix_caching": "--no-prefix-caching"}
+    )
+    # -- resource lifecycle (SC6) ------------------------------------------
+    lifecycle_roots: Tuple[str, ...] = DEFAULT_LIFECYCLE_ROOTS
+    lifecycle_extra_edges: Dict[str, List[str]] = dataclasses.field(
+        default_factory=lambda: {
+            k: list(v) for k, v in DEFAULT_LIFECYCLE_EXTRA_EDGES.items()
+        }
+    )
+    # -- deployment contract (SC7) -----------------------------------------
+    helm_values_path: Optional[str] = "helm/values.yaml"
+    helm_schema_path: Optional[str] = "helm/values.schema.json"
+    helm_overlay_paths: Tuple[str, ...] = (
+        "helm/values-ci.yaml",
+        "helm/values-tpu-example.yaml",
+        "helm/values-multihost-example.yaml",
+    )
+    robustness_docs_path: Optional[str] = "docs/robustness.md"
+    deployment_surfaces: Tuple[DeploymentSurface, ...] = (
+        DEFAULT_DEPLOYMENT_SURFACES
     )
     baseline_path: str = "tools/stackcheck/baseline.json"
 

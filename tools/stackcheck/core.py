@@ -12,6 +12,14 @@ Annotation grammar (docs/static-analysis.md):
         marks the function as a reachability ROOT for the blocking (SC1)
         and determinism (SC2) rule families.
 
+    # stackcheck: thread=<name>
+        On/above a ``def``: the function is the ENTRY POINT of a named
+        OS thread (its target=), e.g. ``thread=kv-prefetch``.  The lock
+        rule family (SC5) attributes every function reachable from it to
+        that thread when deciding which shared state is touched from
+        more than one thread.  ``async def``s are implicitly attributed
+        to the ``asyncio-loop`` thread.
+
     # stackcheck: allow=SC101 reason=<free text to end of line>
         Suppresses the named rule(s) (comma-separated) on the same line,
         the line above the flagged statement, or — when placed on/above a
@@ -23,17 +31,21 @@ Baseline (``tools/stackcheck/baseline.json``): the escape hatch for
 pre-existing debt.  Keys are ``rule::file::qualname::detail`` (no line
 numbers, so unrelated edits don't churn it).  The ratchet is one-way:
 ``--update-baseline`` refuses to grow any rule's count — debt may only
-be paid down or explicitly annotated in source.
+be paid down or explicitly annotated in source.  Entries for the SC5/
+SC6/SC7 families additionally must carry an ``expires`` date (an entry
+without one never suppresses), so grandfathered concurrency/lifecycle/
+deployment findings cannot live forever.
 """
 
 from __future__ import annotations
 
 import ast
 import dataclasses
+import datetime as _dt
 import json
 import re
 from pathlib import Path
-from typing import Dict, List, Optional, Set, Tuple
+from typing import Dict, Iterable, Iterator, List, Optional, Set, Tuple
 
 ANNOTATION_RE = re.compile(
     r"#\s*stackcheck:\s*(?P<body>.+?)\s*$"
@@ -42,9 +54,30 @@ ALLOW_RE = re.compile(
     r"allow=(?P<rules>[A-Z0-9,]+)(?:\s+reason=(?P<reason>.+))?"
 )
 ROOT_RE = re.compile(r"root=(?P<kind>[a-z-]+)")
+THREAD_RE = re.compile(r"thread=(?P<kind>[a-z0-9-]+)")
 BOUNDARY_RE = re.compile(
     r"boundary=(?P<kind>[a-z-]+)(?:\s+reason=(?P<reason>.+))?"
 )
+
+# Rule-id prefixes whose baseline entries must carry an expiry date
+# (the ISSUE-7 families: races, lifecycle, deployment drift).
+EXPIRY_REQUIRED_PREFIXES: Tuple[str, ...] = ("SC5", "SC6", "SC7")
+
+
+def self_attr_name(node: Optional[ast.expr]) -> Optional[str]:
+    """``self.X`` / ``cls.X`` receiver expression -> ``"X"``, else None.
+
+    The single definition shared by callgraph attr typing, SC5 lock
+    tracking, and SC6 resource tracking — the three must agree on what
+    counts as instance state or their attributions silently diverge.
+    """
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id in ("self", "cls")
+    ):
+        return node.attr
+    return None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -74,7 +107,7 @@ class Allow:
 class SourceFile:
     """One parsed module: AST + per-line annotation maps."""
 
-    def __init__(self, path: Path, rel: str, text: str):
+    def __init__(self, path: Path, rel: str, text: str) -> None:
         self.path = path
         self.rel = rel
         self.text = text
@@ -83,6 +116,7 @@ class SourceFile:
         # line -> Allow entries whose comment sits ON that line.
         self.allows: Dict[int, List[Allow]] = {}
         self.roots: Dict[int, str] = {}  # line -> root kind
+        self.threads: Dict[int, str] = {}  # line -> thread name
         # line -> boundary kind: the annotated function is a legacy/
         # gated subtree the reachability rules must not descend into.
         # A reason is mandatory (same rationale as allow=).
@@ -96,6 +130,10 @@ class SourceFile:
             rm = ROOT_RE.search(body)
             if rm:
                 self.roots[i] = rm.group("kind")
+                continue
+            tm = THREAD_RE.search(body)
+            if tm:
+                self.threads[i] = tm.group("kind")
                 continue
             bm = BOUNDARY_RE.search(body)
             if bm:
@@ -174,32 +212,119 @@ def annotation_violations(sources: List[SourceFile]) -> List[Violation]:
 
 # -- baseline ----------------------------------------------------------------
 
-def load_baseline(path: Path) -> Set[str]:
+def _needs_expiry(key: str) -> bool:
+    return key.split("::", 1)[0][:3] in EXPIRY_REQUIRED_PREFIXES
+
+
+@dataclasses.dataclass
+class Baseline:
+    """Parsed baseline: plain (permanent) entries for the legacy rule
+    families, and expiring entries for the SC5/SC6/SC7 families.
+
+    A plain entry for an expiry-required family, or an expiring entry
+    past its date, is NOT live — the violation resurfaces."""
+
+    plain: Set[str] = dataclasses.field(default_factory=set)
+    # key -> {"expires": "YYYY-MM-DD", "reason": "..."}
+    expiring: Dict[str, Dict[str, str]] = dataclasses.field(default_factory=dict)
+    today: _dt.date = dataclasses.field(default_factory=_dt.date.today)
+    # Memo for live_keys(): every `key in baseline` membership test goes
+    # through it, and recomputing would re-parse every expiry date.
+    _live: Optional[Set[str]] = dataclasses.field(
+        default=None, init=False, repr=False, compare=False,
+    )
+
+    def _expired(self, key: str) -> bool:
+        meta = self.expiring.get(key)
+        if meta is None:
+            return False
+        try:
+            return _dt.date.fromisoformat(meta.get("expires", "")) < self.today
+        except ValueError:
+            return True  # unparseable expiry never suppresses
+
+    def live_keys(self) -> Set[str]:
+        if self._live is None:
+            live = {k for k in self.plain if not _needs_expiry(k)}
+            live |= {k for k in self.expiring if not self._expired(k)}
+            self._live = live
+        return self._live
+
+    def invalid_plain(self) -> Set[str]:
+        """Plain entries for families that require an expiry date."""
+        return {k for k in self.plain if _needs_expiry(k)}
+
+    def expired_keys(self) -> Set[str]:
+        return {k for k in self.expiring if self._expired(k)}
+
+    def __contains__(self, key: str) -> bool:
+        return key in self.live_keys()
+
+    def __len__(self) -> int:
+        return len(self.live_keys())
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(sorted(self.live_keys()))
+
+
+def load_baseline(path: Path,
+                  today: Optional[_dt.date] = None) -> Baseline:
     if not path.exists():
-        return set()
+        return Baseline(today=today or _dt.date.today())
     data = json.loads(path.read_text())
-    return set(data.get("entries", []))
+    plain = set(data.get("entries", []))
+    expiring: Dict[str, Dict[str, str]] = {}
+    for entry in data.get("expiring", []):
+        if isinstance(entry, dict) and "key" in entry:
+            expiring[str(entry["key"])] = {
+                "expires": str(entry.get("expires", "")),
+                "reason": str(entry.get("reason", "")),
+            }
+    return Baseline(plain=plain, expiring=expiring,
+                    today=today or _dt.date.today())
+
+
+def _rule_counts(keys: Iterable[str]) -> Dict[str, int]:
+    c: Dict[str, int] = {}
+    for k in keys:
+        rule = k.split("::", 1)[0]
+        c[rule] = c.get(rule, 0) + 1
+    return c
 
 
 def write_baseline(path: Path, violations: List[Violation],
-                   previous: Set[str]) -> Optional[str]:
+                   previous: Baseline) -> Optional[str]:
     """Write the baseline from the current violation set.  Ratchet: any
     rule whose entry count would GROW vs the previous baseline is an
-    error (returns the message; nothing written)."""
-    new_entries = sorted({v.key for v in violations})
+    error (returns the message; nothing written).  SC5/SC6/SC7 keys can
+    only be (re)written when the previous baseline already carries an
+    expiring entry for them — new findings in those families are fixed
+    or annotated in source, never auto-grandfathered."""
+    keys = sorted({v.key for v in violations})
 
-    def counts(entries) -> Dict[str, int]:
-        c: Dict[str, int] = {}
-        for e in entries:
-            rule = e.split("::", 1)[0]
-            c[rule] = c.get(rule, 0) + 1
-        return c
-
-    prev_c, new_c = counts(previous), counts(new_entries)
+    prev_live = previous.live_keys()
+    # `not in prev_live` (not merely `not in previous.expiring`): an
+    # EXPIRED expiring entry must not be silently re-written with its
+    # stale date — the next plain run would still fail, contradicting
+    # the "baseline written" success.
+    unexpirable = [
+        k for k in keys
+        if _needs_expiry(k) and k not in prev_live
+    ]
+    if unexpirable:
+        return (
+            "SC5/SC6/SC7 findings cannot be auto-baselined: they need an "
+            "explicit `expiring` entry (key + expires + reason) added — "
+            "or, if expired, renewed — by hand, or a fix/annotation in "
+            "source: "
+            + "; ".join(unexpirable[:5])
+            + ("; ..." if len(unexpirable) > 5 else "")
+        )
+    prev_c, new_c = _rule_counts(prev_live), _rule_counts(keys)
     grew = [
         f"{rule}: {prev_c.get(rule, 0)} -> {n}"
         for rule, n in sorted(new_c.items())
-        if n > prev_c.get(rule, 0) and previous
+        if n > prev_c.get(rule, 0) and prev_live
     ]
     if grew:
         return (
@@ -207,9 +332,18 @@ def write_baseline(path: Path, violations: List[Violation],
             "(fix or annotate new violations instead): "
             + "; ".join(grew)
         )
-    path.write_text(json.dumps({
-        "version": 1,
-        "counts": counts(new_entries),
-        "entries": new_entries,
-    }, indent=2) + "\n")
+
+    plain = [k for k in keys if not _needs_expiry(k)]
+    expiring = [
+        {"key": k, **previous.expiring[k]}
+        for k in keys if _needs_expiry(k)
+    ]
+    payload: Dict[str, object] = {
+        "version": 2,
+        "counts": _rule_counts(keys),
+        "entries": plain,
+    }
+    if expiring:
+        payload["expiring"] = expiring
+    path.write_text(json.dumps(payload, indent=2) + "\n")
     return None

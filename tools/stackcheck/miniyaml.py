@@ -1,0 +1,174 @@
+"""Minimal YAML-subset parser for the deployment-contract rules (SC7).
+
+stackcheck is pure stdlib by contract (it runs in the lint job with
+nothing installed and never imports the code it checks), so it cannot
+depend on PyYAML.  The helm values files use a disciplined subset —
+block maps, block lists, scalars, empty flow ``{}``/``[]``, comments —
+which this parser covers.  Anything outside the subset raises, loudly:
+silently misparsing a values file would undermine the contract checks.
+
+``parse(text)`` returns ``(data, key_lines)`` where ``key_lines`` maps
+dotted key paths (list indices as ``[i]``) to 1-based line numbers, so
+rules can anchor violations and look up inline allow comments.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Tuple, Union
+
+YamlValue = Union[None, bool, int, float, str, List["YamlValue"],
+                  Dict[str, "YamlValue"]]
+
+_KEY_RE = re.compile(r"^(?P<key>[A-Za-z0-9_./-]+|\"[^\"]*\"):(?:\s+(?P<rest>.*))?$")
+
+
+class MiniYamlError(ValueError):
+    pass
+
+
+def _strip_comment(line: str) -> str:
+    out = []
+    quote: Optional[str] = None
+    for i, ch in enumerate(line):
+        if quote is not None:
+            if ch == quote:
+                quote = None
+            out.append(ch)
+            continue
+        if ch in ("'", '"'):
+            quote = ch
+            out.append(ch)
+            continue
+        if ch == "#" and (i == 0 or line[i - 1] in (" ", "\t")):
+            break
+        out.append(ch)
+    return "".join(out).rstrip()
+
+
+def _scalar(text: str, lineno: int) -> YamlValue:
+    t = text.strip()
+    if t in ("", "~", "null", "Null", "NULL"):
+        return None
+    if t in ("true", "True"):
+        return True
+    if t in ("false", "False"):
+        return False
+    if t == "{}":
+        return {}
+    if t == "[]":
+        return []
+    if len(t) >= 2 and t[0] == t[-1] and t[0] in ("'", '"'):
+        return t[1:-1]
+    if re.fullmatch(r"[+-]?\d+", t):
+        return int(t)
+    if re.fullmatch(r"[+-]?\d*\.\d+", t):
+        return float(t)
+    if t.startswith(("{", "[", "|", ">", "&", "*")):
+        raise MiniYamlError(
+            f"line {lineno}: unsupported YAML construct {t!r} "
+            "(stackcheck's mini parser covers the helm values subset only)"
+        )
+    return t
+
+
+def parse(text: str) -> Tuple[YamlValue, Dict[str, int]]:
+    lines: List[Tuple[int, str, int]] = []  # (indent, content, lineno)
+    for ln, raw in enumerate(text.splitlines(), start=1):
+        stripped = _strip_comment(raw)
+        if not stripped.strip():
+            continue
+        if stripped.startswith("---"):
+            continue
+        indent = len(stripped) - len(stripped.lstrip(" "))
+        lines.append((indent, stripped.strip(), ln))
+
+    key_lines: Dict[str, int] = {}
+
+    def parse_block(i: int, indent: int, path: str) -> Tuple[YamlValue, int]:
+        if i >= len(lines) or lines[i][0] < indent:
+            return None, i
+        if lines[i][1].startswith("- ") or lines[i][1] == "-":
+            return parse_list(i, lines[i][0], path)
+        return parse_map(i, lines[i][0], path)
+
+    def parse_map(i: int, indent: int, path: str) -> Tuple[YamlValue, int]:
+        out: Dict[str, YamlValue] = {}
+        while i < len(lines):
+            ind, content, ln = lines[i]
+            if ind < indent:
+                break
+            if ind > indent:
+                raise MiniYamlError(f"line {ln}: unexpected indent")
+            m = _KEY_RE.match(content)
+            if m is None:
+                raise MiniYamlError(f"line {ln}: expected `key:`, got {content!r}")
+            key = m.group("key").strip('"')
+            rest = m.group("rest")
+            child_path = f"{path}.{key}" if path else key
+            key_lines[child_path] = ln
+            if rest is not None and rest.strip():
+                out[key] = _scalar(rest, ln)
+                i += 1
+            else:
+                value, i = parse_block(i + 1, indent + 1, child_path)
+                out[key] = {} if value is None else value
+        return out, i
+
+    def parse_list(i: int, indent: int, path: str) -> Tuple[YamlValue, int]:
+        out: List[YamlValue] = []
+        while i < len(lines):
+            ind, content, ln = lines[i]
+            if ind < indent or not (content.startswith("- ") or content == "-"):
+                break
+            if ind > indent:
+                raise MiniYamlError(f"line {ln}: unexpected list indent")
+            item_path = f"{path}[{len(out)}]"
+            key_lines[item_path] = ln
+            rest = content[1:].strip()
+            if not rest:
+                value, i = parse_block(i + 1, indent + 1, item_path)
+                out.append(value)
+                continue
+            m = _KEY_RE.match(rest)
+            if m is not None:
+                # Map item whose first key sits on the dash line: splice a
+                # virtual line at the item's key indent and parse a map.
+                dash_offset = content.index(rest[0])
+                lines[i] = (ind + dash_offset, rest, ln)
+                value, i = parse_map(i, ind + dash_offset, item_path)
+                out.append(value)
+            else:
+                out.append(_scalar(rest, ln))
+                i += 1
+        return out, i
+
+    data, i = parse_block(0, 0, "")
+    if i != len(lines):
+        raise MiniYamlError(
+            f"line {lines[i][2]}: trailing content the mini parser "
+            "could not attach"
+        )
+    return data, key_lines
+
+
+def get_path(data: YamlValue, dotted: str) -> YamlValue:
+    """Resolve ``a.b.c`` (no list indices) against parsed data; returns
+    None when any segment is missing."""
+    cur: YamlValue = data
+    for part in dotted.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    return cur
+
+
+def deep_merge(base: YamlValue, overlay: YamlValue) -> YamlValue:
+    """Helm-style values merge: maps merge recursively, everything else
+    (lists included) is replaced by the overlay."""
+    if isinstance(base, dict) and isinstance(overlay, dict):
+        out: Dict[str, YamlValue] = dict(base)
+        for k, v in overlay.items():
+            out[k] = deep_merge(out.get(k), v) if k in out else v
+        return out
+    return overlay if overlay is not None else base

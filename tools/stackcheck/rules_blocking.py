@@ -127,7 +127,7 @@ def check_async_blocking(graph: CallGraph, cfg: C.Config) -> List[Violation]:
         # Nested defs inside the async function run on whatever thread
         # calls them, not necessarily the event loop — scan only the
         # async function's own statements.
-        nested: set = set()
+        nested: Set[int] = set()
         for node in ast.walk(info.node):
             if node is not info.node and isinstance(
                 node, (ast.FunctionDef, ast.AsyncFunctionDef)

@@ -1,0 +1,553 @@
+"""Rule family SC7 — the deployment contract.
+
+Invariant (PR 5, docs/robustness.md): *the chart and the binaries agree.*
+The helm templates hardcode flag names, probe paths, ports, and the
+drain-grace threading; the binaries own the argparse surfaces and HTTP
+routes; `values.yaml`, `values.schema.json`, and the docs table all
+restate pieces of the same contract.  Nothing ties them together at
+runtime — a renamed flag or a probe pointing at a route that moved
+deploys fine and fails in production.  This family cross-checks the
+five surfaces the same way SC3xx cross-checks metrics:
+
+SC701  a flag templated into a container command/args does not exist on
+       that binary's argparse surface.
+SC702  a values key is templated into a flag but its default in
+       values.yaml differs from the flag's argparse default — the
+       chart-default deployment silently diverges from the documented
+       binary default.
+
+Every SC7 sub-rule honors the inline allow: a ``# stackcheck:
+allow=SC70x reason=...`` comment on (or directly above) the flagged
+line of the values file, template, or docs table (in markdown, inside
+an HTML comment on the row) suppresses a deliberate divergence with a
+recorded reason.
+SC703  a probe path (httpGet) or preStop hook path in a template/values
+       probe block is not a registered route on the target server — with
+       the right method: kubelet probes GET, preStop hooks POST, so a
+       POST-only route under a probe still flags — or a probe targets a
+       port name the template never declares.
+SC704  the drain contract is broken: the template does not thread the
+       spec's ``drainGraceSeconds`` into ``--drain-grace-s``, does not
+       source ``terminationGracePeriodSeconds`` from values, or a
+       shipped values file (base or overlay, helm-merged) sets
+       ``terminationGracePeriodSeconds <= drainGraceSeconds`` — strict
+       excess required: the termination countdown also covers the
+       preStop hook and teardown, so equality still SIGKILLs a drain
+       that uses its full budget.
+SC705  a values key referenced by a template is absent from
+       ``values.schema.json`` (typos in overrides validate clean).
+SC706  a row of the docs/robustness.md "Helm values" table names a key
+       missing from values.yaml, or documents a default that drifted.
+
+All YAML parsing is the stdlib-only subset parser (miniyaml.py); no
+template is rendered — the checks read the template source directly, so
+they cover every branch, not just one values combination.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import re
+from pathlib import Path
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.stackcheck import config as C
+from tools.stackcheck import miniyaml
+from tools.stackcheck.core import Violation
+from tools.stackcheck.rules_gates import _argparse_flags
+
+_FLAG_ITEM_RE = re.compile(r'^\s*-\s+"(--[a-z0-9-]+)"\s*$')
+_VALUE_ITEM_RE = re.compile(r"^\s*-\s+(.+?)\s*$")
+_VALUES_REF_RE = re.compile(r"\$?\.Values\.([A-Za-z0-9_.]+)")
+_MODEL_REF_RE = re.compile(r"\$m\.([A-Za-z0-9_.]+)")
+_MODEL_RANGE_RE = re.compile(
+    r"range\s+\$m\s*:=\s*\.Values\.([A-Za-z0-9_.]+)"
+)
+_HTTP_PATH_RE = re.compile(r"^\s*path:\s*(/[A-Za-z0-9_/-]*)\s*$")
+_PRESTOP_PATH_RE = re.compile(r"127\.0\.0\.1:\{\{[^}]*\}\}(/[A-Za-z0-9_/-]+)")
+_NAMED_PORT_RE = re.compile(r'-\s+name:\s+"([a-z0-9-]+)"\s*\n\s*containerPort:')
+_YAML_ALLOW_RE = re.compile(
+    r"#\s*stackcheck:\s*allow=(?P<rules>[A-Z0-9,]+)\s+reason=\S"
+)
+
+
+def _yaml_allowed(lines: List[str], line: int, rule: str) -> bool:
+    """Inline allow for YAML/values files: a `# stackcheck: allow=SC70x
+    reason=...` comment on the key's line or the line above."""
+    for ln in (line, line - 1):
+        if 1 <= ln <= len(lines):
+            m = _YAML_ALLOW_RE.search(lines[ln - 1])
+            if m and rule in m.group("rules").split(","):
+                return True
+    return False
+
+
+def _normalize_default(value: object) -> Optional[str]:
+    """Comparable rendering of a default (None for 'no default')."""
+    if value is None or value is ...:
+        return None
+    if isinstance(value, (dict, list)):
+        # A bare `key:` parses as {} (YAML null) and mappings/lists are
+        # never flag defaults — treat as "no default", not the str() of
+        # the container (which would fabricate an SC702 mismatch).
+        return None
+    if isinstance(value, bool):
+        return "true" if value else "false"
+    if isinstance(value, (int, float)):
+        f = float(value)
+        return str(int(f)) if f.is_integer() else repr(f)
+    s = str(value)
+    return s if s != "" else None
+
+
+def _collect_template_flags(
+    text: str,
+) -> List[Tuple[str, int, Optional[str]]]:
+    """(flag, line, values_path_or_None) for every `- "--flag"` list item
+    in a template; the values path comes from the next list item when it
+    references `.Values.*` (modelSpec `$m.*` refs return None — per-model
+    fields have no chart-level default to compare)."""
+    out: List[Tuple[str, int, Optional[str]]] = []
+    lines = text.splitlines()
+    for i, line in enumerate(lines):
+        m = _FLAG_ITEM_RE.match(line)
+        if m is None:
+            continue
+        flag = m.group(1)
+        values_path: Optional[str] = None
+        for nxt in lines[i + 1:i + 3]:
+            if _FLAG_ITEM_RE.match(nxt):
+                break  # boolean flag: next item is another flag
+            vm = _VALUE_ITEM_RE.match(nxt)
+            if vm is None:
+                continue
+            ref = _VALUES_REF_RE.search(vm.group(1))
+            if ref is not None:
+                values_path = ref.group(1)
+            break
+        out.append((flag, i + 1, values_path))
+    return out
+
+
+def _collect_values_refs(text: str) -> List[Tuple[str, int]]:
+    """Every values key path a template references, with its line:
+    `.Values.a.b` directly, `$m.x` mapped through whatever values list
+    the template's own `range $m := .Values.<path>` binds it to (no
+    binding in this template -> `$m` refs are skipped rather than
+    validated against a guessed subtree)."""
+    out: List[Tuple[str, int]] = []
+    binding = _MODEL_RANGE_RE.search(text)
+    model_base = f"{binding.group(1)}[]" if binding else None
+    for i, line in enumerate(text.splitlines()):
+        for m in _VALUES_REF_RE.finditer(line):
+            out.append((m.group(1), i + 1))
+        if model_base is not None:
+            for m in _MODEL_REF_RE.finditer(line):
+                out.append((f"{model_base}.{m.group(1)}", i + 1))
+    return out
+
+
+def _schema_has(schema: Dict[str, object], dotted: str) -> bool:
+    """Resolve a dotted key path (with `[]` for array items) against a
+    JSON-schema properties tree.  A subtree typed plain `object` with no
+    `properties` (free-form maps like labels/resources) accepts any
+    deeper path."""
+    node: object = schema
+    for raw in dotted.split("."):
+        parts = [raw]
+        if raw.endswith("[]"):
+            parts = [raw[:-2], "[]"]
+        for part in parts:
+            if not isinstance(node, dict):
+                return False
+            if part == "[]":
+                if "items" not in node:
+                    return False
+                node = node["items"]
+                continue
+            props = node.get("properties")
+            if not isinstance(props, dict):
+                # Free-form object (additionalProperties / untyped):
+                # accepts any key below it.
+                return "properties" not in node
+            if part not in props:
+                return False
+            node = props[part]
+    return True
+
+
+def _server_routes(path: Path) -> Set[Tuple[str, str]]:
+    """(METHOD, path) literals from aiohttp route registrations:
+    `app.router.add_get("/p", h)` and `@routes.get("/p")` styles."""
+    routes: Set[Tuple[str, str]] = set()
+    tree = ast.parse(path.read_text(), filename=str(path))
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if not isinstance(fn, ast.Attribute):
+            continue
+        method: Optional[str] = None
+        if fn.attr.startswith("add_") and fn.attr[4:] in (
+            "get", "post", "put", "delete", "patch", "head"
+        ):
+            method = fn.attr[4:].upper()
+        elif fn.attr in ("get", "post", "put", "delete", "patch", "head"):
+            method = fn.attr.upper()
+        if method is None or not node.args:
+            continue
+        arg = node.args[0]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            if arg.value.startswith("/"):
+                routes.add((method, arg.value))
+    return routes
+
+
+def check_deployment(cfg: C.Config) -> List[Violation]:
+    out: List[Violation] = []
+    values_path = cfg.resolve(cfg.helm_values_path)
+    if values_path is None or not values_path.exists():
+        return out  # no chart in this tree: nothing to check
+    values_text = values_path.read_text()
+    values_lines = values_text.splitlines()
+    values, value_key_lines = miniyaml.parse(values_text)
+
+    schema: Optional[Dict[str, object]] = None
+    schema_path = cfg.resolve(cfg.helm_schema_path)
+    if schema_path is not None and schema_path.exists():
+        loaded = json.loads(schema_path.read_text())
+        if isinstance(loaded, dict):
+            schema = loaded
+
+    for surface in cfg.deployment_surfaces:
+        tmpl_path = cfg.resolve(surface.template)
+        if tmpl_path is None or not tmpl_path.exists():
+            continue
+        tmpl_text = tmpl_path.read_text()
+        tmpl_lines = tmpl_text.splitlines()
+
+        argparse_path = cfg.resolve(surface.argparse_file)
+        flags: Dict[str, Dict[str, object]] = {}
+        if argparse_path is not None and argparse_path.exists():
+            from tools.stackcheck.core import SourceFile
+
+            src = SourceFile(
+                argparse_path, surface.argparse_file,
+                argparse_path.read_text(),
+            )
+            flags = _argparse_flags(src)
+
+        routes: Set[Tuple[str, str]] = set()
+        for route_rel in surface.route_files:
+            route_path = cfg.resolve(route_rel)
+            if route_path is not None and route_path.exists():
+                routes |= _server_routes(route_path)
+        # kubelet httpGet probes issue GET: a path registered only as
+        # POST (e.g. /drain) would answer the probe with 405 forever.
+        get_paths = {p for m, p in routes if m == "GET"}
+
+        # -- SC701 / SC702: templated flags vs the argparse surface ------
+        templated = _collect_template_flags(tmpl_text)
+        for flag, line, vpath in templated:
+            if flags and flag not in flags:
+                if not _yaml_allowed(tmpl_lines, line, "SC701"):
+                    out.append(Violation(
+                        rule="SC701", file=surface.template, line=line,
+                        qualname=surface.values_spec or surface.template,
+                        message=(
+                            f"template passes `{flag}` but "
+                            f"{surface.argparse_file} declares no such "
+                            "flag — the pod would crash-loop on argparse "
+                            "error"
+                        ),
+                        detail=flag,
+                    ))
+                continue
+            if vpath is None or flag not in flags:
+                continue
+            chart_default = _normalize_default(
+                miniyaml.get_path(values, vpath)
+            )
+            arg_default = _normalize_default(flags[flag].get("default"))
+            if chart_default is None or arg_default is None:
+                continue
+            if chart_default != arg_default:
+                key_line = value_key_lines.get(vpath, 1)
+                if _yaml_allowed(values_lines, key_line, "SC702"):
+                    continue
+                out.append(Violation(
+                    rule="SC702", file=cfg.helm_values_path or "values.yaml",
+                    line=key_line,
+                    qualname=vpath,
+                    message=(
+                        f"values default `{vpath}: {chart_default}` is "
+                        f"templated into `{flag}` whose argparse default "
+                        f"is `{arg_default}` — chart-default deployments "
+                        "silently diverge from the binary default; align "
+                        "them or annotate the values key with the reason"
+                    ),
+                    detail=f"{vpath}!={flag}",
+                ))
+
+        # -- SC703: probes and preStop hooks vs server routes -------------
+        if routes:
+            probe_paths: List[Tuple[str, str, int]] = []  # (path, file, line)
+            for i, line in enumerate(tmpl_lines):
+                pm = _HTTP_PATH_RE.match(line)
+                if pm is not None:
+                    probe_paths.append((pm.group(1), surface.template, i + 1))
+            if surface.values_spec:
+                for probe_key in (
+                    "startupProbe", "livenessProbe", "readinessProbe"
+                ):
+                    vpath = f"{surface.values_spec}.{probe_key}.httpGet.path"
+                    p = miniyaml.get_path(values, vpath)
+                    if isinstance(p, str):
+                        probe_paths.append((
+                            p, cfg.helm_values_path or "values.yaml",
+                            value_key_lines.get(vpath, 1),
+                        ))
+            for p, file, line in probe_paths:
+                if p not in get_paths:
+                    src_lines = (
+                        values_lines if file == cfg.helm_values_path
+                        else tmpl_lines
+                    )
+                    if _yaml_allowed(src_lines, line, "SC703"):
+                        continue
+                    out.append(Violation(
+                        rule="SC703", file=file, line=line,
+                        qualname=surface.values_spec or surface.template,
+                        message=(
+                            f"probe path `{p}` is not a registered GET "
+                            f"route on the target server "
+                            f"({', '.join(surface.route_files)}) — the "
+                            "kubelet's GET probe would never pass"
+                        ),
+                        detail=p,
+                    ))
+            for m in _PRESTOP_PATH_RE.finditer(tmpl_text):
+                p = m.group(1)
+                line = tmpl_text[:m.start()].count("\n") + 1
+                if ("POST", p) not in routes:
+                    if _yaml_allowed(tmpl_lines, line, "SC703"):
+                        continue
+                    out.append(Violation(
+                        rule="SC703", file=surface.template, line=line,
+                        qualname=surface.values_spec or surface.template,
+                        message=(
+                            f"preStop hook POSTs `{p}` but the server "
+                            "registers no POST route there — graceful "
+                            "drain would silently no-op"
+                        ),
+                        detail=f"preStop:{p}",
+                    ))
+            # Probe port names must be declared container port names.
+            declared_ports = set(_NAMED_PORT_RE.findall(tmpl_text))
+            if surface.values_spec and declared_ports:
+                for probe_key in (
+                    "startupProbe", "livenessProbe", "readinessProbe"
+                ):
+                    vpath = f"{surface.values_spec}.{probe_key}.httpGet.port"
+                    port = miniyaml.get_path(values, vpath)
+                    if (
+                        isinstance(port, str)
+                        and port not in declared_ports
+                    ):
+                        if _yaml_allowed(
+                            values_lines, value_key_lines.get(vpath, 1),
+                            "SC703",
+                        ):
+                            continue
+                        out.append(Violation(
+                            rule="SC703",
+                            file=cfg.helm_values_path or "values.yaml",
+                            line=value_key_lines.get(vpath, 1),
+                            qualname=vpath,
+                            message=(
+                                f"probe targets port name `{port}` but the "
+                                "template declares no container port with "
+                                f"that name (declared: {sorted(declared_ports)})"
+                            ),
+                            detail=port,
+                        ))
+
+        # -- SC704: drain-grace threading ---------------------------------
+        if surface.drain_values_spec:
+            spec = surface.drain_values_spec
+            grace_ref = f"{spec}.drainGraceSeconds"
+            flag_threaded = False
+            for flag, line, vpath in templated:
+                if flag == "--drain-grace-s" and vpath == grace_ref:
+                    flag_threaded = True
+            if not flag_threaded and not _yaml_allowed(
+                tmpl_lines, 1, "SC704"
+            ):
+                out.append(Violation(
+                    rule="SC704", file=surface.template, line=1,
+                    qualname=spec,
+                    message=(
+                        f"template does not thread `{grace_ref}` into "
+                        "`--drain-grace-s` — the chart knob would not "
+                        "reach the binary"
+                    ),
+                    detail=f"{grace_ref}->--drain-grace-s",
+                ))
+            term_ref = f"{spec}.terminationGracePeriodSeconds"
+            if not re.search(
+                r"terminationGracePeriodSeconds:\s*\{\{[^}]*"
+                + re.escape(term_ref), tmpl_text,
+            ) and not _yaml_allowed(tmpl_lines, 1, "SC704"):
+                out.append(Violation(
+                    rule="SC704", file=surface.template, line=1,
+                    qualname=spec,
+                    message=(
+                        "template does not source "
+                        f"terminationGracePeriodSeconds from `{term_ref}` "
+                        "— the SIGKILL deadline would not track the "
+                        "drain grace"
+                    ),
+                    detail=f"{term_ref}->terminationGracePeriodSeconds",
+                ))
+
+        # -- SC705: template values refs vs the schema --------------------
+        if schema is not None:
+            seen: Set[str] = set()
+            for ref, line in _collect_values_refs(tmpl_text):
+                if ref in seen:
+                    continue
+                seen.add(ref)
+                if not _schema_has(schema, ref):
+                    if _yaml_allowed(tmpl_lines, line, "SC705"):
+                        continue
+                    out.append(Violation(
+                        rule="SC705", file=surface.template, line=line,
+                        qualname=ref,
+                        message=(
+                            f"template references `.Values.{ref}` but "
+                            f"{cfg.helm_schema_path} does not declare it "
+                            "— a typoed override would validate clean"
+                        ),
+                        detail=ref,
+                    ))
+
+    # -- SC704(c): termination > grace in every shipped values file --------
+    # Strict excess, matching docs/robustness.md and the chart comments
+    # ("must exceed"): the termination countdown also covers the preStop
+    # hook and process teardown, so term == grace still SIGKILLs a drain
+    # that uses its full budget.
+    overlay_paths: List[
+        Tuple[str, miniyaml.YamlValue, List[str], Dict[str, int]]
+    ] = [
+        (cfg.helm_values_path or "values.yaml", values, values_lines,
+         value_key_lines)
+    ]
+    for rel in cfg.helm_overlay_paths:
+        p = cfg.resolve(rel)
+        if p is None or not p.exists():
+            continue
+        overlay_text = p.read_text()
+        overlay, overlay_key_lines = miniyaml.parse(overlay_text)
+        overlay_paths.append((
+            rel, miniyaml.deep_merge(values, overlay),
+            overlay_text.splitlines(), overlay_key_lines,
+        ))
+    drain_specs = sorted({
+        s.drain_values_spec
+        for s in cfg.deployment_surfaces
+        if s.drain_values_spec
+    })
+    spec_prefixes = sorted(
+        {s.values_spec for s in cfg.deployment_surfaces if s.values_spec}
+        | {
+            s.drain_values_spec
+            for s in cfg.deployment_surfaces
+            if s.drain_values_spec is not None
+        }
+    )
+    for rel, merged, file_lines, file_key_lines in overlay_paths:
+        for spec in drain_specs:
+            grace = miniyaml.get_path(merged, f"{spec}.drainGraceSeconds")
+            term = miniyaml.get_path(
+                merged, f"{spec}.terminationGracePeriodSeconds"
+            )
+            if isinstance(grace, (int, float)) and isinstance(
+                term, (int, float)
+            ):
+                if term <= grace:
+                    line = file_key_lines.get(
+                        f"{spec}.terminationGracePeriodSeconds",
+                        file_key_lines.get(spec, 1),
+                    )
+                    if _yaml_allowed(file_lines, line, "SC704"):
+                        continue
+                    out.append(Violation(
+                        rule="SC704", file=rel, line=line, qualname=spec,
+                        message=(
+                            f"{spec}.terminationGracePeriodSeconds "
+                            f"({term}) <= drainGraceSeconds ({grace}): "
+                            "the termination countdown also covers the "
+                            "preStop hook and teardown, so the kubelet "
+                            "SIGKILLs a pod that uses its full drain "
+                            "budget — set it strictly greater"
+                        ),
+                        detail=f"{rel}:{spec}:termination<=grace",
+                    ))
+
+    # -- SC706: docs/robustness.md helm table vs values.yaml ---------------
+    docs_path = cfg.resolve(cfg.robustness_docs_path)
+    if docs_path is not None and docs_path.exists() and spec_prefixes:
+        docs_text = docs_path.read_text()
+        # `_yaml_allowed` works on any line-commented text; in markdown
+        # the annotation rides an HTML comment on the table row, e.g.
+        # `<!-- # stackcheck: allow=SC706 reason=... -->`.
+        docs_lines = docs_text.splitlines()
+        # Like SC704(c), the recognized spec subtrees come from the
+        # configured deployment surfaces, not a hardcoded tuple — a new
+        # surface's docs rows join the drift check automatically.
+        row_re = re.compile(
+            r"^\|\s*`((?:"
+            + "|".join(re.escape(p) for p in spec_prefixes)
+            + r")\.[A-Za-z0-9_.]+)`\s*\|\s*([^|]*)\|",
+            re.M,
+        )
+        for m in row_re.finditer(docs_text):
+            key, documented = m.group(1), m.group(2).strip().strip("`")
+            line = docs_text[:m.start()].count("\n") + 1
+            actual = miniyaml.get_path(values, key)
+            if key not in value_key_lines:
+                if _yaml_allowed(docs_lines, line, "SC706"):
+                    continue
+                out.append(Violation(
+                    rule="SC706",
+                    file=cfg.robustness_docs_path or "docs/robustness.md",
+                    line=line, qualname=key,
+                    message=(
+                        f"docs table documents `{key}` but values.yaml "
+                        "has no such key (renamed or removed?)"
+                    ),
+                    detail=key,
+                ))
+                continue
+            doc_default = _normalize_default(documented)
+            actual_default = _normalize_default(actual)
+            if (
+                doc_default is not None
+                and actual_default is not None
+                and re.fullmatch(r"[0-9.]+", documented.strip())
+                and doc_default != actual_default
+                and not _yaml_allowed(docs_lines, line, "SC706")
+            ):
+                out.append(Violation(
+                    rule="SC706",
+                    file=cfg.robustness_docs_path or "docs/robustness.md",
+                    line=line, qualname=key,
+                    message=(
+                        f"docs table documents `{key}` default as "
+                        f"`{documented.strip()}` but values.yaml says "
+                        f"`{actual_default}`"
+                    ),
+                    detail=f"{key}:default",
+                ))
+    return out

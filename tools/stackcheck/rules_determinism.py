@@ -65,7 +65,7 @@ class _ClockTaint(ast.NodeVisitor):
     teeth come from the branch/comparison check, which is where a clock
     becomes a *decision*."""
 
-    def __init__(self):
+    def __init__(self) -> None:
         self.tainted: Set[str] = set()
         self.flagged: List[ast.AST] = []
 
@@ -79,46 +79,46 @@ class _ClockTaint(ast.NodeVisitor):
                 return True
         return False
 
-    def visit_Assign(self, node: ast.Assign):
+    def visit_Assign(self, node: ast.Assign) -> None:
         if self._expr_tainted(node.value):
             for tgt in node.targets:
                 if isinstance(tgt, ast.Name):
                     self.tainted.add(tgt.id)
         self.generic_visit(node)
 
-    def visit_AugAssign(self, node: ast.AugAssign):
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
         if self._expr_tainted(node.value) and isinstance(node.target, ast.Name):
             self.tainted.add(node.target.id)
         self.generic_visit(node)
 
     # -- decision sinks --------------------------------------------------
 
-    def _check_condition(self, test: ast.AST):
+    def _check_condition(self, test: ast.AST) -> None:
         if self._expr_tainted(test):
             self.flagged.append(test)
 
-    def visit_If(self, node: ast.If):
+    def visit_If(self, node: ast.If) -> None:
         self._check_condition(node.test)
         self.generic_visit(node)
 
-    def visit_While(self, node: ast.While):
+    def visit_While(self, node: ast.While) -> None:
         self._check_condition(node.test)
         self.generic_visit(node)
 
-    def visit_IfExp(self, node: ast.IfExp):
+    def visit_IfExp(self, node: ast.IfExp) -> None:
         self._check_condition(node.test)
         self.generic_visit(node)
 
-    def visit_Assert(self, node: ast.Assert):
+    def visit_Assert(self, node: ast.Assert) -> None:
         self._check_condition(node.test)
         self.generic_visit(node)
 
-    def visit_comprehension(self, node: ast.comprehension):
+    def visit_comprehension(self, node: ast.comprehension) -> None:
         for cond in node.ifs:
             self._check_condition(cond)
         self.generic_visit(node)
 
-    def visit_Compare(self, node: ast.Compare):
+    def visit_Compare(self, node: ast.Compare) -> None:
         # A comparison on a clock value is a decision even outside an
         # `if` (sorted keys, filters, min/max selection).
         if self._expr_tainted(node):
@@ -126,7 +126,7 @@ class _ClockTaint(ast.NodeVisitor):
         # Don't recurse: the If visitor already flagged enclosing tests;
         # flagging both would double-report.
 
-    def visit_Call(self, node: ast.Call):
+    def visit_Call(self, node: ast.Call) -> None:
         # A tainted value handed to a non-sink call is a decision input
         # escaping this function (e.g. scheduler.set_deadline(now + b)).
         # Sinks (spans/histograms/logs) are fine; args containing a

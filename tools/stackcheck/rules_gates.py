@@ -20,7 +20,7 @@ SC403  argparse ``store_true`` flag declared with ``default=True`` —
 from __future__ import annotations
 
 import ast
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, Iterator, List, Optional, Tuple
 
 from tools.stackcheck import config as C
 from tools.stackcheck.core import SourceFile, Violation
@@ -39,7 +39,9 @@ def _is_bool_annotation(node: Optional[ast.AST]) -> bool:
     return False
 
 
-def _gate_fields(src: SourceFile, classes: Tuple[str, ...]):
+def _gate_fields(src: SourceFile,
+                 classes: Tuple[str, ...],
+                 ) -> Iterator[Tuple[str, str, object, int]]:
     """Yield (class, field, default, line) for bool-ish dataclass fields."""
     for node in src.tree.body:
         if not isinstance(node, ast.ClassDef) or node.name not in classes:
@@ -60,9 +62,9 @@ def _gate_fields(src: SourceFile, classes: Tuple[str, ...]):
             yield node.name, stmt.target.id, default, stmt.lineno
 
 
-def _argparse_flags(src: SourceFile) -> Dict[str, dict]:
+def _argparse_flags(src: SourceFile) -> Dict[str, Dict[str, object]]:
     """flag string -> {line, store_true, default} from add_argument calls."""
-    out: Dict[str, dict] = {}
+    out: Dict[str, Dict[str, object]] = {}
     for node in ast.walk(src.tree):
         if not isinstance(node, ast.Call):
             continue
@@ -99,7 +101,7 @@ def check_gates(sources: List[SourceFile], cfg: C.Config) -> List[Violation]:
     out: List[Violation] = []
     by_rel = {s.rel: s for s in sources}
 
-    all_flags: Dict[str, dict] = {}
+    all_flags: Dict[str, Dict[str, object]] = {}
     for rel in cfg.argparse_files:
         src = by_rel.get(rel)
         if src is None:

@@ -1,0 +1,289 @@
+"""Rule family SC6 — resource lifecycle.
+
+Invariant (PR 5/6, CHANGES.md): *a graceful drain releases everything.*
+Every thread, socket, executor, and pool the package creates must have a
+join/close/shutdown site reachable from the engine's close path
+(``LLMEngine.close()`` / ``AsyncEngine.close()``) or the registry sweep
+the router's drain runs (``ServiceRegistry.close``).  PR 6's
+deleter-flush bug — a drain dropping queued remote DELs because nothing
+on the close path waited for the deleter thread — is exactly the class
+of leak this family catches statically.
+
+SC601  ``threading.Thread`` created with no join/release site reachable
+       from a lifecycle root.  Daemon threads are NOT exempt: dying with
+       the process means dropping whatever they still held (queued DELs,
+       staged KV snapshots); a daemon thread that is genuinely safe to
+       abandon carries an ``allow=SC601 reason=...`` saying why.
+SC602  socket created and stored on ``self`` with no ``.close()`` path
+       reachable from a lifecycle root, or created locally and neither
+       closed, returned (ownership transfer), nor used via ``with``.
+SC603  executor/pool (ThreadPoolExecutor, ProcessPoolExecutor,
+       multiprocessing.Pool) with no ``shutdown``/``close``/
+       ``terminate`` site reachable from a lifecycle root.
+
+Release-site matching is attribute-based: a resource stored to
+``self.X`` is released by any reference to ``self.X.join`` / ``.close``
+/ ``.shutdown`` (call or bare reference — ``asyncio.to_thread(
+self._thread.join, 30)`` counts), by ``for t in self.X: t.join()`` for
+resource lists, or through a local aliased from the attribute — the
+swap-under-lock close idiom ``t, self.X = self.X, None`` followed by
+``t.join()`` (also ``ts, self.X = self.X, []`` + ``for t in ts:
+t.join()``), which confines the handle mutation to the lock without
+joining under it.  The method containing the release must be reachable
+from a lifecycle root (``Config.lifecycle_roots`` + the declared
+``lifecycle_extra_edges`` for dynamic hookups like registry closables).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.stackcheck import config as C
+from tools.stackcheck.callgraph import CallGraph, FuncInfo
+from tools.stackcheck.core import Violation
+from tools.stackcheck.core import self_attr_name as _self_attr
+from tools.stackcheck.rules_blocking import dotted_name
+
+_RELEASE_NAMES = (
+    "join", "close", "shutdown", "terminate", "stop", "cancel",
+)
+
+_THREAD_CTORS = ("threading.Thread", "Thread")
+_SOCKET_CTORS = (
+    "socket.socket", "socket.create_connection", "socket.socketpair",
+)
+_POOL_CTORS = (
+    "ThreadPoolExecutor", "ProcessPoolExecutor",
+    "concurrent.futures.ThreadPoolExecutor",
+    "concurrent.futures.ProcessPoolExecutor",
+    "multiprocessing.Pool", "mp.Pool",
+)
+
+
+@dataclasses.dataclass
+class ResourceSite:
+    kind: str          # "thread" | "socket" | "pool"
+    rule: str          # SC601 | SC602 | SC603
+    ctor: str          # rendered constructor name
+    line: int
+    func: str          # qualname of the creating function
+    attr: Optional[str]   # self.<attr> it is stored to (None = local)
+    daemon: bool = False
+
+
+def _store_target(parents: Dict[int, ast.AST],
+                  node: ast.Call) -> Optional[ast.expr]:
+    """The assignment target the call's value flows into, if any
+    (direct assign only — x = ctor(...) / self.x = ctor(...))."""
+    parent = parents.get(id(node))
+    if isinstance(parent, ast.Assign) and len(parent.targets) == 1:
+        return parent.targets[0]
+    if isinstance(parent, ast.AnnAssign):
+        return parent.target
+    return None
+
+
+def _classify(call: ast.Call) -> Optional[Tuple[str, str]]:
+    name = dotted_name(call.func)
+    base = name.rsplit(".", 1)[-1]
+    if name in _THREAD_CTORS:
+        return ("thread", "SC601")
+    if name in _SOCKET_CTORS:
+        return ("socket", "SC602")
+    if name in _POOL_CTORS or base in (
+        "ThreadPoolExecutor", "ProcessPoolExecutor"
+    ):
+        return ("pool", "SC603")
+    return None
+
+
+def _is_daemon(call: ast.Call) -> bool:
+    for kw in call.keywords:
+        if kw.arg == "daemon" and isinstance(kw.value, ast.Constant):
+            return bool(kw.value.value)
+    return False
+
+
+def collect_resources(graph: CallGraph) -> List[Tuple[FuncInfo, ResourceSite]]:
+    out: List[Tuple[FuncInfo, ResourceSite]] = []
+    for q, info in graph.functions.items():
+        parents: Dict[int, ast.AST] = {}
+        returned: Set[int] = set()
+        with_items: Set[int] = set()
+        appended_attr: Dict[int, str] = {}
+        # Local names that escape ownership or are released in-function:
+        returned_locals: Set[str] = set()
+        released_locals: Set[str] = set()
+        local_appended_to: Dict[str, str] = {}  # local -> self attr
+        for node in ast.walk(info.node):
+            for child in ast.iter_child_nodes(node):
+                parents[id(child)] = node
+            if isinstance(node, ast.Return) and node.value is not None:
+                for sub in ast.walk(node.value):
+                    returned.add(id(sub))
+                    if isinstance(sub, ast.Name):
+                        returned_locals.add(sub.id)
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    for sub in ast.walk(item.context_expr):
+                        with_items.add(id(sub))
+            if (
+                isinstance(node, ast.Attribute)
+                and node.attr in _RELEASE_NAMES
+                and isinstance(node.value, ast.Name)
+            ):
+                released_locals.add(node.value.id)
+            # self.X.append(ctor(...)) / self.X.append(local) store into
+            # a resource list owned by the instance.
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "append"
+            ):
+                attr = _self_attr(node.func.value)
+                if attr is not None:
+                    for arg in node.args:
+                        for sub in ast.walk(arg):
+                            appended_attr[id(sub)] = attr
+                        if isinstance(arg, ast.Name):
+                            local_appended_to[arg.id] = attr
+        for node in ast.walk(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            cls_rule = _classify(node)
+            if cls_rule is None:
+                continue
+            kind, rule = cls_rule
+            if id(node) in returned or id(node) in with_items:
+                continue  # ownership transferred / scoped release
+            target = _store_target(parents, node)
+            attr = _self_attr(target)
+            if attr is None and id(node) in appended_attr:
+                attr = appended_attr[id(node)]
+            if attr is None and isinstance(target, ast.Name):
+                local = target.id
+                if local in local_appended_to:
+                    # `t = ctor(...)` then `self.X.append(t)`: the
+                    # instance list owns it — judge it as self.X.
+                    attr = local_appended_to[local]
+                elif local in returned_locals:
+                    continue  # ownership transferred to the caller
+                elif local in released_locals:
+                    continue  # released on the same local name here
+            out.append((info, ResourceSite(
+                kind=kind, rule=rule, ctor=dotted_name(node.func),
+                line=node.lineno, func=q, attr=attr,
+                daemon=_is_daemon(node) if kind == "thread" else False,
+            )))
+    return out
+
+
+def _release_sites(graph: CallGraph, module: str, cls: Optional[str],
+                   attr: str) -> Set[str]:
+    """Qualnames of functions in the same class referencing a release
+    method on self.<attr> — directly, on elements iterated from it, or
+    through a local aliased from it (the swap-under-lock close idiom:
+    ``t, self.X = self.X, None`` followed by ``t.join()``)."""
+    out: Set[str] = set()
+    for q, info in graph.functions.items():
+        if info.module != module or info.cls != cls:
+            continue
+        aliases: Set[str] = set()
+        for node in ast.walk(info.node):
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+                continue
+            tgt, val = node.targets[0], node.value
+            if (
+                isinstance(tgt, ast.Tuple)
+                and isinstance(val, ast.Tuple)
+                and len(tgt.elts) == len(val.elts)
+            ):
+                pairs = list(zip(tgt.elts, val.elts))
+            else:
+                pairs = [(tgt, val)]
+            for t, v in pairs:
+                if isinstance(t, ast.Name) and _self_attr(v) == attr:
+                    aliases.add(t.id)
+        loop_vars: Set[str] = set()
+        for node in ast.walk(info.node):
+            if (
+                isinstance(node, ast.For)
+                and isinstance(node.target, ast.Name)
+                and (
+                    _self_attr(node.iter) == attr
+                    or (
+                        isinstance(node.iter, ast.Name)
+                        and node.iter.id in aliases
+                    )
+                )
+            ):
+                loop_vars.add(node.target.id)
+        for node in ast.walk(info.node):
+            if not isinstance(node, ast.Attribute):
+                continue
+            if node.attr not in _RELEASE_NAMES:
+                continue
+            if _self_attr(node.value) == attr:
+                out.add(q)
+            elif (
+                isinstance(node.value, ast.Name)
+                and node.value.id in loop_vars | aliases
+            ):
+                out.add(q)
+    return out
+
+
+def lifecycle_reachable(graph: CallGraph, cfg: C.Config) -> Set[str]:
+    roots = [
+        q for q in graph.functions
+        if any(q.endswith(sfx) for sfx in cfg.lifecycle_roots)
+    ]
+    extra = graph.expand_suffix_edges(cfg.lifecycle_extra_edges)
+    return set(graph.reachable(roots, extra_edges=extra))
+
+
+def check_lifecycle(graph: CallGraph, cfg: C.Config) -> List[Violation]:
+    out: List[Violation] = []
+    reachable = lifecycle_reachable(graph, cfg)
+    for info, site in collect_resources(graph):
+        func_span = (info.def_line, info.end_line)
+        if info.src.allowed_at(site.line, site.rule, func_span):
+            continue
+        released_from: Set[str] = set()
+        if site.attr is not None:
+            released_from = _release_sites(
+                graph, info.module, info.cls, site.attr
+            )
+        live_release = released_from & reachable
+        if live_release:
+            continue
+        where = (
+            f"self.{site.attr}" if site.attr is not None
+            else "an unbound local"
+        )
+        if released_from:
+            problem = (
+                f"release site(s) {sorted(x.split(':', 1)[-1] for x in released_from)} "
+                "exist but none is reachable from a lifecycle root "
+                f"({', '.join(s.split(':', 1)[-1] for s in cfg.lifecycle_roots)})"
+            )
+        else:
+            problem = "no join/close/shutdown site exists at all"
+        daemon_note = (
+            " (daemon=True does not exempt it: dying with the process "
+            "drops whatever it still holds — annotate allow=SC601 with "
+            "the reason if abandoning it is genuinely safe)"
+            if site.daemon else ""
+        )
+        out.append(Violation(
+            rule=site.rule, file=info.src.rel, line=site.line,
+            qualname=site.func.split(":", 1)[-1],
+            message=(
+                f"{site.kind} `{site.ctor}` stored in {where}: {problem}"
+                f"{daemon_note}"
+            ),
+            detail=f"{site.attr or 'local'}:{site.ctor}",
+        ))
+    return out

@@ -1,0 +1,557 @@
+"""Rule family SC5 — lock discipline and shared-state races.
+
+The stack runs seven-plus cooperating thread roots (engine step loop,
+prefetch fetchers, offload stager writer, remote-KV deleter, prefix
+exporter, plus the asyncio event loop in each server process) against
+~15 ad-hoc lock sites, and PRs 4–6 each shipped a review-caught race.
+This family turns the locking conventions into checks:
+
+SC501  a module/instance attribute is mutated from >=2 distinct thread
+       roots with no lock held in common across the mutation sites.
+SC502  a blocking call (the SC1xx deny list / kvserver RPC surface) is
+       made while a lock is held — every other thread contending for
+       that lock inherits the full wait.
+SC503  lock-acquisition-order cycle across the call graph (deadlock
+       potential, e.g. A->B in one thread and B->A in another).
+
+Thread attribution: ``# stackcheck: thread=<name>`` marks a function as
+the entry point (``target=``) of a named OS thread; everything reachable
+from it in the call graph runs (at least sometimes) on that thread.
+``async def``s are implicitly attributed to the ``asyncio-loop`` thread.
+Lock identity is intra-class: ``self._lock`` inside class ``C`` is the
+lock ``module:C._lock``; ``threading.Condition(self._lock)`` aliases the
+condition to the lock it wraps.  Attributes holding intrinsically
+thread-safe objects (queue.Queue, threading.Event, locks themselves) are
+exempt from SC501 — their mutation API is the synchronization.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from tools.stackcheck import config as C
+from tools.stackcheck.callgraph import CallGraph, FuncInfo
+from tools.stackcheck.core import Violation
+from tools.stackcheck.core import self_attr_name as _self_attr
+from tools.stackcheck.rules_blocking import _blocking_reason, dotted_name
+
+ASYNCIO_THREAD = "asyncio-loop"
+
+# Constructor basenames establishing lock identity on a self attribute.
+_LOCK_CTORS = ("Lock", "RLock", "Semaphore", "BoundedSemaphore")
+_COND_CTORS = ("Condition",)
+# Attributes holding these are intrinsically thread-safe: their mutation
+# API is the synchronization (and Event.set()/clear() are atomic).
+_THREADSAFE_CTORS = (
+    "Queue", "LifoQueue", "PriorityQueue", "SimpleQueue", "Event",
+) + _LOCK_CTORS + _COND_CTORS
+
+# Method basenames that mutate their receiver in place.
+_MUTATOR_NAMES = (
+    "append", "extend", "insert", "add", "update", "pop", "popitem",
+    "remove", "discard", "clear", "setdefault",
+)
+
+# Condition methods that RELEASE the lock while waiting — not blocking
+# "under" the lock in the SC502 sense.
+_LOCK_RELEASING_WAITS = ("wait", "wait_for")
+
+
+@dataclasses.dataclass
+class ClassLocks:
+    """Lock layout of one class: attr -> canonical lock id, plus the
+    attrs exempt from SC501 because their values are thread-safe."""
+
+    locks: Dict[str, str] = dataclasses.field(default_factory=dict)
+    threadsafe_attrs: Set[str] = dataclasses.field(default_factory=set)
+
+
+@dataclasses.dataclass
+class Mutation:
+    attr: str
+    line: int
+    held: FrozenSet[str]
+    func: str  # qualname
+
+
+@dataclasses.dataclass
+class LockedCall:
+    node: ast.Call
+    held: FrozenSet[str]
+
+
+@dataclasses.dataclass
+class FuncLockFacts:
+    mutations: List[Mutation] = dataclasses.field(default_factory=list)
+    calls: List[LockedCall] = dataclasses.field(default_factory=list)
+    # (held lock, acquired lock, line) for directly nested acquisitions.
+    nested_acquires: List[Tuple[str, str, int]] = dataclasses.field(
+        default_factory=list
+    )
+    # Every lock this function acquires directly (for closure propagation).
+    acquired: Set[str] = dataclasses.field(default_factory=set)
+    # line anchors for acquisitions (lock id -> first line).
+    acquire_lines: Dict[str, int] = dataclasses.field(default_factory=dict)
+
+
+def _ctor_basename(value: ast.expr) -> Optional[str]:
+    if isinstance(value, ast.Call):
+        return dotted_name(value.func).rsplit(".", 1)[-1]
+    return None
+
+
+def collect_class_locks(graph: CallGraph) -> Dict[Tuple[str, str], ClassLocks]:
+    """(module, class) -> lock layout, from `self.X = threading.Lock()`
+    style assignments (plain or annotated) anywhere in the class's
+    methods."""
+    out: Dict[Tuple[str, str], ClassLocks] = {}
+    for info in graph.functions.values():
+        if info.cls is None:
+            continue
+        key = (info.module, info.cls)
+        layout = out.setdefault(key, ClassLocks())
+        for node in ast.walk(info.node):
+            # `self._lock: threading.Lock = threading.Lock()` declares a
+            # lock just as much as the unannotated form — missing the
+            # AnnAssign shape would manufacture phantom SC501s on state
+            # the lock correctly guards (and silently exempt it from
+            # SC502/SC503).
+            target: ast.expr
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                target, value = node.targets[0], node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                target, value = node.target, node.value
+            else:
+                continue
+            attr = _self_attr(target)
+            if attr is None:
+                continue
+            ctor = _ctor_basename(value)
+            if ctor is None:
+                continue
+            canon = f"{info.module}:{info.cls}.{attr}"
+            if ctor in _COND_CTORS:
+                alias: Optional[str] = None
+                if isinstance(value, ast.Call) and value.args:
+                    wrapped = _self_attr(value.args[0])
+                    if wrapped is not None:
+                        alias = f"{info.module}:{info.cls}.{wrapped}"
+                layout.locks[attr] = alias or canon
+                layout.threadsafe_attrs.add(attr)
+            elif ctor in _LOCK_CTORS:
+                layout.locks[attr] = canon
+                layout.threadsafe_attrs.add(attr)
+            elif ctor in _THREADSAFE_CTORS:
+                layout.threadsafe_attrs.add(attr)
+    return out
+
+
+class _LockWalker:
+    """Intra-procedural walk tracking the set of held locks.  Nested
+    function/lambda bodies are skipped: they execute on whatever thread
+    later calls them, not at the point of definition."""
+
+    def __init__(self, info: FuncInfo, layout: ClassLocks) -> None:
+        self.info = info
+        self.layout = layout
+        self.facts = FuncLockFacts()
+
+    def run(self) -> FuncLockFacts:
+        for stmt in self.info.node.body:
+            self._visit(stmt, frozenset())
+        return self.facts
+
+    # -- helpers -----------------------------------------------------------
+
+    def _lock_of(self, expr: ast.expr) -> Optional[str]:
+        attr = _self_attr(expr)
+        if attr is None:
+            return None
+        return self.layout.locks.get(attr)
+
+    def _record_mutation(self, attr: Optional[str], line: int,
+                         held: FrozenSet[str]) -> None:
+        if attr is None or attr in self.layout.threadsafe_attrs:
+            return
+        self.facts.mutations.append(
+            Mutation(attr=attr, line=line, held=held,
+                     func=self.info.qualname)
+        )
+
+    def _mutation_targets(self, target: ast.expr) -> List[Optional[str]]:
+        if isinstance(target, (ast.Tuple, ast.List)):
+            out: List[Optional[str]] = []
+            for elt in target.elts:
+                out.extend(self._mutation_targets(elt))
+            return out
+        if isinstance(target, ast.Subscript):
+            return [_self_attr(target.value)]
+        if isinstance(target, ast.Starred):
+            return self._mutation_targets(target.value)
+        return [_self_attr(target)]
+
+    # -- walk --------------------------------------------------------------
+
+    def _visit(self, node: ast.AST, held: FrozenSet[str]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return  # deferred execution: not on this thread/lock scope
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            acquired = set()
+            for item in node.items:
+                lock = self._lock_of(item.context_expr)
+                if lock is not None:
+                    self.facts.acquired.add(lock)
+                    self.facts.acquire_lines.setdefault(
+                        lock, item.context_expr.lineno
+                    )
+                    for h in held:
+                        if h != lock:
+                            self.facts.nested_acquires.append(
+                                (h, lock, item.context_expr.lineno)
+                            )
+                    acquired.add(lock)
+                self._visit(item.context_expr, held)
+            inner = held | acquired
+            for stmt in node.body:
+                self._visit(stmt, inner)
+            return
+        if isinstance(node, ast.Assign):
+            if self._expr_has_call(node.value):
+                self._visit(node.value, held)
+            for tgt in node.targets:
+                for attr in self._mutation_targets(tgt):
+                    self._record_mutation(attr, node.lineno, held)
+                self._visit_stores_only(tgt, held)
+            return
+        if isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            if node.value is not None:
+                self._visit(node.value, held)
+            for attr in self._mutation_targets(node.target):
+                self._record_mutation(attr, node.lineno, held)
+            return
+        if isinstance(node, ast.Delete):
+            for tgt in node.targets:
+                for attr in self._mutation_targets(tgt):
+                    self._record_mutation(attr, node.lineno, held)
+            return
+        if isinstance(node, ast.Call):
+            self.facts.calls.append(LockedCall(node=node, held=held))
+            # In-place mutator methods on a self attribute.
+            fn = node.func
+            if (
+                isinstance(fn, ast.Attribute)
+                and fn.attr in _MUTATOR_NAMES
+            ):
+                self._record_mutation(
+                    _self_attr(fn.value), node.lineno, held
+                )
+        for child in ast.iter_child_nodes(node):
+            self._visit(child, held)
+
+    def _visit_stores_only(self, node: ast.AST, held: FrozenSet[str]) -> None:
+        # Subscript targets contain value expressions (indices) that may
+        # call things; walk them for call tracking.
+        for child in ast.iter_child_nodes(node):
+            self._visit(child, held)
+
+    @staticmethod
+    def _expr_has_call(node: ast.AST) -> bool:
+        return any(isinstance(n, ast.Call) for n in ast.walk(node))
+
+
+def thread_reach(graph: CallGraph, cfg: C.Config) -> Dict[str, Set[str]]:
+    """thread name -> set of qualnames attributed to that thread.
+
+    Explicit roots come from ``thread=`` annotations; every ``async def``
+    is an implicit root of the asyncio-loop thread.  Attribution follows
+    the call graph (including the configured callback edges)."""
+    roots_by_thread: Dict[str, List[str]] = {}
+    for q, name in graph.find_thread_roots().items():
+        roots_by_thread.setdefault(name, []).append(q)
+    async_roots = [
+        q for q, info in graph.functions.items() if info.is_async
+    ]
+    if async_roots:
+        roots_by_thread.setdefault(ASYNCIO_THREAD, []).extend(async_roots)
+    # The close plane is reached through dynamic hops the AST cannot
+    # resolve (asyncio.to_thread(self.engine.close) passes a function
+    # REFERENCE; generic `.close()` attr calls are too ambiguous for
+    # by-name resolution) — without the declared lifecycle edges,
+    # LLMEngine.close and everything under it would be attributed to no
+    # thread at all and SC501/SC502 would go silent on exactly the
+    # concurrency-sensitive shutdown code.
+    extra: Dict[str, List[str]] = {
+        k: list(v) for k, v in cfg.extra_edges.items()
+    }
+    for q, callees in graph.expand_suffix_edges(
+        cfg.lifecycle_extra_edges
+    ).items():
+        extra.setdefault(q, []).extend(callees)
+    out: Dict[str, Set[str]] = {}
+    for name, roots in roots_by_thread.items():
+        # Strict (typed) edges only: a by-name guess on a generic method
+        # (`get`, `put`, `update`) would attribute another process's code
+        # to this thread and manufacture races that cannot happen.
+        out[name] = set(graph.reachable(
+            roots, extra_edges=extra, strict=True
+        ))
+    return out
+
+
+def _blocking_reason_for_locks(
+    call: ast.Call, graph: CallGraph, info: FuncInfo
+) -> str:
+    """Why this call blocks while a lock is held ('' = it doesn't)."""
+    why = _blocking_reason(call)
+    if why:
+        fn = call.func
+        if (
+            isinstance(fn, ast.Attribute)
+            and fn.attr in _LOCK_RELEASING_WAITS
+        ):
+            return ""
+        return why
+    fn = call.func
+    if isinstance(fn, ast.Attribute):
+        if fn.attr in _LOCK_RELEASING_WAITS:
+            return ""
+        if fn.attr in C.ASYNC_CONTRACT_NAMES:
+            return dotted_name(fn)
+    # Strict resolution only, like every other SC5 edge: a by-name guess
+    # on an untyped receiver (`self.x.delete(...)` where x's class is
+    # unknown) would match the kvserver client surface and manufacture a
+    # phantom blocking-under-lock finding.
+    for target in graph._resolve_call(call, info, ambiguous=False):
+        if any(target.endswith(sfx) for sfx in C.BLOCKING_CONTRACT_SUFFIXES):
+            return target.split(":", 1)[-1]
+    return ""
+
+
+def check_locks(graph: CallGraph, cfg: C.Config) -> List[Violation]:
+    out: List[Violation] = []
+    layouts = collect_class_locks(graph)
+    reach = thread_reach(graph, cfg)
+
+    facts: Dict[str, FuncLockFacts] = {}
+    for q, info in graph.functions.items():
+        layout = layouts.get((info.module, info.cls or ""), ClassLocks())
+        facts[q] = _LockWalker(info, layout).run()
+
+    # Locks held at EVERY (typed-resolved) call site propagate into the
+    # callee: a helper only ever invoked under the lock
+    # (HostOffloadManager._evict_oldest) is as guarded as its callers.
+    # Thread roots and async defs are entered lock-free by the runtime,
+    # so they never inherit anything.
+    callers: Dict[str, List[Tuple[str, FrozenSet[str]]]] = {}
+    for q, info in graph.functions.items():
+        for lc in facts[q].calls:
+            for target in graph._resolve_call(lc.node, info, ambiguous=False):
+                callers.setdefault(target, []).append((q, lc.held))
+    lock_free_entries = set(graph.find_thread_roots())
+    lock_free_entries.update(
+        q for q, info in graph.functions.items() if info.is_async
+    )
+    for callees in cfg.extra_edges.values():
+        for sfx in callees:
+            lock_free_entries.update(
+                q for q in graph.functions if q.endswith(sfx)
+            )
+    all_locks = frozenset().union(*[f.acquired for f in facts.values()]) \
+        if facts else frozenset()
+    # The optimistic all_locks seed only drains through a call chain
+    # that starts at a lock-free entry (or an uncalled function, which
+    # is entered lock-free by definition).  A call-graph cycle with no
+    # such chain into it — e.g. a self-recursive retry helper nobody
+    # calls — would keep all_locks forever, manufacturing SC502s and
+    # masking SC501s; it is dead code in the strict graph, so seed it
+    # lock-free instead.
+    zero_seeded = {
+        q for q in graph.functions
+        if q not in callers or q in lock_free_entries
+    }
+    fwd: Dict[str, Set[str]] = {}
+    for callee, sites in callers.items():
+        for caller, _ in sites:
+            fwd.setdefault(caller, set()).add(callee)
+    entered = set(zero_seeded)
+    work = list(zero_seeded)
+    while work:
+        for callee in fwd.get(work.pop(), ()):
+            if callee not in entered:
+                entered.add(callee)
+                work.append(callee)
+    entry_held: Dict[str, FrozenSet[str]] = {
+        q: (
+            all_locks
+            if q in entered and q not in zero_seeded
+            else frozenset()
+        )
+        for q in graph.functions
+    }
+    changed = True
+    while changed:
+        changed = False
+        for q, sites in callers.items():
+            if q not in entered or q in lock_free_entries:
+                continue
+            new = frozenset.intersection(*[
+                held | entry_held[caller] for caller, held in sites
+            ])
+            if new != entry_held[q]:
+                entry_held[q] = new
+                changed = True
+
+    # -- SC501: cross-thread mutation with no common lock -------------------
+    # (module, class, attr) -> mutation sites + the threads mutating them.
+    by_attr: Dict[Tuple[str, str, str], List[Tuple[Mutation, Set[str]]]] = {}
+    for q, info in graph.functions.items():
+        if info.cls is None or info.name == "__init__":
+            continue
+        threads = {t for t, fns in reach.items() if q in fns}
+        if not threads:
+            continue  # unreachable from any thread root: cannot race
+        for mut in facts[q].mutations:
+            key = (info.module, info.cls, mut.attr)
+            by_attr.setdefault(key, []).append((mut, threads))
+
+    for (module, cls, attr), sites in sorted(by_attr.items()):
+        all_threads: Set[str] = set()
+        for _, threads in sites:
+            all_threads |= threads
+        if len(all_threads) < 2:
+            continue
+        common = frozenset.intersection(*[
+            m.held | entry_held[m.func] for m, _ in sites
+        ])
+        if common:
+            continue
+        # Anchor at the first unlocked site (there must be one: with no
+        # common lock, at least one site holds something the others
+        # don't — prefer a site holding nothing at all).
+        anchor = min(
+            sites, key=lambda s: (len(s[0].held), s[0].line)
+        )[0]
+        info = graph.functions[anchor.func]
+        func_span = (info.def_line, info.end_line)
+        if info.src.allowed_at(anchor.line, "SC501", func_span):
+            continue
+        out.append(Violation(
+            rule="SC501", file=info.src.rel, line=anchor.line,
+            qualname=f"{cls}.{attr}",
+            message=(
+                f"`self.{attr}` is mutated from threads "
+                f"{{{', '.join(sorted(all_threads))}}} with no common "
+                f"lock across its {len(sites)} mutation site(s); guard "
+                "every mutation with one lock or confine the attribute "
+                "to a single owner thread"
+            ),
+            detail=f"{cls}.{attr}",
+        ))
+
+    # -- SC502: blocking call while a lock is held ---------------------------
+    # Caller-propagated locks count: a helper only ever invoked under a
+    # lock (entry_held) blocks its callers' lock just as surely as a
+    # local `with self._lock:` does.
+    for q, info in graph.functions.items():
+        func_span = (info.def_line, info.end_line)
+        for lc in facts[q].calls:
+            held = lc.held | entry_held[q]
+            if not held:
+                continue
+            why = _blocking_reason_for_locks(lc.node, graph, info)
+            if not why:
+                continue
+            if info.src.allowed_at(lc.node.lineno, "SC502", func_span):
+                continue
+            out.append(Violation(
+                rule="SC502", file=info.src.rel, line=lc.node.lineno,
+                qualname=q.split(":", 1)[-1],
+                message=(
+                    f"blocking call `{why}` while holding "
+                    f"{{{', '.join(sorted(held))}}} — every thread "
+                    "contending for the lock inherits the full wait"
+                ),
+                detail=why,
+            ))
+
+    # -- SC503: lock-acquisition-order cycles --------------------------------
+    # Locks each function's call closure can acquire, over STRICTLY
+    # resolved (typed) edges only — the by-name over-approximation would
+    # let a generic `.get()`/`.pop()` manufacture phantom lock edges and
+    # report deadlocks that cannot happen.
+    strict_edges: Dict[str, Set[str]] = graph.typed_edges
+    closure_acq: Dict[str, Set[str]] = {
+        q: set(f.acquired) for q, f in facts.items()
+    }
+    changed = True
+    while changed:
+        changed = False
+        for q in graph.functions:
+            acc = closure_acq[q]
+            before = len(acc)
+            for callee in strict_edges.get(q, ()):
+                acc |= closure_acq.get(callee, set())
+            if len(acc) != before:
+                changed = True
+
+    # order edges: (held, acquired) -> (file, line, via qualname)
+    order_edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+    for q, info in graph.functions.items():
+        for held, acq, line in facts[q].nested_acquires:
+            order_edges.setdefault(
+                (held, acq), (info.src.rel, line, q.split(":", 1)[-1])
+            )
+        for lc in facts[q].calls:
+            if not lc.held:
+                continue
+            # Strict resolution only: a by-name guess ("get", "pop") on
+            # an untyped receiver would manufacture phantom lock edges
+            # and report deadlocks that cannot happen.
+            for target in graph._resolve_call(lc.node, info, ambiguous=False):
+                for acq in closure_acq.get(target, set()):
+                    for held in lc.held:
+                        if held != acq:
+                            order_edges.setdefault(
+                                (held, acq),
+                                (info.src.rel, lc.node.lineno,
+                                 q.split(":", 1)[-1]),
+                            )
+
+    adj: Dict[str, Set[str]] = {}
+    for (a, b) in order_edges:
+        adj.setdefault(a, set()).add(b)
+
+    seen_cycles: Set[Tuple[str, ...]] = set()
+    for start in sorted(adj):
+        stack = [(start, [start])]
+        while stack:
+            node, path = stack.pop()
+            for nxt in sorted(adj.get(node, ())):
+                if nxt == start:
+                    cycle = tuple(sorted(set(path)))
+                    if len(cycle) < 2 or cycle in seen_cycles:
+                        continue
+                    seen_cycles.add(cycle)
+                    edge = order_edges[(node, start)]
+                    file, line, via = edge
+                    src = next(
+                        s for s in graph.sources if s.rel == file
+                    )
+                    if src.allowed_at(line, "SC503"):
+                        continue
+                    out.append(Violation(
+                        rule="SC503", file=file, line=line, qualname=via,
+                        message=(
+                            "lock-acquisition-order cycle "
+                            f"{' -> '.join(path + [start])} (deadlock "
+                            "potential: two threads taking the locks in "
+                            "opposite order wedge each other); pick one "
+                            "global order or drop the nested acquire"
+                        ),
+                        detail="<->".join(cycle),
+                    ))
+                elif nxt not in path and len(path) < 6:
+                    stack.append((nxt, path + [nxt]))
+    return out

@@ -34,7 +34,7 @@ import ast
 import json
 import re
 from pathlib import Path
-from typing import Dict, List, Set, Tuple
+from typing import Any, Dict, List, Set, Tuple, cast
 
 from tools.stackcheck import config as C
 from tools.stackcheck.core import SourceFile, Violation
@@ -71,14 +71,17 @@ def _prose_families(text: str) -> Set[str]:
     return names
 
 
-def parse_registry(path: Path) -> Dict[str, dict]:
+def parse_registry(path: Path) -> Dict[str, Dict[str, object]]:
     """AST-parse the REGISTRY literal (never imports the package)."""
     tree = ast.parse(path.read_text(), filename=str(path))
     for node in tree.body:
         if isinstance(node, ast.Assign):
             for tgt in node.targets:
                 if isinstance(tgt, ast.Name) and tgt.id == "REGISTRY":
-                    return ast.literal_eval(node.value)
+                    return cast(
+                        Dict[str, Dict[str, object]],
+                        ast.literal_eval(node.value),
+                    )
     raise ValueError(f"no REGISTRY assignment found in {path}")
 
 
@@ -159,7 +162,7 @@ def collect_emitted(sources: List[SourceFile],
     return out
 
 
-def _normalize(name: str, registry: Dict[str, dict]) -> str:
+def _normalize(name: str, registry: Dict[str, Dict[str, object]]) -> str:
     """Strip histogram exposition suffixes when the base is a registered
     histogram family."""
     if name in registry:
@@ -177,7 +180,7 @@ def _dashboard_families(path: Path) -> Dict[str, str]:
     data = json.loads(path.read_text())
     out: Dict[str, str] = {}
 
-    def walk_panels(panels):
+    def walk_panels(panels: List[Dict[str, Any]]) -> None:
         for p in panels:
             title = p.get("title", "?")
             for t in p.get("targets", []):
