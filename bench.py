@@ -639,6 +639,154 @@ def bench_engine_mixed_ab(args, preset: str) -> dict:
     }
 
 
+def bench_engine_multistep_ab(args, preset: str) -> dict:
+    """K-step decode-window A/B through the REAL engine
+    (scheduler.decode_window at K in {1, 4, 8}; K=1 is
+    multi_step_window=False, the PR-1 single-token lookahead pipeline).
+    A seeded decode-heavy replay measures the per-token HOST cost — the
+    schedule+dispatch+sample step-phase histogram sums divided by tokens
+    produced, i.e. the host round-trip the window amortizes K-fold —
+    then a second stop-mask replay on the same engines stops every
+    stream mid-window via a stop_token_id chosen from the greedy
+    reference, proving the device stop-mask keeps the wasted-token rate
+    ~0 (the pre-mask tax was up to K-1 tokens per stop).  Greedy parity
+    across every K is asserted on the stop replay's outputs."""
+    import dataclasses as _dc
+    import gc
+
+    from production_stack_tpu.engine.config import (
+        CacheConfig,
+        EngineConfig,
+        PRESETS,
+        SchedulerConfig,
+    )
+    from production_stack_tpu.engine.core.engine import LLMEngine
+    from production_stack_tpu.engine.core.sequence import SamplingParams
+
+    S = max(2, min(args.batch, 8) // 2)  # decode streams
+    ctx_tokens = 96
+    T = 96  # decode tokens per stream in the throughput replay
+    HOST_PHASES = ("schedule", "dispatch", "sample")
+
+    def run(k: int) -> dict:
+        sched = dict(
+            max_num_seqs=S,
+            prefill_buckets=(128, 256),
+            max_model_len=512,
+        )
+        if k == 1:
+            sched["multi_step_window"] = False
+        else:
+            sched["decode_window"] = k
+        eng = LLMEngine(EngineConfig(
+            model=_dc.replace(PRESETS[preset]),
+            cache=CacheConfig(num_blocks=S * ((ctx_tokens + T) // 16 + 3) + 32),
+            scheduler=SchedulerConfig(**sched),
+        ))
+        prompts = [
+            [(7 * i + j) % 101 for j in range(ctx_tokens)] for i in range(S)
+        ]
+        for i in range(S):
+            eng.add_request(
+                f"r{i}", prompt_token_ids=prompts[i],
+                sampling_params=SamplingParams(max_tokens=T, ignore_eos=True),
+            )
+        outs: dict = {i: [] for i in range(S)}
+
+        def pump(until_produced: int) -> int:
+            produced = 0
+            steps = 0
+            while eng.has_unfinished() and produced < until_produced:
+                steps += 1
+                assert steps < 5000, "engine failed to drain"
+                for out in eng.step():
+                    outs[int(out.seq_id[1:])].append(out.new_token_id)
+                    produced += 1
+            return produced
+
+        # Warm: prefills + XLA compile + pipeline/window fill.
+        warmed = pump(16 * S)
+        sums0 = {p: eng.obs.step_hists[p].sum for p in HOST_PHASES}
+        collect0 = eng.obs.step_hists["collect"].sum
+        t0 = time.perf_counter()
+        produced = pump(10**9)
+        wall = time.perf_counter() - t0
+        host_s = sum(
+            eng.obs.step_hists[p].sum - sums0[p] for p in HOST_PHASES
+        )
+        phase_ms = {
+            p: round((eng.obs.step_hists[p].sum - sums0[p]) * 1e3, 2)
+            for p in HOST_PHASES
+        }
+        phase_ms["collect"] = round(
+            (eng.obs.step_hists["collect"].sum - collect0) * 1e3, 2
+        )
+
+        # Stop-mask replay: per-stream stop token = a token first seen
+        # late in the greedy reference, so every stream stops mid-flight
+        # (deterministic across K by greedy parity).
+        stop_toks = []
+        for i in range(S):
+            ref = outs[i]
+            tok = ref[-1]
+            for pos in range(16, len(ref)):
+                if ref[pos] not in ref[:pos]:
+                    tok = ref[pos]
+                    break
+            stop_toks.append(tok)
+        gen0 = eng.stats()["total_generated_tokens"]
+        for i in range(S):
+            eng.add_request(
+                f"s{i}", prompt_token_ids=prompts[i],
+                sampling_params=SamplingParams(
+                    max_tokens=T, ignore_eos=True,
+                    stop_token_ids=[stop_toks[i]],
+                ),
+            )
+        stop_outs: dict = {}
+        steps = 0
+        while eng.has_unfinished():
+            steps += 1
+            assert steps < 5000, "engine failed to drain"
+            for out in eng.step():
+                stop_outs.setdefault(out.seq_id, []).append(out.new_token_id)
+        stats = eng.stats()
+        stop_generated = stats["total_generated_tokens"] - gen0
+        wasted = stats["multistep_wasted_tokens"]
+        result = {
+            "per_token_host_ms": round(host_s / max(produced, 1) * 1e3, 4),
+            "tokens_per_s": round(produced / max(wall, 1e-9), 1),
+            "step_phase_ms": phase_ms,
+            "stop_replay_tokens": int(stop_generated),
+            "wasted_tokens": int(wasted),
+            "wasted_rate": round(wasted / max(stop_generated, 1), 4),
+            "fallbacks": dict(stats["multistep_fallback"]),
+        }
+        del eng
+        gc.collect()
+        return result, stop_outs
+
+    results = {}
+    parity = True
+    ref_stop = None
+    for k in (1, 4, 8):
+        results[f"k{k}"], stop_outs = run(k)
+        if ref_stop is None:
+            ref_stop = stop_outs
+        elif stop_outs != ref_stop:
+            parity = False
+    return {
+        **results,
+        # >= 4x is the acceptance bar: the window amortizes the host
+        # round-trip K-fold, so K=8 should cut per-token host cost ~8x.
+        "host_gap_reduction_k8_vs_k1": round(
+            results["k1"]["per_token_host_ms"]
+            / max(results["k8"]["per_token_host_ms"], 1e-9), 2
+        ),
+        "greedy_parity": parity,
+    }
+
+
 def bench_engine_overload_ab(args, preset: str) -> dict:
     """Overload shedding A/B through the REAL engine: a seeded Poisson
     workload arriving at ~2x the decode capacity, replayed twice — with
@@ -1416,6 +1564,30 @@ def main() -> None:
         except Exception as e:
             log(f"mixed A/B failed: {e}")
             detail["mixed_ab_error"] = str(e)[:200]
+
+    if not args.quick and budget_left("multistep_ab"):
+        # K-step decode-window A/B: per-token host cost at K in {1,4,8}
+        # plus the stop-mask wasted-token rate — the host-round-trip
+        # amortization claim, measured (docs/engine.md StepPlan).
+        try:
+            try:
+                del params, kv
+            except NameError:
+                pass
+            import gc as _gc
+
+            _gc.collect()
+            detail["multistep_ab"] = bench_engine_multistep_ab(args, preset)
+            ab = detail["multistep_ab"]
+            log(f"multistep A/B: per-token host "
+                f"{ab['k1']['per_token_host_ms']} ms @K=1 vs "
+                f"{ab['k8']['per_token_host_ms']} ms @K=8 "
+                f"({ab['host_gap_reduction_k8_vs_k1']}x cut), wasted rate "
+                f"{ab['k8']['wasted_rate']} under the stop-mask, parity "
+                f"{ab['greedy_parity']}")
+        except Exception as e:
+            log(f"multistep A/B failed: {e}")
+            detail["multistep_ab_error"] = str(e)[:200]
 
     if not args.quick and budget_left("overload_ab"):
         # Overload shedding A/B: bounded admission vs the unbounded

@@ -385,12 +385,28 @@ class SchedulerConfig:
     prefill_chunk_buckets: Tuple[int, ...] = (128, 256, 512)
     # "recompute" (drop + re-prefill) or "offload" (page out to host DRAM)
     preemption_mode: str = "offload"
-    # Decode iterations fused into ONE device dispatch (lax.scan over the
-    # decode step with on-device sampling).  vLLM's --num-scheduler-steps:
-    # amortizes host->device dispatch latency across N tokens at the cost
-    # of up to N-1 wasted tokens past a stop condition (truncated on the
-    # host, never surfaced).  1 = classic one-token steps.
+    # Legacy spelling of the K-step decode window (vLLM's
+    # --num-scheduler-steps): a value > 1 forces window size K =
+    # num_scheduler_steps through the same device-resident window
+    # machinery multi_step_window gates.  1 = defer to multi_step_window.
     num_scheduler_steps: int = 1
+    # K-step device-resident decode windows — THE default decode fast
+    # path: the scheduler emits pure-decode plans with a decode_window-
+    # iteration budget whenever no prompt is waiting, and the engine runs
+    # the whole window as ONE device dispatch (lax.scan over decode +
+    # on-device sampling with penalties, the min_tokens EOS floor and
+    # per-row stop masking), so the per-token host round-trip is
+    # amortized K-fold.  Batches using logprobs / logit_bias / guided
+    # decoding (host-visible per-token state) fall back to single-step
+    # per dispatch (tpu:multistep_fallback_total).  None = auto (ON
+    # unless speculative decoding is active); False
+    # (--no-multi-step-window) restores single-token stepping exactly
+    # (greedy parity asserted in tests/test_multistep_window.py).
+    multi_step_window: Optional[bool] = None
+    # Window size K for multi_step_window (compiled-shape inventory grows
+    # by one scan executable per decode bucket; scan compile cost is
+    # ~independent of K).
+    decode_window: int = 8
     # N-gram (prompt-lookup) speculative decoding: draft K tokens by
     # matching the sequence's own trailing bigram against its history and
     # verify them in ONE forward (the K+1 rows share the step's weight
@@ -418,16 +434,17 @@ class SchedulerConfig:
     # Generous default: the first XLA compile of a large bucket set can
     # legitimately take minutes.  0 disables the check.
     step_watchdog_s: float = 300.0
-    # Async one-step-lookahead decode pipeline: dispatch decode step N+1
-    # (input tokens = step N's still-in-flight device-resident sample)
-    # before reading step N's result back, so host scheduling/detokenize
-    # overlaps device compute.  Greedy streams are byte-identical to
-    # synchronous stepping; batches using host-state sampling features
-    # (penalties, logprobs, logit_bias, min_tokens, guided) fall back per
-    # step like multi-step does.  None = auto (ON whenever the classic
-    # single-step path is active); explicit True conflicts with
-    # speculative/multi-step the same way those two conflict with each
-    # other; False forces classic synchronous stepping.
+    # Async lookahead decode pipeline: dispatch decode step (or K-step
+    # window) N+1 — input tokens chained from N's still-in-flight
+    # device-resident sample — before reading N's result back, so host
+    # scheduling/detokenize overlaps device compute.  Greedy streams are
+    # byte-identical to synchronous stepping; single-step batches using
+    # host-state sampling features fall back per step, and K-step windows
+    # chain through the device-resident window carry (done/penalty state
+    # rides along, so stopped rows stay frozen in the successor).
+    # None = auto (ON unless speculative decoding is active); explicit
+    # True conflicts with speculative_ngram; False forces synchronous
+    # stepping.
     pipeline_decode: Optional[bool] = None
 
     def __post_init__(self):
@@ -438,21 +455,30 @@ class SchedulerConfig:
             )
         if self.speculative_ngram < 0:
             raise ValueError("speculative_ngram must be >= 0")
-        if self.pipeline_decode and (
-            self.num_scheduler_steps > 1 or self.speculative_ngram
-        ):
+        if self.decode_window < 1:
+            raise ValueError("decode_window must be >= 1")
+        if self.multi_step_window and self.speculative_ngram:
+            raise ValueError(
+                "multi_step_window and speculative_ngram are mutually "
+                "exclusive (both widen the per-dispatch token window); "
+                "auto mode resolves the window off under speculation"
+            )
+        if self.num_scheduler_steps > 1 and self.multi_step_window is False:
+            raise ValueError(
+                "num_scheduler_steps > 1 requests a K-step decode window "
+                "but multi_step_window=False disables the window machinery "
+                "that runs it; drop one of the two"
+            )
+        if self.pipeline_decode and self.speculative_ngram:
             raise ValueError(
                 "pipeline_decode is mutually exclusive with "
-                "num_scheduler_steps > 1 and speculative_ngram (all three "
-                "restructure the per-step dispatch; pick one)"
+                "speculative_ngram (both restructure the per-step dispatch)"
             )
-        if self.mixed_batch and (
-            self.num_scheduler_steps > 1 or self.speculative_ngram
-        ):
+        if self.mixed_batch and self.speculative_ngram:
             raise ValueError(
-                "mixed_batch is mutually exclusive with "
-                "num_scheduler_steps > 1 and speculative_ngram (mixed steps "
-                "assume one decode token per sequence per dispatch)"
+                "mixed_batch is mutually exclusive with speculative_ngram "
+                "(mixed steps assume one decode token per sequence per "
+                "dispatch)"
             )
         if not self.prefill_chunk_buckets:
             raise ValueError("prefill_chunk_buckets must be non-empty")
@@ -479,21 +505,39 @@ class SchedulerConfig:
             )
 
     @property
+    def window_steps(self) -> int:
+        """Resolved K-step decode-window size: iterations a pure-decode
+        plan may fuse into one device dispatch.  1 = single-token steps
+        (window off / speculative active); num_scheduler_steps > 1 keeps
+        its legacy meaning as an explicit window size."""
+        if self.speculative_ngram:
+            return 1
+        if self.multi_step_window is False:
+            return 1
+        if self.num_scheduler_steps > 1:
+            return self.num_scheduler_steps
+        return max(1, self.decode_window)
+
+    @property
     def pipeline_enabled(self) -> bool:
-        """Resolved pipeline gate: auto (None) turns on exactly when the
-        classic single-step non-speculative decode path is active."""
+        """Resolved pipeline gate: auto (None) turns on unless
+        speculative decoding owns the dispatch shape.  K-step windows
+        chain through the same pipeline (window N+1 dispatched off
+        window N's device-resident carry)."""
         if self.pipeline_decode is None:
-            return self.num_scheduler_steps == 1 and not self.speculative_ngram
+            return not self.speculative_ngram
         return self.pipeline_decode
 
     @property
     def mixed_enabled(self) -> bool:
-        """Resolved mixed-step gate: auto (None) turns on exactly when the
-        classic single-step non-speculative path is active.  The engine
-        additionally clears ``mixed_batch`` when the mesh has a dp/sp axis
-        (the packed mixed batch is not dp/sp-shardable)."""
+        """Resolved mixed-step gate: auto (None) turns on unless
+        speculative decoding is active (mixed steps coexist with K-step
+        windows: the scheduler picks K=1 mixed steps while a prompt
+        waits and K>1 pure-decode windows otherwise).  The engine
+        additionally clears ``mixed_batch`` when the mesh has a dp/sp
+        axis (the packed mixed batch is not dp/sp-shardable)."""
         if self.mixed_batch is None:
-            return self.num_scheduler_steps == 1 and not self.speculative_ngram
+            return not self.speculative_ngram
         return self.mixed_batch
 
     @property

@@ -78,16 +78,21 @@ def _dtype_size(dtype: str) -> int:
 class _PendingStep:
     """One dispatched-but-not-yet-collected engine step.
 
-    Synchronous steps (prefill, speculative, multi-step, and decode
-    batches using host-state sampling features) carry precomputed
-    ``outputs``; pipelined decode steps carry the batch rows and the
-    still-in-flight device sample instead."""
+    Synchronous steps (prefill, speculative, and decode batches using
+    host-state sampling features) carry precomputed ``outputs``;
+    pipelined decode steps carry the batch rows and the still-in-flight
+    device sample instead — [S] for a single-token step, [K, S] emitted
+    tokens for a K-step window (``steps`` holds the per-row iteration
+    budgets and ``win_state`` the device-resident window carry the next
+    window chains from)."""
 
     outputs: Optional[List[StepOutput]] = None
     seqs: Optional[List[Sequence]] = None
-    sampled: Optional[object] = None  # jax.Array [S], uncollected
+    sampled: Optional[object] = None  # jax.Array [S] or [K, S], uncollected
     is_decode: bool = False
     host_s: float = 0.0  # host time spent dispatching this step
+    steps: Optional[List[int]] = None  # per-row window budgets (windows)
+    win_state: Optional[dict] = None  # device window carry (windows)
 
 
 class LLMEngine:
@@ -284,26 +289,50 @@ class LLMEngine:
             config.scheduler.mixed_batch = False
         self._sample_fn = jax.jit(sample_tokens)
 
-        # Multi-step decode (vLLM --num-scheduler-steps analogue): scan N
-        # decode+sample iterations on-device and return all N tokens in one
-        # host round-trip.  Slot targeting moves on-device (the block table
-        # lookup per iteration); rows past their per-seq budget park their
-        # KV write on null block 0.  Sequences using penalties/logprobs
-        # (which need host-side state per token) fall back to single-step.
-        self._decode_multi_fn = None
-        n_steps = config.scheduler.num_scheduler_steps
-        if n_steps > 1:
+        # K-step device-resident decode windows (tentpole of the unified
+        # StepPlan path; vLLM --num-scheduler-steps made the default):
+        # scan K decode+sample iterations on-device and return all K
+        # emitted tokens in one host round-trip.  Slot targeting moves
+        # on-device (the block-table lookup per iteration); penalties and
+        # the min_tokens EOS floor run INSIDE the scan from device-
+        # resident occurrence state, and a per-row stop-token match
+        # freezes the row (no further KV writes, position/ctx frozen, -1
+        # emitted) so stop conditions no longer waste up to K-1 tokens.
+        # The final carry is returned so window N+1 can chain from window
+        # N's still-in-flight state (pipelined windows).
+        self._window_fn = None
+        self._window_steps = config.scheduler.window_steps
+        if self._window_steps > 1:
             model_decode = partial(self.model.decode, cfg=cfg, mesh=self.mesh)
             bs = config.cache.block_size
+            n_steps = self._window_steps
+            vocab = cfg.vocab_size
 
-            def multi_decode(
-                params, tokens, positions, block_tables, ctx_lens,
-                max_steps, kv_caches, temps, top_ps, top_ks, min_ps,
-                step_key, seq_seeds, lora=None, adapter_idx=None,
+            def multi_window(
+                params, tokens, positions, ctx_lens, done, min_left,
+                block_tables, max_steps, kv_caches,
+                temps, top_ps, top_ks, min_ps, seq_seeds,
+                stop_ids, key_base, counts, seen,
+                presence, frequency, repetition,
+                use_penalties, use_min_floor,
+                lora=None, adapter_idx=None,
             ):
+                # Per-row stop set as an [S, V] mask: doubles as the
+                # min_tokens ban mask (the banned set IS the stop set —
+                # vLLM min_tokens semantics) and the freeze predicate.
+                stop_valid = stop_ids >= 0
+                stop_mask = None
+                if use_min_floor:
+                    stop_mask = jax.vmap(
+                        lambda ids, v: jnp.zeros(
+                            (vocab,), jnp.bool_
+                        ).at[jnp.where(v, ids, 0)].max(v)
+                    )(stop_ids, stop_valid)
+
                 def body(carry, t):
-                    tokens, positions, ctx_lens, kv_caches = carry
-                    active = t < max_steps  # [S]
+                    (tokens, positions, ctx_lens, done, min_left,
+                     counts, seen, kv_caches) = carry
+                    active = jnp.logical_and(~done, t < max_steps)  # [S]
                     blk = jnp.take_along_axis(
                         block_tables, (positions // bs)[:, None], axis=1
                     )[:, 0]
@@ -317,33 +346,109 @@ class LLMEngine:
                         positions=positions,
                         block_tables=block_tables,
                         ctx_lens=ctx_lens,
+                        # Frozen/done rows park their KV write on null
+                        # block 0 — no cache slot past the stop position
+                        # is ever written.
                         slot_block_ids=jnp.where(active, blk, 0),
                         slot_offsets=positions % bs,
                         kv_caches=kv_caches,
                         **extra,
                     )
+                    if use_penalties:
+                        logits = sampling_lib.apply_penalties_state(
+                            logits, counts, seen,
+                            presence, frequency, repetition,
+                        )
+                    if use_min_floor:
+                        # Same -1e9 additive bias as the host path's
+                        # logit_bias matrix, active while the row's
+                        # min_tokens floor is unmet (+0.0 elsewhere is
+                        # bit-exact identity).
+                        bias = (
+                            jnp.logical_and(
+                                stop_mask, (min_left > 0)[:, None]
+                            ).astype(jnp.float32) * -1e9
+                        )
+                        logits = logits + bias
+                    # Key schedule matches single-token stepping exactly:
+                    # iteration t of a window dispatched at step counter
+                    # c uses PRNGKey(seed + c + t), the key the classic
+                    # path would use for that token — seeded sampling is
+                    # bit-identical across window sizes.
                     sampled = sample_tokens(
                         logits, temps, top_ps, top_ks,
-                        jax.random.fold_in(step_key, t), seq_seeds,
+                        jax.random.PRNGKey(key_base + t), seq_seeds,
                         min_p=min_ps,
                     )
+                    stop_hit = jnp.logical_and(
+                        active,
+                        jnp.any(
+                            jnp.logical_and(
+                                sampled[:, None] == stop_ids, stop_valid
+                            ),
+                            axis=1,
+                        ),
+                    )
+                    emitted = jnp.where(active, sampled, -1)
+                    appended = jnp.logical_and(active, ~stop_hit)
+                    if use_penalties:
+                        rows = jnp.arange(counts.shape[0])
+                        counts = counts.at[rows, sampled].add(
+                            appended.astype(jnp.int16)
+                        )
+                        seen = seen.at[rows, sampled].max(appended)
                     step = active.astype(jnp.int32)
                     return (
                         jnp.where(active, sampled, tokens),
                         positions + step,
                         ctx_lens + step,
-                        kv_caches,
-                    ), sampled
+                        jnp.logical_or(done, stop_hit),
+                        jnp.maximum(min_left - step, 0),
+                        counts, seen, kv_caches,
+                    ), emitted
 
-                carry, sampled = jax.lax.scan(
+                carry, emitted = jax.lax.scan(
                     body,
-                    (tokens, positions, ctx_lens, kv_caches),
+                    (tokens, positions, ctx_lens, done, min_left,
+                     counts, seen, kv_caches),
                     jnp.arange(n_steps),
                 )
-                return sampled, carry[3]  # [n, S] tokens, new caches
+                (tokens, positions, ctx_lens, done, min_left,
+                 counts, seen, kv_caches) = carry
+                # (No device-side all-finished reduction: every stop is
+                # visible in the emitted [K, S] tokens the host reads
+                # back anyway, so collect() evaluates the all-finished
+                # predicate from host state for free and drops queued
+                # successor windows without any extra device sync.)
+                state = {
+                    "tokens": tokens, "positions": positions,
+                    "ctx_lens": ctx_lens, "done": done,
+                    "min_left": min_left, "counts": counts, "seen": seen,
+                }
+                return emitted, state, kv_caches
 
-            self._decode_multi_fn = jax.jit(
-                multi_decode, donate_argnames=("kv_caches",)
+            self._window_fn = jax.jit(
+                multi_window,
+                static_argnames=("use_penalties", "use_min_floor"),
+                donate_argnames=("kv_caches",),
+            )
+
+            def win_advance(tables, cols, vals):
+                """Chained-window block-table growth: scatter up to C new
+                blocks per row into the device-resident table (col -1 =
+                no growth), mirroring _pipe_advance's single-column
+                form."""
+                rows = jnp.arange(tables.shape[0])[:, None]
+                valid = cols >= 0
+                safe = jnp.where(valid, cols, 0)
+                keep = tables[rows, safe]
+                return tables.at[rows, safe].set(
+                    jnp.where(valid, vals, keep)
+                )
+
+            self._win_advance_fn = jax.jit(win_advance)
+            self._win_occurrence_fn = jax.jit(
+                partial(sampling_lib.occurrence_state, vocab_size=vocab)
             )
         self._penalties_fn = jax.jit(sampling_lib.apply_penalties)
         self._argmax_fn = jax.jit(
@@ -396,6 +501,20 @@ class LLMEngine:
         self.admission_rejected = 0
         self.deadline_expired = 0
         self.deadline_expired_admission = 0
+        # K-step window observability (docs/observability.md): dispatches
+        # that fell back to single-step because a co-scheduled request
+        # needed host-sampled features (by reason — a single logprobs
+        # request silently de-optimized every co-scheduled stream before
+        # this counter existed), and emitted-but-undeliverable window
+        # tokens (abort / out-of-band finish while the window flew; the
+        # device stop-mask keeps ordinary stops at zero waste).  Both are
+        # step-thread-only writers.
+        self.multistep_fallback: Dict[str, int] = {}
+        self.multistep_wasted_tokens = 0
+        # Host-side mirror of the device-resident window block tables
+        # (how many columns of each row are populated), for the chained
+        # windows' delta scatter.
+        self._win_table_lens: List[int] = []
         self._step_time_accum = 0.0
         # (end_time, duration) of recent steps; duty_cycle = busy fraction
         # of the trailing window (the HPA/dashboard signal, vocabulary.py).
@@ -698,6 +817,9 @@ class LLMEngine:
         p = self._pending.popleft()
         if p.outputs is not None:
             outputs = p.outputs
+        elif p.steps is not None:
+            # stackcheck: allow=SC201 reason=t0 only stamps the obs collect-phase histogram inside _collect_window; no plan state reads it
+            outputs = self._collect_window(p, t0)
         else:
             arr = np.asarray(p.sampled)  # the ONE device sync point
             if self.obs.enabled:
@@ -713,9 +835,13 @@ class LLMEngine:
             )
             if self.obs.enabled:
                 self.obs.step_phase("sample", time.time() - t_post)
+        if p.outputs is None:
             # Drop in-flight successors whose every row has now finished:
             # pure overrun steps produce no outputs and must not wedge
-            # the pipeline when the engine drains.
+            # the pipeline when the engine drains.  (For windows this is
+            # the host side of the all-finished predicate: the device
+            # carry's rows are all frozen no-ops, so the successor is
+            # discarded without a second sync.)
             while (
                 self._pending
                 and self._pending[0].sampled is not None
@@ -764,15 +890,15 @@ class LLMEngine:
                 # stackcheck: allow=SC101 reason=1ms idle backoff while async transfers land; the device is idle here by definition (nothing scheduled) so this is pacing, not a data wait
                 time.sleep(0.001)
             return False
-        if plan.prefill is not None:
-            outputs = self._run_prefill(plan.prefill)
+        if plan.decode is None:
+            outputs = self._run_prefill(plan.prefill_chunk)
             self._step_counter += 1
             self._pending.append(
                 # stackcheck: allow=SC201 reason=host_s is a stats field (host-gap metric); no plan state reads it
                 _PendingStep(outputs=outputs, host_s=time.time() - t0)
             )
             return True
-        if plan.mixed is not None:
+        if plan.prefill_chunk is not None:
             # Fused decode+prefill-chunk step: synchronous (the chunk's
             # admission/finalization needs collected state), so the
             # lookahead pipeline pauses for the step and resumes on the
@@ -785,7 +911,9 @@ class LLMEngine:
             ))
             return True
         seqs = plan.decode.seqs
-        if self._can_pipeline(seqs):
+        if plan.decode_window > 1 and self._can_window(seqs):
+            self._pending.append(self._dispatch_window(plan, chain_from=None))
+        elif self._can_pipeline(seqs):
             self._pending.append(self._dispatch_decode_async(seqs, False))
         else:
             outputs = self._run_decode(plan.decode)
@@ -800,10 +928,25 @@ class LLMEngine:
         """Provisionally dispatch decode N+1 while N is still in flight.
         The scheduler plans under the optimistic no-finish assumption
         (rolling back at collect when wrong); inputs chain from N's
-        device-resident sample, so no host sync separates the steps."""
+        device-resident sample — the [S] in-flight token for single
+        steps, the whole window carry (tokens/positions/done/penalty
+        state) for K-step windows — so no host sync separates them."""
+        if not self._pipeline_enabled:
+            return False
         prev = self._pending[-1]
         if prev.sampled is None:
             return False  # only pipelined decode steps chain
+        if prev.win_state is not None:
+            t0 = time.time()
+            plan = self.scheduler.schedule_provisional_window(
+                prev.seqs, prev.steps
+            )
+            if self.obs.enabled:
+                self.obs.step_phase("schedule", time.time() - t0)
+            if plan is None:
+                return False
+            self._pending.append(self._dispatch_window(plan, chain_from=prev))
+            return True
         if not self._can_pipeline(prev.seqs):
             return False
         t0 = time.time()
@@ -817,30 +960,82 @@ class LLMEngine:
         )
         return True
 
+    # Host-state verdicts are cached per-sequence at admission instead of
+    # re-reading SamplingParams attribute chains in a Python loop on the
+    # step thread every dispatch.  Two static verdicts (they never change
+    # over a request's life) plus ONE dynamic bit — the pending
+    # min_tokens floor — which _append_and_check clears exactly once at
+    # the boundary crossing.
     @staticmethod
-    def _batch_uses_host_state(seqs: List[Sequence]) -> bool:
-        """True when any sequence needs host-visible per-token state at
-        sampling time (penalties, a pending min_tokens floor, logprobs,
-        logit_bias, guided decoding).  The ONE gate shared by the fused
-        fast paths — multi-step scan and the lookahead pipeline — so a
-        new host-state feature added here falls back everywhere at once
-        instead of being silently skipped on one path."""
-        return any(
-            s.sampling_params.presence_penalty
-            or s.sampling_params.frequency_penalty
-            or s.sampling_params.repetition_penalty != 1.0
-            or s.sampling_params.min_tokens > len(s.output_token_ids)
-            or s.sampling_params.logprobs
-            or s.sampling_params.logit_bias
-            or s.guide is not None
-            for s in seqs
-        )
+    def _host_state_flags(seq: Sequence):
+        """(window_fallback, classic_fallback) cached verdicts.
+        window_fallback: features the K-step window cannot serve
+        on-device (logprobs, logit_bias, guided — penalties and the
+        min_tokens floor now run inside the scan).  classic_fallback:
+        the stricter single-step-pipeline set (its sampler has no
+        penalty path)."""
+        flags = getattr(seq, "_hs_flags", None)
+        if flags is None:
+            sp = seq.sampling_params
+            window = bool(
+                sp.logprobs or sp.logit_bias or seq.guide is not None
+            )
+            classic = window or bool(
+                sp.presence_penalty
+                or sp.frequency_penalty
+                or sp.repetition_penalty != 1.0
+            )
+            seq._hs_flags = flags = (window, classic)
+            seq._min_tok_pending = (
+                sp.min_tokens > len(seq.output_token_ids)
+            )
+        return flags
+
+    def _batch_uses_host_state(self, seqs: List[Sequence]) -> bool:
+        """True when any sequence needs host-visible per-token state the
+        K-step window cannot reproduce on-device (logprobs, logit_bias,
+        guided decoding).  The ONE fallback gate for the window fast
+        path; each reason is counted in tpu:multistep_fallback_total —
+        a single such request de-optimizes every co-scheduled stream,
+        and that used to be invisible."""
+        return any(self._host_state_flags(s)[0] for s in seqs)
+
+    def _can_window(self, seqs: List[Sequence]) -> bool:
+        """K-step windows serve everything except host-sampled features;
+        a fallback is observable, never silent."""
+        if self._window_fn is None:
+            return False
+        if not self._batch_uses_host_state(seqs):
+            return True
+        # One increment per DISTINCT reason per dispatch (the registered
+        # unit is fallback dispatches, not offending sequences — three
+        # co-scheduled logprobs requests are still ONE de-optimized
+        # dispatch).
+        reasons = set()
+        for s in seqs:
+            if self._host_state_flags(s)[0]:
+                sp = s.sampling_params
+                reasons.add(
+                    "logprobs" if sp.logprobs
+                    else "logit_bias" if sp.logit_bias
+                    else "guided"
+                )
+        for reason in reasons:
+            self.multistep_fallback[reason] = (
+                self.multistep_fallback.get(reason, 0) + 1
+            )
+        return False
 
     def _can_pipeline(self, seqs: List[Sequence]) -> bool:
-        """Pipelined decode covers the common fast path only: host-state
-        batches drop to the classic synchronous path per step — the same
-        per-batch fallback rule the multi-step scan uses."""
-        return self._pipeline_enabled and not self._batch_uses_host_state(seqs)
+        """Single-step pipelined decode covers the common fast path
+        only: its on-device sampler has no penalty/floor path, so
+        penalty batches and pending min_tokens floors ALSO drop to the
+        classic synchronous path per step (K-step windows serve those
+        on-device)."""
+        return self._pipeline_enabled and not any(
+            self._host_state_flags(s)[1] or s._min_tok_pending
+            for s in seqs
+        )
 
     def _note_decode_launch(self) -> None:
         """Host-gap bookkeeping: time since the previous decode step
@@ -951,6 +1146,304 @@ class LLMEngine:
             seqs=list(seqs), sampled=sampled, is_decode=True,
             host_s=time.time() - t0,
         )
+
+    # -- K-step device-resident decode windows -----------------------------
+
+    @staticmethod
+    def _pow2_bucket(n: int, floor: int) -> int:
+        """Shared shape-bucketing for the window's token/stop-id arrays:
+        XLA compiles O(log) variants, not one per length."""
+        b = floor
+        while b < n:
+            b *= 2
+        return b
+
+    def _stop_set_ids(self, seq: Sequence) -> tuple:
+        """THE per-sequence stop set: ``stop_token_ids`` plus EOS unless
+        ``ignore_eos`` — what ends generation at sampling time, and
+        (vLLM min_tokens semantics) exactly the set the unmet min_tokens
+        floor suppresses.  Shared by the window's device stop-mask and
+        the host path's min_tokens logit ban so the two can never
+        diverge.  Out-of-vocab ids can never be sampled and are dropped
+        (this also keeps both the device scatter and the host bias
+        matrix in bounds)."""
+        sp = seq.sampling_params
+        V = self.config.model.vocab_size
+        ids = [t for t in (sp.stop_token_ids or ()) if 0 <= t < V]
+        eos = self.tokenizer.eos_token_id
+        if eos is not None and not sp.ignore_eos:
+            ids.append(eos)
+        return tuple(sorted(set(ids)))
+
+    def _window_host_state(self, seqs: List[Sequence], steps: List[int]):
+        """Host arrays + static flags for a window batch (re)build."""
+        S = self._decode_bucket(len(seqs))
+        (tokens, positions, tables, ctx_lens, _sb, _so) = (
+            self._decode_batch_arrays(seqs, S)
+        )
+        max_steps = np.zeros((S,), np.int32)
+        max_steps[: len(seqs)] = steps
+        done = np.ones((S,), bool)
+        done[: len(seqs)] = False
+        pad = S - len(seqs)
+        min_left = np.array(
+            [
+                max(0, s.sampling_params.min_tokens
+                    - len(s.output_token_ids))
+                for s in seqs
+            ] + [0] * pad,
+            np.int32,
+        )
+        presence = np.array(
+            [s.sampling_params.presence_penalty for s in seqs] + [0.0] * pad,
+            np.float32,
+        )
+        frequency = np.array(
+            [s.sampling_params.frequency_penalty for s in seqs] + [0.0] * pad,
+            np.float32,
+        )
+        repetition = np.array(
+            [s.sampling_params.repetition_penalty for s in seqs]
+            + [1.0] * pad,
+            np.float32,
+        )
+        stop_lists = [self._stop_set_ids(s) for s in seqs]
+        B = self._pow2_bucket(
+            max([len(ids) for ids in stop_lists] + [1]), 1
+        )
+        stop_ids = np.full((S, B), -1, np.int32)
+        for i, ids in enumerate(stop_lists):
+            stop_ids[i, : len(ids)] = ids
+        use_penalties = bool(
+            np.any(presence) or np.any(frequency) or np.any(repetition != 1.0)
+        )
+        use_min_floor = bool(np.any(min_left > 0))
+        return {
+            "S": S, "tokens": tokens, "positions": positions,
+            "tables": tables, "ctx_lens": ctx_lens,
+            "max_steps": max_steps, "done": done, "min_left": min_left,
+            "presence": presence, "frequency": frequency,
+            "repetition": repetition, "stop_ids": stop_ids,
+            "use_penalties": use_penalties, "use_min_floor": use_min_floor,
+        }
+
+    def _window_build(self, seqs: List[Sequence], steps: List[int]) -> dict:
+        """Full batch (re)build: transfer every window input to the
+        device and construct the occurrence state the penalty math
+        reads.  Runs once per batch composition; steady-state windows
+        chain through _window_chain's delta transfer instead."""
+        h = self._window_host_state(seqs, steps)
+        S = h["S"]
+        batch_spec = shardings_lib.decode_batch_spec()
+        row_spec = P(AXES.DP, None)
+        temps, top_ps, top_ks, min_ps, seeds = self._sampling_arrays(seqs, S)
+        state = {
+            "tokens": self._put(h["tokens"], batch_spec),
+            "positions": self._put(h["positions"], batch_spec),
+            "ctx_lens": self._put(h["ctx_lens"], batch_spec),
+            "done": self._put(h["done"], batch_spec),
+            "min_left": self._put(h["min_left"], batch_spec),
+            "tables": self._put(h["tables"], row_spec),
+            "max_steps": self._put(h["max_steps"], batch_spec),
+            "temps": self._put(temps, batch_spec),
+            "top_ps": self._put(top_ps, batch_spec),
+            "top_ks": self._put(top_ks, batch_spec),
+            "min_ps": self._put(min_ps, batch_spec),
+            "seeds": self._put(seeds, batch_spec),
+            "stop_ids": self._put(h["stop_ids"], row_spec),
+            "presence": self._put(h["presence"], batch_spec),
+            "frequency": self._put(h["frequency"], batch_spec),
+            "repetition": self._put(h["repetition"], batch_spec),
+            "use_penalties": h["use_penalties"],
+            "use_min_floor": h["use_min_floor"],
+        }
+        if h["use_penalties"]:
+            # Device-resident occurrence state, built by scatter from
+            # the bucketed [S, L] id arrays (same content as the host
+            # path's arrays, so penalty values are bit-identical).
+            L = self._pow2_bucket(
+                max([len(s.output_token_ids) for s in seqs] + [1]), 64
+            )
+            out_tokens = np.full((S, L), -1, np.int32)
+            for i, s in enumerate(seqs):
+                ids = s.output_token_ids[-L:]
+                out_tokens[i, : len(ids)] = ids
+            Lc = self._pow2_bucket(
+                max(len(s.all_token_ids) for s in seqs), 64
+            )
+            ctx_tokens = np.full((S, Lc), -1, np.int32)
+            for i, s in enumerate(seqs):
+                ids = s.all_token_ids[-Lc:]
+                ctx_tokens[i, : len(ids)] = ids
+            counts, seen = self._win_occurrence_fn(
+                self._put(out_tokens, row_spec),
+                self._put(ctx_tokens, row_spec),
+            )
+        else:
+            counts = self._put(np.zeros((S, 1), np.int16), row_spec)
+            seen = self._put(np.zeros((S, 1), bool), row_spec)
+        state["counts"] = counts
+        state["seen"] = seen
+        if self.lora_registry is not None:
+            adapter = np.zeros((S,), np.int32)
+            for i, seq in enumerate(seqs):
+                adapter[i] = seq.adapter_idx
+            state["adapter"] = self._put(adapter, batch_spec)
+        self._win_table_lens = [len(s.block_table) for s in seqs]
+        return state
+
+    def _window_chain(self, prev: _PendingStep, seqs: List[Sequence],
+                      steps: List[int]) -> dict:
+        """Steady path: window N+1's state IS window N's still-in-flight
+        device carry — tokens/positions/done/penalty state never touch
+        the host.  Only the per-window budget and up to C new block-table
+        columns per row transfer."""
+        state = dict(prev.win_state)
+        S = state["max_steps"].shape[0]
+        batch_spec = shardings_lib.decode_batch_spec()
+        max_steps = np.zeros((S,), np.int32)
+        max_steps[: len(steps)] = steps
+        state["max_steps"] = self._put(max_steps, batch_spec)
+        # Fixed delta width: retraces would otherwise key on how many
+        # blocks happened to be crossed this window.
+        C = self._window_steps // self.block_pool.block_size + 2
+        cols = np.full((S, C), -1, np.int32)
+        vals = np.zeros((S, C), np.int32)
+        for i, seq in enumerate(seqs):
+            have = self._win_table_lens[i]
+            new = seq.block_table[have:]
+            for j, blk in enumerate(new[:C]):
+                cols[i, j] = have + j
+                vals[i, j] = blk
+            self._win_table_lens[i] = have + len(new[:C])
+        state["tables"] = self._win_advance_fn(
+            state["tables"],
+            self._put(cols, P(AXES.DP, None)),
+            self._put(vals, P(AXES.DP, None)),
+        )
+        return state
+
+    # stackcheck: root=step-thread
+    def _dispatch_window(self, plan, chain_from: Optional[_PendingStep] = None
+                         ) -> _PendingStep:
+        """Enqueue one K-step decode window on the device and return
+        without any host round-trip.  ``chain_from=None`` (re)builds the
+        device-resident window state from host bookkeeping;  otherwise
+        the state chains from the previous window's in-flight carry
+        (pipelined windows — the device never drains between them)."""
+        t0 = time.time()
+        decode = plan.decode
+        seqs = decode.seqs
+        if chain_from is None:
+            state = self._window_build(seqs, decode.steps)
+            self._note_decode_launch()
+        else:
+            state = self._window_chain(chain_from, seqs, decode.steps)
+            self._gap_steps += 1  # device busy: zero gap by construction
+            self._last_decode_end = None
+        lora_kwargs = {}
+        if self.lora_registry is not None:
+            lora_kwargs = {
+                "lora": self.lora_registry.params,
+                "adapter_idx": state["adapter"],
+            }
+        emitted, out_state, self.kv_caches = self._window_fn(
+            self.params,
+            tokens=state["tokens"],
+            positions=state["positions"],
+            ctx_lens=state["ctx_lens"],
+            done=state["done"],
+            min_left=state["min_left"],
+            block_tables=state["tables"],
+            max_steps=state["max_steps"],
+            kv_caches=self.kv_caches,
+            temps=state["temps"],
+            top_ps=state["top_ps"],
+            top_ks=state["top_ks"],
+            min_ps=state["min_ps"],
+            seq_seeds=state["seeds"],
+            stop_ids=state["stop_ids"],
+            # Masked to 31 bits: a long-lived engine's monotone step
+            # counter would otherwise overflow the host->int32 cast and
+            # kill the step thread.  Below 2**31 key ordinals (years of
+            # serving) the schedule is bit-identical to single-token
+            # stepping; past it, +t wraps in-graph, which PRNGKey treats
+            # as bits — still deterministic across lockstep replicas.
+            key_base=jnp.int32(
+                (self.config.seed + self._step_counter) & 0x7FFFFFFF
+            ),
+            counts=state["counts"],
+            seen=state["seen"],
+            presence=state["presence"],
+            frequency=state["frequency"],
+            repetition=state["repetition"],
+            use_penalties=state["use_penalties"],
+            use_min_floor=state["use_min_floor"],
+            **lora_kwargs,
+        )
+        # One key ordinal per iteration: single-token stepping would have
+        # burned exactly these counter values for the same tokens.
+        self._step_counter += self._window_steps
+        state.update(out_state)
+        # stackcheck: allow=SC201 reason=host_s is a stats field (host-gap metric); no plan state reads it
+        return _PendingStep(
+            seqs=list(seqs), sampled=emitted, is_decode=True,
+            host_s=time.time() - t0, steps=list(decode.steps),
+            win_state=state,
+        )
+
+    def _collect_window(self, p: _PendingStep, t0: float) -> List[StepOutput]:
+        """Read one window's [K, S] emitted tokens back and replay them
+        through the single finish protocol, iteration by iteration —
+        exactly the per-token path single stepping takes, so streams are
+        identical.  Device-frozen rows emit -1 (their stop already
+        retired) and cost nothing; emitted tokens that can no longer be
+        delivered (their sequence aborted / finished out-of-band while
+        the window flew) are counted as multistep waste."""
+        arr = np.asarray(p.sampled)  # [K, S] — the ONE device sync point
+        if self.obs.enabled:
+            self.obs.step_phase("collect", time.time() - t0)
+        t_post = time.time()
+        outputs: List[StepOutput] = []
+        delivered = [0] * len(p.seqs)
+        alive = [(i, s) for i, s in enumerate(p.seqs) if not s.is_finished]
+        for t in range(arr.shape[0]):
+            batch = []
+            toks = []
+            for i, s in alive:
+                if t >= p.steps[i]:
+                    continue
+                tok = int(arr[t, i])
+                if tok < 0:
+                    continue  # frozen row: stop-mask spent no token here
+                batch.append((i, s))
+                toks.append(tok)
+            if not batch:
+                # done/budget masks are monotone within a window: no row
+                # can re-activate at a later iteration.
+                break
+            outs = self._append_and_check(
+                [s for _, s in batch], toks, first_token=False
+            )
+            outputs.extend(outs)
+            for i, _ in batch:
+                delivered[i] += 1
+            alive = [(i, s) for i, s in alive if not s.is_finished]
+        # Waste = emitted (device-computed, >= 0) minus delivered to the
+        # finish protocol: rows finished before the window collected
+        # (abort, out-of-band) deliver none, and rows a HOST-side finish
+        # (stop string, guided rejection) retires mid-replay skip their
+        # tail.  Device-stopped rows emit -1 past the stop, so ordinary
+        # stops contribute zero by construction.
+        wasted = 0
+        for i in range(len(p.seqs)):
+            k = min(p.steps[i], arr.shape[0])
+            wasted += int((arr[:k, i] >= 0).sum()) - delivered[i]
+        if wasted:
+            self.multistep_wasted_tokens += wasted
+        if self.obs.enabled:
+            self.obs.step_phase("sample", time.time() - t_post)
+        return outputs
 
     def restore_seq_blocks(self, seq: Sequence) -> str:
         """Scheduler restore_cb: page an offloaded sequence's KV snapshot
@@ -1711,58 +2204,6 @@ class LLMEngine:
         batch_spec = shardings_lib.decode_batch_spec()
         lora_kwargs = self._lora_kwargs(seqs, S, 1, batch_spec)
 
-        # Multi-step path: penalties/logprobs need host-visible per-token
-        # state, so any sequence using them drops the whole batch to
-        # single-step (they're rare; the common path stays fused).
-        use_multi = (
-            self._decode_multi_fn is not None
-            and not self._batch_uses_host_state(seqs)
-        )
-        if use_multi:
-            max_steps = np.zeros((S,), np.int32)
-            max_steps[: len(seqs)] = plan.steps
-            temps, top_ps, top_ks, min_ps, seeds = self._sampling_arrays(
-                seqs, S
-            )
-            self._note_decode_launch()
-            sampled, self.kv_caches = self._decode_multi_fn(
-                self.params,
-                tokens=self._put(tokens, batch_spec),
-                positions=self._put(positions, batch_spec),
-                block_tables=self._put(block_tables, P(AXES.DP, None)),
-                ctx_lens=self._put(ctx_lens, batch_spec),
-                max_steps=self._put(max_steps, batch_spec),
-                kv_caches=self.kv_caches,
-                temps=self._put(temps, batch_spec),
-                top_ps=self._put(top_ps, batch_spec),
-                top_ks=self._put(top_ks, batch_spec),
-                min_ps=self._put(min_ps, batch_spec),
-                step_key=jax.random.PRNGKey(
-                    self.config.seed + self._step_counter
-                ),
-                seq_seeds=self._put(seeds, batch_spec),
-                **lora_kwargs,
-            )
-            arr = np.asarray(sampled)  # [n, S] — ONE device->host sync
-            outputs: List[StepOutput] = []
-            alive = list(enumerate(seqs))
-            for t in range(arr.shape[0]):
-                batch = [(i, s) for (i, s) in alive if t < plan.steps[i]]
-                if not batch:
-                    break
-                outs = self._append_and_check(
-                    [s for _, s in batch],
-                    [int(arr[t, i]) for i, _ in batch],
-                    first_token=False,
-                )
-                outputs.extend(outs)
-                # Tokens computed past a finish are discarded here, never
-                # appended (vLLM multi-step semantics).
-                alive = [
-                    (i, s) for (i, s), o in zip(batch, outs) if not o.finished
-                ]
-            return outputs
-
         self._note_decode_launch()
         logits, self.kv_caches = self._decode_fn(
             self.params,
@@ -1998,15 +2439,13 @@ class LLMEngine:
         # same bias, and rebuilding/transferring it per token would
         # dominate the step.
         def _min_tokens_banned(s) -> tuple:
-            """Token ids suppressed while min_tokens is unmet: EOS and
-            every stop_token_id (vLLM min_tokens semantics)."""
-            sp = s.sampling_params
-            if sp.min_tokens <= len(s.output_token_ids):
+            """Token ids suppressed while min_tokens is unmet — the
+            sequence's stop set (_stop_set_ids, shared with the window's
+            device stop-mask so host and device semantics cannot
+            drift)."""
+            if s.sampling_params.min_tokens <= len(s.output_token_ids):
                 return ()
-            banned = list(sp.stop_token_ids or ())
-            if self.tokenizer.eos_token_id is not None and not sp.ignore_eos:
-                banned.append(self.tokenizer.eos_token_id)
-            return tuple(sorted(set(banned)))
+            return self._stop_set_ids(s)
 
         min_tok_banned = [_min_tokens_banned(s) for s in seqs]
         if any(s.sampling_params.logit_bias for s in seqs) or any(
@@ -2206,6 +2645,12 @@ class LLMEngine:
             if not stop_hit:
                 seq.output_token_ids.append(token_id)
                 self.total_generated_tokens += 1
+                if getattr(seq, "_min_tok_pending", False) and (
+                    len(seq.output_token_ids) >= sp.min_tokens
+                ):
+                    # The ONE boundary crossing: the cached host-state
+                    # verdict never needs re-reading after this.
+                    seq._min_tok_pending = False
             if seq.first_token_time is None:
                 seq.first_token_time = now
                 if self.obs.enabled:
@@ -2486,4 +2931,8 @@ class LLMEngine:
             ),
             "spec_tokens_drafted": self.spec_tokens_drafted,
             "spec_tokens_accepted": self.spec_tokens_accepted,
+            # K-step decode windows: single-step fallbacks by reason and
+            # emitted-but-undeliverable window tokens.
+            "multistep_fallback": dict(self.multistep_fallback),
+            "multistep_wasted_tokens": self.multistep_wasted_tokens,
         }

@@ -58,13 +58,10 @@ class DecodePlan:
 
 @dataclasses.dataclass
 class MixedPlan:
-    """One fused step: every running sequence's decode token PLUS a
-    bounded prefill chunk of the head waiting sequence, packed into a
-    single model invocation under the scheduler's token budget
-    (chunked-prefill-integrated batching; Sarathi-Serve / vLLM
-    ``max_num_batched_tokens``).  The chunk length comes from the small
-    ``prefill_chunk_buckets`` set so the compiled-shape space stays
-    bounded at |chunk_buckets| x |decode batch buckets|."""
+    """Compatibility view of a fused decode+prefill-chunk step (the
+    unified :class:`StepPlan` carries the fields directly; this shape is
+    what ``plan.mixed`` returns for callers written against the PR-3
+    plan taxonomy)."""
 
     decode: DecodePlan
     prefill_chunk: Optional[PrefillPlan] = None
@@ -72,13 +69,44 @@ class MixedPlan:
 
 @dataclasses.dataclass
 class StepPlan:
-    prefill: Optional[PrefillPlan] = None
+    """THE one step-plan type (unifies the former prefill / decode /
+    MixedPlan / provisional taxonomy).  Exactly one execution shape per
+    plan, read off two fields:
+
+      decode only                     pure decode — ``decode_window`` (K)
+                                      iterations per row budgeted in
+                                      ``decode.steps`` (K > 1 only when
+                                      no prompt is waiting)
+      prefill_chunk only              one prefill step (bucketed, maybe
+                                      chunked)
+      decode + prefill_chunk          fused mixed step (always K=1: the
+                                      chunk's admission needs collected
+                                      state every step)
+
+    ``provisional`` marks plans made while the previous window is still
+    in flight (optimistic no-finish assumption; the engine rolls back
+    at collect)."""
+
     decode: Optional[DecodePlan] = None
-    mixed: Optional[MixedPlan] = None
+    prefill_chunk: Optional[PrefillPlan] = None
+    decode_window: int = 1
+    provisional: bool = False
 
     @property
     def is_empty(self) -> bool:
-        return self.prefill is None and self.decode is None and self.mixed is None
+        return self.decode is None and self.prefill_chunk is None
+
+    @property
+    def prefill(self) -> Optional[PrefillPlan]:
+        """A dedicated (non-fused) prefill step's plan, else None."""
+        return self.prefill_chunk if self.decode is None else None
+
+    @property
+    def mixed(self) -> Optional[MixedPlan]:
+        """Compatibility view: the fused decode+chunk pair, else None."""
+        if self.decode is not None and self.prefill_chunk is not None:
+            return MixedPlan(decode=self.decode, prefill_chunk=self.prefill_chunk)
+        return None
 
 
 class Scheduler:
@@ -197,23 +225,35 @@ class Scheduler:
                 return bucket
         return None
 
+    def _window_for_pass(self) -> int:
+        """Window-selection rule: K > 1 pure-decode windows only when no
+        prompt is waiting to prefill (a waiting head needs K=1 steps so
+        admission — mixed chunk or dedicated prefill — is re-evaluated
+        every token, not every K tokens)."""
+        window = self.config.window_steps
+        if window > 1 and self.num_waiting:
+            return 1
+        return window
+
     # stackcheck: root=step-thread
     def schedule(self) -> StepPlan:
-        """With ``mixed_batch`` on and sequences decoding, emit a fused
-        decode+prefill-chunk plan so arriving prompts never stall the
-        decoders; otherwise prefer admitting a prefill when a batch slot
-        is open, else decode every running sequence (the classic
-        alternating path — also what ``mixed_batch=False`` restores)."""
+        """Emit one unified :class:`StepPlan`.  With ``mixed_batch`` on
+        and sequences decoding, a fused decode+chunk plan keeps arriving
+        prompts from stalling the decoders; otherwise prefer admitting a
+        prefill when a batch slot is open, else decode every running
+        sequence — as a K-step window when no prompt waits (the
+        device-resident fast path), single-token steps otherwise."""
+        window = self._window_for_pass()
         if self.config.mixed_enabled and self.running:
-            plan = self._try_schedule_mixed()
+            plan = self._try_schedule_mixed(window)
             if plan is not None:
                 return plan
         plan = self._try_schedule_prefill()
         if plan is not None:
-            return StepPlan(prefill=plan)
-        decode = self._try_schedule_decode()
+            return StepPlan(prefill_chunk=plan)
+        decode = self._try_schedule_decode(window)
         if decode is not None:
-            return StepPlan(decode=decode)
+            return StepPlan(decode=decode, decode_window=window)
         # No step possible.  Two partially-prefilled sequences can coexist
         # (one per queue, or via offload restore) and deadlock each other
         # by jointly holding the pool; roll back the youngest — freeing its
@@ -221,7 +261,7 @@ class Scheduler:
         while self._rollback_youngest_partial():
             plan = self._try_schedule_prefill()
             if plan is not None:
-                return StepPlan(prefill=plan)
+                return StepPlan(prefill_chunk=plan)
         return StepPlan()
 
     def _rollback_youngest_partial(self) -> bool:
@@ -272,7 +312,7 @@ class Scheduler:
             return self.waiting
         return self.preempted
 
-    def _try_schedule_mixed(self) -> Optional[StepPlan]:
+    def _try_schedule_mixed(self, window: int = 1) -> Optional[StepPlan]:
         """Fused step: decode every running sequence AND, when the token
         budget and a batch slot allow, a bounded prefill chunk of the
         admission head.  Returns None to fall back to the classic
@@ -290,7 +330,7 @@ class Scheduler:
             and len(self.running) < self.config.max_num_seqs
         ):
             return None
-        decode = self._try_schedule_decode()
+        decode = self._try_schedule_decode(window)
         if decode is None:
             # Pool pressure emptied the running set: the classic path's
             # prefill-first + rollback machinery handles recovery.
@@ -300,8 +340,8 @@ class Scheduler:
             budget = self.config.batched_tokens_budget - len(decode.seqs)
             chunk = self._try_schedule_prefill(chunk_budget=budget)
         if chunk is None:
-            return StepPlan(decode=decode)
-        return StepPlan(mixed=MixedPlan(decode=decode, prefill_chunk=chunk))
+            return StepPlan(decode=decode, decode_window=window)
+        return StepPlan(decode=decode, prefill_chunk=chunk)
 
     def _try_schedule_prefill(
         self, chunk_budget: Optional[int] = None
@@ -405,13 +445,13 @@ class Scheduler:
             is_final=is_final,
         )
 
-    def _step_budget(self, seq: Sequence) -> int:
-        """Decode iterations this sequence can run in one multi-step (or
+    def _step_budget(self, seq: Sequence, window: int = 1) -> int:
+        """Decode iterations this sequence can run in one window (or
         speculative) plan: bounded by max_model_len and the request's
-        max_tokens (stop/EOS cut shorter on the host — those tokens are
-        computed and discarded)."""
+        max_tokens (stop/EOS cut shorter — the device stop-mask freezes
+        the row; a mismatching host-only condition discards on readback)."""
         n = max(
-            self.config.num_scheduler_steps,
+            window,
             # K drafts + the bonus token per dispatch.
             self.config.speculative_ngram + 1,
         )
@@ -419,7 +459,7 @@ class Scheduler:
         room_out = seq.sampling_params.max_tokens - seq.num_generated
         return max(1, min(n, room_len, room_out))
 
-    def _try_schedule_decode(self) -> Optional[DecodePlan]:
+    def _try_schedule_decode(self, window: int = 1) -> Optional[DecodePlan]:
         if not self.running:
             return None
         bs = self.block_pool.block_size
@@ -428,7 +468,7 @@ class Scheduler:
             # Iteration i consumes the token at position num_tokens-1+i, so
             # a k-step budget writes KV through slot num_tokens+k-2 — the
             # table must cover num_tokens+k-1 slots (k=1: num_tokens).
-            slots = seq.num_tokens + self._step_budget(seq) - 1
+            slots = seq.num_tokens + self._step_budget(seq, window) - 1
             return max(0, -(-slots // bs) - len(seq.block_table))
 
         # Ensure every running sequence has blocks for its whole budget;
@@ -446,7 +486,61 @@ class Scheduler:
                 seq.block_table.extend(self.block_pool.allocate(need))
         return DecodePlan(
             seqs=list(self.running),
-            steps=[self._step_budget(seq) for seq in self.running],
+            steps=[self._step_budget(seq, window) for seq in self.running],
+        )
+
+    def schedule_provisional_window(
+        self, inflight_seqs: List[Sequence], inflight_steps: List[int]
+    ) -> Optional[StepPlan]:
+        """Plan the NEXT K-step decode window while the previous window
+        is still in flight on the device, under the optimistic
+        assumption that no in-flight row stops early and every row emits
+        its full ``inflight_steps`` budget (the device window carry
+        keeps actually-stopped rows frozen; the engine discards their
+        overrun on readback).  Declines (None) whenever the pipeline
+        must break and replan synchronously: the running set changed, an
+        admission is pending (window selection must drop to K=1 mixed
+        steps), every row's remaining budget is zero, or backing the
+        window would require preemption."""
+        window = self.config.window_steps
+        if window <= 1:
+            return None
+        if len(self.running) != len(inflight_seqs) or any(
+            a is not b for a, b in zip(self.running, inflight_seqs)
+        ):
+            return None
+        if not self.running:
+            return None
+        if self.waiting or self.preempted:
+            # A waiting prompt demands K=1 steps (mixed admission) —
+            # chaining another K-step window would starve it.
+            return None
+        bs = self.block_pool.block_size
+        steps: List[int] = []
+        needs: List[int] = []
+        for seq, prev_k in zip(self.running, inflight_steps):
+            # The in-flight window will (optimistically) land prev_k
+            # tokens before this one runs.
+            base_tokens = seq.num_tokens + prev_k
+            base_gen = seq.num_generated + prev_k
+            room_len = self.config.max_model_len - base_tokens
+            room_out = seq.sampling_params.max_tokens - base_gen
+            k = max(0, min(window, room_len, room_out))
+            steps.append(k)
+            slots = base_tokens + k - 1
+            needs.append(max(0, -(-slots // bs) - len(seq.block_table)))
+        if not any(steps):
+            return None
+        total = sum(needs)
+        if total and not self.block_pool.can_allocate(total):
+            return None
+        for seq, need in zip(self.running, needs):
+            if need:
+                seq.block_table.extend(self.block_pool.allocate(need))
+        return StepPlan(
+            decode=DecodePlan(seqs=list(self.running), steps=steps),
+            decode_window=window,
+            provisional=True,
         )
 
     def schedule_provisional(
@@ -527,6 +621,13 @@ class Scheduler:
         seq.outputs_absorbed += len(seq.output_token_ids)
         seq.prompt_token_ids = seq.all_token_ids
         seq.output_token_ids = []
+        # Emptying output_token_ids re-arms the min_tokens floor (the
+        # host predicate counts post-preemption output tokens); the
+        # engine's cached boundary-crossing bit must re-arm with it.
+        if getattr(seq, "_min_tok_pending", None) is not None:
+            seq._min_tok_pending = (
+                seq.sampling_params.min_tokens > 0
+            )
         self.queued_prompt_tokens += seq.num_prompt_tokens
         self.preempted.appendleft(seq)
         logger.debug("Preempted %s (mode=%s)", seq.seq_id, self.config.preemption_mode)

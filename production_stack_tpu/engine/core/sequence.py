@@ -118,6 +118,15 @@ class Sequence:
     # Guided decoding state (engine/guided.py JsonGuide) when the request
     # set response_format.
     guide: Optional[object] = None
+    # Cached host-state sampling verdicts (LLMEngine._host_state_flags):
+    # the (window_fallback, classic_fallback) pair is static over the
+    # request's life, so it's computed once instead of re-reading
+    # SamplingParams attribute chains on the step thread every dispatch.
+    # _min_tok_pending is the ONE dynamic bit — the min_tokens floor is
+    # still unmet — cleared by the engine exactly at the boundary
+    # crossing and re-armed when preemption empties output_token_ids.
+    _hs_flags: Optional[tuple] = None
+    _min_tok_pending: Optional[bool] = None
 
     @property
     def num_prompt_tokens(self) -> int:

@@ -83,6 +83,63 @@ def compute_logprobs(logits: jax.Array, token_ids: jax.Array) -> jax.Array:
     return jnp.take_along_axis(logp, token_ids[:, None], axis=-1)[:, 0]
 
 
+def occurrence_state(
+    out_tokens: jax.Array,  # [S, L] int32 generated-so-far, -1 padded
+    ctx_tokens: jax.Array,  # [S, Lc] int32 prompt+generated, -1 padded
+    vocab_size: int,
+):
+    """Device-resident per-sequence token-occurrence state: the
+    ``counts`` histogram over GENERATED tokens (int16 — the bounded
+    per-token occurrence count feeding presence/frequency) and the
+    ``seen`` bitmap over prompt AND generated tokens (repetition).
+    Built by scatter from the small [S, L] id arrays; the K-step decode
+    window carries both through its scan and updates them per sampled
+    token, so penalties apply on-device with no host round-trip."""
+    valid = out_tokens >= 0
+    ids = jnp.where(valid, out_tokens, 0)
+    counts = jax.vmap(
+        lambda i, v: jnp.zeros((vocab_size,), jnp.int16).at[i].add(
+            v.astype(jnp.int16)
+        )
+    )(ids, valid)
+    cvalid = ctx_tokens >= 0
+    cids = jnp.where(cvalid, ctx_tokens, 0)
+    seen = jax.vmap(
+        lambda i, v: jnp.zeros((vocab_size,), jnp.bool_).at[i].max(v)
+    )(cids, cvalid)
+    return counts, seen
+
+
+def apply_penalties_state(
+    logits: jax.Array,  # [S, V] fp32
+    counts: jax.Array,  # [S, V] int16 generated-token occurrence counts
+    seen: jax.Array,  # [S, V] bool prompt+generated occurrence bitmap
+    presence: jax.Array,  # [S]
+    frequency: jax.Array,  # [S]
+    repetition: jax.Array,  # [S]; 1.0 = off
+) -> jax.Array:
+    """The ONE place the penalty math lives (host single-step path and
+    the K-step decode window both land here, so the two can never
+    diverge).  HF/vLLM ``repetition_penalty`` over prompt AND generated
+    tokens applies to the RAW logits first (for every seen token,
+    positive logits divide by the penalty, negative multiply — HF
+    ``RepetitionPenaltyLogitsProcessor``), then the OpenAI
+    presence/frequency penalties over the GENERATED tokens (vLLM
+    semantics: the prompt is not penalized).  Per sequence:
+    ``logit[t] -= presence*[count(t)>0] + frequency*count(t)``.
+
+    Order matters when both families hit the same token (HF/vLLM apply
+    repetition before the subtraction: logit 2.0, presence 1.5, rep 2.0
+    must give -0.5, not +0.25).  With penalties off the result is
+    bit-identical to the input (x/1.0, x*1.0 and x-0.0 are exact)."""
+    rep = repetition[:, None]
+    scaled = jnp.where(logits > 0, logits / rep, logits * rep)
+    logits = jnp.where(seen, scaled, logits)
+    countsf = counts.astype(jnp.float32)
+    penalty = presence[:, None] * (countsf > 0) + frequency[:, None] * countsf
+    return logits - penalty
+
+
 def apply_penalties(
     logits: jax.Array,  # [S, V] fp32
     out_tokens: jax.Array,  # [S, L] int32 generated-so-far, -1 padded
@@ -91,30 +148,20 @@ def apply_penalties(
     repetition: jax.Array = None,  # [S]; 1.0 = off
     ctx_tokens: jax.Array = None,  # [S, Lc] prompt+generated, -1 padded
 ) -> jax.Array:
-    """HF/vLLM ``repetition_penalty`` over prompt AND generated tokens
-    applied to the RAW logits first (for every seen token, positive
-    logits divide by the penalty, negative multiply — HF
-    ``RepetitionPenaltyLogitsProcessor``), then the OpenAI
-    presence/frequency penalties over the GENERATED tokens (vLLM
-    semantics: the prompt is not penalized).  Per sequence:
-    ``logit[t] -= presence*[count(t)>0] + frequency*count(t)``.
-
-    Order matters when both families hit the same token (HF/vLLM apply
-    repetition before the subtraction: logit 2.0, presence 1.5, rep 2.0
-    must give -0.5, not +0.25).
-
-    The [S, V] count matrix is built on-device by scatter-add from the
-    small [S, L] id array — no dense host->device transfer per step."""
+    """Single-step host-path entry: build the occurrence state from the
+    per-step token-id arrays, then apply the shared penalty math.
+    ``repetition=None`` skips the seen-bitmap build entirely (the
+    common presence/frequency-only batch)."""
     S, V = logits.shape
     if repetition is not None:
-        cvalid = ctx_tokens >= 0
-        cids = jnp.where(cvalid, ctx_tokens, 0)
-        seen = jax.vmap(
-            lambda i, v: jnp.zeros((V,), jnp.bool_).at[i].max(v)
-        )(cids, cvalid)
-        rep = repetition[:, None]
-        scaled = jnp.where(logits > 0, logits / rep, logits * rep)
-        logits = jnp.where(seen, scaled, logits)
+        counts, seen = occurrence_state(
+            out_tokens,
+            ctx_tokens if ctx_tokens is not None else out_tokens,
+            V,
+        )
+        return apply_penalties_state(
+            logits, counts, seen, presence, frequency, repetition
+        )
     valid = out_tokens >= 0
     ids = jnp.where(valid, out_tokens, 0)
     counts = jax.vmap(

@@ -359,11 +359,24 @@ def build_engine_app(
             (vocab.TPU_DEADLINE_EXPIRED, s["deadline_expired_total"]),
             (vocab.TPU_QUEUED_PROMPT_TOKENS, s["queued_prompt_tokens"]),
             (vocab.TPU_LAST_STEP_AGE, engine.last_step_age_s),
+            # K-step decode windows: emitted-but-undeliverable tokens
+            # (the labeled fallback family renders below).
+            (vocab.TPU_MULTISTEP_WASTED_TOKENS, s["multistep_wasted_tokens"]),
         ]
         # Latency histogram families (TTFT/ITL/e2e + step phases) ride the
         # same exposition; rendered even at zero observations so the
         # router scraper and dashboards see stable names.
-        text = vocab.render_prometheus(pairs) + engine.engine.obs.render_metrics()
+        text = (
+            vocab.render_prometheus(pairs)
+            + vocab.render_labeled_counter(
+                vocab.TPU_MULTISTEP_FALLBACK, "reason",
+                {
+                    **dict.fromkeys(vocab.TPU_MULTISTEP_FALLBACK_REASONS, 0),
+                    **s["multistep_fallback"],
+                },
+            )
+            + engine.engine.obs.render_metrics()
+        )
         return web.Response(text=text)
 
     # -- request tracing debug surface (obs/) ------------------------------
@@ -1666,18 +1679,38 @@ def main(argv=None) -> None:
         "--num-scheduler-steps",
         type=int,
         default=1,
-        help="decode iterations fused per device dispatch (vLLM "
-        "--num-scheduler-steps): amortizes dispatch latency, may compute "
-        "up to N-1 discarded tokens past a stop condition",
+        help="legacy spelling of the K-step decode window (vLLM "
+        "--num-scheduler-steps): a value > 1 forces window size K "
+        "through the same device-resident machinery --decode-window "
+        "sizes; 1 defers to --decode-window",
+    )
+    parser.add_argument(
+        "--no-multi-step-window",
+        action="store_true",
+        help="disable K-step device-resident decode windows (the default "
+        "decode fast path: K decode+sample iterations per device "
+        "dispatch with on-device penalties, the min_tokens EOS floor "
+        "and per-row stop masking) and restore single-token stepping "
+        "exactly — A/B baseline / debugging.  Auto-disabled by "
+        "--speculative-ngram",
+    )
+    parser.add_argument(
+        "--decode-window",
+        type=int,
+        default=8,
+        help="window size K for the K-step decode fast path (iterations "
+        "fused per pure-decode dispatch; the per-token host round-trip "
+        "is amortized K-fold and the device stop-mask keeps stop "
+        "conditions from wasting the tail of the window)",
     )
     parser.add_argument(
         "--no-pipeline-decode",
         action="store_true",
-        help="disable the async one-step-lookahead decode pipeline "
-        "(dispatch decode N+1 while step N's tokens are in flight; "
-        "greedy streams are identical, decode_host_gap_ms shows the "
-        "recovered host serialization).  Auto-disabled by "
-        "--num-scheduler-steps > 1 and --speculative-ngram",
+        help="disable the async lookahead decode pipeline (dispatch "
+        "decode step or K-step window N+1 while N's tokens are in "
+        "flight; greedy streams are identical, decode_host_gap_ms shows "
+        "the recovered host serialization).  Auto-disabled by "
+        "--speculative-ngram",
     )
     parser.add_argument(
         "--no-mixed-batch",
@@ -1813,6 +1846,11 @@ def main(argv=None) -> None:
             ),
             "scheduler.num_scheduler_steps": args.num_scheduler_steps,
             "scheduler.speculative_ngram": args.speculative_ngram,
+            **(
+                {"scheduler.multi_step_window": False}
+                if args.no_multi_step_window else {}
+            ),
+            "scheduler.decode_window": args.decode_window,
             **(
                 {"scheduler.pipeline_decode": False}
                 if args.no_pipeline_decode else {}

@@ -160,6 +160,20 @@ REGISTRY = {
         "mirrors": ("fake_engine", "dashboard", "docs"),
         "help": "Requests shed/aborted on an expired client deadline",
     },
+    "tpu:multistep_fallback_total": {
+        "kind": "counter", "layer": "engine", "labels": ("reason",),
+        "mirrors": ("fake_engine", "dashboard", "docs"),
+        "help": "K-step decode-window dispatches dropped to single-step "
+                "because a co-scheduled request needed host-sampled "
+                "features (reason: logprobs | logit_bias | guided)",
+    },
+    "tpu:multistep_wasted_tokens_total": {
+        "kind": "counter", "layer": "engine",
+        "mirrors": ("fake_engine", "dashboard", "docs"),
+        "help": "Window tokens emitted but undeliverable (abort / "
+                "out-of-band finish mid-window; device stop-mask keeps "
+                "ordinary stops at zero waste)",
+    },
     # -- engine request-level histograms (obs layer) -----------------------
     "tpu:ttft_seconds": {
         "kind": "histogram", "layer": "engine",

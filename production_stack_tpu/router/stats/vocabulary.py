@@ -113,6 +113,18 @@ TPU_KV_PREFETCH_WASTE = "tpu:kv_prefetch_waste"
 # their client deadline expired before first token.
 TPU_ADMISSION_REJECTED = "tpu:admission_rejected_total"
 TPU_DEADLINE_EXPIRED = "tpu:deadline_expired_total"
+# K-step decode windows (scheduler multi_step_window): dispatches that
+# fell back to single-step because a co-scheduled request needed
+# host-sampled features (labeled by reason — logprobs / logit_bias /
+# guided; one such request de-optimizes every co-scheduled stream), and
+# window tokens emitted but undeliverable (sequence aborted or finished
+# out-of-band while the window flew; ordinary stops cost zero under the
+# device stop-mask).  waste/total_generated is the amortization tax.
+TPU_MULTISTEP_FALLBACK = "tpu:multistep_fallback_total"
+# The closed reason set, pre-seeded as zero-valued series so scrapers,
+# dashboards, and rate() see stable label sets from boot.
+TPU_MULTISTEP_FALLBACK_REASONS = ("guided", "logit_bias", "logprobs")
+TPU_MULTISTEP_WASTED_TOKENS = "tpu:multistep_wasted_tokens_total"
 TPU_COUNTERS = frozenset({
     TPU_TOTAL_PROMPT_TOKENS,
     TPU_TOTAL_GENERATED_TOKENS,
@@ -127,6 +139,7 @@ TPU_COUNTERS = frozenset({
     TPU_KV_PREFETCH_WASTE,
     TPU_ADMISSION_REJECTED,
     TPU_DEADLINE_EXPIRED,
+    TPU_MULTISTEP_WASTED_TOKENS,
 })
 
 
@@ -192,4 +205,16 @@ def render_prometheus(pairs) -> str:
         kind = "counter" if name in TPU_COUNTERS else "gauge"
         lines.append(f"# TYPE {name} {kind}")
         lines.append(f"{name} {float(value)}")
+    return "\n".join(lines) + "\n"
+
+
+def render_labeled_counter(name: str, label: str, values) -> str:
+    """Serialize one LABELED counter family ({label="key"} series from a
+    plain dict).  The TYPE header renders even with no series yet so
+    scrapers and dashboards see a stable family name from boot (same
+    contract render_prometheus gives unlabeled families).  Shared by the
+    real engine server and the fake engine."""
+    lines = [f"# TYPE {name} counter"]
+    for key in sorted(values):
+        lines.append(f'{name}{{{label}="{key}"}} {float(values[key])}')
     return "\n".join(lines) + "\n"

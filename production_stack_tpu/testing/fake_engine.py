@@ -249,7 +249,15 @@ def build_fake_engine_app(state: FakeEngineState | None = None) -> web.Applicati
             (vocab.TPU_DEADLINE_EXPIRED, state.deadline_expired),
             (vocab.TPU_QUEUED_PROMPT_TOKENS, 0),
             (vocab.TPU_LAST_STEP_AGE, 0.0),
-        ]) + state.obs.render_metrics()
+            # K-step decode windows: the fake engine has no device, so
+            # nothing falls back and nothing is wasted — but both
+            # families must exist for the scrape contract
+            # (TPU_MULTISTEP_FALLBACK renders its labeled header below).
+            (vocab.TPU_MULTISTEP_WASTED_TOKENS, 0),
+        ]) + vocab.render_labeled_counter(
+            vocab.TPU_MULTISTEP_FALLBACK, "reason",
+            dict.fromkeys(vocab.TPU_MULTISTEP_FALLBACK_REASONS, 0),
+        ) + state.obs.render_metrics()
 
     async def debug_requests(_request: web.Request) -> web.Response:
         return web.json_response(state.obs.debug_payload())

@@ -185,7 +185,11 @@ async def test_leader_publishes_lockstep_events():
     config = config_from_preset(
         "tiny-llama",
         **{"scheduler.max_num_seqs": 2, "scheduler.max_model_len": 128,
-           "cache.num_blocks": 64},
+           "cache.num_blocks": 64,
+           # One publish per token step: the >=3-events assertion below
+           # pins the per-step broadcast cadence, which K-step windows
+           # would legitimately compress to one publish per window.
+           "scheduler.multi_step_window": False},
     )
     engine = AsyncEngine(config, lockstep=RecordingChannel())
     await engine.start()

@@ -88,6 +88,10 @@ def make_engine(port=None, role="decode", prefetch=None, num_blocks=96,
             prefill_buckets=(16, 32, 64),
             max_model_len=128,
             mixed_batch=False,  # deterministic step pattern for timing
+            # One token per step(): the offload/restore tests below
+            # reason about what landed after N steps, and an 8-token
+            # request must not drain inside one K-step window.
+            multi_step_window=False,
         ),
     ))
 

@@ -1,9 +1,13 @@
-"""Multi-step decode scheduling (SchedulerConfig.num_scheduler_steps).
+"""Multi-step decode scheduling (the legacy num_scheduler_steps spelling
+of the K-step decode window).
 
 vLLM's --num-scheduler-steps analogue: N decode iterations run as ONE
 device dispatch (lax.scan with on-device sampling), so greedy outputs must
 be bit-identical to classic single-token stepping, stop conditions must
-truncate on the host, and block allocation must cover the whole budget.
+truncate (now via the device stop-mask), and block allocation must cover
+the whole budget.  The window-first surface (multi_step_window /
+decode_window, on-device penalties, stop-mask internals) is covered in
+tests/test_multistep_window.py.
 """
 
 
@@ -22,8 +26,14 @@ def make_engine(n_steps: int, **sched_kw):
         max_num_seqs=2,
         prefill_buckets=(16, 32, 64),
         max_model_len=128,
-        num_scheduler_steps=n_steps,
     )
+    # n_steps=1 is the single-token reference: the default config now
+    # windows decode (multi_step_window auto-on), so the reference must
+    # disable it explicitly.
+    if n_steps > 1:
+        sched["num_scheduler_steps"] = n_steps
+    else:
+        sched["multi_step_window"] = False
     sched.update(sched_kw)
     return LLMEngine(EngineConfig(
         model=ModelConfig(dtype="float32"),
@@ -90,16 +100,21 @@ def test_sampled_path_runs_and_respects_budget():
     assert finish["a"] == FinishReason.LENGTH
 
 
-def test_penalties_fall_back_to_single_step():
+def test_penalties_run_on_device_with_parity():
     engine = make_engine(4)
-    assert engine._decode_multi_fn is not None
-    outs, _ = drain(engine, [
+    assert engine._window_fn is not None
+    reqs = [
         ("pen", "repeat repeat repeat", SamplingParams(
             max_tokens=9, presence_penalty=0.5)),
         ("plain", "other request", SamplingParams(max_tokens=9)),
-    ])
-    # Both finish correctly even though the batch mixes penalty and plain
-    # sequences (the whole batch drops to single-step).
+    ]
+    outs, _ = drain(engine, reqs)
+    # Penalty batches now run INSIDE the window scan (device-resident
+    # occurrence counts) — no fallback, and greedy streams match the
+    # single-step host path exactly.
+    assert engine.multistep_fallback == {}
+    ref, _ = drain(make_engine(1), reqs)
+    assert outs == ref
     assert len(outs["pen"]) == 9
     assert len(outs["plain"]) == 9
 

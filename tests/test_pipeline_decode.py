@@ -26,6 +26,10 @@ def make_engine(pipeline, **sched_kw):
         prefill_buckets=(16, 32, 64),
         max_model_len=128,
         pipeline_decode=pipeline,
+        # This file exercises the SINGLE-STEP lookahead pipeline; K-step
+        # windows (the new default, which chain through the same
+        # pipeline) are covered in tests/test_multistep_window.py.
+        multi_step_window=False,
     )
     sched.update(sched_kw)
     return LLMEngine(EngineConfig(
@@ -205,13 +209,15 @@ def test_preemption_parity_under_pool_pressure():
     assert got == ref
 
 
-def test_pipeline_conflicts_with_multistep_and_speculative():
-    with pytest.raises(ValueError):
-        SchedulerConfig(pipeline_decode=True, num_scheduler_steps=4)
+def test_pipeline_conflicts_with_speculative_but_chains_windows():
     with pytest.raises(ValueError):
         SchedulerConfig(pipeline_decode=True, speculative_ngram=3)
-    # Auto mode resolves off under either feature, on otherwise.
-    assert not SchedulerConfig(num_scheduler_steps=4).pipeline_enabled
+    # The multi-step<->pipeline mutual exclusion is LIFTED: the pipeline
+    # chains K-step windows (window N+1 dispatched off window N's
+    # in-flight carry), so both auto-resolve on together.
+    cfg = SchedulerConfig(pipeline_decode=True, num_scheduler_steps=4)
+    assert cfg.pipeline_enabled and cfg.window_steps == 4
+    assert SchedulerConfig(num_scheduler_steps=4).pipeline_enabled
     assert not SchedulerConfig(speculative_ngram=3).pipeline_enabled
     assert SchedulerConfig().pipeline_enabled
     assert not SchedulerConfig(pipeline_decode=False).pipeline_enabled

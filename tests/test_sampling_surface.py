@@ -24,7 +24,13 @@ def make_engine(n_steps=1):
         cache=CacheConfig(block_size=4, num_blocks=64),
         scheduler=SchedulerConfig(
             max_num_seqs=2, prefill_buckets=(16, 32, 64), max_model_len=128,
-            num_scheduler_steps=n_steps,
+            # n_steps=1 is the single-token reference; the default config
+            # now windows decode, so the reference disables it explicitly
+            # (same convention as tests/test_multistep_decode.py).
+            **(
+                {"num_scheduler_steps": n_steps}
+                if n_steps > 1 else {"multi_step_window": False}
+            ),
         ),
     ))
 
@@ -97,12 +103,14 @@ def test_min_p_greedy_unchanged_multistep():
 
 def test_logit_bias_falls_back_to_single_step():
     engine = make_engine(4)
-    assert engine._decode_multi_fn is not None
+    assert engine._window_fn is not None
     base, _ = drain(make_engine(4), SamplingParams(max_tokens=3), "b")
     banned = base[1]
     out, _ = drain(engine, SamplingParams(
         max_tokens=3, logit_bias={banned: -100.0}))
     assert banned not in out
+    # The fallback is observable, never silent (ISSUE 8 satellite).
+    assert engine.multistep_fallback.get("logit_bias", 0) > 0
 
 
 async def test_stream_options_include_usage_conformance():

@@ -27,6 +27,11 @@ def make_engine(spec=0):
         scheduler=SchedulerConfig(
             max_num_seqs=2, prefill_buckets=(16, 32, 64), max_model_len=160,
             speculative_ngram=spec,
+            # spec=0 is this file's classic one-token-per-step reference
+            # (step-count assertions depend on it); the default K-step
+            # window must not compress its step count.  spec>0 resolves
+            # the window off on its own.
+            multi_step_window=False if spec == 0 else None,
         ),
     ))
 
