@@ -1067,6 +1067,259 @@ def bench_remote_prefix_ab(args, preset: str) -> dict:
     }
 
 
+def bench_disagg_ab(args, preset: str) -> dict:
+    """Disaggregated prefill/decode A/B through the REAL stack: router +
+    two CPU engines replaying one seeded Poisson mixed workload both
+    ways —
+
+      disagg: 1 prefill-role + 1 decode-role engine over an in-process
+              kvserver, routing policy ``disagg`` (two-phase prime ->
+              handoff -> decode with admission prefetch import);
+      fused:  the same 2 engines role-less, least-loaded routing
+              (today's behavior — prompts prefill on whichever backend
+              decodes them).
+
+    Claim (DistServe/Splitwise): moving ALL prefill off the decode pool
+    removes prompt interference from inter-token latency — decode ITL
+    p95 improves — at a bounded TTFT cost (the prime + export + import
+    handoff; acceptance bound: p95 TTFT regression <= 10%).  Handoff
+    latency comes from the router's own
+    ``tpu_router:disagg_handoff_seconds`` histogram, fallback counters
+    must stay zero (any nonzero = the fast path silently wasn't
+    measured)."""
+    import asyncio
+    import dataclasses as _dc
+    import gc
+    import threading
+
+    n_requests = 20
+    gen_tokens = 24
+    mean_gap_s = 0.25
+    rng = np.random.RandomState(7)
+    # Mixed prompt mix: short chat heads + long document heads — the
+    # long ones are the decode-interference injectors.
+    # In WORDS (~3.6 tokens each on tiny-llama's tokenizer): ~115 to
+    # ~920 prompt tokens, under max_model_len 2048.
+    prompt_lens = rng.choice([32, 80, 160, 256], size=n_requests,
+                             p=[0.35, 0.25, 0.25, 0.15])
+    gaps = rng.exponential(mean_gap_s, n_requests)
+
+    def make_engine(role, kv_url):
+        from production_stack_tpu.engine.config import (
+            CacheConfig,
+            EngineConfig,
+            PRESETS,
+            SchedulerConfig,
+        )
+        from production_stack_tpu.engine.server.async_engine import AsyncEngine
+
+        return AsyncEngine(EngineConfig(
+            model=_dc.replace(PRESETS[preset]),
+            cache=CacheConfig(
+                num_blocks=768,
+                remote_kv_url=kv_url,
+                disagg_role=role,
+            ),
+            scheduler=SchedulerConfig(
+                max_num_seqs=4,
+                prefill_buckets=(128, 256, 512),
+                max_model_len=2048,
+            ),
+        ))
+
+    async def replay(client, model: str) -> dict:
+        send_times: list = []
+        ttfts: list = []
+        gaps_observed: list = []
+
+        async def one(i: int, delay: float):
+            await asyncio.sleep(delay)
+            prompt = " ".join(
+                f"w{(13 * i + j) % 997}" for j in range(int(prompt_lens[i]))
+            )
+            t0 = time.perf_counter()
+            resp = await client.post(
+                "/v1/completions",
+                json={"model": model, "prompt": prompt,
+                      "max_tokens": gen_tokens, "ignore_eos": True,
+                      "stream": True},
+            )
+            assert resp.status == 200, await resp.text()
+            last = None
+            async for chunk in resp.content.iter_any():
+                now = time.perf_counter()
+                if b"data: " not in chunk:
+                    continue
+                if last is None:
+                    ttfts.append(now - t0)
+                else:
+                    gaps_observed.append(now - last)
+                last = now
+
+        offsets = np.cumsum(gaps)
+        await asyncio.gather(*(one(i, float(offsets[i]))
+                               for i in range(n_requests)))
+
+        def p95(xs):
+            xs = sorted(xs)
+            return xs[int(0.95 * (len(xs) - 1))] * 1e3 if xs else 0.0
+
+        return {
+            "ttft_p95_ms": round(p95(ttfts), 2),
+            "ttft_p50_ms": round(p95(ttfts[:1]) if not ttfts else
+                                 sorted(ttfts)[len(ttfts) // 2] * 1e3, 2),
+            "itl_p95_ms": round(p95(gaps_observed), 2),
+            "itl_max_ms": round(max(gaps_observed) * 1e3, 2)
+            if gaps_observed else 0.0,
+        }
+
+    async def run_mode(disagg: bool) -> dict:
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from production_stack_tpu.engine.server.api_server import (
+            build_engine_app,
+        )
+        from production_stack_tpu.kvserver.server import KVStore, handle_client
+        from production_stack_tpu.router.app import build_app
+        from production_stack_tpu.router.parser import (
+            parse_args as parse_router_args,
+        )
+
+        kv_loop = None
+        kv_thread = None
+        kv_url = None
+        if disagg:
+            kv_store = KVStore(capacity_bytes=256 << 20)
+            kv_loop = asyncio.new_event_loop()
+            started = threading.Event()
+            state: dict = {}
+
+            def serve():
+                asyncio.set_event_loop(kv_loop)
+
+                async def boot():
+                    server = await asyncio.start_server(
+                        lambda r, w: handle_client(kv_store, r, w),
+                        "127.0.0.1", 0,
+                    )
+                    state["port"] = server.sockets[0].getsockname()[1]
+                    started.set()
+
+                kv_loop.run_until_complete(boot())
+                kv_loop.run_forever()
+
+            kv_thread = threading.Thread(target=serve, daemon=True)
+            kv_thread.start()
+            assert started.wait(10)
+            kv_url = f"kv://127.0.0.1:{state['port']}"
+
+        roles = ("prefill", "decode") if disagg else (None, None)
+        engines = [make_engine(r, kv_url if disagg else None) for r in roles]
+        servers = []
+        for eng in engines:
+            s = TestServer(build_engine_app(eng, preset))
+            await s.start_server()
+            servers.append(s)
+        urls = [str(s.make_url("")).rstrip("/") for s in servers]
+        router_argv = [
+            "--static-backends", ",".join(urls),
+            "--static-models", ",".join([preset] * 2),
+            "--engine-stats-interval", "1",
+            "--routing-logic", "disagg" if disagg else "least_loaded",
+        ]
+        if disagg:
+            router_argv += ["--static-backend-roles", "prefill,decode"]
+        router_server = TestServer(build_app(parse_router_args(router_argv)))
+        await router_server.start_server()
+        client = TestClient(router_server)
+        try:
+            # Warm every engine's compile caches off the clock (each
+            # prefill bucket + the decode shapes), through the router so
+            # the disagg path warms its prime flow too.
+            for _ in range(2):
+                for prompt_len in (32, 80, 160, 256):
+                    resp = await client.post(
+                        "/v1/completions",
+                        json={"model": preset,
+                              "prompt": " ".join(
+                                  f"warm{j}" for j in range(prompt_len)
+                              ),
+                              "max_tokens": 2, "ignore_eos": True},
+                    )
+                    await resp.read()
+            from prometheus_client import REGISTRY as _REG
+
+            def handoff_stats():
+                s = _REG.get_sample_value(
+                    "tpu_router:disagg_handoff_seconds_sum"
+                ) or 0.0
+                c = _REG.get_sample_value(
+                    "tpu_router:disagg_handoff_seconds_count"
+                ) or 0.0
+                fb = {
+                    r: _REG.get_sample_value(
+                        "tpu_router:disagg_fallback_total", {"reason": r}
+                    ) or 0.0
+                    for r in ("prime_failed", "prefix_miss",
+                              "handoff_unexported", "prefill_pool_empty",
+                              "prefill_breaker_open", "decode_pool_empty")
+                }
+                return s, c, fb
+
+            h_sum0, h_count0, fb0 = handoff_stats()
+            result = await replay(client, preset)
+            h_sum1, h_count1, fb1 = handoff_stats()
+            if disagg:
+                handoffs = h_count1 - h_count0
+                result["handoffs"] = int(handoffs)
+                result["handoff_mean_ms"] = round(
+                    (h_sum1 - h_sum0) / handoffs * 1e3, 2
+                ) if handoffs else 0.0
+                result["fallbacks"] = {
+                    r: int(fb1[r] - fb0[r]) for r in fb1
+                    if fb1[r] - fb0[r] > 0
+                }
+                result["decode_engine_prefix_imported"] = int(
+                    engines[1].engine.remote_prefix_blocks_fetched
+                )
+                result["decode_engine_handoff_hits"] = int(
+                    engines[1].engine.disagg_handoff_hits
+                )
+            return result
+        finally:
+            await client.close()
+            await router_server.close()
+            for s in servers:
+                await s.close()
+            if kv_loop is not None:
+                kv_loop.call_soon_threadsafe(kv_loop.stop)
+            if kv_thread is not None:
+                kv_thread.join(timeout=5)
+
+    fused = asyncio.run(run_mode(False))
+    gc.collect()
+    disagg = asyncio.run(run_mode(True))
+    gc.collect()
+    return {
+        "workload": {
+            "requests": n_requests,
+            "gen_tokens": gen_tokens,
+            "mean_arrival_gap_s": mean_gap_s,
+            "prompt_lens": sorted(set(int(x) for x in prompt_lens)),
+        },
+        "fused": fused,
+        "disagg": disagg,
+        # > 1.0 = disaggregation cut the decode ITL tail.
+        "itl_p95_ratio": round(
+            fused["itl_p95_ms"] / max(disagg["itl_p95_ms"], 1e-9), 3
+        ),
+        # <= 1.10 is the acceptance bound (TTFT tax of the handoff).
+        "ttft_p95_ratio": round(
+            disagg["ttft_p95_ms"] / max(fused["ttft_p95_ms"], 1e-9), 3
+        ),
+    }
+
+
 # -- trace report ----------------------------------------------------------
 
 
@@ -1637,6 +1890,36 @@ def main() -> None:
         except Exception as e:
             log(f"remote prefix A/B failed: {e}")
             detail["remote_prefix_ab_error"] = str(e)[:200]
+
+    if not args.quick and budget_left("disagg_ab"):
+        # Disaggregated prefill/decode A/B: router + 1 prefill + 1 decode
+        # engine (two-phase disagg policy over the KV plane) vs the same
+        # 2 engines fused, one seeded Poisson mixed replay — the
+        # decode-ITL-without-prompt-interference claim, measured, plus
+        # the handoff's TTFT tax (docs/engine.md "Disaggregated data
+        # path").
+        try:
+            try:
+                del params, kv
+            except NameError:
+                pass
+            import gc as _gc
+
+            _gc.collect()
+            detail["disagg_ab"] = bench_disagg_ab(args, preset)
+            ab = detail["disagg_ab"]
+            log(f"disagg A/B: fused ITL p95 {ab['fused']['itl_p95_ms']} ms "
+                f"vs disagg {ab['disagg']['itl_p95_ms']} ms "
+                f"({ab['itl_p95_ratio']}x tail cut), TTFT p95 "
+                f"{ab['fused']['ttft_p95_ms']} -> "
+                f"{ab['disagg']['ttft_p95_ms']} ms "
+                f"({ab['ttft_p95_ratio']}x), handoff mean "
+                f"{ab['disagg'].get('handoff_mean_ms')} ms, "
+                f"{ab['disagg'].get('handoffs')} handoffs, fallbacks "
+                f"{ab['disagg'].get('fallbacks')}")
+        except Exception as e:
+            log(f"disagg A/B failed: {e}")
+            detail["disagg_ab_error"] = str(e)[:200]
 
     result = {
         "metric": f"decode_throughput_{preset}_b{S}_ctx{ctx}",

@@ -288,6 +288,13 @@ class CacheConfig:
     # Background fetcher threads for the prefetch plane (each issues
     # independent RPCs through the client connection pool).
     prefetch_threads: int = 2
+    # Disaggregated decode-phase handoff: how long the API server lets a
+    # handoff-tagged request wait (off the event loop, off the step
+    # thread) for the prefetched chain to land in the prefix cache before
+    # admitting anyway.  Bounds the TTFT tax of a slow store; an actual
+    # store miss exits the wait early.  0 disables the wait (handoff
+    # requests admit local-only like any other, and will recompute).
+    disagg_handoff_wait_s: float = 2.0
     # KV cache precision (vLLM --kv-cache-dtype analogue).  "int8" stores
     # each cached K/V vector as int8 with a per-(token, head) fp32 scale:
     # KV HBM traffic and pool bytes roughly halve (decode is
@@ -313,6 +320,8 @@ class CacheConfig:
             )
         if self.prefetch_threads < 1:
             raise ValueError("prefetch_threads must be >= 1")
+        if self.disagg_handoff_wait_s < 0:
+            raise ValueError("disagg_handoff_wait_s must be >= 0")
 
     @property
     def remote_prefetch_enabled(self) -> bool:

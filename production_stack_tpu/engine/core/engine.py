@@ -195,6 +195,13 @@ class LLMEngine:
         self._export_lock = threading.Lock()
         self.remote_prefix_blocks_fetched = 0
         self.remote_prefix_blocks_exported = 0
+        # Disaggregated serving counters (written ONLY by the API
+        # server's event loop — the single-writer-per-thread contract
+        # the deadline counters follow): prefill-phase primes served,
+        # and decode-phase handoff prefetch outcomes.
+        self.disagg_prefill_primes = 0
+        self.disagg_handoff_hits = 0
+        self.disagg_handoff_misses = 0
         self.scheduler = Scheduler(
             config.scheduler,
             self.block_pool,
@@ -1870,6 +1877,128 @@ class LLMEngine:
                 return
             time.sleep(0.01)
 
+    # -- disaggregated prefill/decode handoff (docs/engine.md) -------------
+
+    def cache_ns_of(self, adapter: Optional[str]) -> int:
+        """The prefix-cache namespace a request with this adapter would
+        hash under (mirrors add_request; 0 = base model)."""
+        if adapter and self.lora_registry is not None:
+            return self.lora_registry.namespace_of(adapter)
+        return 0
+
+    def handoff_token(
+        self, prompt_token_ids: List[int], cache_ns: int = 0
+    ) -> dict:
+        """The prefill-phase handoff token: the prompt's prefix hash
+        chain (store content keys) + length, plus the model-identity key
+        prefix so a decode peer can verify it shares weights before
+        waiting on imports.  Called off the event loop (the first
+        ``_px_key_prefix`` pays a small D2H for the weight fingerprint).
+
+        ``exported`` reports whether this engine CAN have exported the
+        chain (store + prefill role) — the router's fused fallback keys
+        on it; it is not a per-block store receipt (content-keyed PUTs
+        are idempotent and a racing eviction shows up as a decode-side
+        miss, which degrades safely)."""
+        hashes = prefix_block_hashes(
+            prompt_token_ids, self.block_pool.block_size, namespace=cache_ns
+        )
+        key_prefix = self._px_key_prefix()
+        return {
+            "chain": [key_prefix + d.hex() for d in hashes],
+            "chain_len": len(hashes),
+            "chain_tail": hashes[-1].hex() if hashes else "",
+            "prompt_tokens": len(prompt_token_ids),
+            "block_size": self.block_pool.block_size,
+            "px": key_prefix,
+            "exported": bool(
+                self._exports and self.offload.remote_client is not None
+            ),
+        }
+
+    def wait_handoff_prefix(
+        self,
+        prompt_token_ids: List[int],
+        cache_ns: int,
+        handoff: dict,
+        timeout: float,
+    ) -> str:
+        """Decode-phase handoff consumption: make sure a prefetch of the
+        prompt's chain is in flight and wait (bounded) for the FETCH to
+        complete into host staging.  A staged chain is imported by the
+        step thread at the top of its next dispatch, BEFORE any
+        ``schedule()`` runs — so admitting the request after this
+        returns "hit" guarantees its first schedule serves the whole
+        prompt from the prefix cache and decode never executes prompt
+        tokens.  (Waiting for the cache import itself would deadlock an
+        idle engine: the import point only runs when there is work.)
+
+        Runs on an asyncio.to_thread worker: the polling sleep below
+        never touches the event loop or the step thread.  Returns
+        "hit" (chain staged or already cached), "partial", "miss", or
+        "disabled" (no prefetch plane / imports off / model-identity
+        mismatch).
+        """
+        if self.kv_prefetch is None or not self._imports:
+            return "disabled"
+        hashes = prefix_block_hashes(
+            prompt_token_ids, self.block_pool.block_size, namespace=cache_ns
+        )
+        if not hashes:
+            return "hit"  # prompt shorter than one block: nothing to import
+        peer_px = handoff.get("px")
+        if peer_px and peer_px != self._px_key_prefix():
+            # Different weights/namespace: the peer's exports can never
+            # match our keys — admit local-only immediately.
+            return "disabled"
+        start = self.block_pool.count_cached_prefix(hashes)
+        if start >= len(hashes):
+            return "hit"
+        key_prefix = self._px_key_prefix()
+        sid = f"handoff-{hashes[-1].hex()[:16]}"
+        submitted = self.kv_prefetch.submit_chain(
+            sid,
+            [key_prefix + d.hex() for d in hashes[start:]],
+            hashes[start:],
+            start,
+        )
+        if not submitted:
+            # A same-head job is already in flight (same-prompt burst,
+            # or this handoff raced a sibling): we own no job to watch,
+            # so poll coverage on a shortened budget.
+            timeout = min(timeout, 0.5)
+        deadline = time.time() + max(0.0, timeout)
+        grace_until: Optional[float] = None
+        while time.time() < deadline:
+            covered = self.block_pool.count_cached_prefix(hashes)
+            if covered >= len(hashes):
+                return "hit"
+            status = self.kv_prefetch.chain_status(sid)
+            if status == "done":
+                # Staged in host buffers: the step thread's dispatch
+                # drains it into the prefix cache before the request's
+                # first schedule() — that IS the hit.
+                return "hit"
+            if status == "absent" and submitted:
+                # Our own fetch settled without a result (store miss
+                # completes empty and pops the job) OR the step thread
+                # already consumed it.  One short grace window for the
+                # coverage check above to observe a consumed import,
+                # then classify instead of burning the budget.  Without
+                # `submitted` there never was a job under our sid — the
+                # sibling that owns the in-flight twin fetch is what we
+                # are waiting on, so poll coverage to the (shortened)
+                # budget instead of grace-breaking immediately.
+                if grace_until is None:
+                    grace_until = time.time() + 0.1
+                elif time.time() >= grace_until:
+                    break
+            time.sleep(0.005)
+        covered = self.block_pool.count_cached_prefix(hashes)
+        if covered >= len(hashes):
+            return "hit"
+        return "partial" if covered > start else "miss"
+
     # stackcheck: allow=SC201 reason=the TTL-keyed export dedupe gates only store-side export traffic; the local plan never reads it, and duplicate exports across replicas are idempotent content-keyed PUTs
     def _export_prefix_blocks(self, seq) -> None:
         """After a final prefill: push every full prompt block to the
@@ -2918,6 +3047,11 @@ class LLMEngine:
             "loaded_loras": len(self.loaded_adapters()),
             "remote_prefix_blocks_fetched": self.remote_prefix_blocks_fetched,
             "remote_prefix_blocks_exported": self.remote_prefix_blocks_exported,
+            # Disaggregated serving: prefill-phase primes served, and
+            # decode-phase handoff prefetch outcomes (docs/engine.md).
+            "disagg_prefill_primes": self.disagg_prefill_primes,
+            "disagg_handoff_hits": self.disagg_handoff_hits,
+            "disagg_handoff_misses": self.disagg_handoff_misses,
             # Async KV transfer plane (kv/prefetch.py): blocks imported /
             # dropped by admission-time prefetch, and fetches in flight.
             "kv_prefetch_hit": (

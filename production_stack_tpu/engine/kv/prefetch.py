@@ -142,6 +142,17 @@ class PrefetchManager:
         with self._lock:
             return ("chain", seq_id) in self._jobs
 
+    def chain_status(self, seq_id: str) -> str:
+        """"absent" (no job — completed empty, already consumed, or
+        never submitted), "inflight", "cancelled", or "done" (staged:
+        the step thread imports it at its next dispatch, BEFORE any
+        schedule() — the disagg handoff wait keys on this)."""
+        with self._lock:
+            job = self._jobs.get(("chain", seq_id))
+            if job is None:
+                return "absent"
+            return str(job["state"])
+
     def pop_completed(self) -> List[PrefetchedChain]:
         """Drain every finished chain fetch (step thread).  Ownership of
         the staging buffers transfers to the caller."""

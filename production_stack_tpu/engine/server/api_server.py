@@ -348,6 +348,11 @@ def build_engine_app(
              s["remote_prefix_blocks_fetched"]),
             (vocab.TPU_REMOTE_PREFIX_BLOCKS_EXPORTED,
              s["remote_prefix_blocks_exported"]),
+            # Disaggregated serving: prime completions served and
+            # decode-phase handoff prefetch outcomes (docs/engine.md).
+            (vocab.TPU_DISAGG_PREFILL_PRIMES, s["disagg_prefill_primes"]),
+            (vocab.TPU_DISAGG_HANDOFF_HITS, s["disagg_handoff_hits"]),
+            (vocab.TPU_DISAGG_HANDOFF_MISSES, s["disagg_handoff_misses"]),
             (vocab.TPU_KV_PREFETCH_HIT, s["kv_prefetch_hit"]),
             (vocab.TPU_KV_PREFETCH_WASTE, s["kv_prefetch_waste"]),
             (vocab.TPU_KV_PREFETCH_INFLIGHT, s["kv_prefetch_inflight"]),
@@ -699,6 +704,96 @@ def build_engine_app(
                         "deadline_unmeetable",
                     )
 
+        # -- disaggregated prefill phase (docs/engine.md) ------------------
+        # The router's disagg policy primes a prefill-pool engine with
+        # this marker: run the prefill (admission control and deadlines
+        # above already applied), EAGERLY flush the prefix-chain export
+        # so the shared store holds it before we answer — the decode
+        # side's prefetch must never race the export writer — and return
+        # a handoff token instead of generating.
+        if request.headers.get("x-disagg-phase") == "prefill":
+            prime_params = dataclasses.replace(
+                params, max_tokens=0, logprobs=False, top_logprobs=0,
+                echo=False,
+            )
+            gen = engine.generate(
+                prompt_token_ids=prompt_token_ids,
+                sampling_params=prime_params,
+                request_id=request_id,
+                adapter=adapter,
+            )
+            try:
+                async for _event in gen:
+                    pass
+            except DeadlineExceeded as e:
+                engine.engine.deadline_expired_admission += 1
+                return web.json_response(
+                    {"error": {"message": str(e), "type": "deadline_expired",
+                               "code": 504}},
+                    status=504,
+                )
+            # Eager (not off-step) export: the gather ran on the step
+            # thread at final prefill; this blocks (off the event loop)
+            # until the px-export writer has MPUT the chain.
+            await asyncio.to_thread(
+                engine.engine.flush_prefix_exports, 10.0
+            )
+            handoff = await asyncio.to_thread(
+                engine.engine.handoff_token,
+                prompt_token_ids,
+                engine.engine.cache_ns_of(adapter),
+            )
+            engine.engine.disagg_prefill_primes += 1
+            return web.json_response(
+                {
+                    "id": request_id,
+                    "object": "disagg.prefill",
+                    "created": created,
+                    "model": model_name,
+                    "disagg": {"handoff": handoff},
+                    "usage": {
+                        "prompt_tokens": len(prompt_token_ids),
+                        "completion_tokens": 0,
+                        "total_tokens": len(prompt_token_ids),
+                    },
+                },
+                headers={"X-Request-Id": request_id},
+            )
+
+        # -- disaggregated decode phase -------------------------------------
+        # A handoff-tagged generation waits (bounded, off the event loop
+        # and off the step thread) for the prefetched chain to land in
+        # the prefix cache, so its first schedule() serves the whole
+        # prompt from cache.  Any other outcome admits normally — the
+        # engine recomputes the prefill locally (in-place fused
+        # fallback), never fails the request.
+        disagg_prefix_outcome: Optional[str] = None
+        handoff_hdr = request.headers.get("x-disagg-handoff")
+        if handoff_hdr:
+            try:
+                handoff = json.loads(handoff_hdr)
+            except json.JSONDecodeError:
+                handoff = None
+            disagg_prefix_outcome = "disabled"
+            if isinstance(handoff, dict):
+                wait_s = engine.engine.config.cache.disagg_handoff_wait_s
+                if deadline is not None:
+                    # Leave headroom for the generation itself.
+                    wait_s = min(
+                        wait_s, max(0.0, deadline - time.time() - 0.05)
+                    )
+                disagg_prefix_outcome = await asyncio.to_thread(
+                    engine.engine.wait_handoff_prefix,
+                    prompt_token_ids,
+                    engine.engine.cache_ns_of(adapter),
+                    handoff,
+                    wait_s,
+                )
+            if disagg_prefix_outcome == "hit":
+                engine.engine.disagg_handoff_hits += 1
+            else:
+                engine.engine.disagg_handoff_misses += 1
+
         obs = engine.engine.obs
         if obs.enabled:
             # Start the trace only AFTER every validation 400 above: a
@@ -812,13 +907,14 @@ def build_engine_app(
             }
 
         if stream:
-            response = web.StreamResponse(
-                headers={
-                    "Content-Type": "text/event-stream",
-                    "Cache-Control": "no-cache",
-                    "X-Request-Id": request_id,
-                }
-            )
+            stream_headers = {
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+                "X-Request-Id": request_id,
+            }
+            if disagg_prefix_outcome is not None:
+                stream_headers["X-Disagg-Prefix"] = disagg_prefix_outcome
+            response = web.StreamResponse(headers=stream_headers)
             await response.prepare(request)
 
             # Merge the n per-choice event streams through one queue so
@@ -1117,6 +1213,9 @@ def build_engine_app(
             choices.append(choice)
         obj = "chat.completion" if chat else "text_completion"
         n_out = total_out
+        final_headers = {"X-Request-Id": request_id}
+        if disagg_prefix_outcome is not None:
+            final_headers["X-Disagg-Prefix"] = disagg_prefix_outcome
         return web.json_response(
             {
                 "id": request_id,
@@ -1130,7 +1229,7 @@ def build_engine_app(
                     "total_tokens": len(prompt_token_ids) + n_out,
                 },
             },
-            headers={"X-Request-Id": request_id},
+            headers=final_headers,
         )
 
     async def embeddings(request: web.Request) -> web.Response:
@@ -1751,6 +1850,13 @@ def main(argv=None) -> None:
         "--prefetch-threads", type=int, default=2,
         help="background fetcher threads for the KV prefetch plane",
     )
+    parser.add_argument(
+        "--disagg-handoff-wait-s", type=float, default=2.0,
+        help="decode-phase handoff: bounded wait for the prefetched "
+        "prefix chain to land in the cache before admitting anyway "
+        "(caps the TTFT tax of a slow store; a store miss exits early; "
+        "0 disables the wait)",
+    )
     parser.add_argument("--no-prefix-caching", action="store_true")
     parser.add_argument(
         "--kv-cache-dtype",
@@ -1873,6 +1979,7 @@ def main(argv=None) -> None:
                 if args.no_remote_prefetch else {}
             ),
             "cache.prefetch_threads": args.prefetch_threads,
+            "cache.disagg_handoff_wait_s": args.disagg_handoff_wait_s,
             "cache.enable_prefix_caching": not args.no_prefix_caching,
             **(
                 {"cache.kv_cache_dtype": args.kv_cache_dtype}

@@ -125,6 +125,25 @@ REGISTRY = {
         "mirrors": ("fake_engine", "dashboard", "docs"),
         "help": "KV blocks pushed to the shared store (disagg_role)",
     },
+    "tpu:disagg_prefill_primes_total": {
+        "kind": "counter", "layer": "engine",
+        "mirrors": ("fake_engine", "dashboard", "docs"),
+        "help": "Disagg prefill-phase prime completions served (prefill "
+                "ran, chain eagerly exported, handoff token returned)",
+    },
+    "tpu:disagg_handoff_hits_total": {
+        "kind": "counter", "layer": "engine",
+        "mirrors": ("fake_engine", "dashboard", "docs"),
+        "help": "Decode-phase handoffs whose prefetched chain covered the "
+                "whole prompt (decode executed no prompt tokens)",
+    },
+    "tpu:disagg_handoff_misses_total": {
+        "kind": "counter", "layer": "engine",
+        "mirrors": ("fake_engine", "dashboard", "docs"),
+        "help": "Decode-phase handoffs admitted without a full chain "
+                "import (prefill recomputed locally — in-place fused "
+                "fallback)",
+    },
     "tpu:spec_tokens_drafted": {
         "kind": "counter", "layer": "engine",
         "mirrors": ("fake_engine", "dashboard", "docs"),
@@ -365,6 +384,26 @@ REGISTRY = {
         "source_name": "tpu_router:pii_detections",
         "mirrors": ("dashboard", "docs"),
         "help": "PII entities detected in request bodies",
+    },
+    "tpu_router:disagg_fallback_total": {
+        "kind": "counter", "layer": "router", "labels": ("reason",),
+        "mirrors": ("dashboard", "docs"),
+        "help": "Two-phase disagg requests degraded to the fused path "
+                "(reason: prefill_pool_empty | prefill_breaker_open | "
+                "decode_pool_empty | prime_failed | handoff_unexported | "
+                "prefix_miss)",
+    },
+    "tpu_router:disagg_requests_total": {
+        "kind": "counter", "layer": "router", "labels": ("role",),
+        "mirrors": ("dashboard", "docs"),
+        "help": "Requests routed by the disagg policy, by phase role "
+                "(prefill | decode | fused)",
+    },
+    "tpu_router:disagg_handoff_seconds": {
+        "kind": "histogram", "layer": "router",
+        "mirrors": ("dashboard", "docs"),
+        "help": "Disagg prefill-phase latency: prime connect + engine "
+                "prefill + eager export + handoff response",
     },
     # -- router latency histograms (custom render, labeled by server) ------
     "tpu_router:ttft_seconds": {

@@ -33,6 +33,8 @@ from typing import Dict, List, Optional
 import aiohttp
 
 from production_stack_tpu.router.service_discovery import (
+    DEFAULT_ROLE_LABEL,
+    ENGINE_ROLES,
     EndpointInfo,
     ServiceDiscovery,
 )
@@ -60,10 +62,14 @@ class K8sServiceDiscovery(ServiceDiscovery):
         probe_timeout: float = 5.0,
         watch_timeout_s: int = 30,
         probe_ttl: float = 60.0,
+        role_label: str = DEFAULT_ROLE_LABEL,
     ):
         self.namespace = namespace
         self.port = port
         self.label_selector = label_selector
+        # Pod label carrying the disagg role ("prefill"/"decode"); the
+        # helm role pools stamp it (stackcheck SC707 pins the agreement).
+        self.role_label = role_label
         self.api_server = (api_server or in_cluster_api_server()).rstrip("/")
         self._token = token
         self._ca_path = ca_path
@@ -287,16 +293,34 @@ class K8sServiceDiscovery(ServiceDiscovery):
         self, name: str, pod_ip: str, models: List[str], labels: dict
     ) -> None:
         url = f"http://{pod_ip}:{self.port}"
+        raw_role = labels.get(self.role_label) or None
+        role = raw_role if raw_role in ENGINE_ROLES else None
         existing = self._endpoints.get(name)
-        if existing is not None and existing.url == url and existing.model_names == models:
+        if (
+            existing is not None
+            and existing.url == url
+            and existing.model_names == models
+            and existing.role == role
+        ):
             return  # steady-state MODIFIED churn
-        logger.info("Discovered engine %s at %s (models %s)", name, url, models)
+        if raw_role is not None and role is None:
+            # After the churn short-circuit: one mislabeled pod must not
+            # re-warn on every watch event.
+            logger.warning(
+                "Pod %s carries unknown %s=%r; treating as fused",
+                name, self.role_label, raw_role,
+            )
+        logger.info(
+            "Discovered engine %s at %s (models %s, role %s)",
+            name, url, models, role or "fused",
+        )
         self._endpoints[name] = EndpointInfo(
             url=url,
             model_names=models,
             added_timestamp=time.time(),
             model_label=labels.get("model"),
             pod_name=name,
+            role=role,
         )
 
     def _delete_engine(self, name: str) -> None:

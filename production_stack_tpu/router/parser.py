@@ -51,10 +51,24 @@ def parse_args(argv=None) -> argparse.Namespace:
         help="Probe <backend>/v1/models at startup for backends without a "
         "configured model list",
     )
+    parser.add_argument(
+        "--static-backend-roles",
+        default=None,
+        help="Comma-separated disagg roles, one entry per backend: "
+        "'prefill', 'decode', or empty (fused).  Required by "
+        "--routing-logic disagg under static discovery",
+    )
     parser.add_argument("--k8s-namespace", default="default")
     parser.add_argument("--k8s-port", type=int, default=8000)
     parser.add_argument(
         "--k8s-label-selector", default="", help="Label selector for engine pods"
+    )
+    parser.add_argument(
+        "--k8s-role-label",
+        default="app.production-stack-tpu/role",
+        help="Pod label carrying the disagg role ('prefill'/'decode'); "
+        "the helm role pools stamp it on engine pods (stackcheck SC707 "
+        "pins the chart<->flag agreement)",
     )
 
     # Routing (reference parser.py:98-116).
@@ -203,8 +217,35 @@ def validate_args(args: argparse.Namespace) -> None:
                         f"{flag} has {len(entries)} entries but "
                         f"--static-backends has {len(urls)}"
                     )
+        if args.static_backend_roles:
+            # split(","), not parse_static_models: empty entries are
+            # meaningful here (fused backends in a mixed fleet).
+            roles = [r.strip() for r in args.static_backend_roles.split(",")]
+            if len(roles) != len(urls):
+                raise ValueError(
+                    f"--static-backend-roles has {len(roles)} entries but "
+                    f"--static-backends has {len(urls)}"
+                )
+            for role in roles:
+                if role and role not in ("prefill", "decode"):
+                    raise ValueError(
+                        f"--static-backend-roles entries must be 'prefill', "
+                        f"'decode', or empty; got {role!r}"
+                    )
     if args.routing_logic == "session" and not args.session_key:
         raise ValueError("--routing-logic session requires --session-key")
+    if (
+        args.routing_logic == "disagg"
+        and args.service_discovery == "static"
+        and not args.static_backend_roles
+    ):
+        # Without roles the prefill pool is permanently empty and every
+        # request silently runs fused — fail at boot, not via metrics.
+        raise ValueError(
+            "--routing-logic disagg under static discovery requires "
+            "--static-backend-roles (at least one 'prefill' and one "
+            "'decode' backend)"
+        )
     if args.model_aliases:
         parse_static_aliases(args.model_aliases)
     if args.batch_processor not in ("local",):

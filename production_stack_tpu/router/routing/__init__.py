@@ -11,6 +11,10 @@ Algorithms:
 * ``kv_aware`` — prefix-affinity + load-aware scoring; maximizes TPU HBM
   KV-cache reuse (capability the reference only gets implicitly through
   session stickiness).
+* ``disagg`` — two-phase disaggregated prefill/decode over the shared KV
+  plane: prime a prefill-pool backend, hand the prefix chain off, decode
+  on a decode-pool backend (DistServe/Splitwise analogue; the reference
+  left this roadmap-only, README.md:57).
 """
 
 from __future__ import annotations
@@ -22,6 +26,7 @@ from production_stack_tpu.router.routing.round_robin import RoundRobinRouter
 from production_stack_tpu.router.routing.session import SessionRouter
 from production_stack_tpu.router.routing.least_loaded import LeastLoadedRouter
 from production_stack_tpu.router.routing.kv_aware import KVAwareRouter
+from production_stack_tpu.router.routing.disagg import DisaggRouter
 
 ROUTING_SERVICE = "routing_logic"
 
@@ -30,6 +35,7 @@ _ALGORITHMS = {
     "session": SessionRouter,
     "least_loaded": LeastLoadedRouter,
     "kv_aware": KVAwareRouter,
+    "disagg": DisaggRouter,
 }
 
 

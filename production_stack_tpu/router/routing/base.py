@@ -41,6 +41,18 @@ def require_endpoints(endpoints: List[EndpointInfo]) -> List[EndpointInfo]:
     return endpoints
 
 
+def exclude_prefill_role(endpoints: List[EndpointInfo]) -> List[EndpointInfo]:
+    """Decode-capable selection: dedicated prefill-pool backends only run
+    the disagg prime phase — a session/KV-affinity/least-loaded pick must
+    not park a generation stream on one (it would decode at prefill-pool
+    batch shapes AND re-introduce the interference disaggregation exists
+    to remove).  Degrades rather than 500s: when ONLY prefill-role
+    backends exist they stay eligible (a prefill-role engine can still
+    decode; disagg_role only steers KV export/import)."""
+    capable = [ep for ep in endpoints if getattr(ep, "role", None) != "prefill"]
+    return capable if capable else endpoints
+
+
 def filter_circuit_available(endpoints: List[EndpointInfo], breaker) -> List[EndpointInfo]:
     """Drop endpoints whose circuit breaker is open (docs/robustness.md):
     an opened backend receives NO traffic until a half-open probe

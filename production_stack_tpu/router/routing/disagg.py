@@ -1,0 +1,100 @@
+"""Disaggregated prefill/decode routing policy (DistServe/Splitwise
+analogue over the async KV plane — ROADMAP item 1).
+
+The ``disagg`` policy two-phases each completion request:
+
+1. **Prefill phase** — pick a prefill-pool backend by *queued prompt
+   tokens* (the scraped ``tpu:queued_prompt_tokens`` gauge: prefill work
+   is prompt-token-bound, so queue depth in requests under-weights long
+   prompts) and issue a prime call (``x-disagg-phase: prefill``).  The
+   engine runs the prefill, **eagerly** exports the prefix chain to the
+   shared KV store, and returns a handoff token instead of generating.
+2. **Decode phase** — route the real generation to a decode-pool backend
+   (least-loaded), forwarding the handoff token; the decode engine's
+   admission-time remote prefetch (PR 4) imports the chain so decode
+   never executes prompt tokens.
+
+The two-phase orchestration itself (the prime HTTP call, deadline
+re-check between phases, per-role breaker handling, fused fallback) lives
+in ``router/services/request_service/disagg.py`` — this class is the
+*selection* policy plus the ``two_phase`` capability marker the request
+path keys on.  When either pool is unavailable the policy degrades to a
+fused single-backend route (``route_request`` over decode-capable
+endpoints), never a 500.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from production_stack_tpu.router.routing.base import (
+    RoutingInterface,
+    exclude_prefill_role,
+    require_endpoints,
+)
+from production_stack_tpu.router.service_discovery import EndpointInfo
+
+
+class DisaggRouter(RoutingInterface):
+    """Selection policy for the two-phase disagg data path."""
+
+    # Capability marker the request path uses to enter the two-phase flow
+    # (duck-typed so tests can fake it without importing this module).
+    two_phase = True
+
+    def _load(self, url: str, engine_stats, request_stats) -> float:
+        if url in engine_stats:
+            es = engine_stats[url]
+            return float(es.num_running_requests + es.num_queuing_requests)
+        if url in request_stats:
+            rs = request_stats[url]
+            return float(rs.in_prefill_requests + rs.in_decoding_requests)
+        return 0.0
+
+    def select_prefill(
+        self,
+        prefill_pool: List[EndpointInfo],
+        engine_stats: Optional[Dict[str, Any]] = None,
+        request_stats: Optional[Dict[str, Any]] = None,
+    ) -> str:
+        """Prefill-pool pick: least queued **prompt tokens** (scraped
+        ``tpu:queued_prompt_tokens``), tie-broken by queue depth then URL.
+        Unscraped backends count as idle, like every other policy."""
+        engine_stats = engine_stats or {}
+        request_stats = request_stats or {}
+
+        def key(ep: EndpointInfo):
+            queued_tokens = 0.0
+            if ep.url in engine_stats:
+                queued_tokens = float(
+                    getattr(engine_stats[ep.url], "queued_prompt_tokens", 0.0)
+                )
+            return (
+                queued_tokens,
+                self._load(ep.url, engine_stats, request_stats),
+                ep.url,
+            )
+
+        return min(require_endpoints(prefill_pool), key=key).url
+
+    def route_request(
+        self,
+        endpoints: List[EndpointInfo],
+        engine_stats,
+        request_stats,
+        request,
+        request_json: Optional[Dict[str, Any]] = None,
+    ) -> str:
+        """Decode-phase (and fused-fallback) pick: least-loaded over
+        decode-capable endpoints.  Decode work is slot-bound, not
+        prompt-token-bound — with the prefix imported, admitting another
+        stream costs one batch slot regardless of prompt length."""
+        endpoints = require_endpoints(exclude_prefill_role(endpoints))
+        engine_stats = engine_stats or {}
+        request_stats = request_stats or {}
+        return min(
+            endpoints,
+            key=lambda ep: (
+                self._load(ep.url, engine_stats, request_stats), ep.url
+            ),
+        ).url

@@ -34,7 +34,11 @@ import threading
 from collections import OrderedDict
 from typing import Any, Dict, List, Optional
 
-from production_stack_tpu.router.routing.base import RoutingInterface, require_endpoints
+from production_stack_tpu.router.routing.base import (
+    RoutingInterface,
+    exclude_prefill_role,
+    require_endpoints,
+)
 from production_stack_tpu.router.service_discovery import EndpointInfo
 
 
@@ -117,7 +121,10 @@ class KVAwareRouter(RoutingInterface):
         request,
         request_json: Optional[Dict[str, Any]] = None,
     ) -> str:
-        endpoints = require_endpoints(endpoints)
+        # Prefix affinity is a DECODE-locality signal: learning a prefix
+        # owner in the prefill pool would steer every affine follow-up to
+        # a backend that never serves generations.
+        endpoints = require_endpoints(exclude_prefill_role(endpoints))
         engine_stats = engine_stats or {}
         request_stats = request_stats or {}
         hashes = self._prefix_hashes(extract_prompt_text(request_json))

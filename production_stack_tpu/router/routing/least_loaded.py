@@ -11,7 +11,11 @@ from __future__ import annotations
 
 from typing import Any, Dict, List, Optional
 
-from production_stack_tpu.router.routing.base import RoutingInterface, require_endpoints
+from production_stack_tpu.router.routing.base import (
+    RoutingInterface,
+    exclude_prefill_role,
+    require_endpoints,
+)
 from production_stack_tpu.router.service_discovery import EndpointInfo
 
 
@@ -24,7 +28,7 @@ class LeastLoadedRouter(RoutingInterface):
         request,
         request_json: Optional[Dict[str, Any]] = None,
     ) -> str:
-        endpoints = require_endpoints(endpoints)
+        endpoints = require_endpoints(exclude_prefill_role(endpoints))
         engine_stats = engine_stats or {}
         request_stats = request_stats or {}
 

@@ -10,7 +10,11 @@ from __future__ import annotations
 import threading
 from typing import Any, Dict, List, Optional
 
-from production_stack_tpu.router.routing.base import RoutingInterface, require_endpoints
+from production_stack_tpu.router.routing.base import (
+    RoutingInterface,
+    exclude_prefill_role,
+    require_endpoints,
+)
 from production_stack_tpu.router.service_discovery import EndpointInfo
 
 
@@ -27,7 +31,7 @@ class RoundRobinRouter(RoutingInterface):
         request,
         request_json: Optional[Dict[str, Any]] = None,
     ) -> str:
-        endpoints = require_endpoints(endpoints)
+        endpoints = require_endpoints(exclude_prefill_role(endpoints))
         # Sort by URL so the rotation order is stable across calls even if
         # discovery returns endpoints in a different order (reference sorts
         # the same way, routing_logic.py:73-74).

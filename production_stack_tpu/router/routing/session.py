@@ -13,6 +13,7 @@ from typing import Any, Dict, List, Optional
 
 from production_stack_tpu.router.routing.base import (
     RoutingInterface,
+    exclude_prefill_role,
     lowest_qps_url,
     require_endpoints,
 )
@@ -39,7 +40,9 @@ class SessionRouter(RoutingInterface):
         request,
         request_json: Optional[Dict[str, Any]] = None,
     ) -> str:
-        endpoints = require_endpoints(endpoints)
+        # Sessions are generation streams: a dedicated prefill-pool
+        # backend must never become a session's sticky home.
+        endpoints = require_endpoints(exclude_prefill_role(endpoints))
         session_id = request.headers.get(self.session_key)
         if not session_id:
             return lowest_qps_url(endpoints, request_stats or {})

@@ -30,6 +30,16 @@ logger = logging.getLogger(__name__)
 
 DISCOVERY_SERVICE = "service_discovery"
 
+# Disaggregated serving roles (docs/engine.md "Disaggregated data path").
+# A "prefill"-role engine runs the prime phase and exports prefix chains;
+# a "decode"-role engine admits with remote-prefetch imports.  Role-less
+# endpoints are fused (serve both phases, today's behavior).
+ENGINE_ROLES = ("prefill", "decode")
+# Pod label the helm chart stamps on role-pool engine pods and the
+# router's k8s discovery reads back (--k8s-role-label; stackcheck SC707
+# pins the chart<->flag agreement).
+DEFAULT_ROLE_LABEL = "app.production-stack-tpu/role"
+
 
 @dataclasses.dataclass
 class EndpointInfo:
@@ -43,6 +53,24 @@ class EndpointInfo:
     # "chat" | "completion" | "embeddings" | "rerank" | "score"
     model_types: Optional[List[str]] = None
     sleep: bool = False  # engine put to sleep by autoscaler; excluded from routing
+    # Disaggregated serving role: "prefill" | "decode" | None (fused).
+    role: Optional[str] = None
+
+
+def role_pool(endpoints: List["EndpointInfo"], role: str) -> List["EndpointInfo"]:
+    """Endpoints labeled with exactly ``role``."""
+    return [ep for ep in endpoints if ep.role == role]
+
+
+def decode_capable(endpoints: List["EndpointInfo"]) -> List["EndpointInfo"]:
+    """Endpoints eligible to serve the decode/generation phase: everything
+    except dedicated prefill-pool backends (role-less fused endpoints
+    count — they decode today and keep decoding under disagg)."""
+    return [ep for ep in endpoints if ep.role != "prefill"]
+
+
+def roles_configured(endpoints: List["EndpointInfo"]) -> bool:
+    return any(ep.role for ep in endpoints)
 
 
 class ServiceDiscovery:
@@ -79,6 +107,7 @@ class StaticServiceDiscovery(ServiceDiscovery):
         models: Optional[List[List[str]]] = None,
         model_labels: Optional[List[str]] = None,
         model_types: Optional[List[List[str]]] = None,
+        roles: Optional[List[Optional[str]]] = None,
         probe_models: bool = False,
         probe_timeout: float = 5.0,
     ):
@@ -87,6 +116,13 @@ class StaticServiceDiscovery(ServiceDiscovery):
             raise ValueError(
                 f"static URLs ({len(urls)}) and model lists ({len(models)}) differ in length"
             )
+        if roles is not None:
+            for role in roles:
+                if role and role not in ENGINE_ROLES:
+                    raise ValueError(
+                        f"invalid backend role {role!r}; expected one of "
+                        f"{ENGINE_ROLES} or empty (fused)"
+                    )
         now = time.time()
         self._endpoints = [
             EndpointInfo(
@@ -95,6 +131,7 @@ class StaticServiceDiscovery(ServiceDiscovery):
                 added_timestamp=now,
                 model_label=(model_labels[i] if model_labels else None),
                 model_types=(model_types[i] if model_types else None),
+                role=(roles[i] or None) if roles else None,
             )
             for i, (url, model_list) in enumerate(zip(urls, models))
         ]
@@ -150,11 +187,20 @@ def build_service_discovery(args) -> ServiceDiscovery:
             if args.static_model_types
             else None
         )
+        # Per-backend disagg roles ("prefill,decode," — empty = fused);
+        # getattr: dynamic-config reloads may carry pre-roles namespaces.
+        roles_raw = getattr(args, "static_backend_roles", None)
+        roles = (
+            [entry.strip() or None for entry in roles_raw.split(",")]
+            if roles_raw
+            else None
+        )
         return StaticServiceDiscovery(
             urls,
             models,
             model_labels=labels,
             model_types=types,
+            roles=roles,
             probe_models=args.static_probe_models,
         )
     if args.service_discovery == "k8s":
@@ -164,6 +210,7 @@ def build_service_discovery(args) -> ServiceDiscovery:
             namespace=args.k8s_namespace,
             port=args.k8s_port,
             label_selector=args.k8s_label_selector,
+            role_label=getattr(args, "k8s_role_label", DEFAULT_ROLE_LABEL),
         )
     raise ValueError(f"Invalid service discovery type: {args.service_discovery}")
 

@@ -6,7 +6,7 @@ Extended with the gauges the reference's dashboard charts but never exports
 rate) so one scrape of the router suffices for the whole stack.
 """
 
-from prometheus_client import Counter, Gauge
+from prometheus_client import Counter, Gauge, Histogram
 
 current_qps = Gauge("tpu_router:current_qps", "Sliding-window QPS", ["server"])
 avg_ttft = Gauge("tpu_router:avg_ttft", "Average time-to-first-token (s)", ["server"])
@@ -58,3 +58,43 @@ deadline_expired_total = Counter(
     "Requests shed by the router because their deadline expired before "
     "(or during) backend connect",
 )
+
+# -- disaggregated prefill/decode serving (routing policy `disagg`) --------
+# Handoff latency: the whole prefill phase as the router sees it — prime
+# connect + engine prefill + eager chain export + handoff-token response.
+# Decode-phase admission happens inside this budget's shadow, so p95 here
+# IS the TTFT tax disaggregation pays for interference-free decode.
+disagg_handoff_seconds = Histogram(
+    "tpu_router:disagg_handoff_seconds",
+    "Disagg prefill-phase (prime + eager export + handoff) latency",
+    buckets=(0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0),
+)
+# Why a two-phase request degraded to the fused single-backend path.
+# Closed reason set, pre-seeded below so dashboards and rate() see stable
+# label sets from boot (the same contract as the engine's labeled
+# fallback counter).
+DISAGG_FALLBACK_REASONS = (
+    "prefill_pool_empty",   # no prefill-role backends discovered/healthy
+    "prefill_breaker_open", # prefill pool exists but every breaker is open
+    "decode_pool_empty",    # no decode-capable backend for phase 2
+    "prime_failed",         # prime call errored/timed out/was shed
+    "handoff_unexported",   # prime ran but the engine had no store to export to
+    "prefix_miss",          # decode-side prefetch missed; decode recomputed
+)
+disagg_fallback_total = Counter(
+    "tpu_router:disagg_fallback_total",
+    "Two-phase disagg requests degraded to the fused path, by reason",
+    ["reason"],
+)
+for _reason in DISAGG_FALLBACK_REASONS:
+    disagg_fallback_total.labels(reason=_reason)
+# Per-role routed-request accounting: every completion the disagg policy
+# handled lands here once per phase it actually routed ("prefill" for the
+# prime, "decode" for the generation, "fused" when it degraded).
+disagg_requests_total = Counter(
+    "tpu_router:disagg_requests_total",
+    "Requests routed by the disagg policy, by phase role",
+    ["role"],
+)
+for _role in ("prefill", "decode", "fused"):
+    disagg_requests_total.labels(role=_role)

@@ -1,0 +1,247 @@
+"""Two-phase disaggregated prefill/decode orchestration (the fleet half
+of disagg serving; ROADMAP item 1, DistServe/Splitwise analogue).
+
+``route_general_request`` calls :func:`run_prefill_phase` when the active
+routing policy advertises ``two_phase`` (the ``disagg`` policy).  This
+module owns phase 1 and the decision of what phase 2 looks like:
+
+* pick a prefill-pool backend (least queued prompt tokens) and issue the
+  prime call (``x-disagg-phase: prefill``) — the engine prefills, eagerly
+  exports the prefix chain to the shared KV store, and answers with a
+  handoff token instead of generating;
+* re-check the request deadline between phases (a prime that ate the
+  whole budget sheds a 504 here instead of occupying a decode slot);
+* return the decode-phase candidate pool plus the compact handoff header
+  the decode engine's admission-time prefetch keys on.
+
+Every failure mode degrades to the **fused** single-backend path — the
+pre-disagg behavior — and is counted under
+``tpu_router:disagg_fallback_total{reason}``; a two-phase request never
+500s because a role pool is missing, a breaker is open, or the store
+dropped the chain (docs/robustness.md "Disagg handoff failure
+semantics").
+
+The handoff header is deliberately COMPACT: the full hash chain rides the
+prime *response* (debuggability), but a 20k-token prompt is ~1,250 chain
+keys — far past header budgets — and the decode engine recomputes the
+identical chain from the same prompt anyway (content-keyed store).  The
+header carries only the chain length, tail digest, prompt length, and the
+model-identity key prefix for verification.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import json
+import logging
+import time
+from typing import Any, Dict, List, Optional
+
+import aiohttp
+from aiohttp import web
+
+from production_stack_tpu.router.service_discovery import (
+    decode_capable,
+    role_pool,
+)
+
+logger = logging.getLogger(__name__)
+
+# Hard cap on the prime call: the per-request deadline (when present) is
+# the real budget; this only bounds deadline-less requests against a
+# wedged prefill backend (first XLA compile of a bucket legitimately
+# takes minutes, so this errs long — the breaker covers dead backends).
+PRIME_TIMEOUT_S = 300.0
+
+# Handoff-token fields forwarded to the decode phase (see module
+# docstring for why the full chain stays out of the header).
+_HANDOFF_HEADER_FIELDS = ("chain_len", "chain_tail", "prompt_tokens", "px",
+                          "exported", "block_size")
+
+
+@dataclasses.dataclass
+class DisaggOutcome:
+    """What phase 2 should do.
+
+    ``shed`` non-None: return it immediately (deadline expired between
+    phases).  ``server_url`` non-None: phase 2 goes there (sticky fused
+    fallback); otherwise the caller routes over ``endpoints``.
+    """
+
+    phase: str                      # "decode" (two-phase) | "fused"
+    endpoints: List[Any]
+    extra_headers: Dict[str, str] = dataclasses.field(default_factory=dict)
+    server_url: Optional[str] = None
+    shed: Optional[web.Response] = None
+    fallback_reason: Optional[str] = None
+
+
+def _fused(endpoints, reason: str, server_url: Optional[str] = None) -> DisaggOutcome:
+    from production_stack_tpu.router.services import metrics_service as ms
+
+    ms.disagg_fallback_total.labels(reason=reason).inc()
+    ms.disagg_requests_total.labels(role="fused").inc()
+    pool = decode_capable(endpoints) or endpoints
+    return DisaggOutcome(
+        phase="fused", endpoints=pool, server_url=server_url,
+        fallback_reason=reason,
+    )
+
+
+async def prefill_phase(
+    request: web.Request,
+    registry,
+    *,
+    endpoints: List[Any],
+    all_endpoints: List[Any],
+    engine_stats: Dict[str, Any],
+    request_stats: Dict[str, Any],
+    body_bytes: bytes,
+    forward_headers: Dict[str, str],
+    request_id: str,
+    deadline: Optional[float],
+    endpoint_path: str,
+    tracer=None,
+) -> DisaggOutcome:
+    """Phase 1 of the two-phase disagg data path.
+
+    ``endpoints`` — model-filtered AND breaker-filtered; ``all_endpoints``
+    — model-filtered only (distinguishes "no prefill pool configured"
+    from "prefill pool exists but every breaker is open").
+    """
+    from production_stack_tpu.router.routing import ROUTING_SERVICE
+    from production_stack_tpu.router.services import metrics_service as ms
+    from production_stack_tpu.router.services.request_service.request import (
+        CIRCUIT_BREAKER,
+        CLIENT_SESSION,
+    )
+
+    prefill_pool = role_pool(endpoints, "prefill")
+    decode_pool = decode_capable(endpoints)
+    if not prefill_pool:
+        reason = (
+            "prefill_breaker_open"
+            if role_pool(all_endpoints, "prefill")
+            else "prefill_pool_empty"
+        )
+        return _fused(endpoints, reason)
+    if not decode_pool:
+        return _fused(endpoints, "decode_pool_empty")
+
+    router = registry.require(ROUTING_SERVICE)
+    prefill_url = router.select_prefill(
+        prefill_pool, engine_stats, request_stats
+    )
+    breaker = registry.get(CIRCUIT_BREAKER)
+    if breaker is not None and not breaker.on_attempt(prefill_url):
+        # Half-open probe already in flight on the only viable pick.
+        return _fused(endpoints, "prefill_breaker_open")
+
+    session: aiohttp.ClientSession = registry.require(CLIENT_SESSION)
+    prime_headers = dict(forward_headers)
+    prime_headers["x-disagg-phase"] = "prefill"
+    # The prime is an internal sub-request: derive its id so engine-side
+    # traces join, but never collide with the decode phase's id.
+    prime_headers["x-request-id"] = f"{request_id}-prefill"
+    now = time.time()
+    budget = PRIME_TIMEOUT_S
+    if deadline is not None:
+        # Floor of 250 ms: a deadline about to expire still gets a real
+        # prime attempt — the between-phases re-check below (not an
+        # artificially starved connect) decides whether to shed.
+        budget = min(budget, max(0.25, deadline - now))
+    t0 = time.time()
+    handoff: Optional[Dict[str, Any]] = None
+    try:
+        async with session.post(
+            f"{prefill_url}{endpoint_path}",
+            data=body_bytes if body_bytes else None,
+            headers=prime_headers,
+            timeout=aiohttp.ClientTimeout(total=budget),
+        ) as resp:
+            if resp.status == 429:
+                try:
+                    retry_after = float(resp.headers.get("Retry-After", ""))
+                except (TypeError, ValueError):
+                    retry_after = None
+                if breaker is not None:
+                    breaker.on_backpressure(prefill_url, retry_after)
+            elif resp.status >= 500:
+                if breaker is not None:
+                    breaker.on_failure(prefill_url)
+            elif breaker is not None:
+                breaker.on_success(prefill_url)
+            if resp.status == 200:
+                try:
+                    body = await resp.json()
+                    handoff = (body.get("disagg") or {}).get("handoff")
+                except (ValueError, AttributeError, TypeError):
+                    # 200 with a malformed/non-object body (a non-engine
+                    # backend in the pool): degrade like any other prime
+                    # failure — this path must never 500.
+                    handoff = None
+    except asyncio.CancelledError:
+        raise
+    except (aiohttp.ClientError, ConnectionResetError, asyncio.TimeoutError) as e:
+        # Read-side idle timeouts are exempt from breaker counting on the
+        # proxy path; the prime's bounded total timeout conflates the two,
+        # so only count clear connect-stage/5xx failures — a None
+        # t_connected-style split is not available through
+        # ClientTimeout(total=...).  Conservative: connection errors
+        # count, pure timeouts do not.
+        if breaker is not None and not isinstance(e, asyncio.TimeoutError):
+            breaker.on_failure(prefill_url)
+        logger.warning("disagg prime against %s failed: %s", prefill_url, e)
+    dt = time.time() - t0
+    if tracer is not None:
+        tracer.add_span(
+            request_id, "router.disagg_prefill", t0, t0 + dt,
+            server=prefill_url,
+        )
+
+    if handoff is None or not isinstance(handoff, dict):
+        return _fused(endpoints, "prime_failed")
+
+    ms.disagg_requests_total.labels(role="prefill").inc()
+    ms.disagg_handoff_seconds.observe(dt)
+
+    # Deadline re-check BETWEEN phases: the prime consumed real budget;
+    # handing a dead-on-arrival generation to a decode backend would burn
+    # a batch slot on an answer nobody is waiting for.
+    if deadline is not None and time.time() >= deadline:
+        ms.deadline_expired_total.inc()
+        if tracer is not None:
+            tracer.finish(
+                request_id, error="deadline_expired", server=prefill_url
+            )
+        return DisaggOutcome(
+            phase="shed", endpoints=endpoints,
+            shed=web.json_response(
+                {"error": {
+                    "message": "request deadline expired between the "
+                               "disagg prefill and decode phases",
+                    "type": "deadline_expired", "code": 504,
+                }},
+                status=504,
+            ),
+        )
+
+    if not handoff.get("exported"):
+        # The prime ran but the chain never reached the shared store (no
+        # remote KV configured, or export writer backlogged).  The
+        # prefill backend holds the KV in its LOCAL prefix cache, so the
+        # best degraded route is sticky: decode right there.
+        return _fused(
+            endpoints, "handoff_unexported", server_url=prefill_url
+        )
+
+    compact = {
+        k: handoff[k] for k in _HANDOFF_HEADER_FIELDS if k in handoff
+    }
+    ms.disagg_requests_total.labels(role="decode").inc()
+    return DisaggOutcome(
+        phase="decode",
+        endpoints=decode_pool,
+        extra_headers={"x-disagg-handoff": json.dumps(compact)},
+    )

@@ -86,6 +86,11 @@ _HOP_BY_HOP = {
     # explicitly (the inbound value may be the one we minted from a
     # `timeout` body field).
     "x-request-deadline",
+    # Disagg control plane: the router mints these itself (the prime
+    # marker and the handoff token) — an external client must not be able
+    # to smuggle either through the proxy.
+    "x-disagg-phase",
+    "x-disagg-handoff",
 }
 
 
@@ -239,15 +244,59 @@ async def route_general_request(
     request_stats = monitor.get_request_stats(time.time()) if monitor else {}
 
     router = registry.require(ROUTING_SERVICE)
-    try:
-        server_url = router.route_request(
-            endpoints, engine_stats, request_stats, request, body_json
+
+    # Two-phase disaggregated prefill/decode (routing policy `disagg`):
+    # prime a prefill-pool backend (which eagerly exports the prefix
+    # chain), then route the generation to a decode-pool backend whose
+    # admission-time prefetch imports it.  Every failure mode degrades to
+    # the fused single-backend path below — never a 500
+    # (docs/robustness.md "Disagg handoff failure semantics").
+    server_url: Optional[str] = None
+    extra_headers: Optional[Dict[str, str]] = None
+    if (
+        getattr(router, "two_phase", False)
+        and body_json is not None
+        and endpoint_path in ("/v1/chat/completions", "/v1/completions")
+    ):
+        from production_stack_tpu.router.services.request_service.disagg import (
+            prefill_phase,
         )
-    except ValueError as e:
-        return _reject(
-            _error_response(503, str(e), "service_unavailable"),
-            "routing_failed",
+
+        prime_fwd = _forward_headers(request.headers)
+        if deadline is not None:
+            prime_fwd["x-request-deadline"] = repr(float(deadline))
+        if trace is not None:
+            prime_fwd["traceparent"] = make_traceparent(trace.trace_id)
+        outcome = await prefill_phase(
+            request, registry,
+            endpoints=endpoints,
+            all_endpoints=[ep for ep in discovery.get_endpoint_info()
+                           if not ep.sleep],
+            engine_stats=engine_stats,
+            request_stats=request_stats,
+            body_bytes=body_bytes,
+            forward_headers=prime_fwd,
+            request_id=request_id,
+            deadline=deadline,
+            endpoint_path=endpoint_path,
+            tracer=tracer,
         )
+        if outcome.shed is not None:
+            return outcome.shed
+        endpoints = outcome.endpoints
+        extra_headers = outcome.extra_headers or None
+        server_url = outcome.server_url
+
+    if server_url is None:
+        try:
+            server_url = router.route_request(
+                endpoints, engine_stats, request_stats, request, body_json
+            )
+        except ValueError as e:
+            return _reject(
+                _error_response(503, str(e), "service_unavailable"),
+                "routing_failed",
+            )
 
     if tracer is not None and trace is not None:
         tracer.add_span(
@@ -282,6 +331,7 @@ async def route_general_request(
         background=background,
         fallback_urls=fallback_urls,
         deadline=deadline,
+        extra_headers=extra_headers,
     )
 
 
@@ -297,6 +347,7 @@ async def process_request(
     background: Optional[Any] = None,
     fallback_urls: Optional[list] = None,
     deadline: Optional[float] = None,
+    extra_headers: Optional[Dict[str, str]] = None,
 ) -> web.StreamResponse:
     """Open one backend stream and relay chunks, feeding the stats lifecycle
     (reference process_request, request.py:44-117).
@@ -317,6 +368,10 @@ async def process_request(
 
     headers = _forward_headers(request.headers)
     headers["x-request-id"] = request_id
+    if extra_headers:
+        # Router-minted control headers (the disagg handoff token) —
+        # added after the hop-by-hop strip so clients cannot spoof them.
+        headers.update(extra_headers)
     if deadline is not None:
         # Normalized absolute form, whatever the client sent (header or
         # `timeout` body field) — the engine enforces it at admission and
@@ -418,6 +473,21 @@ async def process_request(
                         breaker.on_success(url)
                 if monitor:
                     monitor.on_backend_connected(url, request_id, t_connected)
+                if extra_headers and "x-disagg-handoff" in extra_headers:
+                    # Decode-phase prefetch outcome: anything but a full
+                    # chain import means the decode engine recomputed the
+                    # prefill locally — the in-place fused fallback the
+                    # two-phase contract degrades to (never a third
+                    # backend, never a failure).
+                    px_outcome = backend.headers.get("x-disagg-prefix")
+                    if px_outcome is not None and px_outcome != "hit":
+                        from production_stack_tpu.router.services import (
+                            metrics_service as ms,
+                        )
+
+                        ms.disagg_fallback_total.labels(
+                            reason="prefix_miss"
+                        ).inc()
                 resp_headers = _forward_headers(backend.headers)
                 # Echo the request id on the proxied response too (the
                 # engine may predate the header; the client must always

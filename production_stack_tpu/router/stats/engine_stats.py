@@ -38,6 +38,10 @@ class EngineStats:
     kv_offload_usage_perc: float = 0.0
     accelerator_utilization: float = 0.0
     decode_host_gap_ms: float = 0.0
+    # Prompt tokens held by waiting+preempted sequences — the disagg
+    # policy's prefill-pool load signal (prefill is prompt-token-bound,
+    # so queue depth in requests under-weights long prompts).
+    queued_prompt_tokens: float = 0.0
     scraped_at: float = 0.0
 
     # Sample-name suffixes that belong to histogram/summary internals.

@@ -49,6 +49,11 @@ ENGINE_METRIC_CANDIDATES: Dict[str, List[str]] = {
     "decode_host_gap_ms": [
         "tpu:decode_host_gap_ms",
     ],
+    # Prompt tokens queued in waiting+preempted sequences (the disagg
+    # policy's prefill-pool selection signal).
+    "queued_prompt_tokens": [
+        "tpu:queued_prompt_tokens",
+    ],
 }
 
 # Names our own engine exports (used by the engine server and the fake
@@ -125,6 +130,16 @@ TPU_MULTISTEP_FALLBACK = "tpu:multistep_fallback_total"
 # dashboards, and rate() see stable label sets from boot.
 TPU_MULTISTEP_FALLBACK_REASONS = ("guided", "logit_bias", "logprobs")
 TPU_MULTISTEP_WASTED_TOKENS = "tpu:multistep_wasted_tokens_total"
+# Disaggregated prefill/decode serving (docs/engine.md "Disaggregated
+# data path"): prefill-phase prime completions served (the handoff
+# producer side), and decode-phase handoff prefetch outcomes — a hit
+# means the imported chain covered the whole prompt (decode executed no
+# prompt tokens), a miss means the decode engine recomputed the prefill
+# locally (the in-place fused fallback; reads beside
+# tpu_router:disagg_fallback_total{reason="prefix_miss"}).
+TPU_DISAGG_PREFILL_PRIMES = "tpu:disagg_prefill_primes_total"
+TPU_DISAGG_HANDOFF_HITS = "tpu:disagg_handoff_hits_total"
+TPU_DISAGG_HANDOFF_MISSES = "tpu:disagg_handoff_misses_total"
 TPU_COUNTERS = frozenset({
     TPU_TOTAL_PROMPT_TOKENS,
     TPU_TOTAL_GENERATED_TOKENS,
@@ -140,6 +155,9 @@ TPU_COUNTERS = frozenset({
     TPU_ADMISSION_REJECTED,
     TPU_DEADLINE_EXPIRED,
     TPU_MULTISTEP_WASTED_TOKENS,
+    TPU_DISAGG_PREFILL_PRIMES,
+    TPU_DISAGG_HANDOFF_HITS,
+    TPU_DISAGG_HANDOFF_MISSES,
 })
 
 

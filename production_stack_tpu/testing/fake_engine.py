@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import hashlib
 import json
 import random
 import time
@@ -39,6 +40,9 @@ class FakeEngineState:
         capacity: int | None = None,
         max_queued: int = 0,
         admission_control: bool = True,
+        disagg_role: str | None = None,
+        shared_store: set | None = None,
+        prefetch_outcome: str | None = None,
     ):
         self.model = model
         self.tokens_per_sec = tokens_per_sec
@@ -86,6 +90,26 @@ class FakeEngineState:
         # every connection the router actually made (the breaker tests'
         # "an open backend receives no traffic" assertion).
         self.data_plane_hits = 0
+        # -- disaggregated prefill/decode emulation (--disagg-role) --------
+        # Same contract as the real engine (docs/engine.md): a prefill
+        # prime (x-disagg-phase: prefill) returns a handoff token and
+        # records the chain export; a handoff-tagged generation
+        # (x-disagg-handoff) simulates the prefetch — a hit skips the
+        # TTFT sleep (the prompt was imported, decode runs no prompt
+        # tokens) and stamps X-Disagg-Prefix.  ``shared_store`` is the
+        # simulated shared KV store: pass ONE set to every fake in a
+        # fleet so prefill-pool exports are visible to decode-pool fakes.
+        if disagg_role not in (None, "prefill", "decode", "both"):
+            raise ValueError(f"unknown disagg_role {disagg_role!r}")
+        self.disagg_role = disagg_role
+        self.shared_store = shared_store if shared_store is not None else set()
+        # Force the decode-phase outcome ("hit"/"miss") regardless of the
+        # store — the prefetch-miss fallback tests key on this.
+        self.prefetch_outcome = prefetch_outcome
+        self.exports: list = []  # recorded prime exports (chains)
+        self.disagg_prefill_primes = 0
+        self.disagg_handoff_hits = 0
+        self.disagg_handoff_misses = 0
 
     def inject(self, kind: str, **params) -> None:
         """Arm a fault: ``refuse`` (close the connection pre-response;
@@ -148,6 +172,19 @@ class FakeEngineState:
 
 def _sse(data: dict) -> bytes:
     return f"data: {json.dumps(data)}\n\n".encode()
+
+
+def fake_prefix_chain(prompt_text: str, chunk_chars: int = 64) -> list:
+    """Deterministic stand-in for the engine's prefix hash chain: one
+    chained blake2b digest per ``chunk_chars`` of prompt text.  Prefill
+    and decode fakes derive the SAME chain from the same prompt — the
+    content-keyed-store property the real handoff relies on."""
+    chain = []
+    h = hashlib.blake2b(digest_size=8)
+    for start in range(0, max(len(prompt_text), 1), chunk_chars):
+        h.update(prompt_text[start : start + chunk_chars].encode("utf-8"))
+        chain.append(h.hexdigest())
+    return chain
 
 
 def _word(rng: random.Random) -> str:
@@ -229,6 +266,13 @@ def build_fake_engine_app(state: FakeEngineState | None = None) -> web.Applicati
             # (no store and no drafter here; contract parity only).
             (vocab.TPU_REMOTE_PREFIX_BLOCKS_FETCHED, 0),
             (vocab.TPU_REMOTE_PREFIX_BLOCKS_EXPORTED, 0),
+            # Disaggregated serving emulation (--disagg-role): primes
+            # served and simulated handoff prefetch outcomes — live
+            # values, so router CI can assert the whole two-phase flow
+            # through /metrics alone.
+            (vocab.TPU_DISAGG_PREFILL_PRIMES, state.disagg_prefill_primes),
+            (vocab.TPU_DISAGG_HANDOFF_HITS, state.disagg_handoff_hits),
+            (vocab.TPU_DISAGG_HANDOFF_MISSES, state.disagg_handoff_misses),
             (vocab.TPU_SPEC_TOKENS_DRAFTED, 0),
             (vocab.TPU_SPEC_TOKENS_ACCEPTED, 0),
             # The fake engine serves every prompt instantly, so no mixed
@@ -394,6 +438,79 @@ def build_fake_engine_app(state: FakeEngineState | None = None) -> web.Applicati
             request.headers.get("x-request-id")
             or f"cmpl-{uuid.uuid4().hex[:16]}"
         )
+
+        # -- disagg prefill prime (x-disagg-phase) -------------------------
+        # Same contract as the real engine server: run the (simulated)
+        # prefill, record the eager export, return the handoff token
+        # with zero completion tokens.
+        if request.headers.get("x-disagg-phase") == "prefill":
+            state.total_requests += 1
+            state.num_running += 1
+            try:
+                await asyncio.sleep(state.ttft)  # the prefill cost
+                chain = fake_prefix_chain(prompt_text)
+                exported = state.disagg_role in ("prefill", "both")
+                if exported:
+                    state.shared_store.update(chain)
+                    state.exports.append(chain)
+                state.disagg_prefill_primes += 1
+                prompt_tokens = max(1, len(prompt_text) // 4)
+                state.total_prompt_tokens += prompt_tokens
+                return web.json_response(
+                    {
+                        "id": request_id,
+                        "object": "disagg.prefill",
+                        "created": int(time.time()),
+                        "model": body.get("model", state.model),
+                        "disagg": {"handoff": {
+                            "chain": chain,
+                            "chain_len": len(chain),
+                            "chain_tail": chain[-1],
+                            "prompt_tokens": prompt_tokens,
+                            "block_size": 16,
+                            "px": "px:fake:",
+                            "exported": exported,
+                        }},
+                        "usage": {
+                            "prompt_tokens": prompt_tokens,
+                            "completion_tokens": 0,
+                            "total_tokens": prompt_tokens,
+                        },
+                    },
+                    headers={"X-Request-Id": request_id},
+                )
+            finally:
+                state.num_running -= 1
+
+        # -- disagg decode-phase handoff (x-disagg-handoff) ----------------
+        # A hit means the prefix chain "imported": decode starts with no
+        # prefill work, so the TTFT sleep is skipped.  Any other outcome
+        # keeps the full TTFT (the in-place recompute fallback).
+        disagg_outcome = None
+        ttft_s = state.ttft
+        handoff_hdr = request.headers.get("x-disagg-handoff")
+        if handoff_hdr:
+            try:
+                handoff = json.loads(handoff_hdr)
+            except json.JSONDecodeError:
+                handoff = None
+            if state.prefetch_outcome is not None:
+                disagg_outcome = state.prefetch_outcome
+            elif state.disagg_role not in ("decode", "both"):
+                disagg_outcome = "disabled"
+            elif (
+                isinstance(handoff, dict)
+                and handoff.get("exported")
+                and handoff.get("chain_tail") in state.shared_store
+            ):
+                disagg_outcome = "hit"
+            else:
+                disagg_outcome = "miss"
+            if disagg_outcome == "hit":
+                state.disagg_handoff_hits += 1
+                ttft_s = 0.0
+            else:
+                state.disagg_handoff_misses += 1
         t_recv = time.time()
         state.obs.start_request(
             request_id,
@@ -408,18 +525,19 @@ def build_fake_engine_app(state: FakeEngineState | None = None) -> web.Applicati
         try:
             object_name = "chat.completion.chunk" if chat else "text_completion"
             if stream:
-                response = web.StreamResponse(
-                    headers={
-                        "Content-Type": "text/event-stream",
-                        "Cache-Control": "no-cache",
-                        "X-Request-Id": request_id,
-                    }
-                )
+                stream_headers = {
+                    "Content-Type": "text/event-stream",
+                    "Cache-Control": "no-cache",
+                    "X-Request-Id": request_id,
+                }
+                if disagg_outcome is not None:
+                    stream_headers["X-Disagg-Prefix"] = disagg_outcome
+                response = web.StreamResponse(headers=stream_headers)
                 # Prepare BEFORE the TTFT sleep, like the real engine
                 # server: the router's backend_connect span must end at
                 # connect, not absorb prefill time.
                 await response.prepare(request)
-                await asyncio.sleep(state.ttft)
+                await asyncio.sleep(ttft_s)
                 t_first = time.time()
                 t_last = t_first
                 for i in range(max_tokens):
@@ -480,7 +598,7 @@ def build_fake_engine_app(state: FakeEngineState | None = None) -> web.Applicati
                 await response.write(b"data: [DONE]\n\n")
                 await response.write_eof()
                 return response
-            await asyncio.sleep(state.ttft)
+            await asyncio.sleep(ttft_s)
             t_first = time.time()
             interval = state.token_interval()
             await asyncio.sleep(max_tokens * interval)
@@ -503,6 +621,9 @@ def build_fake_engine_app(state: FakeEngineState | None = None) -> web.Applicati
             else:
                 choice = {"index": 0, "text": text, "finish_reason": "length"}
                 object_name = "text_completion"
+            resp_headers = {"X-Request-Id": request_id}
+            if disagg_outcome is not None:
+                resp_headers["X-Disagg-Prefix"] = disagg_outcome
             return web.json_response(
                 {
                     "id": request_id,
@@ -516,7 +637,7 @@ def build_fake_engine_app(state: FakeEngineState | None = None) -> web.Applicati
                         "total_tokens": len(prompt_text) // 4 + max_tokens,
                     },
                 },
-                headers={"X-Request-Id": request_id},
+                headers=resp_headers,
             )
         except (asyncio.CancelledError, ConnectionResetError):
             # The peer tore the stream down (client disconnect, router
@@ -549,9 +670,18 @@ def main(argv=None) -> None:
     parser.add_argument("--model", default="fake/llama-3-8b")
     parser.add_argument("--tokens-per-sec", type=float, default=500.0)
     parser.add_argument("--ttft", type=float, default=0.02)
+    parser.add_argument(
+        "--disagg-role",
+        default=None,
+        choices=["prefill", "decode", "both"],
+        help="emulate a disagg role pool member: prefill serves prime "
+        "calls and records exports; decode honors handoff tokens with a "
+        "simulated prefetch hit (TTFT skipped) or miss",
+    )
     args = parser.parse_args(argv)
     state = FakeEngineState(
-        model=args.model, tokens_per_sec=args.tokens_per_sec, ttft=args.ttft
+        model=args.model, tokens_per_sec=args.tokens_per_sec, ttft=args.ttft,
+        disagg_role=args.disagg_role,
     )
     web.run_app(
         build_fake_engine_app(state), host=args.host, port=args.port, access_log=None

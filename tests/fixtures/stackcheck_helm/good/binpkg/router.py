@@ -1,0 +1,23 @@
+"""Fixture router binary: the argparse surface + routes the router
+template targets (SC707 reads --k8s-role-label's default)."""
+
+import argparse
+
+from aiohttp import web
+
+
+def build_parser():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--port", type=int, default=8001)
+    parser.add_argument("--k8s-role-label", default="app.role")
+    return parser
+
+
+async def health(request):
+    return web.json_response({"status": "ok"})
+
+
+def make_app():
+    app = web.Application()
+    app.router.add_get("/health", health)
+    return app

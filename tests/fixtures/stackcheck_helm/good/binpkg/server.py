@@ -10,6 +10,10 @@ def build_parser():
     parser.add_argument("--port", type=int, default=8000)
     parser.add_argument("--max-num-seqs", type=int, default=8)
     parser.add_argument("--drain-grace-s", type=float, default=30)
+    parser.add_argument(
+        "--disagg-role", default=None,
+        choices=["prefill", "decode", "both"],
+    )
     return parser
 
 

@@ -1,0 +1,604 @@
+"""Two-phase disaggregated prefill/decode routing (routing policy
+``disagg``) — the fleet half of disagg serving.
+
+Router + in-process fake engines exercise the whole two-phase flow and
+every documented failure mode (docs/robustness.md "Disagg handoff
+failure semantics"): the policy must DEGRADE to the fused path — never
+fail a request — when the prefill pool is empty, drained, or
+breaker-open, when the prime call dies, and when the decode-side
+prefetch misses.  The final test runs the real data path end to end:
+router + one prefill-role and one decode-role CPU tiny-llama engine over
+an in-process kvserver, asserting the decode engine imports the prefix
+chain instead of recomputing it.
+"""
+
+import asyncio
+import json
+import threading
+import time
+
+from aiohttp.test_utils import TestClient, TestServer
+from prometheus_client import REGISTRY as PROM_REGISTRY
+
+from production_stack_tpu.router.app import build_app
+from production_stack_tpu.router.parser import parse_args
+from production_stack_tpu.testing.fake_engine import (
+    FakeEngineState,
+    build_fake_engine_app,
+)
+
+MODEL = "fake/llama-3-8b"
+
+
+def _counter(name: str, **labels) -> float:
+    value = PROM_REGISTRY.get_sample_value(name, labels or None)
+    return 0.0 if value is None else value
+
+
+async def start_fake(role=None, store=None, **kw):
+    state = FakeEngineState(
+        model=MODEL, disagg_role=role, shared_store=store,
+        tokens_per_sec=2000.0, ttft=kw.pop("ttft", 0.005), **kw,
+    )
+    server = TestServer(build_fake_engine_app(state))
+    await server.start_server()
+    return state, server
+
+
+async def start_router(servers, roles, extra_args=()):
+    urls = [str(s.make_url("")).rstrip("/") for s in servers]
+    args = parse_args([
+        "--static-backends", ",".join(urls),
+        "--static-models", ",".join([MODEL] * len(urls)),
+        "--static-backend-roles", ",".join(roles),
+        "--routing-logic", "disagg",
+        "--engine-stats-interval", "1",
+        *extra_args,
+    ])
+    app = build_app(args)
+    server = TestServer(app)
+    await server.start_server()
+    return app, server, TestClient(server)
+
+
+async def test_two_phase_happy_path_prefill_primes_decode_serves():
+    store = set()
+    pre, e1 = await start_fake("prefill", store)
+    dec, e2 = await start_fake("decode", store)
+    fallback0 = {
+        r: _counter("tpu_router:disagg_fallback_total", reason=r)
+        for r in ("prime_failed", "prefix_miss", "prefill_pool_empty")
+    }
+    handoff0 = _counter("tpu_router:disagg_handoff_seconds_count")
+    try:
+        app, server, client = await start_router([e1, e2], ["prefill", "decode"])
+        try:
+            resp = await client.post(
+                "/v1/completions",
+                json={"model": MODEL, "prompt": "x" * 400, "max_tokens": 3},
+            )
+            assert resp.status == 200
+            # Decode-side prefetch hit: the chain the prefill fake
+            # exported was visible in the shared store.
+            assert resp.headers.get("x-disagg-prefix") == "hit"
+            body = await resp.json()
+            assert body["choices"][0]["text"]
+            # The prime ran on the prefill backend, the generation on the
+            # decode backend — and ONLY there.
+            assert pre.disagg_prefill_primes == 1
+            assert len(pre.exports) == 1
+            assert dec.disagg_handoff_hits == 1
+            assert dec.disagg_handoff_misses == 0
+            assert dec.total_requests == 1
+            # The prime rode the SAME deadline/trace plumbing: its id is
+            # derived, never colliding with the decode phase's.
+            assert pre.last_headers.get("x-disagg-phase") == "prefill"
+            assert pre.last_headers["x-request-id"].endswith("-prefill")
+            assert "x-disagg-handoff" in {
+                k.lower() for k in dec.last_headers
+            }
+            # Metric families moved: handoff latency observed, no
+            # fallback counted.
+            assert _counter(
+                "tpu_router:disagg_handoff_seconds_count"
+            ) == handoff0 + 1
+            for r, v0 in fallback0.items():
+                assert _counter(
+                    "tpu_router:disagg_fallback_total", reason=r
+                ) == v0, r
+        finally:
+            await client.close()
+    finally:
+        await e1.close()
+        await e2.close()
+
+
+async def test_two_phase_streaming_stream_intact():
+    store = set()
+    pre, e1 = await start_fake("prefill", store)
+    dec, e2 = await start_fake("decode", store)
+    try:
+        app, server, client = await start_router([e1, e2], ["prefill", "decode"])
+        try:
+            resp = await client.post(
+                "/v1/chat/completions",
+                json={"model": MODEL, "stream": True, "max_tokens": 4,
+                      "messages": [{"role": "user", "content": "hi " * 50}]},
+            )
+            assert resp.status == 200
+            raw = await resp.read()
+            events = [ln for ln in raw.split(b"\n\n") if ln.startswith(b"data: ")]
+            assert events[-1] == b"data: [DONE]"
+            assert json.loads(events[0][6:])["choices"][0]["delta"]["content"]
+            assert pre.disagg_prefill_primes == 1
+            assert dec.disagg_handoff_hits == 1
+        finally:
+            await client.close()
+    finally:
+        await e1.close()
+        await e2.close()
+
+
+async def test_no_prefill_pool_degrades_to_fused():
+    """Roles configured but no prefill backend discovered: the policy
+    serves the fused path (no prime, no failure)."""
+    d1, e1 = await start_fake("decode")
+    d2, e2 = await start_fake("decode")
+    before = _counter(
+        "tpu_router:disagg_fallback_total", reason="prefill_pool_empty"
+    )
+    try:
+        app, server, client = await start_router([e1, e2], ["decode", "decode"])
+        try:
+            resp = await client.post(
+                "/v1/completions",
+                json={"model": MODEL, "prompt": "x", "max_tokens": 2},
+            )
+            assert resp.status == 200
+            assert d1.disagg_prefill_primes == d2.disagg_prefill_primes == 0
+            assert _counter(
+                "tpu_router:disagg_fallback_total",
+                reason="prefill_pool_empty",
+            ) == before + 1
+        finally:
+            await client.close()
+    finally:
+        await e1.close()
+        await e2.close()
+
+
+async def test_prefill_breaker_open_degrades_to_fused_no_request_fails():
+    """ISSUE acceptance: with the prefill pool's breaker OPEN, every
+    request still serves (fused), none 500s — and the prefill backend
+    receives no further traffic while open."""
+    store = set()
+    pre, e1 = await start_fake("prefill", store)
+    dec, e2 = await start_fake("decode", store)
+    try:
+        app, server, client = await start_router([e1, e2], ["prefill", "decode"])
+        try:
+            # Open the prefill backend's breaker: 5 consecutive 5xx
+            # primes (each degrades that request to fused — still 200).
+            pre.inject("error_5xx", count=5)
+            for _ in range(5):
+                resp = await client.post(
+                    "/v1/completions",
+                    json={"model": MODEL, "prompt": "y" * 200,
+                          "max_tokens": 2},
+                )
+                assert resp.status == 200
+            hits_when_open = pre.data_plane_hits
+            # Breaker now open: the policy skips the prime entirely.
+            for _ in range(4):
+                resp = await client.post(
+                    "/v1/completions",
+                    json={"model": MODEL, "prompt": "y" * 200,
+                          "max_tokens": 2},
+                )
+                assert resp.status == 200
+            assert pre.data_plane_hits == hits_when_open
+            assert dec.total_requests == 9  # every request served
+            assert _counter(
+                "tpu_router:disagg_fallback_total", reason="prime_failed"
+            ) >= 5
+            assert _counter(
+                "tpu_router:disagg_fallback_total",
+                reason="prefill_breaker_open",
+            ) >= 4
+        finally:
+            await client.close()
+    finally:
+        await e1.close()
+        await e2.close()
+
+
+async def test_prefill_pool_drained_degrades_to_fused():
+    """ISSUE acceptance: POST /drain on the only prefill replica — the
+    prime gets the drain 503 and the request serves fused."""
+    store = set()
+    pre, e1 = await start_fake("prefill", store)
+    dec, e2 = await start_fake("decode", store)
+    try:
+        app, server, client = await start_router([e1, e2], ["prefill", "decode"])
+        try:
+            pre.draining = True
+            resp = await client.post(
+                "/v1/completions",
+                json={"model": MODEL, "prompt": "z" * 200, "max_tokens": 2},
+            )
+            assert resp.status == 200
+            assert (await resp.json())["choices"][0]["text"]
+            assert pre.disagg_prefill_primes == 0
+            assert dec.total_requests == 1
+        finally:
+            await client.close()
+    finally:
+        await e1.close()
+        await e2.close()
+
+
+async def test_deadline_expiring_between_phases_sheds_504_before_decode():
+    """The prime succeeds but eats the whole deadline: the router sheds a
+    504 BETWEEN phases — the decode pool never sees the request."""
+    store = set()
+    # Prime takes ~100 ms; the deadline expires ~30 ms in (the prime's
+    # 250 ms budget floor still lets it finish, so the between-phases
+    # re-check — not a starved connect — does the shedding).
+    pre, e1 = await start_fake("prefill", store, ttft=0.1)
+    dec, e2 = await start_fake("decode", store)
+    try:
+        app, server, client = await start_router([e1, e2], ["prefill", "decode"])
+        try:
+            resp = await client.post(
+                "/v1/completions",
+                json={"model": MODEL, "prompt": "w" * 200, "max_tokens": 2},
+                headers={"X-Request-Deadline": repr(time.time() + 0.03)},
+            )
+            assert resp.status == 504
+            assert (await resp.json())["error"]["type"] == "deadline_expired"
+            assert pre.disagg_prefill_primes == 1  # prime did run
+            assert dec.data_plane_hits == 0  # decode never admitted
+        finally:
+            await client.close()
+    finally:
+        await e1.close()
+        await e2.close()
+
+
+async def test_decode_prefetch_miss_recomputes_in_place_no_third_backend():
+    """ISSUE acceptance: a decode-side prefetch miss falls back by
+    recomputing on the SAME decode backend — prefill is never re-run on
+    a third backend and the request succeeds."""
+    pre, e1 = await start_fake("prefill", set())
+    # Separate store: the decode fake can never see the export => miss.
+    dec, e2 = await start_fake("decode", set())
+    before = _counter(
+        "tpu_router:disagg_fallback_total", reason="prefix_miss"
+    )
+    try:
+        app, server, client = await start_router([e1, e2], ["prefill", "decode"])
+        try:
+            resp = await client.post(
+                "/v1/completions",
+                json={"model": MODEL, "prompt": "q" * 300, "max_tokens": 2},
+            )
+            assert resp.status == 200
+            assert resp.headers.get("x-disagg-prefix") == "miss"
+            assert (await resp.json())["choices"][0]["text"]
+            assert pre.disagg_prefill_primes == 1  # exactly one prime
+            assert pre.total_requests == 1  # never re-primed
+            assert dec.disagg_handoff_misses == 1
+            assert dec.total_requests == 1
+            assert _counter(
+                "tpu_router:disagg_fallback_total", reason="prefix_miss"
+            ) == before + 1
+        finally:
+            await client.close()
+    finally:
+        await e1.close()
+        await e2.close()
+
+
+async def test_handoff_unexported_sticks_decode_to_prefill_backend():
+    """A prime that could not export (no store behind the engine) makes
+    the KV local-only: the degraded route decodes ON the prefill backend
+    (its prefix cache holds the prompt) instead of recomputing cold."""
+    # disagg_role=None: the fake answers primes but exports nothing —
+    # the role label is a ROUTER-side attribute (--static-backend-roles).
+    pre, e1 = await start_fake(None)
+    dec, e2 = await start_fake("decode")
+    before = _counter(
+        "tpu_router:disagg_fallback_total", reason="handoff_unexported"
+    )
+    try:
+        app, server, client = await start_router([e1, e2], ["prefill", "decode"])
+        try:
+            resp = await client.post(
+                "/v1/completions",
+                json={"model": MODEL, "prompt": "s" * 300, "max_tokens": 2},
+            )
+            assert resp.status == 200
+            assert pre.disagg_prefill_primes == 1
+            # Sticky fused: the generation ran on the PRIME's backend.
+            assert pre.total_requests == 2  # prime + generation
+            assert dec.total_requests == 0
+            assert _counter(
+                "tpu_router:disagg_fallback_total",
+                reason="handoff_unexported",
+            ) == before + 1
+        finally:
+            await client.close()
+    finally:
+        await e1.close()
+        await e2.close()
+
+
+async def test_prefill_drain_mid_handoff_completes_export():
+    """ISSUE acceptance: a prefill replica draining MID-handoff still
+    completes the in-flight prime (export recorded, handoff returned)
+    while /ready flips to 503 for new work — the drain contract's
+    "finish in-flight streams" half applied to primes."""
+    store = set()
+    pre, e1 = await start_fake("prefill", store, ttft=0.2)
+    dec, e2 = await start_fake("decode", store)
+    try:
+        app, server, client = await start_router([e1, e2], ["prefill", "decode"])
+        try:
+            task = asyncio.ensure_future(client.post(
+                "/v1/completions",
+                json={"model": MODEL, "prompt": "d" * 300, "max_tokens": 2},
+            ))
+            await asyncio.sleep(0.05)  # prime is now in flight
+            eng_client = TestClient(e1)
+            drain_resp = await eng_client.post("/drain")
+            assert drain_resp.status == 200
+            ready = await eng_client.get("/ready")
+            assert ready.status == 503  # /ready flipped immediately
+            resp = await task
+            assert resp.status == 200
+            # The in-flight handoff completed despite the drain: export
+            # recorded, decode imported it.
+            assert len(pre.exports) == 1
+            assert dec.disagg_handoff_hits == 1
+            await eng_client.close()
+        finally:
+            await client.close()
+    finally:
+        await e1.close()
+        await e2.close()
+
+
+# -- routing-policy unit behavior -------------------------------------------
+
+
+def _ep(url, role=None):
+    from production_stack_tpu.router.service_discovery import EndpointInfo
+
+    return EndpointInfo(url=url, model_names=[MODEL], role=role)
+
+
+class _Req:
+    def __init__(self, headers=None):
+        self.headers = headers or {}
+
+
+def test_standard_routers_exclude_prefill_role_backends():
+    """ISSUE satellite: with roles configured, KVAwareRouter and
+    SessionRouter (and the load-based policies) must never park a
+    generation on a dedicated prefill backend."""
+    from production_stack_tpu.router.routing.kv_aware import KVAwareRouter
+    from production_stack_tpu.router.routing.least_loaded import (
+        LeastLoadedRouter,
+    )
+    from production_stack_tpu.router.routing.round_robin import (
+        RoundRobinRouter,
+    )
+    from production_stack_tpu.router.routing.session import SessionRouter
+
+    eps = [_ep("http://p1", "prefill"), _ep("http://p2", "prefill"),
+           _ep("http://d1", "decode"), _ep("http://f1", None)]
+    decode_urls = {"http://d1", "http://f1"}
+
+    kv = KVAwareRouter()
+    for _ in range(6):
+        url = kv.route_request(eps, {}, {}, _Req(), {"prompt": "shared " * 40})
+        assert url in decode_urls
+    sess = SessionRouter(session_key="x-user-id")
+    for uid in ("alice", "bob", "carol", "dave", "erin"):
+        url = sess.route_request(
+            eps, {}, {}, _Req({"x-user-id": uid}), {"prompt": "x"}
+        )
+        assert url in decode_urls, uid
+    # No-session fallback (lowest QPS) excludes prefill too.
+    assert sess.route_request(eps, {}, {}, _Req(), {}) in decode_urls
+    for _ in range(6):
+        assert RoundRobinRouter().route_request(
+            eps, {}, {}, _Req(), {"model": MODEL}
+        ) in decode_urls
+        assert LeastLoadedRouter().route_request(
+            eps, {}, {}, _Req(), {}
+        ) in decode_urls
+
+
+def test_prefill_only_fleet_stays_routable():
+    """Degrade, never 500: when ONLY prefill-role backends exist they
+    stay eligible (a prefill-role engine can still decode)."""
+    from production_stack_tpu.router.routing.session import SessionRouter
+
+    eps = [_ep("http://p1", "prefill")]
+    assert SessionRouter(session_key="k").route_request(
+        eps, {}, {}, _Req({"k": "u"}), {}
+    ) == "http://p1"
+
+
+def test_disagg_select_prefill_prefers_least_queued_prompt_tokens():
+    from production_stack_tpu.router.routing.disagg import DisaggRouter
+    from production_stack_tpu.router.stats.engine_stats import EngineStats
+
+    router = DisaggRouter()
+    pool = [_ep("http://p1", "prefill"), _ep("http://p2", "prefill")]
+    stats = {
+        # p1 has fewer queued REQUESTS but far more queued PROMPT TOKENS
+        # (one 8k-token prompt): prefill load is token-bound, pick p2.
+        "http://p1": EngineStats(num_queuing_requests=1,
+                                 queued_prompt_tokens=8000),
+        "http://p2": EngineStats(num_queuing_requests=3,
+                                 queued_prompt_tokens=600),
+    }
+    assert router.select_prefill(pool, stats, {}) == "http://p2"
+    # route_request (decode phase) never picks a prefill backend.
+    eps = pool + [_ep("http://d1", "decode")]
+    assert router.route_request(eps, {}, {}, _Req(), {}) == "http://d1"
+
+
+def test_parser_validates_static_backend_roles():
+    import pytest
+
+    with pytest.raises(ValueError, match="entries"):
+        parse_args([
+            "--static-backends", "http://a:1,http://b:2",
+            "--static-models", "m,m",
+            "--static-backend-roles", "prefill",
+        ])
+    with pytest.raises(ValueError, match="prefill"):
+        parse_args([
+            "--static-backends", "http://a:1,http://b:2",
+            "--static-models", "m,m",
+            "--static-backend-roles", "prefill,weird",
+        ])
+    # Empty entries are fused members of a mixed fleet.
+    args = parse_args([
+        "--static-backends", "http://a:1,http://b:2",
+        "--static-models", "m,m",
+        "--static-backend-roles", "prefill,",
+    ])
+    assert args.static_backend_roles == "prefill,"
+    # disagg + static discovery without roles: the prefill pool would be
+    # permanently empty and the fleet would silently run fused — fail at
+    # boot instead (the CLI twin of stackcheck SC707).
+    with pytest.raises(ValueError, match="static-backend-roles"):
+        parse_args([
+            "--static-backends", "http://a:1,http://b:2",
+            "--static-models", "m,m",
+            "--routing-logic", "disagg",
+        ])
+
+
+def test_scraper_parses_queued_prompt_tokens():
+    from production_stack_tpu.router.stats.engine_stats import EngineStats
+
+    text = (
+        "# TYPE tpu:num_requests_waiting gauge\n"
+        "tpu:num_requests_waiting 2.0\n"
+        "# TYPE tpu:queued_prompt_tokens gauge\n"
+        "tpu:queued_prompt_tokens 512.0\n"
+    )
+    stats = EngineStats.from_prometheus_text(text)
+    assert stats.num_queuing_requests == 2
+    assert stats.queued_prompt_tokens == 512.0
+
+
+# -- real-engine end-to-end --------------------------------------------------
+
+
+async def test_real_engine_two_phase_decode_imports_chain():
+    """The whole disagg data path on real CPU engines: router + a
+    prefill-role and a decode-role tiny engine over an in-process
+    kvserver.  The prime finalizes + eagerly exports the chain; the
+    decode engine's handoff wait imports it, so decode admits with the
+    prompt served from the prefix cache (remote blocks fetched > 0,
+    X-Disagg-Prefix: hit) — decode never executes those prompt tokens."""
+    from production_stack_tpu.engine.config import (
+        CacheConfig,
+        EngineConfig,
+        ModelConfig,
+        SchedulerConfig,
+    )
+    from production_stack_tpu.engine.server.api_server import build_engine_app
+    from production_stack_tpu.engine.server.async_engine import AsyncEngine
+    from production_stack_tpu.kvserver.server import KVStore, handle_client
+
+    # In-process kvserver (the shared KV plane the handoff rides).
+    kv_store = KVStore(capacity_bytes=64 << 20)
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+    state = {}
+
+    def serve():
+        asyncio.set_event_loop(loop)
+
+        async def boot():
+            server = await asyncio.start_server(
+                lambda r, w: handle_client(kv_store, r, w), "127.0.0.1", 0
+            )
+            state["port"] = server.sockets[0].getsockname()[1]
+            started.set()
+
+        loop.run_until_complete(boot())
+        loop.run_forever()
+
+    kv_thread = threading.Thread(target=serve, daemon=True)
+    kv_thread.start()
+    assert started.wait(5)
+    kv_url = f"kv://127.0.0.1:{state['port']}"
+
+    def make_engine(role):
+        return AsyncEngine(EngineConfig(
+            model=ModelConfig(dtype="float32"),
+            cache=CacheConfig(
+                block_size=4, num_blocks=128,
+                remote_kv_url=kv_url, disagg_role=role,
+            ),
+            scheduler=SchedulerConfig(
+                max_num_seqs=2, prefill_buckets=(16, 32, 64),
+                max_model_len=128,
+            ),
+        ))
+
+    pre_eng = make_engine("prefill")
+    dec_eng = make_engine("decode")
+    e1 = TestServer(build_engine_app(pre_eng, "tiny-llama"))
+    e2 = TestServer(build_engine_app(dec_eng, "tiny-llama"))
+    await e1.start_server()
+    await e2.start_server()
+    try:
+        urls = [str(s.make_url("")).rstrip("/") for s in (e1, e2)]
+        args = parse_args([
+            "--static-backends", ",".join(urls),
+            "--static-models", "tiny-llama,tiny-llama",
+            "--static-backend-roles", "prefill,decode",
+            "--routing-logic", "disagg",
+            "--engine-stats-interval", "1",
+        ])
+        router_server = TestServer(build_app(args))
+        await router_server.start_server()
+        client = TestClient(router_server)
+        try:
+            prompt = "the quick brown fox jumps over the lazy dog " * 2
+            resp = await client.post(
+                "/v1/completions",
+                json={"model": "tiny-llama", "prompt": prompt,
+                      "max_tokens": 4},
+            )
+            assert resp.status == 200, await resp.text()
+            assert resp.headers.get("x-disagg-prefix") == "hit"
+            body = await resp.json()
+            assert body["usage"]["completion_tokens"] >= 1
+            # Prefill side: one prime, chain exported to the store.
+            assert pre_eng.engine.disagg_prefill_primes == 1
+            assert pre_eng.engine.remote_prefix_blocks_exported > 0
+            # Decode side: the chain was IMPORTED, not recomputed — the
+            # handoff wait resolved before admission.
+            assert dec_eng.engine.disagg_handoff_hits == 1
+            assert dec_eng.engine.remote_prefix_blocks_fetched > 0
+            # And both /metrics expose the new families.
+            eng_metrics = await (await TestClient(e2).get("/metrics")).text()
+            assert "tpu:disagg_handoff_hits_total 1.0" in eng_metrics
+        finally:
+            await client.close()
+            await router_server.close()
+    finally:
+        await e1.close()
+        await e2.close()
+        loop.call_soon_threadsafe(loop.stop)
+        kv_thread.join(timeout=5)

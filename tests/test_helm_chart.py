@@ -432,7 +432,7 @@ def _sc7_config(root):
     from pathlib import Path
 
     from tools.stackcheck import Config
-    from tools.stackcheck.config import DeploymentSurface
+    from tools.stackcheck.config import DeploymentSurface, RoleContract
 
     return Config(
         repo_root=Path(root),
@@ -449,6 +449,18 @@ def _sc7_config(root):
                 values_spec="servingEngineSpec",
                 drain_values_spec="servingEngineSpec",
             ),
+            DeploymentSurface(
+                template="helm/templates/deployment-router.yaml",
+                argparse_file="binpkg/router.py",
+                route_files=("binpkg/router.py",),
+                values_spec="routerSpec",
+            ),
+        ),
+        role_contract=RoleContract(
+            engine_template="helm/templates/deployment-engine.yaml",
+            engine_argparse_file="binpkg/server.py",
+            router_template="helm/templates/deployment-router.yaml",
+            router_argparse_file="binpkg/router.py",
         ),
     )
 
@@ -490,6 +502,11 @@ def test_stackcheck_bad_chart_renders_but_flags_every_seeded_break():
     # SC706: docs table drifted from values.yaml (changed + removed key).
     assert ("SC706", "servingEngineSpec.maxNumSeqs:default") in details
     assert ("SC706", "servingEngineSpec.removedKey") in details
+    # SC707 (ISSUE seed): the role label is rendered on the role-pool
+    # Deployments but under a key the router's --k8s-role-label never
+    # selects — the chart deploys, role discovery returns None for every
+    # pod, and the fleet silently runs fused.
+    assert ("SC707", "role_label:app.disagg-role!=app.role") in details
 
 
 def test_stackcheck_sc704_equality_flags_and_yaml_allow_suppresses(tmp_path):
@@ -522,3 +539,66 @@ def test_stackcheck_sc704_equality_flags_and_yaml_allow_suppresses(tmp_path):
         "  # stackcheck: allow=SC704 reason=no preStop hook on this pod",
     ))
     assert run_checks(_sc7_config(root), families=["deployment"]) == []
+
+
+def test_stackcheck_sc707_invalid_role_value_flags(tmp_path):
+    """A roles[].role value outside the engine binary's --disagg-role
+    choices validates against the schema (it's just a string) and
+    renders fine — the pool pod only crash-loops at deploy time.  SC707
+    catches it statically."""
+    import shutil
+
+    from tools.stackcheck import run_checks
+
+    root = tmp_path / "tree"
+    shutil.copytree(os.path.join(STACKCHECK_HELM, "good"), root)
+    values = root / "helm" / "values.yaml"
+    values.write_text(values.read_text().replace(
+        '- role: "prefill"', '- role: "prefil"'
+    ))
+    violations = run_checks(_sc7_config(root), families=["deployment"])
+    assert any(
+        v.rule == "SC707" and v.detail == "role_value:prefil"
+        for v in violations
+    ), violations
+
+
+def test_role_pools_render_per_role_deployments():
+    """servingEngineSpec.roles renders one Deployment + role-labeled
+    Service per role per model, each passing --disagg-role and carrying
+    the role label the router's discovery selects (routerSpec
+    k8sRoleLabel); role selectors stay disjoint so the prefill and
+    decode Deployments of one model never adopt each other's pods."""
+    values = tpu_values()
+    values["servingEngineSpec"]["roles"] = [
+        {"role": "prefill", "replicaCount": 1, "maxNumSeqs": 4},
+        {"role": "decode", "replicaCount": 3},
+    ]
+    values.setdefault("routerSpec", {})["routingLogic"] = "disagg"
+    objs = load_manifests(render_chart(CHART_DIR, values, release_name="dz"))
+    deps = {o["metadata"]["name"]: o for o in by_kind(objs, "Deployment")}
+    # The fused engine deployment is REPLACED by the role pools.
+    assert "dz-llama3-8b-deployment-engine" not in deps
+    pre = deps["dz-llama3-8b-prefill-deployment-engine"]
+    dec = deps["dz-llama3-8b-decode-deployment-engine"]
+    assert pre["spec"]["replicas"] == 1 and dec["spec"]["replicas"] == 3
+    for d, role, mns in ((pre, "prefill", "4"), (dec, "decode", "32")):
+        cmd = d["spec"]["template"]["spec"]["containers"][0]["command"]
+        assert cmd[cmd.index("--disagg-role") + 1] == role
+        # Per-role maxNumSeqs override; decode falls back to engineConfig.
+        assert cmd[cmd.index("--max-num-seqs") + 1] == mns
+        # The handoff rides the shared store.
+        assert cmd[cmd.index("--remote-kv-url") + 1] == \
+            "kv://dz-cache-server-service:9400"
+        labels = d["spec"]["template"]["metadata"]["labels"]
+        assert labels["app.production-stack-tpu/role"] == role
+        assert d["spec"]["selector"]["matchLabels"][
+            "app.production-stack-tpu/role"] == role
+    svcs = {s["metadata"]["name"]: s for s in by_kind(objs, "Service")}
+    assert svcs["dz-llama3-8b-prefill-engine-service"]["spec"]["selector"][
+        "app.production-stack-tpu/role"] == "prefill"
+    # The router passes the matching role-label flag (SC707's contract).
+    router_args = deps["dz-deployment-router"]["spec"]["template"]["spec"][
+        "containers"][0]["args"]
+    assert router_args[router_args.index("--k8s-role-label") + 1] == \
+        "app.production-stack-tpu/role"

@@ -220,4 +220,5 @@ def test_available_routing_logics():
         "session",
         "least_loaded",
         "kv_aware",
+        "disagg",
     }

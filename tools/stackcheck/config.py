@@ -153,6 +153,30 @@ class DeploymentSurface:
     drain_values_spec: Optional[str] = None
 
 
+@dataclasses.dataclass(frozen=True)
+class RoleContract:
+    """The disagg role-pool contract (SC707): the engine template's
+    role-labeled Deployments, the router's role-label flag, and the
+    values/schema `roles` surface must agree — a mismatched label key
+    deploys fine and silently runs the whole fleet fused."""
+
+    engine_template: str
+    engine_argparse_file: str
+    router_template: str
+    router_argparse_file: str
+    roles_values_path: str = "servingEngineSpec.roles"
+    role_label_flag: str = "--k8s-role-label"
+    role_flag: str = "--disagg-role"
+
+
+DEFAULT_ROLE_CONTRACT = RoleContract(
+    engine_template="helm/templates/deployment-engine.yaml",
+    engine_argparse_file="production_stack_tpu/engine/server/api_server.py",
+    router_template="helm/templates/deployment-router.yaml",
+    router_argparse_file="production_stack_tpu/router/parser.py",
+)
+
+
 DEFAULT_DEPLOYMENT_SURFACES: Tuple[DeploymentSurface, ...] = (
     DeploymentSurface(
         template="helm/templates/deployment-engine.yaml",
@@ -247,6 +271,9 @@ class Config:
     deployment_surfaces: Tuple[DeploymentSurface, ...] = (
         DEFAULT_DEPLOYMENT_SURFACES
     )
+    # SC707 disagg role-pool contract; None disables (fixture trees
+    # without a router surface).
+    role_contract: Optional[RoleContract] = DEFAULT_ROLE_CONTRACT
     baseline_path: str = "tools/stackcheck/baseline.json"
 
     def resolve(self, rel: Optional[str]) -> Optional[Path]:

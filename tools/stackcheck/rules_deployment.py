@@ -38,6 +38,13 @@ SC705  a values key referenced by a template is absent from
        ``values.schema.json`` (typos in overrides validate clean).
 SC706  a row of the docs/robustness.md "Helm values" table names a key
        missing from values.yaml, or documents a default that drifted.
+SC707  the disagg role-pool contract is broken: the role label key the
+       engine template renders on role-pool Deployments differs from the
+       key the router will select on (its ``--k8s-role-label`` — the
+       templated value, else the argparse default); or a ``roles[].role``
+       value in a shipped values file is outside the engine binary's
+       ``--disagg-role`` choices.  Both deploy fine and silently run the
+       fleet fused — role discovery returns None for every pod.
 
 All YAML parsing is the stdlib-only subset parser (miniyaml.py); no
 template is rendered — the checks read the template source directly, so
@@ -202,6 +209,187 @@ def _server_routes(path: Path) -> Set[Tuple[str, str]]:
             if arg.value.startswith("/"):
                 routes.add((method, arg.value))
     return routes
+
+
+_ANY_RANGE_RE = re.compile(
+    r"range\s+(\$\w+)\s*:=\s*\$?\.Values\.([A-Za-z0-9_.]+)"
+)
+_FLAG_LITERAL_ITEM_RE = re.compile(r'^\s*-\s+"([^"]*)"\s*$')
+
+
+def _template_flag_value(text: str, flag: str) -> Optional[str]:
+    """The value item following a templated ``- "--flag"``: a literal
+    string, or the values-default of a ``.Values.*`` ref (resolved by the
+    caller) — returned as ("literal", s) / ("ref", path) packed into a
+    prefixed string, None when the flag is absent or value-less."""
+    lines = text.splitlines()
+    for i, line in enumerate(lines):
+        m = _FLAG_ITEM_RE.match(line)
+        if m is None or m.group(1) != flag:
+            continue
+        for nxt in lines[i + 1:i + 3]:
+            if _FLAG_ITEM_RE.match(nxt):
+                return None  # boolean flag
+            ref = _VALUES_REF_RE.search(nxt)
+            if ref is not None:
+                return "ref:" + ref.group(1)
+            lit = _FLAG_LITERAL_ITEM_RE.match(nxt)
+            if lit is not None:
+                return "lit:" + lit.group(1)
+            break
+    return None
+
+
+def _role_label_keys(text: str, roles_values_path: str) -> List[Tuple[str, int]]:
+    """Label keys whose VALUE is the role field of the roles-range
+    variable: ``<key>: {{ $r.role ... }}`` inside a template that binds
+    ``range $r := .Values.<roles_values_path>``.  Returns (key, line)."""
+    var: Optional[str] = None
+    for m in _ANY_RANGE_RE.finditer(text):
+        if m.group(2) == roles_values_path:
+            var = m.group(1)
+            break
+    if var is None:
+        return []
+    key_re = re.compile(
+        r"^\s*([A-Za-z0-9./_-]+):\s*\{\{-?\s*" + re.escape(var) + r"\.role\b"
+    )
+    out: List[Tuple[str, int]] = []
+    for i, line in enumerate(text.splitlines()):
+        km = key_re.match(line)
+        if km is not None:
+            out.append((km.group(1), i + 1))
+    return out
+
+
+def _check_role_contract(
+    cfg: C.Config,
+    values: miniyaml.YamlValue,
+    values_lines: List[str],
+    value_key_lines: Dict[str, int],
+    overlays: List[Tuple[str, "miniyaml.YamlValue", List[str], Dict[str, int]]],
+) -> List[Violation]:
+    """SC707 — see module docstring."""
+    out: List[Violation] = []
+    rc = cfg.role_contract
+    if rc is None:
+        return out
+    engine_tmpl = cfg.resolve(rc.engine_template)
+    router_tmpl = cfg.resolve(rc.router_template)
+    if engine_tmpl is None or not engine_tmpl.exists():
+        return out
+    engine_text = engine_tmpl.read_text()
+    engine_lines = engine_text.splitlines()
+    label_keys = _role_label_keys(engine_text, rc.roles_values_path)
+    if not label_keys:
+        return out  # no role pools rendered in this chart
+
+    # The key the router will read roles from: the template's
+    # --k8s-role-label value (literal or values default), falling back to
+    # the router binary's argparse default.
+    router_key: Optional[str] = None
+    router_src = rc.router_template
+    if router_tmpl is not None and router_tmpl.exists():
+        packed = _template_flag_value(
+            router_tmpl.read_text(), rc.role_label_flag
+        )
+        if packed is not None:
+            kind, _, payload = packed.partition(":")
+            if kind == "lit":
+                router_key = payload
+            elif kind == "ref":
+                resolved = miniyaml.get_path(values, payload)
+                if isinstance(resolved, str) and resolved:
+                    router_key = resolved
+                    router_src = cfg.helm_values_path or "values.yaml"
+    role_choices: Optional[Tuple[str, ...]] = None
+    router_arg_path = cfg.resolve(rc.router_argparse_file)
+    if router_key is None and router_arg_path is not None \
+            and router_arg_path.exists():
+        from tools.stackcheck.core import SourceFile
+
+        rflags = _argparse_flags(SourceFile(
+            router_arg_path, rc.router_argparse_file,
+            router_arg_path.read_text(),
+        ))
+        info = rflags.get(rc.role_label_flag)
+        if info is not None and isinstance(info.get("default"), str):
+            router_key = str(info["default"])
+            router_src = rc.router_argparse_file
+    engine_arg_path = cfg.resolve(rc.engine_argparse_file)
+    if engine_arg_path is not None and engine_arg_path.exists():
+        from tools.stackcheck.core import SourceFile
+
+        eflags = _argparse_flags(SourceFile(
+            engine_arg_path, rc.engine_argparse_file,
+            engine_arg_path.read_text(),
+        ))
+        info = eflags.get(rc.role_flag)
+        choices_obj = info.get("choices") if info is not None else None
+        if isinstance(choices_obj, (list, tuple)):
+            role_choices = tuple(str(c) for c in choices_obj)
+
+    if router_key is None:
+        out.append(Violation(
+            rule="SC707", file=rc.engine_template, line=label_keys[0][1],
+            qualname=rc.roles_values_path,
+            message=(
+                "engine template renders role-labeled pods but neither "
+                f"the router template nor {rc.router_argparse_file} "
+                f"defines {rc.role_label_flag} — the router can never "
+                "select roles; the fleet silently runs fused"
+            ),
+            detail="role_label_flag_missing",
+        ))
+    else:
+        for key, line in label_keys:
+            if key == router_key:
+                continue
+            if _yaml_allowed(engine_lines, line, "SC707"):
+                continue
+            out.append(Violation(
+                rule="SC707", file=rc.engine_template, line=line,
+                qualname=rc.roles_values_path,
+                message=(
+                    f"engine role pools label pods `{key}: <role>` but "
+                    f"the router selects roles via `{router_key}` "
+                    f"({router_src}) — role discovery returns None for "
+                    "every pod and the fleet silently runs fused"
+                ),
+                detail=f"role_label:{key}!={router_key}",
+            ))
+
+    # roles[].role values in every shipped values file must be within the
+    # engine binary's --disagg-role choices.
+    if role_choices:
+        for rel, merged, file_lines, file_key_lines in overlays:
+            roles_value = miniyaml.get_path(merged, rc.roles_values_path)
+            if not isinstance(roles_value, list):
+                continue
+            for idx, entry in enumerate(roles_value):
+                role = entry.get("role") if isinstance(entry, dict) else None
+                if role is None or str(role) in role_choices:
+                    continue
+                line = file_key_lines.get(
+                    rc.roles_values_path,
+                    file_key_lines.get(
+                        rc.roles_values_path.split(".")[0], 1
+                    ),
+                )
+                if _yaml_allowed(file_lines, line, "SC707"):
+                    continue
+                out.append(Violation(
+                    rule="SC707", file=rel, line=line,
+                    qualname=f"{rc.roles_values_path}[{idx}]",
+                    message=(
+                        f"roles[{idx}].role = {role!r} is outside the "
+                        f"engine binary's {rc.role_flag} choices "
+                        f"{list(role_choices)} — the pool pod would "
+                        "crash-loop on argparse error"
+                    ),
+                    detail=f"role_value:{role}",
+                ))
+    return out
 
 
 def check_deployment(cfg: C.Config) -> List[Violation]:
@@ -453,6 +641,11 @@ def check_deployment(cfg: C.Config) -> List[Violation]:
             rel, miniyaml.deep_merge(values, overlay),
             overlay_text.splitlines(), overlay_key_lines,
         ))
+    # -- SC707: disagg role-pool contract ----------------------------------
+    out.extend(_check_role_contract(
+        cfg, values, values_lines, value_key_lines, overlay_paths
+    ))
+
     drain_specs = sorted({
         s.drain_values_spec
         for s in cfg.deployment_surfaces

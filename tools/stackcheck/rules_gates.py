@@ -82,7 +82,7 @@ def _argparse_flags(src: SourceFile) -> Dict[str, Dict[str, object]]:
             continue
         kw = {}
         for k in node.keywords:
-            if k.arg in ("action", "default"):
+            if k.arg in ("action", "default", "choices"):
                 try:
                     kw[k.arg] = ast.literal_eval(k.value)
                 except (ValueError, SyntaxError):
@@ -91,6 +91,7 @@ def _argparse_flags(src: SourceFile) -> Dict[str, Dict[str, object]]:
             "line": node.lineno,
             "store_true": kw.get("action") == "store_true",
             "default": kw.get("default", None),
+            "choices": kw.get("choices", None),
         }
         for f in flags:
             out[f] = info
