@@ -1320,6 +1320,96 @@ def bench_disagg_ab(args, preset: str) -> dict:
     }
 
 
+def bench_fleet_surge_ab(
+    args,
+    *,
+    num_engines: int = 12,
+    duration_s: float = 6.0,
+    base_qps: float = 6.0,
+    peak_qps: float = 60.0,
+    seed: int = 7,
+) -> dict:
+    """Fleet-level admission A/B over the in-process fleet harness
+    (testing/fleet.py): the SAME seeded 10x diurnal replay — replicas
+    scaled 2→N→2 through drain mid-surge — run twice:
+
+      router_shed: fleet admission ON (router/capacity.py) — the router
+        sheds with structured 429s the moment estimated headroom is
+        exhausted, before any engine queue grows;
+      engine_shed: --no-fleet-admission — overload queues per-engine
+        until each backend's own bounded-admission 429 trips (the PR-5
+        baseline), oversubscription degrading every admitted stream's
+        ITL on the way there.
+
+    The claim (docs/robustness.md "Fleet admission & autoscaling
+    contract"): router-level shedding holds admitted p95 ITL flat at
+    comparable goodput, and relocates sheds from N engine queues to one
+    cheap headroom check.  CPU-only, no jax import — fake engines model
+    capacity-degraded ITL deterministically."""
+    import asyncio
+
+    from production_stack_tpu.testing.fleet import FleetHarness
+
+    n_mid = max(4, num_engines)
+
+    async def run(fleet_admission: bool) -> dict:
+        h = FleetHarness(
+            num_engines=n_mid, seed=seed,
+            capacity=2, max_queued=8,
+            tokens_per_sec=60.0, ttft=0.01, max_tokens=6,
+            default_slots=8.0,
+            fleet_admission=fleet_admission,
+            router_args=("--stream-idle-timeout-s", "2.0"),
+        )
+        await h.start(active=2)
+        try:
+            async def scale_up():
+                await h.scale_to(n_mid)
+
+            async def scale_down():
+                h.scale_to_background(2)
+
+            await h.replay(
+                duration_s=duration_s, base_qps=base_qps,
+                peak_qps=peak_qps,
+                events=[
+                    (duration_s * 0.4, scale_up),
+                    (duration_s * 0.75, scale_down),
+                ],
+            )
+            await h.wait_background()
+            rep = h.report()
+            return {
+                "total": rep["total"],
+                "completed": rep["completed"],
+                "shed_router": rep["shed_router"],
+                "shed_engine": rep["shed_engine"],
+                "dropped": rep["dropped"],
+                "errors": rep["error"],
+                "admitted_itl_p95_ms": round(
+                    rep["admitted_itl_p95_s"] * 1e3, 2
+                ),
+                "oracle_admitted": round(h.oracle_admitted(), 1),
+            }
+        finally:
+            await h.close()
+
+    router_shed = asyncio.run(run(True))
+    engine_shed = asyncio.run(run(False))
+    return {
+        "router_shed": router_shed,
+        "engine_shed": engine_shed,
+        # > 1.0 = fleet admission cut the admitted requests' ITL tail.
+        "itl_p95_ratio": round(
+            engine_shed["admitted_itl_p95_ms"]
+            / max(router_shed["admitted_itl_p95_ms"], 1e-9), 3
+        ),
+        "goodput_ratio": round(
+            router_shed["completed"] / max(engine_shed["completed"], 1), 3
+        ),
+    }
+
+
 # -- trace report ----------------------------------------------------------
 
 
@@ -1920,6 +2010,27 @@ def main() -> None:
         except Exception as e:
             log(f"disagg A/B failed: {e}")
             detail["disagg_ab_error"] = str(e)[:200]
+
+    if not args.quick and budget_left("fleet_surge_ab"):
+        # Fleet admission A/B: router-level shed (capacity model) vs
+        # engine-level shed only, same seeded 10x diurnal surge with a
+        # 2->N->2 scale cycle through drain — the admitted-ITL-stays-
+        # flat-at-the-fleet-level claim, measured (docs/robustness.md
+        # "Fleet admission & autoscaling contract").  Fake-engine fleet:
+        # no TPU, no jax import.
+        try:
+            detail["fleet_surge_ab"] = bench_fleet_surge_ab(args)
+            ab = detail["fleet_surge_ab"]
+            log(f"fleet surge A/B: engine-shed p95 ITL "
+                f"{ab['engine_shed']['admitted_itl_p95_ms']} ms vs "
+                f"router-shed {ab['router_shed']['admitted_itl_p95_ms']} ms "
+                f"({ab['itl_p95_ratio']}x tail cut), goodput ratio "
+                f"{ab['goodput_ratio']}, sheds "
+                f"{ab['router_shed']['shed_router']} router vs "
+                f"{ab['engine_shed']['shed_engine']} engine)")
+        except Exception as e:
+            log(f"fleet surge A/B failed: {e}")
+            detail["fleet_surge_ab_error"] = str(e)[:200]
 
     result = {
         "metric": f"decode_throughput_{preset}_b{S}_ctx{ctx}",

@@ -344,6 +344,26 @@ REGISTRY = {
         "mirrors": ("dashboard", "docs"),
         "help": "Per-backend breaker state (0=closed, 1=half-open, 2=open)",
     },
+    # -- fleet-level admission control (router/capacity.py) ----------------
+    "tpu_router:fleet_headroom_slots": {
+        "kind": "gauge", "layer": "router", "labels": ("pool",),
+        "mirrors": ("dashboard", "docs"),
+        "help": "Capacity-model fleet headroom in spare request slots per "
+                "admission pool (fleet, or prefill/decode under disagg "
+                "role pools); the prom-adapter exposes it for HPA",
+    },
+    "tpu_router:backend_capacity_slots": {
+        "kind": "gauge", "layer": "router", "labels": ("server",),
+        "mirrors": ("docs",),
+        "help": "Learned max useful concurrency per backend (the online "
+                "capacity model's slot estimate)",
+    },
+    "tpu_router:backend_capacity_score": {
+        "kind": "gauge", "layer": "router", "labels": ("server",),
+        "mirrors": ("dashboard", "docs"),
+        "help": "Free-capacity fraction per backend (1 = idle, 0 = "
+                "saturated or inside an engine-429 Retry-After window)",
+    },
     "tpu_router:semantic_cache_size": {
         "kind": "gauge", "layer": "router",
         "mirrors": ("dashboard", "docs"),
@@ -354,6 +374,13 @@ REGISTRY = {
         "kind": "counter", "layer": "router",
         "mirrors": ("dashboard", "docs"),
         "help": "Requests shed at the router on an expired deadline",
+    },
+    "tpu_router:fleet_admission_rejected_total": {
+        "kind": "counter", "layer": "router", "labels": ("reason",),
+        "mirrors": ("dashboard", "docs"),
+        "help": "Requests shed at the router by fleet-level admission "
+                "control (reason: no_headroom | low_priority) — in a "
+                "healthy fleet these strictly precede any engine-side 429",
     },
     "tpu_router:semantic_cache_hits_total": {
         "kind": "counter", "layer": "router",

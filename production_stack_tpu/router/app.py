@@ -17,6 +17,12 @@ from aiohttp import web
 
 from production_stack_tpu.obs.trace import Tracer
 from production_stack_tpu.router import parser as router_parser
+from production_stack_tpu.router.capacity import (
+    CAPACITY_MODEL,
+    FLEET_ADMISSION,
+    CapacityModel,
+    FleetAdmission,
+)
 from production_stack_tpu.router.circuit_breaker import CircuitBreaker
 from production_stack_tpu.router.routing import initialize_routing_logic
 from production_stack_tpu.router.service_discovery import (
@@ -90,6 +96,25 @@ def initialize_all(app: web.Application, args) -> ServiceRegistry:
         )
     registry.set(RETRY_BUDGET, args.retry_budget)
     registry.set(DRAIN_CONTROLLER, DrainController(grace_s=args.drain_grace_s))
+
+    # Fleet-level admission (router/capacity.py): capacity model +
+    # admission controller.  --no-fleet-admission leaves BOTH keys unset,
+    # reproducing the per-engine-shed-only path exactly (the capacity
+    # model is only fed from the proxy/metrics paths through the keys).
+    if not getattr(args, "no_fleet_admission", False):
+        model = CapacityModel(
+            default_slots=args.fleet_default_slots,
+            slo_p95_itl_s=args.fleet_slo_p95_itl_s,
+            slo_p95_ttft_s=args.fleet_slo_p95_ttft_s,
+        )
+        registry.set(CAPACITY_MODEL, model)
+        registry.set(
+            FLEET_ADMISSION,
+            FleetAdmission(
+                model,
+                low_priority_headroom_frac=args.fleet_low_priority_headroom,
+            ),
+        )
 
     # Optional subsystems -------------------------------------------------
     if args.enable_batch_api:

@@ -1,0 +1,438 @@
+"""Online fleet capacity model + router-level (fleet) admission control.
+
+ROADMAP item 2, the "millions of users" gap: PR 5 bounded each ENGINE's
+queue (structured 429s once `max_queued_requests`/`max_queued_tokens`
+trips), but nothing protected the FLEET — an overloaded fleet still
+queues per-engine until every backend's local bound trips, paying a full
+routing decision, backend connect, and engine admission pass per doomed
+request.  This module makes the router the overload firewall: it learns
+each backend's capacity online from the existing stats plane (the
+engine-stats scraper + the request-stats monitor — no new probes) and
+sheds at the router the moment estimated fleet headroom is exhausted, so
+fleet-level sheds strictly precede engine-level 429s in an overload.
+
+Capacity model (per backend, all observations from the stats plane):
+
+* ``slots`` — the learned maximum USEFUL concurrency: how many requests
+  this backend can hold in flight before it starts queueing (the engine's
+  ``max_num_seqs`` analogue as observed from outside).  Starts at an
+  optimistic prior (``default_slots``) and is clamped DOWN whenever the
+  scrape shows the engine queueing (``tpu:num_requests_waiting`` > 0 or a
+  growing ``tpu:queued_prompt_tokens``) or its windowed p95 ITL/TTFT
+  breaches the SLO at the router-observed concurrency; it is probed back
+  UP (one slot at a time) while the backend runs healthy at the frontier,
+  so a transient brownout does not depress the estimate forever.
+* ``qps_capacity`` — the admitted-QPS knee of the (admitted-QPS,
+  p95-ITL/TTFT) curve: the highest windowed QPS this backend sustained
+  while inside the SLO, shrunk proportionally (``qps * slo/p95``) when
+  the SLO is breached.  Exported for scoring/HPA dashboards; admission
+  itself keys on slots (concurrency is synchronously known at the router
+  — no scrape/window lag on the shed decision).
+* an engine 429 is a ZERO-HEADROOM observation: the backend told us its
+  bound.  ``on_backpressure`` clamps slots to the observed concurrency
+  and marks the backend saturated for the advertised ``Retry-After``
+  window — the same event PR 5 uses to drop routing weight now also
+  teaches the capacity model (docs/robustness.md "Fleet admission").
+
+Headroom is measured in request SLOTS (spare concurrency), per pool:
+with disagg role pools (PR 9) the prefill and decode pools have separate
+headroom, and admission for a generation request keys on the
+DECODE-CAPABLE pool only — a saturated prefill pool must not shed work
+the decode/fused pool could absorb (the disagg policy already degrades
+the prime phase to the fused path; shedding here would turn a degraded
+request into a lost one).
+
+Priority-aware degradation: requests carrying an OpenAI-style body
+``priority`` > 0 (lower value = more important, matching the engine
+scheduler's convention) or an ``x-request-priority`` header are
+DEGRADABLE — they shed first, while fleet headroom is merely LOW
+(below ``low_priority_headroom_frac`` of fleet slots), so speculative /
+batch work drains off before interactive traffic feels anything.
+
+Single-event-loop use only (the router is one asyncio loop): no locking,
+mutating entry points are all called from request handlers or the
+metrics endpoint.  Every threshold takes an injectable clock so tests
+drive the model deterministically.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, List, Mapping, Optional, Tuple
+
+CAPACITY_MODEL = "capacity_model"
+FLEET_ADMISSION = "fleet_admission"
+
+# Closed reason set for tpu_router:fleet_admission_rejected_total — kept
+# stable so dashboards and rate() see the same label sets from boot.
+FLEET_SHED_REASONS = ("no_headroom", "low_priority")
+
+
+def request_priority(headers: Mapping[str, str], body: Optional[dict]) -> int:
+    """Effective request priority: the ``x-request-priority`` header wins,
+    else the OpenAI-style body ``priority`` int (engine convention: lower
+    = more important, 0 default; > 0 = degradable/speculative work)."""
+    raw = headers.get("x-request-priority")
+    if raw is None and body is not None:
+        raw = body.get("priority")
+    if raw is None:
+        return 0
+    try:
+        return int(raw)
+    except (TypeError, ValueError):
+        return 0
+
+
+@dataclasses.dataclass
+class BackendCapacity:
+    """One backend's learned capacity state."""
+
+    slots: float                 # max useful concurrency estimate
+    qps_capacity: float = 0.0    # admitted-QPS knee estimate
+    saturated_until: float = 0.0  # zero-headroom window (engine 429)
+    last_inflight: int = 0
+    last_qps: float = 0.0
+    last_p95_itl: float = 0.0
+    last_p95_ttft: float = 0.0
+    last_queued: float = 0.0
+    last_queued_tokens: float = 0.0
+    observations: int = 0
+
+    def saturated(self, now: float) -> bool:
+        return now < self.saturated_until
+
+
+class CapacityModel:
+    """Per-backend capacity estimates learned from the stats plane."""
+
+    def __init__(
+        self,
+        *,
+        default_slots: float = 64.0,
+        min_slots: float = 1.0,
+        slo_p95_itl_s: float = 2.0,
+        slo_p95_ttft_s: float = 10.0,
+        probe_step: float = 1.0,
+        refresh_interval_s: float = 0.25,
+        clock=time.time,
+    ):
+        if default_slots < min_slots:
+            raise ValueError("default_slots must be >= min_slots")
+        self.default_slots = float(default_slots)
+        self.min_slots = float(min_slots)
+        self.slo_p95_itl_s = float(slo_p95_itl_s)
+        self.slo_p95_ttft_s = float(slo_p95_ttft_s)
+        self.probe_step = float(probe_step)
+        self.refresh_interval_s = float(refresh_interval_s)
+        self._clock = clock
+        self._backends: Dict[str, BackendCapacity] = {}
+        self._last_refresh: float = 0.0
+        # Last scraped engine-shed counter per url: growth between
+        # refreshes is saturation evidence even when another router
+        # instance absorbed the 429s (multi-router deployments).
+        self._last_shed_counter: Dict[str, float] = {}
+
+    # -- per-backend state -------------------------------------------------
+
+    def _bc(self, url: str) -> BackendCapacity:
+        bc = self._backends.get(url)
+        if bc is None:
+            bc = self._backends[url] = BackendCapacity(slots=self.default_slots)
+        return bc
+
+    def observe(
+        self,
+        url: str,
+        *,
+        inflight: int,
+        qps: float = 0.0,
+        p95_itl: float = 0.0,
+        p95_ttft: float = 0.0,
+        queued_requests: float = 0.0,
+        queued_prompt_tokens: float = 0.0,
+    ) -> None:
+        """One stats-plane observation for ``url``.  Saturation evidence
+        (engine-side queueing, SLO breach) clamps the slot estimate DOWN
+        to the observed concurrency; a healthy reading at the frontier
+        probes it UP by one step."""
+        bc = self._bc(url)
+        bc.observations += 1
+        bc.last_inflight = int(inflight)
+        bc.last_qps = float(qps)
+        bc.last_p95_itl = float(p95_itl)
+        bc.last_p95_ttft = float(p95_ttft)
+        bc.last_queued = float(queued_requests)
+        bc.last_queued_tokens = float(queued_prompt_tokens)
+
+        itl_breach = p95_itl > 0 and p95_itl > self.slo_p95_itl_s
+        ttft_breach = p95_ttft > 0 and p95_ttft > self.slo_p95_ttft_s
+        queueing = queued_requests > 0
+        if queueing or itl_breach or ttft_breach:
+            # The backend is at/above capacity at this concurrency.
+            bc.slots = max(self.min_slots, min(bc.slots, float(max(inflight, 1))))
+            if qps > 0 and itl_breach:
+                # Shrink the QPS knee proportionally to the breach.
+                shrunk = qps * self.slo_p95_itl_s / p95_itl
+                bc.qps_capacity = (
+                    min(bc.qps_capacity, shrunk) if bc.qps_capacity > 0
+                    else shrunk
+                )
+        else:
+            if qps > bc.qps_capacity:
+                bc.qps_capacity = float(qps)
+            if inflight >= bc.slots:
+                # Healthy at the frontier: probe one slot up so a
+                # transiently clamped backend can re-earn its capacity.
+                bc.slots = min(
+                    self.default_slots * 4.0, bc.slots + self.probe_step
+                )
+
+    def on_backpressure(
+        self, url: str, retry_after_s: Optional[float], inflight: Optional[int] = None
+    ) -> None:
+        """An engine 429 seen by the proxy: a zero-headroom observation.
+        Clamp slots to the concurrency the 429 was observed at and mark
+        the backend saturated for the advertised window (the same window
+        PR 5 uses for the routing-weight drop)."""
+        bc = self._bc(url)
+        at = inflight if inflight is not None else bc.last_inflight
+        bc.slots = max(self.min_slots, min(bc.slots, float(max(at, 1))))
+        window = retry_after_s if retry_after_s and retry_after_s > 0 else 1.0
+        bc.saturated_until = self._clock() + float(window)
+
+    def prune(self, live_urls) -> List[str]:
+        """Drop state for backends that left discovery (pod churn);
+        returns the removed urls so the metrics layer can retire their
+        gauge labels (same contract as CircuitBreaker.prune)."""
+        live = set(live_urls)
+        gone = [u for u in self._backends if u not in live]
+        for url in gone:
+            del self._backends[url]
+        for url in [u for u in self._last_shed_counter if u not in live]:
+            del self._last_shed_counter[url]
+        return gone
+
+    # -- bulk refresh from the stats plane ---------------------------------
+
+    def refresh(
+        self, endpoints, engine_stats, request_stats, prune: bool = True
+    ) -> List[str]:
+        """Fold one scrape/monitor snapshot into the model, then (only
+        with ``prune=True``, i.e. when ``endpoints`` is the FULL live
+        discovery list — the /metrics path) drop departures (returned,
+        for gauge-label retirement).  The request path passes its
+        per-request CANDIDATE list, which excludes backpressured/broken
+        backends — pruning against it would evict exactly the saturation
+        state the model just learned."""
+        for ep in endpoints:
+            es = engine_stats.get(ep.url)
+            rs = request_stats.get(ep.url)
+            self.observe(
+                ep.url,
+                inflight=getattr(rs, "uncompleted_requests", 0) if rs else 0,
+                qps=getattr(rs, "qps", 0.0) if rs else 0.0,
+                p95_itl=getattr(rs, "itl_p95", 0.0) if rs else 0.0,
+                p95_ttft=getattr(rs, "ttft_p95", 0.0) if rs else 0.0,
+                queued_requests=(
+                    getattr(es, "num_queuing_requests", 0) if es else 0.0
+                ),
+                queued_prompt_tokens=(
+                    getattr(es, "queued_prompt_tokens", 0.0) if es else 0.0
+                ),
+            )
+            # AFTER the observation (so the healthy-frontier probe-up
+            # cannot undo the clamp): a grown engine-shed counter since
+            # the last scrape is a zero-headroom observation even when a
+            # DIFFERENT router absorbed the 429s.  The baseline is only
+            # seeded from a REAL scrape (es present): recording 0.0 for
+            # an unscraped backend would misread a long-lived engine's
+            # cumulative counter as fresh 429s on the router's first
+            # post-restart refresh and spuriously clamp the whole fleet.
+            if es is not None:
+                shed_counter = getattr(es, "admission_rejected_total", 0.0)
+                prev = self._last_shed_counter.get(ep.url)
+                if prev is not None and shed_counter > prev:
+                    self.on_backpressure(
+                        ep.url, None,
+                        inflight=(
+                            getattr(rs, "uncompleted_requests", 0) if rs else 0
+                        ),
+                    )
+                self._last_shed_counter[ep.url] = shed_counter
+        gone = self.prune([ep.url for ep in endpoints]) if prune else []
+        self._last_refresh = self._clock()
+        return gone
+
+    def refresh_maybe(
+        self, endpoints, engine_stats, request_stats, monitor=None
+    ) -> None:
+        """Rate-limited refresh for the request path: at most one full
+        fold per ``refresh_interval_s`` — per-request cost stays O(1).
+        When ``monitor`` is given, the windowed p95 quantiles are
+        recomputed from it (the per-request ``request_stats`` map skips
+        them to keep the routing hot path cheap)."""
+        if self._clock() - self._last_refresh < self.refresh_interval_s:
+            return
+        if monitor is not None:
+            request_stats = monitor.get_request_stats(
+                self._clock(), with_quantiles=True
+            )
+        self.refresh(endpoints, engine_stats, request_stats, prune=False)
+
+    # -- reads --------------------------------------------------------------
+
+    def slots_of(self, url: str) -> float:
+        bc = self._backends.get(url)
+        return bc.slots if bc is not None else self.default_slots
+
+    def qps_capacity_of(self, url: str) -> float:
+        bc = self._backends.get(url)
+        return bc.qps_capacity if bc is not None else 0.0
+
+    def capacity_score(self, url: str, inflight: Optional[int] = None) -> float:
+        """Free-capacity fraction in [0, 1]: 1 = idle, 0 = saturated
+        (slots full, or inside an engine-429 Retry-After window).
+        Never-observed backends score against the prior."""
+        bc = self._backends.get(url)
+        if bc is None:
+            used = inflight if inflight is not None else 0
+            return max(0.0, min(1.0, 1.0 - used / self.default_slots))
+        if bc.saturated(self._clock()):
+            return 0.0
+        used = inflight if inflight is not None else bc.last_inflight
+        if bc.slots <= 0:
+            return 0.0
+        return max(0.0, min(1.0, 1.0 - used / bc.slots))
+
+    def backend_headroom(self, url: str, inflight: Optional[int] = None) -> float:
+        """Spare request slots on one backend (0 while saturated).
+        Never-observed backends count against the optimistic prior, but
+        still net off the caller's synchronous in-flight count."""
+        bc = self._backends.get(url)
+        if bc is None:
+            used = inflight if inflight is not None else 0
+            return max(0.0, self.default_slots - used)
+        if bc.saturated(self._clock()):
+            return 0.0
+        used = inflight if inflight is not None else bc.last_inflight
+        return max(0.0, bc.slots - used)
+
+    def pool_capacity(self, endpoints) -> float:
+        return sum(self.slots_of(ep.url) for ep in endpoints)
+
+    def pool_headroom(self, endpoints, request_stats=None) -> float:
+        """Fleet/pool headroom in spare request slots.  When the caller
+        passes the live ``request_stats`` map, in-flight counts come from
+        it synchronously (no scrape lag on the shed decision)."""
+        total = 0.0
+        for ep in endpoints:
+            inflight = None
+            if request_stats is not None:
+                rs = request_stats.get(ep.url)
+                inflight = getattr(rs, "uncompleted_requests", 0) if rs else 0
+            total += self.backend_headroom(ep.url, inflight)
+        return total
+
+    def min_retry_after(self, endpoints, default: float = 1.0) -> float:
+        """Soonest saturation window expiry across the pool — the honest
+        Retry-After for a fleet-level shed."""
+        now = self._clock()
+        waits = [
+            bc.saturated_until - now
+            for url, bc in self._backends.items()
+            if any(ep.url == url for ep in endpoints) and bc.saturated(now)
+        ]
+        if not waits:
+            return float(default)
+        return max(0.1, min(min(waits), 30.0))
+
+    def snapshot(self) -> Dict[str, BackendCapacity]:
+        """url -> live BackendCapacity (metrics endpoint render)."""
+        return dict(self._backends)
+
+
+@dataclasses.dataclass
+class ShedDecision:
+    """A fleet-level shed: why, and how long the client should back off."""
+
+    reason: str          # one of FLEET_SHED_REASONS
+    retry_after_s: float
+    pool: str            # "fleet" | "decode" | "prefill"
+    headroom: float
+    capacity: float
+
+
+class FleetAdmission:
+    """The shed decision: admit, or 429 at the router.
+
+    Per-role aware: with disagg role pools, a generation request is
+    gated on the DECODE-CAPABLE pool's headroom (fused endpoints count —
+    they can absorb the whole request), never on the prefill pool's —
+    see module docstring.  Priority-aware: degradable requests
+    (priority > 0) shed early while headroom is merely low.
+    """
+
+    def __init__(
+        self,
+        model: CapacityModel,
+        *,
+        low_priority_headroom_frac: float = 0.15,
+        retry_after_default_s: float = 1.0,
+        clock=time.time,
+    ):
+        self.model = model
+        self.low_priority_headroom_frac = float(low_priority_headroom_frac)
+        self.retry_after_default_s = float(retry_after_default_s)
+        self._clock = clock
+
+    def check(
+        self,
+        endpoints: List,
+        engine_stats: Mapping,
+        request_stats: Mapping,
+        priority: int = 0,
+        monitor=None,
+    ) -> Optional[ShedDecision]:
+        """None = admit.  ``endpoints`` is the already-filtered candidate
+        list for this request (model + health + breaker filtering done)."""
+        if not endpoints:
+            return None  # nothing to protect; the routing layer will 503
+        self.model.refresh_maybe(endpoints, engine_stats, request_stats, monitor)
+        pool_name, pool = self._admission_pool(endpoints)
+        capacity = self.model.pool_capacity(pool)
+        headroom = self.model.pool_headroom(pool, request_stats)
+        if capacity <= 0:
+            return None
+        if headroom <= 0:
+            return ShedDecision(
+                reason="no_headroom",
+                retry_after_s=self.model.min_retry_after(
+                    pool, self.retry_after_default_s
+                ),
+                pool=pool_name, headroom=headroom, capacity=capacity,
+            )
+        if priority > 0 and headroom < capacity * self.low_priority_headroom_frac:
+            # Degradation ladder: speculative / low-priority work drains
+            # off while the fleet still has a sliver of headroom, so
+            # interactive traffic never queues behind it.
+            return ShedDecision(
+                reason="low_priority",
+                retry_after_s=self.retry_after_default_s,
+                pool=pool_name, headroom=headroom, capacity=capacity,
+            )
+        return None
+
+    @staticmethod
+    def _admission_pool(endpoints) -> Tuple[str, List]:
+        """The pool whose headroom gates this request: the decode-capable
+        endpoints when disagg roles are configured (prefill-pool
+        saturation must not shed work the decode/fused pool could
+        absorb), the whole fleet otherwise."""
+        if any(getattr(ep, "role", None) for ep in endpoints):
+            decode_capable = [
+                ep for ep in endpoints if getattr(ep, "role", None) != "prefill"
+            ]
+            if decode_capable:
+                return "decode", decode_capable
+        return "fleet", list(endpoints)

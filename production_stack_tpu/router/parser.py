@@ -124,6 +124,42 @@ def parse_args(argv=None) -> argparse.Namespace:
         "streams finish up to this many seconds, then exit 0",
     )
 
+    # Fleet-level admission control (router/capacity.py): the router
+    # learns each backend's capacity online from the stats plane and
+    # sheds with a structured 429 + Retry-After when estimated fleet
+    # headroom is exhausted — before any engine queue grows.
+    parser.add_argument(
+        "--no-fleet-admission",
+        action="store_true",
+        help="disable router-level fleet admission control (overload then "
+        "queues per-engine until each backend's local bound 429s — the "
+        "pre-fleet-admission behavior)",
+    )
+    parser.add_argument(
+        "--fleet-default-slots", type=float, default=64.0,
+        help="capacity-model prior: max useful concurrency assumed per "
+        "backend until the stats plane teaches a better estimate.  "
+        "Deliberately optimistic — the router must never shed work the "
+        "fleet hasn't PROVEN it cannot take (observed queueing, SLO "
+        "breach, or an engine 429 all clamp the estimate down instantly)",
+    )
+    parser.add_argument(
+        "--fleet-slo-p95-itl-s", type=float, default=2.0,
+        help="windowed p95 inter-token-latency SLO; a backend breaching "
+        "it has its capacity estimate clamped to its current concurrency",
+    )
+    parser.add_argument(
+        "--fleet-slo-p95-ttft-s", type=float, default=10.0,
+        help="windowed p95 TTFT SLO for the capacity model (same clamp "
+        "semantics as the ITL SLO)",
+    )
+    parser.add_argument(
+        "--fleet-low-priority-headroom", type=float, default=0.15,
+        help="degradation ladder: shed priority>0 (speculative/batch) "
+        "requests once fleet headroom falls below this fraction of fleet "
+        "capacity, so interactive traffic never queues behind them",
+    )
+
     # Request tracing (production_stack_tpu/obs): per-request span
     # timelines at GET /debug/requests, joined with the engine's at
     # /debug/requests/{id}.
@@ -258,3 +294,9 @@ def validate_args(args: argparse.Namespace) -> None:
         raise ValueError("--retry-budget must be >= 0")
     if args.drain_grace_s < 0:
         raise ValueError("--drain-grace-s must be >= 0")
+    if args.fleet_default_slots < 1:
+        raise ValueError("--fleet-default-slots must be >= 1")
+    if args.fleet_slo_p95_itl_s <= 0 or args.fleet_slo_p95_ttft_s <= 0:
+        raise ValueError("fleet SLO thresholds must be > 0")
+    if not (0.0 <= args.fleet_low_priority_headroom <= 1.0):
+        raise ValueError("--fleet-low-priority-headroom must be in [0, 1]")

@@ -13,7 +13,13 @@ from aiohttp import web
 from prometheus_client import CONTENT_TYPE_LATEST, generate_latest
 
 from production_stack_tpu.obs.histogram import render_labeled_histograms
-from production_stack_tpu.router.service_discovery import DISCOVERY_SERVICE
+from production_stack_tpu.router.capacity import CAPACITY_MODEL
+from production_stack_tpu.router.service_discovery import (
+    DISCOVERY_SERVICE,
+    decode_capable,
+    role_pool,
+    roles_configured,
+)
 from production_stack_tpu.router.services import metrics_service as ms
 from production_stack_tpu.router.services.request_service.request import (
     CIRCUIT_BREAKER,
@@ -50,10 +56,20 @@ def render_router_histograms(monitor) -> str:
 @routes.get("/metrics")
 async def metrics(request: web.Request) -> web.Response:
     registry = request.app["registry"]
+    discovery = registry.get(DISCOVERY_SERVICE)
+    scraper = registry.get(ENGINE_STATS_SCRAPER)
+    engine_stats = scraper.get_engine_stats() if scraper is not None else {}
 
     monitor = registry.get(REQUEST_STATS_MONITOR)
+    request_stats = {}
     if monitor is not None:
-        for server, stats in monitor.get_request_stats(time.time()).items():
+        # One snapshot serves the gauge refresh AND the capacity model;
+        # quantiles on — the model's SLO clamp reads itl_p95/ttft_p95,
+        # and a scrape is the rate-limited place to pay the sort.
+        request_stats = monitor.get_request_stats(
+            time.time(), with_quantiles=True
+        )
+        for server, stats in request_stats.items():
             ms.current_qps.labels(server=server).set(stats.qps)
             ms.avg_ttft.labels(server=server).set(stats.ttft)
             ms.avg_latency.labels(server=server).set(stats.latency)
@@ -69,11 +85,10 @@ async def metrics(request: web.Request) -> web.Response:
 
     breaker = registry.get(CIRCUIT_BREAKER)
     if breaker is not None:
-        discovery_svc = registry.get(DISCOVERY_SERVICE)
-        if discovery_svc is not None:
+        if discovery is not None:
             # Retire breaker state + gauge labels for backends that left
             # discovery (pod churn would otherwise grow both unboundedly).
-            live = [ep.url for ep in discovery_svc.get_endpoint_info()]
+            live = [ep.url for ep in discovery.get_endpoint_info()]
             for gone in breaker.prune(live):
                 try:
                     ms.circuit_state.remove(gone)
@@ -82,16 +97,62 @@ async def metrics(request: web.Request) -> web.Response:
         for server, state_value in breaker.snapshot().items():
             ms.circuit_state.labels(server=server).set(state_value)
 
-    scraper = registry.get(ENGINE_STATS_SCRAPER)
-    if scraper is not None:
-        for server, es in scraper.get_engine_stats().items():
-            ms.engine_kv_usage_perc.labels(server=server).set(es.kv_usage_perc)
-            ms.engine_prefix_cache_hit_rate.labels(server=server).set(
-                es.prefix_cache_hit_rate
-            )
-            ms.engine_queue_depth.labels(server=server).set(es.num_queuing_requests)
+    for server, es in engine_stats.items():
+        ms.engine_kv_usage_perc.labels(server=server).set(es.kv_usage_perc)
+        ms.engine_prefix_cache_hit_rate.labels(server=server).set(
+            es.prefix_cache_hit_rate
+        )
+        ms.engine_queue_depth.labels(server=server).set(es.num_queuing_requests)
 
-    discovery = registry.get(DISCOVERY_SERVICE)
+    # Fleet capacity model (router/capacity.py): refresh from the live
+    # stats plane so a scrape always reflects current headroom, then
+    # export per-pool headroom and per-backend capacity/score.
+    capacity = registry.get(CAPACITY_MODEL)
+    if capacity is not None and discovery is not None:
+        all_endpoints = discovery.get_endpoint_info()
+        # Admission pools exclude sleeping endpoints; pruning must NOT —
+        # a backend asleep is still in discovery, and evicting its
+        # learned capacity would restart it at the optimistic prior on
+        # wake (prune is for pod churn only).
+        endpoints = [ep for ep in all_endpoints if not ep.sleep]
+        capacity.refresh(endpoints, engine_stats, request_stats, prune=False)
+        gone_urls = capacity.prune([ep.url for ep in all_endpoints])
+        ms.fleet_headroom_slots.labels(pool="fleet").set(
+            capacity.pool_headroom(endpoints, request_stats)
+        )
+        if roles_configured(endpoints):
+            ms.fleet_headroom_slots.labels(pool="prefill").set(
+                capacity.pool_headroom(
+                    role_pool(endpoints, "prefill"), request_stats
+                )
+            )
+            ms.fleet_headroom_slots.labels(pool="decode").set(
+                capacity.pool_headroom(decode_capable(endpoints), request_stats)
+            )
+        else:
+            # Roles gone (fleet hot-swapped back to fused): retire the
+            # per-role labels instead of freezing their last values — a
+            # frozen headroom=0 series would pin the adapter's
+            # min()-over-pools HPA signal at zero forever.
+            for stale_pool in ("prefill", "decode"):
+                try:
+                    ms.fleet_headroom_slots.remove(stale_pool)
+                except KeyError:
+                    pass
+        for server, bc in capacity.snapshot().items():
+            ms.backend_capacity_slots.labels(server=server).set(bc.slots)
+            ms.backend_capacity_score.labels(server=server).set(
+                capacity.capacity_score(server)
+            )
+        # Retire labels for departed backends (pod churn) — same contract
+        # as circuit_state above.
+        for gone in gone_urls:
+            for gauge in (ms.backend_capacity_slots, ms.backend_capacity_score):
+                try:
+                    gauge.remove(gone)
+                except KeyError:
+                    pass
+
     if discovery is not None:
         per_model: dict = {}
         for ep in discovery.get_endpoint_info():

@@ -2,9 +2,15 @@
 
 The reference's StaticRoute CRD advertises ``roundrobin|least_loaded``
 (src/router-controller/api/v1alpha1/staticroute_types.go:42) but the Python
-router never implements the latter; we do.  Load = engine running+waiting
-queue depth from scraped stats, falling back to router-side in-flight counts
-for engines that have not been scraped yet.
+router never implements the latter; we do.  Load = the MAX of the scraped
+engine running+waiting queue depth and the router's own synchronous
+in-flight count for that backend.  Scrape-only reads go stale for a whole
+scrape interval — a burst arriving between scrapes would pile onto one
+"least loaded" backend until the next scrape catches up (and could push it
+past its admission bound while the rest of the fleet idles); the router's
+own in-flight counter moves per request, so the fresh local lower bound
+caps the pileup.  (In multi-router deployments the scraped value still
+contributes the OTHER routers' load — hence max, not replacement.)
 """
 
 from __future__ import annotations
@@ -33,12 +39,14 @@ class LeastLoadedRouter(RoutingInterface):
         request_stats = request_stats or {}
 
         def load(ep: EndpointInfo) -> float:
+            scraped = 0.0
             if ep.url in engine_stats:
                 es = engine_stats[ep.url]
-                return float(es.num_running_requests + es.num_queuing_requests)
+                scraped = float(es.num_running_requests + es.num_queuing_requests)
+            local = 0.0
             if ep.url in request_stats:
                 rs = request_stats[ep.url]
-                return float(rs.in_prefill_requests + rs.in_decoding_requests)
-            return 0.0
+                local = float(rs.in_prefill_requests + rs.in_decoding_requests)
+            return max(scraped, local)
 
         return min(endpoints, key=lambda ep: (load(ep), ep.url)).url

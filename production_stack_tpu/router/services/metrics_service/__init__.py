@@ -59,6 +59,41 @@ deadline_expired_total = Counter(
     "(or during) backend connect",
 )
 
+# -- fleet-level admission control (router/capacity.py) --------------------
+# The router is the fleet's overload firewall: when the online capacity
+# model estimates fleet headroom exhausted, requests shed HERE with a
+# structured 429 + Retry-After — before any engine queue grows.  Closed
+# reason set, pre-seeded so dashboards and rate() see stable label sets
+# from boot: "no_headroom" (the admission pool's spare slots hit zero),
+# "low_priority" (degradable work shed early while headroom is merely low).
+fleet_admission_rejected_total = Counter(
+    "tpu_router:fleet_admission_rejected_total",
+    "Requests shed at the router by fleet-level admission control, by reason",
+    ["reason"],
+)
+for _shed_reason in ("no_headroom", "low_priority"):
+    fleet_admission_rejected_total.labels(reason=_shed_reason)
+# Estimated spare request slots per admission pool ("fleet" for fused
+# fleets; "prefill"/"decode" under disagg role pools) — the autoscaling
+# surface's scale-up signal (observability/prom-adapter.yaml).
+fleet_headroom_slots = Gauge(
+    "tpu_router:fleet_headroom_slots",
+    "Capacity-model fleet headroom in spare request slots, per pool",
+    ["pool"],
+)
+# Per-backend learned capacity: max useful concurrency and the free
+# fraction (1 = idle, 0 = saturated or inside an engine-429 window).
+backend_capacity_slots = Gauge(
+    "tpu_router:backend_capacity_slots",
+    "Learned max useful concurrency per backend (capacity model)",
+    ["server"],
+)
+backend_capacity_score = Gauge(
+    "tpu_router:backend_capacity_score",
+    "Free-capacity fraction per backend (0 = saturated, 1 = idle)",
+    ["server"],
+)
+
 # -- disaggregated prefill/decode serving (routing policy `disagg`) --------
 # Handoff latency: the whole prefill phase as the router sees it — prime
 # connect + engine prefill + eager chain export + handoff-token response.

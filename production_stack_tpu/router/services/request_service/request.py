@@ -29,6 +29,11 @@ import aiohttp
 from aiohttp import web
 
 from production_stack_tpu.obs.trace import make_traceparent, parse_traceparent
+from production_stack_tpu.router.capacity import (
+    CAPACITY_MODEL,
+    FLEET_ADMISSION,
+    request_priority,
+)
 from production_stack_tpu.router.routing import ROUTING_SERVICE
 from production_stack_tpu.router.service_discovery import DISCOVERY_SERVICE
 from production_stack_tpu.utils.net import parse_deadline
@@ -242,6 +247,49 @@ async def route_general_request(
     engine_stats = scraper.get_engine_stats() if scraper else {}
     monitor = registry.get(REQUEST_STATS_MONITOR)
     request_stats = monitor.get_request_stats(time.time()) if monitor else {}
+
+    # Fleet-level admission (router/capacity.py): when the online
+    # capacity model estimates the admission pool's headroom exhausted,
+    # shed HERE with a structured 429 + Retry-After — before a routing
+    # decision, a backend connect, or an engine queue slot is spent.
+    # Fleet sheds therefore strictly precede engine 429s in an overload
+    # (docs/robustness.md "Fleet admission & autoscaling contract").
+    admission = registry.get(FLEET_ADMISSION)
+    if admission is not None:
+        shed = admission.check(
+            endpoints, engine_stats, request_stats,
+            priority=request_priority(request.headers, body_json),
+            monitor=monitor,
+        )
+        if shed is not None:
+            from production_stack_tpu.router.services import (
+                metrics_service as ms,
+            )
+
+            ms.fleet_admission_rejected_total.labels(reason=shed.reason).inc()
+            resp = web.json_response(
+                {
+                    "error": {
+                        "message": (
+                            "fleet overloaded: estimated "
+                            f"{shed.pool}-pool headroom exhausted "
+                            f"({shed.headroom:.1f}/{shed.capacity:.1f} "
+                            "slots free)"
+                        ),
+                        "type": "fleet_overloaded",
+                        "code": 429,
+                        "detail": {
+                            "reason": shed.reason,
+                            "pool": shed.pool,
+                            "headroom_slots": round(shed.headroom, 2),
+                            "capacity_slots": round(shed.capacity, 2),
+                        },
+                    }
+                },
+                status=429,
+                headers={"Retry-After": str(max(1, int(shed.retry_after_s)))},
+            )
+            return _reject(resp, f"fleet_shed_{shed.reason}")
 
     router = registry.require(ROUTING_SERVICE)
 
@@ -467,6 +515,13 @@ async def process_request(
                         except (TypeError, ValueError):
                             retry_after = None
                         breaker.on_backpressure(url, retry_after)
+                        # The same event is a ZERO-HEADROOM observation
+                        # for the fleet capacity model: the engine told
+                        # us its bound, so fleet admission stops sending
+                        # work its way for the advertised window.
+                        capacity = registry.get(CAPACITY_MODEL)
+                        if capacity is not None:
+                            capacity.on_backpressure(url, retry_after)
                     elif backend.status >= 500:
                         breaker.on_failure(url)
                     else:

@@ -42,6 +42,9 @@ class EngineStats:
     # policy's prefill-pool load signal (prefill is prompt-token-bound,
     # so queue depth in requests under-weights long prompts).
     queued_prompt_tokens: float = 0.0
+    # Cumulative engine admission 429s (counter): the capacity model
+    # reads its growth as saturation evidence from OTHER routers' traffic.
+    admission_rejected_total: float = 0.0
     scraped_at: float = 0.0
 
     # Sample-name suffixes that belong to histogram/summary internals.

@@ -40,6 +40,11 @@ class RequestStats:
     itl: float = 0.0  # inter-token latency seconds, sliding-window average
     queueing_delay: float = 0.0  # router-side, seconds
     decoding_length: float = 0.0  # avg streamed chunks per finished request
+    # Windowed tail latencies (NOT the cumulative histograms below): the
+    # online capacity model's SLO signal must reflect the last window,
+    # not the whole process lifetime (router/capacity.py).
+    itl_p95: float = 0.0
+    ttft_p95: float = 0.0
 
 
 class SlidingWindow:
@@ -76,6 +81,18 @@ class SlidingWindow:
             now = time.time()
         self._expire(now)
         return len(self._samples) / self.window
+
+    def quantile(self, q: float, now: Optional[float] = None) -> float:
+        """Windowed quantile of the sample VALUES (nearest-rank on a
+        sorted copy; 0.0 when empty).  O(n log n) on the window — called
+        from the capacity model's rate-limited refresh, not per request."""
+        if now is not None:
+            self._expire(now)
+        if not self._samples:
+            return 0.0
+        values = sorted(v for _, v in self._samples)
+        idx = min(len(values) - 1, max(0, int(q * (len(values) - 1) + 0.5)))
+        return values[idx]
 
 
 class _EngineWindows:
@@ -239,7 +256,16 @@ class RequestStatsMonitor:
         with self._lock:
             return {url: dict(w.hists) for url, w in self._engines.items()}
 
-    def get_request_stats(self, current_time: Optional[float] = None) -> Dict[str, RequestStats]:
+    def get_request_stats(
+        self,
+        current_time: Optional[float] = None,
+        with_quantiles: bool = False,
+    ) -> Dict[str, RequestStats]:
+        """Per-engine snapshot.  ``with_quantiles`` additionally fills the
+        windowed p95 fields (itl_p95/ttft_p95) — an O(n log n) sort over
+        each window, so the per-request routing path leaves it off; the
+        capacity model's rate-limited refresh and the metrics endpoint
+        turn it on."""
         now = time.time() if current_time is None else current_time
         out: Dict[str, RequestStats] = {}
         with self._lock:
@@ -258,5 +284,11 @@ class RequestStatsMonitor:
                     itl=w.itl.average(now),
                     queueing_delay=w.queueing.average(now),
                     decoding_length=w.decoding_length.average(now),
+                    itl_p95=(
+                        w.itl.quantile(0.95, now) if with_quantiles else 0.0
+                    ),
+                    ttft_p95=(
+                        w.ttft.quantile(0.95, now) if with_quantiles else 0.0
+                    ),
                 )
         return out

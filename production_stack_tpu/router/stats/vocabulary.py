@@ -54,6 +54,12 @@ ENGINE_METRIC_CANDIDATES: Dict[str, List[str]] = {
     "queued_prompt_tokens": [
         "tpu:queued_prompt_tokens",
     ],
+    # Cumulative engine-side admission 429s.  The fleet capacity model
+    # (router/capacity.py) treats a GROWING value as saturation evidence
+    # even when another router instance absorbed the 429s.
+    "admission_rejected_total": [
+        "tpu:admission_rejected_total",
+    ],
 }
 
 # Names our own engine exports (used by the engine server and the fake

@@ -671,6 +671,14 @@ def main(argv=None) -> None:
     parser.add_argument("--tokens-per-sec", type=float, default=500.0)
     parser.add_argument("--ttft", type=float, default=0.02)
     parser.add_argument(
+        "--capacity", type=int, default=None,
+        help="model max_num_seqs: per-token intervals degrade once "
+        "in-flight exceeds this, the waiting gauge rises, and bounded "
+        "admission 429s past capacity+max-queued (live-drive stand-in "
+        "for a saturating engine; None keeps the constant-rate fake)",
+    )
+    parser.add_argument("--max-queued", type=int, default=0)
+    parser.add_argument(
         "--disagg-role",
         default=None,
         choices=["prefill", "decode", "both"],
@@ -681,6 +689,7 @@ def main(argv=None) -> None:
     args = parser.parse_args(argv)
     state = FakeEngineState(
         model=args.model, tokens_per_sec=args.tokens_per_sec, ttft=args.ttft,
+        capacity=args.capacity, max_queued=args.max_queued,
         disagg_role=args.disagg_role,
     )
     web.run_app(

@@ -1,0 +1,22 @@
+"""Fixture metric registry for the SC708 autoscaling-contract tests
+(AST-parsed, never imported — same contract as the real
+production_stack_tpu/obs/metric_registry.py)."""
+
+REGISTRY = {
+    "tpu:num_requests_waiting": {
+        "kind": "gauge", "layer": "engine", "mirrors": (),
+        "help": "queue depth",
+    },
+    "tpu:queued_prompt_tokens": {
+        "kind": "gauge", "layer": "engine", "mirrors": (),
+        "help": "queued prompt tokens",
+    },
+    "tpu:deadline_expired_total": {
+        "kind": "counter", "layer": "engine", "mirrors": (),
+        "help": "deadline misses",
+    },
+    "tpu_router:fleet_headroom_slots": {
+        "kind": "gauge", "layer": "router", "labels": ("pool",),
+        "mirrors": (), "help": "fleet headroom",
+    },
+}

@@ -441,6 +441,14 @@ def _sc7_config(root):
         helm_schema_path="helm/values.schema.json",
         helm_overlay_paths=(),
         robustness_docs_path="docs/robustness.md",
+        # SC708: fixture registry + autoscaling surfaces.
+        registry_path="registry.py",
+        observability_yaml_paths=(
+            "observability/prom-adapter.yaml",
+            "observability/hpa-example.yaml",
+        ),
+        hpa_template_paths=("helm/templates/hpa.yaml",),
+        prom_adapter_path="observability/prom-adapter.yaml",
         deployment_surfaces=(
             DeploymentSurface(
                 template="helm/templates/deployment-engine.yaml",
@@ -507,6 +515,14 @@ def test_stackcheck_bad_chart_renders_but_flags_every_seeded_break():
     # selects — the chart deploys, role discovery returns None for every
     # pod, and the fleet silently runs fused.
     assert ("SC707", "role_label:app.disagg-role!=app.role") in details
+    # SC708: the adapter queries a family the registry doesn't know
+    # (renamed series — matches nothing, HPA never scales) ...
+    assert ("SC708", "tpu:num_requests_wating") in details
+    # ... an HPA consumes a custom metric no adapter rule exposes ...
+    assert ("SC708", "hpa:tpu_queue_depth") in details
+    assert ("SC708", "hpa:tpu_router_headroom_slots") in details
+    # ... and a helm HPA template annotation names an unregistered family.
+    assert ("SC708", "tpu_router:fleet_headroom") in details
 
 
 def test_stackcheck_sc704_equality_flags_and_yaml_allow_suppresses(tmp_path):
@@ -602,3 +618,62 @@ def test_role_pools_render_per_role_deployments():
         "containers"][0]["args"]
     assert router_args[router_args.index("--k8s-role-label") + 1] == \
         "app.production-stack-tpu/role"
+
+
+def test_hpa_renders_router_and_per_role_pools():
+    """templates/hpa.yaml: routerSpec.autoscaling renders a router HPA;
+    roles[].maxReplicas renders one HPA per role pool targeting the
+    matching Deployment, with the role-appropriate adapter metric names
+    (prefill = queued prompt tokens, decode = queue depth + deadline-miss
+    rate) — the names stackcheck SC708 cross-checks against
+    observability/prom-adapter.yaml and the metric registry."""
+    overrides = {
+        "routerSpec": {"autoscaling": {
+            "enabled": True, "minReplicas": 1, "maxReplicas": 4,
+            "targetInflightPerPod": 200,
+        }},
+        "servingEngineSpec": {
+            "modelSpec": [{
+                "name": "llama", "repository": "r", "tag": "t",
+                "engineConfig": {"modelPreset": "tiny-llama"},
+            }],
+            "roles": [
+                {"role": "prefill", "replicaCount": 1, "maxReplicas": 4},
+                {"role": "decode", "replicaCount": 2, "minReplicas": 2,
+                 "maxReplicas": 12, "targetQueueDepth": 2},
+            ],
+        },
+    }
+    objs = load_manifests(render_chart(CHART_DIR, overrides, release_name="as"))
+    hpas = {o["metadata"]["name"]: o for o in by_kind(
+        objs, "HorizontalPodAutoscaler")}
+    assert set(hpas) == {
+        "as-router-hpa", "as-llama-prefill-engine-hpa",
+        "as-llama-decode-engine-hpa",
+    }
+
+    def metric_names(hpa):
+        return [m["pods"]["metric"]["name"] for m in hpa["spec"]["metrics"]]
+
+    router = hpas["as-router-hpa"]
+    assert router["spec"]["scaleTargetRef"]["name"] == "as-deployment-router"
+    assert metric_names(router) == ["tpu_router_inflight_requests"]
+
+    pre = hpas["as-llama-prefill-engine-hpa"]
+    assert pre["spec"]["scaleTargetRef"]["name"] == \
+        "as-llama-prefill-deployment-engine"
+    assert pre["spec"]["minReplicas"] == 1 and pre["spec"]["maxReplicas"] == 4
+    assert metric_names(pre) == ["tpu_queued_prompt_tokens"]
+
+    dec = hpas["as-llama-decode-engine-hpa"]
+    assert dec["spec"]["scaleTargetRef"]["name"] == \
+        "as-llama-decode-deployment-engine"
+    assert dec["spec"]["minReplicas"] == 2 and dec["spec"]["maxReplicas"] == 12
+    assert metric_names(dec) == [
+        "tpu_num_requests_waiting", "tpu_deadline_miss_rate"]
+    depth = dec["spec"]["metrics"][0]["pods"]["target"]["averageValue"]
+    assert str(depth) == "2"
+
+    # Autoscaling off + no role min/max: no HPA objects at all.
+    objs = load_manifests(render_chart(CHART_DIR, release_name="off"))
+    assert by_kind(objs, "HorizontalPodAutoscaler") == []

@@ -124,29 +124,57 @@ async def test_every_dashboard_expr_is_emitted():
 
 
 async def test_prom_adapter_rule_matches_engine_metric():
+    """Every ENGINE-layer series the adapter queries must be live on the
+    engine's /metrics output (the router families are covered by the
+    router metrics tests; stackcheck SC708 additionally pins every
+    series against the metric registry in CI)."""
     with open(os.path.join(OBS_DIR, "prom-adapter.yaml")) as f:
         adapter = yaml.safe_load(f)
     rules = adapter["rules"]["custom"]
-    assert len(rules) == 1
-    series = rules[0]["seriesQuery"]
+    assert len(rules) >= 4, "queue/tokens/deadline/headroom signals expected"
     emitted = emitted_names(await scrape_engine_metrics())
-    assert series in emitted
-    # The HPA-facing rename drops the colon.
-    assert rules[0]["name"]["as"] == "tpu_num_requests_waiting"
+    renames = {}
+    for rule in rules:
+        series = rule["seriesQuery"]
+        renames[series] = rule["name"]["as"]
+        # The HPA-facing rename drops the colon.
+        assert ":" not in rule["name"]["as"]
+        assert series in rule["metricsQuery"]
+        if series.startswith("tpu:"):
+            assert series in emitted, f"{series} not emitted by the engine"
+    # The classic queue-depth rule survives the rewrite, and the new
+    # SLO/fleet signals are exposed.
     from production_stack_tpu.router.stats import vocabulary
 
-    assert series == vocabulary.HPA_QUEUE_METRIC
+    assert renames[vocabulary.HPA_QUEUE_METRIC] == "tpu_num_requests_waiting"
+    assert renames["tpu:deadline_expired_total"] == "tpu_deadline_miss_rate"
+    assert (
+        renames["tpu_router:fleet_headroom_slots"]
+        == "tpu_router_fleet_headroom_slots"
+    )
 
 
 def test_hpa_example_consistent_with_adapter_and_chart():
+    with open(os.path.join(OBS_DIR, "prom-adapter.yaml")) as f:
+        adapter = yaml.safe_load(f)
+    exposed = {r["name"]["as"] for r in adapter["rules"]["custom"]}
     with open(os.path.join(OBS_DIR, "hpa-example.yaml")) as f:
-        hpa = yaml.safe_load(f)
-    metric = hpa["spec"]["metrics"][0]["pods"]["metric"]["name"]
-    assert metric == "tpu_num_requests_waiting"
-    # Target naming matches the chart's engine Deployment naming scheme.
-    target = hpa["spec"]["scaleTargetRef"]
-    assert target["kind"] == "Deployment"
-    assert re.fullmatch(r".+-deployment-engine", target["name"])
+        hpas = [doc for doc in yaml.safe_load_all(f) if doc]
+    assert len(hpas) == 2  # fused/decode queue-depth HPA + prefill HPA
+    for hpa in hpas:
+        # Every custom metric an HPA consumes must be an adapter rename
+        # (the static twin of this check is stackcheck SC708).
+        for m in hpa["spec"]["metrics"]:
+            assert m["pods"]["metric"]["name"] in exposed
+        # Target naming matches the chart's engine Deployment scheme.
+        target = hpa["spec"]["scaleTargetRef"]
+        assert target["kind"] == "Deployment"
+        assert re.fullmatch(r".+-deployment-engine", target["name"])
+    fused, prefill = hpas
+    assert fused["spec"]["metrics"][0]["pods"]["metric"]["name"] == \
+        "tpu_num_requests_waiting"
+    assert prefill["spec"]["metrics"][0]["pods"]["metric"]["name"] == \
+        "tpu_queued_prompt_tokens"
 
 
 async def test_trace_propagation_and_debug_join():

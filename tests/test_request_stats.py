@@ -6,6 +6,8 @@ queueing-delay measurements the reference allocated but never fed
 (SURVEY.md section 7 bug list).
 """
 
+import pytest
+
 from production_stack_tpu.router.stats.request_stats import (
     RequestStatsMonitor,
     SlidingWindow,
@@ -128,3 +130,30 @@ def test_multiple_engines_isolated():
     assert stats["http://a"].finished_requests == 1
     assert stats["http://b"].finished_requests == 0
     assert stats["http://b"].uncompleted_requests == 1
+
+
+def test_windowed_quantiles_reflect_window_not_lifetime():
+    """The p95 fields the fleet capacity model reads (itl_p95/ttft_p95)
+    are WINDOWED — old samples expire — and only computed when asked
+    (with_quantiles=True; the per-request routing path skips the sort)."""
+    w = SlidingWindow(window=10.0)
+    w.update(0.1, 1.0)  # two early slow outliers (>5% of 20 samples)
+    w.update(0.2, 1.0)
+    for i in range(18):
+        w.update(5.0 + i * 0.01, 0.010)
+    assert w.quantile(0.95, now=5.5) == 1.0
+    assert w.quantile(0.50, now=5.5) == 0.010
+    # The outliers age out of the window: the p95 recovers.
+    assert w.quantile(0.95, now=10.5) == 0.010
+    assert SlidingWindow(5.0).quantile(0.95) == 0.0  # empty -> 0
+
+    m = RequestStatsMonitor(sliding_window_size=60.0)
+    m.on_new_request(URL, "r1", timestamp=0.0)
+    m.on_request_response(URL, "r1", timestamp=0.5)  # TTFT 0.5
+    for i in range(1, 21):
+        m.on_token_chunk(URL, "r1", timestamp=0.5 + i * 0.02)
+    cheap = m.get_request_stats(current_time=1.0)[URL]
+    assert cheap.itl_p95 == 0.0 and cheap.ttft_p95 == 0.0
+    full = m.get_request_stats(current_time=1.0, with_quantiles=True)[URL]
+    assert full.ttft_p95 == 0.5
+    assert full.itl_p95 == pytest.approx(0.02, abs=0.005)

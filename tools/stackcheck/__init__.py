@@ -44,7 +44,10 @@ RULE_FAMILIES = {
     "gates": ("SC401", "SC402", "SC403"),
     "locks": ("SC501", "SC502", "SC503"),
     "lifecycle": ("SC601", "SC602", "SC603"),
-    "deployment": ("SC701", "SC702", "SC703", "SC704", "SC705", "SC706"),
+    "deployment": (
+        "SC701", "SC702", "SC703", "SC704", "SC705", "SC706", "SC707",
+        "SC708",
+    ),
 }
 
 # `--rules SC5,SC6,SC7` style shorthands: rule-id prefix -> family name.

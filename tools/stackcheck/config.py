@@ -274,6 +274,18 @@ class Config:
     # SC707 disagg role-pool contract; None disables (fixture trees
     # without a router surface).
     role_contract: Optional[RoleContract] = DEFAULT_ROLE_CONTRACT
+    # -- SC708: autoscaling PromQL contract --------------------------------
+    # YAML surfaces whose tpu:/tpu_router: family references must exist
+    # in the metric registry, and whose HPA custom-metric names must be
+    # prometheus-adapter `as:` renames — an unregistered family deploys
+    # fine and the HPA silently never scales (the SC707 failure shape).
+    observability_yaml_paths: Tuple[str, ...] = (
+        "observability/prom-adapter.yaml",
+        "observability/hpa-example.yaml",
+        "observability/kube-prom-stack.yaml",
+    )
+    hpa_template_paths: Tuple[str, ...] = ("helm/templates/hpa.yaml",)
+    prom_adapter_path: Optional[str] = "observability/prom-adapter.yaml"
     baseline_path: str = "tools/stackcheck/baseline.json"
 
     def resolve(self, rel: Optional[str]) -> Optional[Path]:

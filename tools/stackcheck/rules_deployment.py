@@ -45,6 +45,13 @@ SC707  the disagg role-pool contract is broken: the role label key the
        value in a shipped values file is outside the engine binary's
        ``--disagg-role`` choices.  Both deploy fine and silently run the
        fleet fused — role discovery returns None for every pod.
+SC708  the autoscaling PromQL contract is broken: a
+       ``tpu:``/``tpu_router:`` family referenced by an
+       ``observability/*.yaml`` surface or a helm HPA template does not
+       exist in ``metric_registry.py`` (renamed or never emitted — the
+       adapter rule matches nothing and the HPA silently never scales);
+       or an HPA custom-metric name is not the ``as:`` rename of any
+       prometheus-adapter rule (the custom metrics API would 404 it).
 
 All YAML parsing is the stdlib-only subset parser (miniyaml.py); no
 template is rendered — the checks read the template source directly, so
@@ -392,8 +399,99 @@ def _check_role_contract(
     return out
 
 
+# HPA custom-metric reference: `metric:` followed by its `name:` key.
+_HPA_METRIC_NAME_RE = re.compile(
+    r"metric:\s*\n\s*name:\s*\"?([A-Za-z0-9_:-]+)\"?"
+)
+# prometheus-adapter rename: the `as:` key inside a rule's name block.
+_ADAPTER_AS_RE = re.compile(r"^\s*as:\s*\"?([A-Za-z0-9_]+)\"?\s*$")
+
+
+def _check_promql_registry(cfg: C.Config) -> List[Violation]:
+    """SC708 — see module docstring.  Skips silently when the tree has
+    no metric registry (fixture trees exercising only SC70x)."""
+    out: List[Violation] = []
+    reg_path = cfg.resolve(cfg.registry_path)
+    if reg_path is None or not reg_path.exists():
+        return out
+    from tools.stackcheck.rules_metrics import (
+        FAMILY_RE,
+        _normalize,
+        parse_registry,
+    )
+
+    registry = parse_registry(reg_path)
+
+    adapter_names: Set[str] = set()
+    adapter_rel = cfg.prom_adapter_path
+    adapter_path = cfg.resolve(adapter_rel)
+    if adapter_path is not None and adapter_path.exists():
+        for line in adapter_path.read_text().splitlines():
+            m = _ADAPTER_AS_RE.match(line)
+            if m is not None:
+                adapter_names.add(m.group(1))
+
+    surfaces = list(cfg.observability_yaml_paths) + list(cfg.hpa_template_paths)
+    for rel in surfaces:
+        path = cfg.resolve(rel)
+        if path is None or not path.exists():
+            continue
+        text = path.read_text()
+        lines = text.splitlines()
+        # (a) every referenced family must exist in the registry.
+        seen: Set[str] = set()
+        for i, line in enumerate(lines):
+            for fam in FAMILY_RE.findall(line):
+                if fam in seen:
+                    continue
+                seen.add(fam)
+                if _normalize(fam, registry) in registry:
+                    continue
+                if _yaml_allowed(lines, i + 1, "SC708"):
+                    continue
+                out.append(Violation(
+                    rule="SC708", file=rel, line=i + 1,
+                    qualname="autoscaling",
+                    message=(
+                        f"`{fam}` is not a registered metric family "
+                        f"({cfg.registry_path}) — the adapter rule/query "
+                        "matches nothing and the HPA silently never "
+                        "scales (renamed family, or missing registry "
+                        "entry)"
+                    ),
+                    detail=fam,
+                ))
+        # (b) every HPA custom-metric name must be an adapter `as:`
+        # rename (only stack-owned `tpu*` names are checked — resource
+        # metrics like cpu are out of scope).
+        if not adapter_names:
+            continue
+        for m in _HPA_METRIC_NAME_RE.finditer(text):
+            name = m.group(1)
+            if not name.startswith("tpu"):
+                continue
+            if name in adapter_names:
+                continue
+            line = text[: m.start()].count("\n") + 2  # the `name:` line
+            if _yaml_allowed(lines, line, "SC708"):
+                continue
+            out.append(Violation(
+                rule="SC708", file=rel, line=line,
+                qualname="autoscaling",
+                message=(
+                    f"HPA references custom metric `{name}` but no "
+                    f"prometheus-adapter rule in {adapter_rel} exposes "
+                    "it (`as:` rename missing) — the custom metrics API "
+                    "404s and the HPA silently never scales"
+                ),
+                detail=f"hpa:{name}",
+            ))
+    return out
+
+
 def check_deployment(cfg: C.Config) -> List[Violation]:
     out: List[Violation] = []
+    out.extend(_check_promql_registry(cfg))
     values_path = cfg.resolve(cfg.helm_values_path)
     if values_path is None or not values_path.exists():
         return out  # no chart in this tree: nothing to check
