@@ -407,22 +407,35 @@ class SchedulerConfig:
     # per-row stop masking), so the per-token host round-trip is
     # amortized K-fold.  Batches using logprobs / logit_bias / guided
     # decoding (host-visible per-token state) fall back to single-step
-    # per dispatch (tpu:multistep_fallback_total).  None = auto (ON
-    # unless speculative decoding is active); False
-    # (--no-multi-step-window) restores single-token stepping exactly
-    # (greedy parity asserted in tests/test_multistep_window.py).
+    # per dispatch (tpu:multistep_fallback_total).  With
+    # speculative_ngram set, the n-gram drafter runs INSIDE the window
+    # scan (spec_window_enabled).  None = auto (ON); False
+    # (--no-multi-step-window) restores single-token stepping exactly —
+    # and, with speculative_ngram, the legacy host-side speculative path
+    # (greedy parity asserted in tests/test_multistep_window.py and
+    # tests/test_speculative.py).
     multi_step_window: Optional[bool] = None
     # Window size K for multi_step_window (compiled-shape inventory grows
     # by one scan executable per decode bucket; scan compile cost is
     # ~independent of K).
     decode_window: int = 8
-    # N-gram (prompt-lookup) speculative decoding: draft K tokens by
-    # matching the sequence's own trailing bigram against its history and
-    # verify them in ONE forward (the K+1 rows share the step's weight
-    # streaming, so accepted drafts are nearly free on an HBM-bound
-    # decode).  Greedy-only; batches with sampling/penalties/logprobs/
-    # bias/guided members fall back to classic stepping.  0 = off.
-    # Mutually exclusive with num_scheduler_steps > 1.
+    # N-gram (prompt-lookup) speculative decoding: draft up to this many
+    # tokens by matching the sequence's trailing bigram against its own
+    # recent history and verify them alongside the committed token in
+    # ONE forward (the draft rows share the step's weight streaming, so
+    # accepted drafts are nearly free on an HBM-bound decode).  With the
+    # K-step decode window active (the default) the drafter runs INSIDE
+    # the window scan: drafts are proposed on-device from the carried
+    # history, verified in the same scan-iteration forward, and
+    # acceptance folds into the carried state — a rejected draft costs a
+    # scan iteration, never a host round-trip.  Greedy-only (acceptance
+    # compares the model's own argmax); batches with sampled rows run
+    # the plain window, and logprobs/logit_bias/guided rows fall back to
+    # single-step like any other window batch.  With
+    # multi_step_window=False the LEGACY host-side speculative path runs
+    # instead (drafts built on the host, one wide verify dispatch per
+    # step — the A/B baseline and the fallback the host-state rows use).
+    # 0 = off.
     speculative_ngram: int = 0
     # Bounded admission (overload protection): once the waiting queue
     # holds this many requests (or prompt tokens), the API server rejects
@@ -451,43 +464,45 @@ class SchedulerConfig:
     # host-state sampling features fall back per step, and K-step windows
     # chain through the device-resident window carry (done/penalty state
     # rides along, so stopped rows stay frozen in the successor).
-    # None = auto (ON unless speculative decoding is active); explicit
-    # True conflicts with speculative_ngram; False forces synchronous
-    # stepping.
+    # None = auto (ON unless the LEGACY host-side speculative path is
+    # active — speculative_ngram with the window disabled — whose wide
+    # verify dispatch is synchronous); explicit True conflicts with that
+    # legacy combination; False forces synchronous stepping.
     pipeline_decode: Optional[bool] = None
 
     def __post_init__(self):
-        if self.speculative_ngram and self.num_scheduler_steps > 1:
-            raise ValueError(
-                "speculative_ngram and num_scheduler_steps > 1 are mutually "
-                "exclusive (both widen the per-dispatch token window)"
-            )
         if self.speculative_ngram < 0:
             raise ValueError("speculative_ngram must be >= 0")
         if self.decode_window < 1:
             raise ValueError("decode_window must be >= 1")
-        if self.multi_step_window and self.speculative_ngram:
-            raise ValueError(
-                "multi_step_window and speculative_ngram are mutually "
-                "exclusive (both widen the per-dispatch token window); "
-                "auto mode resolves the window off under speculation"
-            )
         if self.num_scheduler_steps > 1 and self.multi_step_window is False:
             raise ValueError(
                 "num_scheduler_steps > 1 requests a K-step decode window "
                 "but multi_step_window=False disables the window machinery "
                 "that runs it; drop one of the two"
             )
-        if self.pipeline_decode and self.speculative_ngram:
+        # speculative_ngram COMPOSES with multi_step_window /
+        # num_scheduler_steps / pipeline_decode / mixed_batch: the
+        # drafter runs inside the window scan (draft-and-verify per scan
+        # iteration, acceptance folded into the carried state).  Only
+        # the LEGACY host-side speculative path — speculative_ngram with
+        # the window explicitly disabled — keeps the old conflicts: its
+        # wide verify dispatch is synchronous and one-plan-shaped.
+        legacy_spec = bool(self.speculative_ngram) and self.window_steps == 1
+        if self.pipeline_decode and legacy_spec:
             raise ValueError(
-                "pipeline_decode is mutually exclusive with "
-                "speculative_ngram (both restructure the per-step dispatch)"
+                "pipeline_decode requires the fused speculative window; "
+                "the legacy host-side speculative path (speculative_ngram "
+                "with multi_step_window=False) dispatches synchronously — "
+                "drop --no-multi-step-window or --no-pipeline-decode"
             )
-        if self.mixed_batch and self.speculative_ngram:
+        if self.mixed_batch and legacy_spec:
             raise ValueError(
-                "mixed_batch is mutually exclusive with speculative_ngram "
-                "(mixed steps assume one decode token per sequence per "
-                "dispatch)"
+                "mixed_batch requires the fused speculative window; the "
+                "legacy host-side speculative path (speculative_ngram "
+                "with multi_step_window=False) assumes one plan shape per "
+                "dispatch — drop --no-multi-step-window or "
+                "--no-mixed-batch"
             )
         if not self.prefill_chunk_buckets:
             raise ValueError("prefill_chunk_buckets must be non-empty")
@@ -517,10 +532,11 @@ class SchedulerConfig:
     def window_steps(self) -> int:
         """Resolved K-step decode-window size: iterations a pure-decode
         plan may fuse into one device dispatch.  1 = single-token steps
-        (window off / speculative active); num_scheduler_steps > 1 keeps
-        its legacy meaning as an explicit window size."""
-        if self.speculative_ngram:
-            return 1
+        (window off); num_scheduler_steps > 1 keeps its legacy meaning
+        as an explicit window size.  Speculation no longer resolves the
+        window off — the drafter runs INSIDE the scan (spec_window_enabled);
+        only the explicit multi_step_window=False escape hatch restores
+        the legacy host-side speculative path."""
         if self.multi_step_window is False:
             return 1
         if self.num_scheduler_steps > 1:
@@ -528,25 +544,47 @@ class SchedulerConfig:
         return max(1, self.decode_window)
 
     @property
+    def spec_window_enabled(self) -> bool:
+        """The fused draft-and-verify path: n-gram speculation proposed,
+        verified, and folded INSIDE the K-step window scan.  False means
+        either no speculation, or the legacy host-side speculative path
+        (speculative_ngram with multi_step_window=False)."""
+        return bool(self.speculative_ngram) and self.window_steps > 1
+
+    @property
+    def window_max_tokens(self) -> int:
+        """Per-pure-decode-window token ceiling a single row may emit:
+        K iterations, each committing one token plus up to
+        speculative_ngram accepted drafts under the fused path.  THE
+        bound the scheduler budgets block allocation and max_model_len
+        room against (max-acceptance growth), and the engine sizes the
+        chained-window block-table delta from."""
+        if self.spec_window_enabled:
+            return self.window_steps * (self.speculative_ngram + 1)
+        return self.window_steps
+
+    @property
     def pipeline_enabled(self) -> bool:
-        """Resolved pipeline gate: auto (None) turns on unless
-        speculative decoding owns the dispatch shape.  K-step windows
-        chain through the same pipeline (window N+1 dispatched off
-        window N's device-resident carry)."""
+        """Resolved pipeline gate: auto (None) turns on unless the
+        LEGACY host-side speculative path owns the dispatch shape
+        (fused speculative windows chain through the pipeline like any
+        window: N+1 dispatched off window N's device-resident carry,
+        draft history included)."""
         if self.pipeline_decode is None:
-            return not self.speculative_ngram
+            return not (self.speculative_ngram and self.window_steps == 1)
         return self.pipeline_decode
 
     @property
     def mixed_enabled(self) -> bool:
-        """Resolved mixed-step gate: auto (None) turns on unless
-        speculative decoding is active (mixed steps coexist with K-step
-        windows: the scheduler picks K=1 mixed steps while a prompt
-        waits and K>1 pure-decode windows otherwise).  The engine
-        additionally clears ``mixed_batch`` when the mesh has a dp/sp
-        axis (the packed mixed batch is not dp/sp-shardable)."""
+        """Resolved mixed-step gate: auto (None) turns on unless the
+        LEGACY host-side speculative path is active (mixed steps coexist
+        with K-step windows — speculative or not: the scheduler picks
+        K=1 mixed steps while a prompt waits and K>1 pure-decode windows
+        otherwise).  The engine additionally clears ``mixed_batch`` when
+        the mesh has a dp/sp axis (the packed mixed batch is not
+        dp/sp-shardable)."""
         if self.mixed_batch is None:
-            return not self.speculative_ngram
+            return not (self.speculative_ngram and self.window_steps == 1)
         return self.mixed_batch
 
     @property

@@ -91,8 +91,13 @@ class _PendingStep:
     sampled: Optional[object] = None  # jax.Array [S] or [K, S], uncollected
     is_decode: bool = False
     host_s: float = 0.0  # host time spent dispatching this step
-    steps: Optional[List[int]] = None  # per-row window budgets (windows)
+    steps: Optional[List[int]] = None  # per-row window TOKEN budgets (windows)
     win_state: Optional[dict] = None  # device window carry (windows)
+    # Fused speculative windows: ``sampled`` is [K, W, S] (W = ngram + 1
+    # sub-steps per scan iteration) and ``spec_stats`` the still-in-flight
+    # (drafted [K, S], accepted [K, S]) device counters collect() folds
+    # into tpu:spec_tokens_* and tpu:spec_window_tokens_total.
+    spec_stats: Optional[tuple] = None
 
 
 class LLMEngine:
@@ -309,6 +314,10 @@ class LLMEngine:
         # N's still-in-flight state (pipelined windows).
         self._window_fn = None
         self._window_steps = config.scheduler.window_steps
+        # Per-window per-row token ceiling (max-acceptance growth under
+        # the fused speculative window): sizes the chained-window
+        # block-table delta and mirrors the scheduler's block budget.
+        self._window_max_tokens = config.scheduler.window_max_tokens
         if self._window_steps > 1:
             model_decode = partial(self.model.decode, cfg=cfg, mesh=self.mesh)
             bs = config.cache.block_size
@@ -440,6 +449,255 @@ class LLMEngine:
                 donate_argnames=("kv_caches",),
             )
 
+        # Fused n-gram speculation INSIDE the K-step window scan (the
+        # ROADMAP item-1 plan fusion): each scan iteration proposes up
+        # to `speculative_ngram` draft tokens on-device from a carried
+        # recent-history buffer (prompt lookup: most recent earlier
+        # occurrence of the trailing bigram), verifies them in the SAME
+        # forward by scoring the draft positions alongside the committed
+        # token (W = ngram+1 rows per sequence — the host speculative
+        # path's expanded-batch layout, now inside the scan), and folds
+        # acceptance into the carried state.  A rejected draft costs a
+        # scan iteration, never a host round-trip; accepted tokens
+        # advance the row's position/KV cursor inside the window.
+        # Greedy-only (acceptance compares the model's own argmax, so
+        # greedy streams are byte-identical by construction); penalties,
+        # the min_tokens floor and stop masking apply to EVERY accepted
+        # token sequentially through the same apply_penalties_state /
+        # stop-mask code the single-step path uses.
+        self._spec_window_fn = None
+        if self._window_steps > 1 and config.scheduler.spec_window_enabled:
+            model_decode = partial(self.model.decode, cfg=cfg, mesh=self.mesh)
+            bs = config.cache.block_size
+            n_steps = self._window_steps
+            vocab = cfg.vocab_size
+            D = config.scheduler.speculative_ngram  # drafts per iteration
+            W = D + 1  # verify rows per sequence (committed + drafts)
+            H = self._SPEC_HIST_WINDOW
+
+            def spec_window(
+                params, tokens, positions, ctx_lens, done, min_left,
+                block_tables, max_steps, kv_caches,
+                stop_ids, counts, seen, hist,
+                presence, frequency, repetition,
+                use_penalties, use_min_floor,
+                lora=None, adapter_idx=None,
+            ):
+                stop_valid = stop_ids >= 0
+                stop_mask = None
+                if use_min_floor:
+                    stop_mask = jax.vmap(
+                        lambda ids, v: jnp.zeros(
+                            (vocab,), jnp.bool_
+                        ).at[jnp.where(v, ids, 0)].max(v)
+                    )(stop_ids, stop_valid)
+                bmax = block_tables.shape[1]
+                if lora is not None:
+                    wide_adapter = jnp.repeat(adapter_idx, W)
+
+                def body(carry, t):
+                    (tokens, positions, ctx_lens, done, min_left,
+                     emitted_cnt, counts, seen, hist, kv_caches) = carry
+                    # Budget gate is the TOKEN count, not the iteration
+                    # index: acceptance advances a row several tokens
+                    # per iteration and max_steps budgets the
+                    # max-acceptance growth the scheduler allocated
+                    # blocks for.
+                    active = jnp.logical_and(~done, emitted_cnt < max_steps)
+
+                    # -- on-device prompt-lookup draft ------------------
+                    # Most recent earlier occurrence of the trailing
+                    # bigram within the carried [S, H] history (left
+                    # -1-padded, hist[:, -1] == the committed token);
+                    # the tokens that followed it are the draft.  No
+                    # bigram hit falls back to the most recent UNIGRAM
+                    # occurrence of the committed token: the verify rows
+                    # are computed either way (static shapes), so a
+                    # speculative proposal is free and a rejected one
+                    # costs nothing the empty iteration didn't.
+                    key0 = hist[:, H - 2][:, None]
+                    key1 = hist[:, H - 1][:, None]
+                    starts = jnp.arange(H - 2)
+                    match2 = jnp.logical_and(
+                        jnp.logical_and(
+                            hist[:, : H - 2] == key0,
+                            hist[:, 1 : H - 1] == key1,
+                        ),
+                        hist[:, : H - 2] >= 0,
+                    )
+                    best2 = jnp.max(
+                        jnp.where(match2, starts[None, :], -1), axis=1
+                    )
+                    match1 = jnp.logical_and(
+                        hist[:, 1 : H - 1] == key1, hist[:, 1 : H - 1] >= 0
+                    )
+                    best1 = jnp.max(
+                        jnp.where(match1, starts[None, :], -1), axis=1
+                    )
+                    best = jnp.where(best2 >= 0, best2, best1)
+                    dpos = best[:, None] + 2 + jnp.arange(D)[None, :]
+                    draft = jnp.take_along_axis(
+                        hist, jnp.clip(dpos, 0, H - 1), axis=1
+                    )
+                    # Room for drafts: the bonus/correction token always
+                    # takes one budget slot, drafts fill the rest.
+                    room = jnp.maximum(max_steps - emitted_cnt - 1, 0)
+                    dvalid = (
+                        (best >= 0)[:, None]
+                        & (dpos < H)
+                        & (draft >= 0)
+                        & (jnp.arange(D)[None, :] < room[:, None])
+                        & active[:, None]
+                    )
+                    # Only a contiguous prefix is verifiable.
+                    dvalid = jnp.cumsum(
+                        jnp.where(dvalid, 0, 1), axis=1
+                    ) == 0
+                    draft = jnp.where(dvalid, draft, 0)
+                    nd = dvalid.sum(axis=1).astype(jnp.int32)
+
+                    # -- one wide verify forward ------------------------
+                    # Row j of sequence i consumes chain[j] at position
+                    # pos+j with ctx pos+j+1 — exactly the host
+                    # speculative layout, so the shared decode kernel's
+                    # write-then-attend order makes draft rows see their
+                    # predecessors' KV.  Dead rows park KV on null
+                    # block 0 (never corrupt a live slot).
+                    chain = jnp.concatenate([tokens[:, None], draft], axis=1)
+                    row_live = jnp.concatenate(
+                        [active[:, None], dvalid], axis=1
+                    )
+                    offs = jnp.arange(W)[None, :]
+                    wpos = positions[:, None] + offs
+                    wctx = ctx_lens[:, None] + offs
+                    blk = jnp.take_along_axis(
+                        block_tables,
+                        jnp.clip(wpos // bs, 0, bmax - 1),
+                        axis=1,
+                    )
+                    extra = (
+                        {"lora": lora, "adapter_idx": wide_adapter}
+                        if lora is not None else {}
+                    )
+                    logits, kv_caches = model_decode(
+                        params,
+                        tokens=chain.reshape(-1),
+                        positions=jnp.where(row_live, wpos, 0).reshape(-1),
+                        block_tables=jnp.repeat(block_tables, W, axis=0),
+                        ctx_lens=jnp.where(row_live, wctx, 0).reshape(-1),
+                        slot_block_ids=jnp.where(
+                            row_live, blk, 0
+                        ).reshape(-1),
+                        slot_offsets=(wpos % bs).reshape(-1),
+                        kv_caches=kv_caches,
+                        **extra,
+                    )
+                    # No dtype cast: the verify rows must see EXACTLY the
+                    # logits the single-row path would (lm_head already
+                    # emits fp32), or greedy parity could drift.
+                    logits = logits.reshape(tokens.shape[0], W, vocab)
+
+                    # -- sequential verify: penalties / min-floor / stop
+                    # applied to every accepted token in order, through
+                    # the SAME apply_penalties_state call site the
+                    # single-step path uses (the PR-8 one-call-site
+                    # rule), so streams are byte-identical.
+                    rows = jnp.arange(tokens.shape[0])
+                    alive = active
+                    last_tok = tokens
+                    adv = jnp.zeros_like(positions)
+                    acc_cnt = jnp.zeros_like(positions)
+                    new_done = done
+                    emits = []
+                    for j in range(W):
+                        lj = logits[:, j, :]
+                        if use_penalties:
+                            lj = sampling_lib.apply_penalties_state(
+                                lj, counts, seen,
+                                presence, frequency, repetition,
+                            )
+                        if use_min_floor:
+                            bias = (
+                                jnp.logical_and(
+                                    stop_mask, (min_left > 0)[:, None]
+                                ).astype(jnp.float32) * -1e9
+                            )
+                            lj = lj + bias
+                        tok_j = jnp.argmax(lj, axis=-1).astype(jnp.int32)
+                        stop_hit = jnp.logical_and(
+                            alive,
+                            jnp.any(
+                                jnp.logical_and(
+                                    tok_j[:, None] == stop_ids, stop_valid
+                                ),
+                                axis=1,
+                            ),
+                        )
+                        emits.append(jnp.where(alive, tok_j, -1))
+                        appended = jnp.logical_and(alive, ~stop_hit)
+                        if use_penalties:
+                            counts = counts.at[rows, tok_j].add(
+                                appended.astype(jnp.int16)
+                            )
+                            seen = seen.at[rows, tok_j].max(appended)
+                        step = alive.astype(jnp.int32)
+                        adv = adv + step
+                        min_left = jnp.maximum(min_left - step, 0)
+                        last_tok = jnp.where(alive, tok_j, last_tok)
+                        new_done = jnp.logical_or(new_done, stop_hit)
+                        if j < W - 1:
+                            agree = jnp.logical_and(
+                                dvalid[:, j], tok_j == draft[:, j]
+                            )
+                            acc = jnp.logical_and(appended, agree)
+                            acc_cnt = acc_cnt + acc.astype(jnp.int32)
+                            alive = acc
+                    emitted = jnp.stack(emits, axis=0)  # [W, S]
+
+                    # -- fold acceptance into the carried state ---------
+                    # (history shifts by the emitted count so the next
+                    # iteration's bigram lookup sees the new tokens).
+                    cat = jnp.concatenate(
+                        [hist, jnp.maximum(emitted.T, 0)], axis=1
+                    )
+                    hidx = jnp.arange(H)[None, :] + adv[:, None]
+                    hist = jnp.take_along_axis(cat, hidx, axis=1)
+                    return (
+                        jnp.where(active, last_tok, tokens),
+                        positions + adv,
+                        ctx_lens + adv,
+                        new_done,
+                        min_left,
+                        emitted_cnt + adv,
+                        counts, seen, hist, kv_caches,
+                    ), (emitted, nd, acc_cnt)
+
+                carry, ys = jax.lax.scan(
+                    body,
+                    (tokens, positions, ctx_lens, done, min_left,
+                     jnp.zeros_like(positions), counts, seen, hist,
+                     kv_caches),
+                    jnp.arange(n_steps),
+                )
+                (tokens, positions, ctx_lens, done, min_left, _cnt,
+                 counts, seen, hist, kv_caches) = carry
+                emitted, drafted, accepted = ys  # [K, W, S], [K, S], [K, S]
+                state = {
+                    "tokens": tokens, "positions": positions,
+                    "ctx_lens": ctx_lens, "done": done,
+                    "min_left": min_left, "counts": counts, "seen": seen,
+                    "hist": hist,
+                }
+                return emitted, drafted, accepted, state, kv_caches
+
+            self._spec_window_fn = jax.jit(
+                spec_window,
+                static_argnames=("use_penalties", "use_min_floor"),
+                donate_argnames=("kv_caches",),
+            )
+
+        if self._window_steps > 1:
+
             def win_advance(tables, cols, vals):
                 """Chained-window block-table growth: scatter up to C new
                 blocks per row into the device-resident table (col -1 =
@@ -461,9 +719,19 @@ class LLMEngine:
         self._argmax_fn = jax.jit(
             lambda logits: jnp.argmax(logits, axis=-1).astype(jnp.int32)
         )
-        # N-gram speculative decoding effectiveness counters.
+        # N-gram speculative decoding effectiveness counters (fed by
+        # BOTH the legacy host-side path and the fused window path).
         self.spec_tokens_drafted = 0
         self.spec_tokens_accepted = 0
+        # Fused speculative-window outcomes per collected window
+        # (tpu:spec_window_tokens_total{outcome}): draft tokens the
+        # verifier accepted / rejected inside windows, and window tokens
+        # emitted by the fused path but undeliverable at collect
+        # (abort / out-of-band finish mid-window).  Step-thread-only
+        # writer, like the multistep counters.
+        self.spec_window_tokens: Dict[str, int] = {
+            "accepted": 0, "rejected": 0, "wasted": 0,
+        }
         self._logprobs_fn = jax.jit(
             sampling_lib.top_logprobs_of, static_argnames=("k",)
         )
@@ -975,12 +1243,16 @@ class LLMEngine:
     # the boundary crossing.
     @staticmethod
     def _host_state_flags(seq: Sequence):
-        """(window_fallback, classic_fallback) cached verdicts.
+        """(window_fallback, classic_fallback, greedy) cached verdicts.
         window_fallback: features the K-step window cannot serve
         on-device (logprobs, logit_bias, guided — penalties and the
         min_tokens floor now run inside the scan).  classic_fallback:
         the stricter single-step-pipeline set (its sampler has no
-        penalty path)."""
+        penalty path).  greedy: temperature <= 0 — the fused
+        speculative window drafts only for all-greedy batches
+        (acceptance compares the model's own argmax; sampled batches
+        run the plain window with the classic key schedule, so seeded
+        streams stay bit-identical across window sizes)."""
         flags = getattr(seq, "_hs_flags", None)
         if flags is None:
             sp = seq.sampling_params
@@ -992,7 +1264,7 @@ class LLMEngine:
                 or sp.frequency_penalty
                 or sp.repetition_penalty != 1.0
             )
-            seq._hs_flags = flags = (window, classic)
+            seq._hs_flags = flags = (window, classic, sp.temperature <= 0)
             seq._min_tok_pending = (
                 sp.min_tokens > len(seq.output_token_ids)
             )
@@ -1291,6 +1563,18 @@ class LLMEngine:
             seen = self._put(np.zeros((S, 1), bool), row_spec)
         state["counts"] = counts
         state["seen"] = seen
+        if self._spec_window_fn is not None:
+            # Carried drafting history for the fused speculative window:
+            # the last H tokens (prompt + generated), left -1-padded so
+            # hist[:, -1] is always the committed last token.  The scan
+            # appends accepted tokens on-device; only a batch rebuild
+            # retransfers it.
+            H = self._SPEC_HIST_WINDOW
+            hist = np.full((S, H), -1, np.int32)
+            for i, s in enumerate(seqs):
+                ids = s.all_token_ids[-H:]
+                hist[i, H - len(ids):] = ids
+            state["hist"] = self._put(hist, row_spec)
         if self.lora_registry is not None:
             adapter = np.zeros((S,), np.int32)
             for i, seq in enumerate(seqs):
@@ -1312,8 +1596,10 @@ class LLMEngine:
         max_steps[: len(steps)] = steps
         state["max_steps"] = self._put(max_steps, batch_spec)
         # Fixed delta width: retraces would otherwise key on how many
-        # blocks happened to be crossed this window.
-        C = self._window_steps // self.block_pool.block_size + 2
+        # blocks happened to be crossed this window.  Sized for the
+        # MAX-ACCEPTANCE growth — a fused speculative window can land
+        # K x (ngram + 1) tokens, not K.
+        C = self._window_max_tokens // self.block_pool.block_size + 2
         cols = np.full((S, C), -1, np.int32)
         vals = np.zeros((S, C), np.int32)
         for i, seq in enumerate(seqs):
@@ -1354,60 +1640,107 @@ class LLMEngine:
                 "lora": self.lora_registry.params,
                 "adapter_idx": state["adapter"],
             }
-        emitted, out_state, self.kv_caches = self._window_fn(
-            self.params,
-            tokens=state["tokens"],
-            positions=state["positions"],
-            ctx_lens=state["ctx_lens"],
-            done=state["done"],
-            min_left=state["min_left"],
-            block_tables=state["tables"],
-            max_steps=state["max_steps"],
-            kv_caches=self.kv_caches,
-            temps=state["temps"],
-            top_ps=state["top_ps"],
-            top_ks=state["top_ks"],
-            min_ps=state["min_ps"],
-            seq_seeds=state["seeds"],
-            stop_ids=state["stop_ids"],
-            # Masked to 31 bits: a long-lived engine's monotone step
-            # counter would otherwise overflow the host->int32 cast and
-            # kill the step thread.  Below 2**31 key ordinals (years of
-            # serving) the schedule is bit-identical to single-token
-            # stepping; past it, +t wraps in-graph, which PRNGKey treats
-            # as bits — still deterministic across lockstep replicas.
-            key_base=jnp.int32(
-                (self.config.seed + self._step_counter) & 0x7FFFFFFF
-            ),
-            counts=state["counts"],
-            seen=state["seen"],
-            presence=state["presence"],
-            frequency=state["frequency"],
-            repetition=state["repetition"],
-            use_penalties=state["use_penalties"],
-            use_min_floor=state["use_min_floor"],
-            **lora_kwargs,
-        )
-        # One key ordinal per iteration: single-token stepping would have
-        # burned exactly these counter values for the same tokens.
-        self._step_counter += self._window_steps
+        # The fused speculative window drafts only for all-greedy
+        # batches (acceptance compares the model's own argmax); a batch
+        # with sampled rows runs the PLAIN window below with the classic
+        # per-iteration key schedule, so seeded streams stay
+        # bit-identical across window sizes with speculation configured.
+        spec_stats = None
+        if self._spec_window_fn is not None and all(
+            self._host_state_flags(s)[2] for s in seqs
+        ):
+            emitted, drafted, accepted, out_state, self.kv_caches = (
+                self._spec_window_fn(
+                    self.params,
+                    tokens=state["tokens"],
+                    positions=state["positions"],
+                    ctx_lens=state["ctx_lens"],
+                    done=state["done"],
+                    min_left=state["min_left"],
+                    block_tables=state["tables"],
+                    max_steps=state["max_steps"],
+                    kv_caches=self.kv_caches,
+                    stop_ids=state["stop_ids"],
+                    counts=state["counts"],
+                    seen=state["seen"],
+                    hist=state["hist"],
+                    presence=state["presence"],
+                    frequency=state["frequency"],
+                    repetition=state["repetition"],
+                    use_penalties=state["use_penalties"],
+                    use_min_floor=state["use_min_floor"],
+                    **lora_kwargs,
+                )
+            )
+            spec_stats = (drafted, accepted)
+            # Greedy argmax consumes no PRNG ordinals; the counter still
+            # advances one per iteration (deterministic on every
+            # lockstep replica — acceptance is a pure function of the
+            # shared weights and carried state, never of wall clock).
+            self._step_counter += self._window_steps
+        else:
+            emitted, out_state, self.kv_caches = self._window_fn(
+                self.params,
+                tokens=state["tokens"],
+                positions=state["positions"],
+                ctx_lens=state["ctx_lens"],
+                done=state["done"],
+                min_left=state["min_left"],
+                block_tables=state["tables"],
+                max_steps=state["max_steps"],
+                kv_caches=self.kv_caches,
+                temps=state["temps"],
+                top_ps=state["top_ps"],
+                top_ks=state["top_ks"],
+                min_ps=state["min_ps"],
+                seq_seeds=state["seeds"],
+                stop_ids=state["stop_ids"],
+                # Masked to 31 bits: a long-lived engine's monotone step
+                # counter would otherwise overflow the host->int32 cast
+                # and kill the step thread.  Below 2**31 key ordinals
+                # (years of serving) the schedule is bit-identical to
+                # single-token stepping; past it, +t wraps in-graph,
+                # which PRNGKey treats as bits — still deterministic
+                # across lockstep replicas.
+                key_base=jnp.int32(
+                    (self.config.seed + self._step_counter) & 0x7FFFFFFF
+                ),
+                counts=state["counts"],
+                seen=state["seen"],
+                presence=state["presence"],
+                frequency=state["frequency"],
+                repetition=state["repetition"],
+                use_penalties=state["use_penalties"],
+                use_min_floor=state["use_min_floor"],
+                **lora_kwargs,
+            )
+            # One key ordinal per iteration: single-token stepping would
+            # have burned exactly these counter values for the same
+            # tokens.
+            self._step_counter += self._window_steps
         state.update(out_state)
         # stackcheck: allow=SC201 reason=host_s is a stats field (host-gap metric); no plan state reads it
         return _PendingStep(
             seqs=list(seqs), sampled=emitted, is_decode=True,
             host_s=time.time() - t0, steps=list(decode.steps),
-            win_state=state,
+            win_state=state, spec_stats=spec_stats,
         )
 
     def _collect_window(self, p: _PendingStep, t0: float) -> List[StepOutput]:
-        """Read one window's [K, S] emitted tokens back and replay them
-        through the single finish protocol, iteration by iteration —
-        exactly the per-token path single stepping takes, so streams are
-        identical.  Device-frozen rows emit -1 (their stop already
-        retired) and cost nothing; emitted tokens that can no longer be
-        delivered (their sequence aborted / finished out-of-band while
-        the window flew) are counted as multistep waste."""
-        arr = np.asarray(p.sampled)  # [K, S] — the ONE device sync point
+        """Read one window's emitted tokens back ([K, S] plain, or
+        [K, W, S] from the fused speculative scan — flattened to the
+        chronological [K*W, S] token order) and replay them through the
+        single finish protocol, token by token — exactly the per-token
+        path single stepping takes, so streams are identical.
+        Device-frozen rows emit -1 (their stop already retired) and cost
+        nothing; emitted tokens that can no longer be delivered (their
+        sequence aborted / finished out-of-band while the window flew)
+        are counted as multistep waste.  Fused windows additionally
+        account drafted / accepted / wasted speculation per window."""
+        arr = np.asarray(p.sampled)  # the ONE device sync point
+        spec = p.spec_stats is not None
+        if arr.ndim == 3:
+            arr = arr.reshape(-1, arr.shape[-1])  # [K*W, S], in order
         if self.obs.enabled:
             self.obs.step_phase("collect", time.time() - t0)
         t_post = time.time()
@@ -1418,17 +1751,22 @@ class LLMEngine:
             batch = []
             toks = []
             for i, s in alive:
-                if t >= p.steps[i]:
-                    continue
+                if delivered[i] >= p.steps[i]:
+                    continue  # token budget exhausted (belt and braces)
                 tok = int(arr[t, i])
                 if tok < 0:
                     continue  # frozen row: stop-mask spent no token here
                 batch.append((i, s))
                 toks.append(tok)
             if not batch:
-                # done/budget masks are monotone within a window: no row
-                # can re-activate at a later iteration.
-                break
+                if not spec:
+                    # done/budget masks are monotone within a plain
+                    # window: no row can re-activate later.
+                    break
+                # Fused windows interleave -1 gaps per iteration (a row
+                # that accepted fewer drafts than a neighbor pads its
+                # sub-steps), so an empty slice is NOT terminal.
+                continue
             outs = self._append_and_check(
                 [s for _, s in batch], toks, first_token=False
             )
@@ -1444,10 +1782,21 @@ class LLMEngine:
         # stops contribute zero by construction.
         wasted = 0
         for i in range(len(p.seqs)):
-            k = min(p.steps[i], arr.shape[0])
-            wasted += int((arr[:k, i] >= 0).sum()) - delivered[i]
+            wasted += int((arr[:, i] >= 0).sum()) - delivered[i]
         if wasted:
             self.multistep_wasted_tokens += wasted
+        if spec:
+            # Per-window speculation accounting: drafted/accepted feed
+            # the existing acceptance-rate counters; the outcome split
+            # (accepted / rejected / wasted) is the fused family.
+            n = len(p.seqs)
+            drafted = int(np.asarray(p.spec_stats[0])[:, :n].sum())
+            accepted = int(np.asarray(p.spec_stats[1])[:, :n].sum())
+            self.spec_tokens_drafted += drafted
+            self.spec_tokens_accepted += accepted
+            self.spec_window_tokens["accepted"] += accepted
+            self.spec_window_tokens["rejected"] += drafted - accepted
+            self.spec_window_tokens["wasted"] += wasted
         if self.obs.enabled:
             self.obs.step_phase("sample", time.time() - t_post)
         return outputs
@@ -2371,6 +2720,13 @@ class LLMEngine:
     # step at long contexts.
     _DRAFT_SCAN_WINDOW = 1024
 
+    # Device-resident history window the FUSED drafter matches against
+    # (a fixed [S, H] carry in the window scan — compile-time constant so
+    # the executable inventory never keys on context length).  Smaller
+    # than the host path's scan bound: the lookup is O(S*H) per scan
+    # iteration and recent repetition dominates prompt-lookup hits.
+    _SPEC_HIST_WINDOW = 128
+
     @classmethod
     def _draft_ngram(cls, seq: Sequence, k: int, n: int = 2) -> List[int]:
         """Prompt-lookup drafting: find the most recent earlier occurrence
@@ -3065,6 +3421,9 @@ class LLMEngine:
             ),
             "spec_tokens_drafted": self.spec_tokens_drafted,
             "spec_tokens_accepted": self.spec_tokens_accepted,
+            # Fused speculative windows: per-window outcome split
+            # (accepted / rejected draft tokens, wasted emissions).
+            "spec_window_tokens": dict(self.spec_window_tokens),
             # K-step decode windows: single-step fallbacks by reason and
             # emitted-but-undeliverable window tokens.
             "multistep_fallback": dict(self.multistep_fallback),

@@ -48,11 +48,12 @@ class PrefillPlan:
 @dataclasses.dataclass
 class DecodePlan:
     seqs: List[Sequence]  # <= max_num_seqs running sequences
-    # Per-sequence decode-iteration budget for this plan (aligned with
-    # ``seqs``).  All 1s for classic stepping; with multi-step scheduling
-    # (SchedulerConfig.num_scheduler_steps > 1) each entry is capped by the
-    # sequence's remaining room (max_model_len, max_tokens) and its blocks
-    # are pre-allocated for the whole budget.
+    # Per-sequence decode TOKEN budget for this plan (aligned with
+    # ``seqs``).  All 1s for classic stepping; for K-step windows each
+    # entry is capped by the sequence's remaining room (max_model_len,
+    # max_tokens) and its blocks are pre-allocated for the whole budget —
+    # under the fused speculative window that is the MAX-ACCEPTANCE
+    # growth K x (ngram + 1), not the iteration count.
     steps: List[int] = dataclasses.field(default_factory=list)
 
 
@@ -445,16 +446,41 @@ class Scheduler:
             is_final=is_final,
         )
 
+    def _window_token_cap(self, window: int) -> int:
+        """Per-row token ceiling for a pure-decode window plan: the
+        max-acceptance growth K x (ngram + 1) only when the fused
+        drafter can actually engage — it drafts exclusively for
+        all-greedy batches (the same temperature <= 0 predicate the
+        engine dispatches on, read from broadcast SamplingParams so
+        lockstep replicas agree) — and plain K otherwise, so sampled
+        workloads never pre-allocate blocks for drafts that cannot
+        happen."""
+        if (
+            window > 1
+            and self.config.spec_window_enabled
+            and all(
+                s.sampling_params.temperature <= 0 for s in self.running
+            )
+        ):
+            return window * (self.config.speculative_ngram + 1)
+        return window
+
     def _step_budget(self, seq: Sequence, window: int = 1) -> int:
-        """Decode iterations this sequence can run in one window (or
+        """Decode TOKENS this sequence may emit in one window (or
         speculative) plan: bounded by max_model_len and the request's
         max_tokens (stop/EOS cut shorter — the device stop-mask freezes
-        the row; a mismatching host-only condition discards on readback)."""
-        n = max(
-            window,
-            # K drafts + the bonus token per dispatch.
-            self.config.speculative_ngram + 1,
-        )
+        the row; a mismatching host-only condition discards on readback).
+        Under the fused speculative window a K-iteration plan can land
+        up to K x (ngram + 1) tokens at full acceptance, so the budget —
+        and the block pre-allocation derived from it — covers the
+        max-acceptance growth (_window_token_cap), never just the
+        iteration count."""
+        if window > 1:
+            n = self._window_token_cap(window)
+        else:
+            # Legacy host-side speculation (and K=1 passes with
+            # speculation on): K drafts + the bonus token per dispatch.
+            n = max(1, self.config.speculative_ngram + 1)
         room_len = self.config.max_model_len - seq.num_tokens
         room_out = seq.sampling_params.max_tokens - seq.num_generated
         return max(1, min(n, room_len, room_out))
@@ -516,16 +542,22 @@ class Scheduler:
             # chaining another K-step window would starve it.
             return None
         bs = self.block_pool.block_size
+        # Per-window per-row token ceiling: K x (ngram + 1) under the
+        # fused speculative window at max acceptance (all-greedy batch),
+        # K otherwise.
+        max_tok = self._window_token_cap(window)
         steps: List[int] = []
         needs: List[int] = []
         for seq, prev_k in zip(self.running, inflight_steps):
-            # The in-flight window will (optimistically) land prev_k
-            # tokens before this one runs.
+            # The in-flight window will (optimistically) land its whole
+            # prev_k token budget before this one runs (full acceptance
+            # under speculation; the device carry keeps the real count
+            # and the engine discards overrun on readback).
             base_tokens = seq.num_tokens + prev_k
             base_gen = seq.num_generated + prev_k
             room_len = self.config.max_model_len - base_tokens
             room_out = seq.sampling_params.max_tokens - base_gen
-            k = max(0, min(window, room_len, room_out))
+            k = max(0, min(max_tok, room_len, room_out))
             steps.append(k)
             slots = base_tokens + k - 1
             needs.append(max(0, -(-slots // bs) - len(seq.block_table)))

@@ -119,9 +119,11 @@ class Sequence:
     # set response_format.
     guide: Optional[object] = None
     # Cached host-state sampling verdicts (LLMEngine._host_state_flags):
-    # the (window_fallback, classic_fallback) pair is static over the
-    # request's life, so it's computed once instead of re-reading
-    # SamplingParams attribute chains on the step thread every dispatch.
+    # the (window_fallback, classic_fallback, greedy) triple is static
+    # over the request's life, so it's computed once instead of
+    # re-reading SamplingParams attribute chains on the step thread
+    # every dispatch (greedy = temperature <= 0, the fused speculative
+    # window's drafting predicate).
     # _min_tok_pending is the ONE dynamic bit — the min_tokens floor is
     # still unmet — cleared by the engine exactly at the boundary
     # crossing and re-armed when preemption empties output_token_ids.

@@ -380,6 +380,13 @@ def build_engine_app(
                     **s["multistep_fallback"],
                 },
             )
+            + vocab.render_labeled_counter(
+                vocab.TPU_SPEC_WINDOW_TOKENS, "outcome",
+                {
+                    **dict.fromkeys(vocab.TPU_SPEC_WINDOW_OUTCOMES, 0),
+                    **s["spec_window_tokens"],
+                },
+            )
             + engine.engine.obs.render_metrics()
         )
         return web.Response(text=text)
@@ -1771,8 +1778,13 @@ def main(argv=None) -> None:
         type=int,
         default=0,
         help="n-gram (prompt-lookup) speculative decoding: draft K tokens "
-        "from the sequence's own history and verify in one forward "
-        "(greedy-only; mutually exclusive with --num-scheduler-steps > 1)",
+        "from the sequence's own history and verify them alongside the "
+        "committed token in one forward.  With the K-step decode window "
+        "active (the default) the drafter runs INSIDE the window scan — "
+        "drafts proposed on-device, acceptance folded into the carried "
+        "state, a rejected draft costs a scan iteration, never a host "
+        "round-trip.  Greedy-only; with --no-multi-step-window the "
+        "legacy host-side speculative path runs instead",
     )
     parser.add_argument(
         "--num-scheduler-steps",
@@ -1790,8 +1802,9 @@ def main(argv=None) -> None:
         "decode fast path: K decode+sample iterations per device "
         "dispatch with on-device penalties, the min_tokens EOS floor "
         "and per-row stop masking) and restore single-token stepping "
-        "exactly — A/B baseline / debugging.  Auto-disabled by "
-        "--speculative-ngram",
+        "exactly — A/B baseline / debugging.  With --speculative-ngram "
+        "this is the compat escape hatch selecting the legacy host-side "
+        "speculative path",
     )
     parser.add_argument(
         "--decode-window",
@@ -1808,16 +1821,18 @@ def main(argv=None) -> None:
         help="disable the async lookahead decode pipeline (dispatch "
         "decode step or K-step window N+1 while N's tokens are in "
         "flight; greedy streams are identical, decode_host_gap_ms shows "
-        "the recovered host serialization).  Auto-disabled by "
-        "--speculative-ngram",
+        "the recovered host serialization).  Auto-disabled only by the "
+        "legacy host-side speculative path (--speculative-ngram with "
+        "--no-multi-step-window)",
     )
     parser.add_argument(
         "--no-mixed-batch",
         action="store_true",
         help="disable fused mixed prefill+decode steps (arriving prompts "
         "then stall all decoders for a full prefill bucket per step — "
-        "the pre-mixed alternating scheduler).  Auto-disabled by "
-        "--num-scheduler-steps > 1, --speculative-ngram, and dp/sp meshes",
+        "the pre-mixed alternating scheduler).  Auto-disabled by the "
+        "legacy host-side speculative path (--speculative-ngram with "
+        "--no-multi-step-window) and dp/sp meshes",
     )
     parser.add_argument(
         "--max-num-batched-tokens",

@@ -186,6 +186,15 @@ REGISTRY = {
                 "because a co-scheduled request needed host-sampled "
                 "features (reason: logprobs | logit_bias | guided)",
     },
+    "tpu:spec_window_tokens_total": {
+        "kind": "counter", "layer": "engine", "labels": ("outcome",),
+        "mirrors": ("fake_engine", "dashboard", "docs"),
+        "help": "Fused speculative-window outcomes (outcome: accepted | "
+                "rejected | wasted) — draft tokens the in-scan verifier "
+                "accepted/rejected, and fused-window tokens emitted but "
+                "undeliverable at collect; acceptance rate stays "
+                "derivable from tpu:spec_tokens_{drafted,accepted}",
+    },
     "tpu:multistep_wasted_tokens_total": {
         "kind": "counter", "layer": "engine",
         "mirrors": ("fake_engine", "dashboard", "docs"),

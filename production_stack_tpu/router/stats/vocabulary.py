@@ -124,6 +124,17 @@ TPU_KV_PREFETCH_WASTE = "tpu:kv_prefetch_waste"
 # their client deadline expired before first token.
 TPU_ADMISSION_REJECTED = "tpu:admission_rejected_total"
 TPU_DEADLINE_EXPIRED = "tpu:deadline_expired_total"
+# Fused speculative windows (scheduler speculative_ngram with the
+# K-step window active): per-window outcome split of the on-device
+# draft-and-verify — draft tokens the verifier accepted / rejected
+# inside windows, plus window tokens emitted by the fused path but
+# undeliverable at collect (abort / out-of-band finish mid-window).
+# Acceptance RATE stays derivable from tpu:spec_tokens_{drafted,
+# accepted}, which the fused path feeds alongside the legacy host path.
+TPU_SPEC_WINDOW_TOKENS = "tpu:spec_window_tokens_total"
+# The closed outcome set, pre-seeded as zero-valued series so scrapers,
+# dashboards, and rate() see stable label sets from boot.
+TPU_SPEC_WINDOW_OUTCOMES = ("accepted", "rejected", "wasted")
 # K-step decode windows (scheduler multi_step_window): dispatches that
 # fell back to single-step because a co-scheduled request needed
 # host-sampled features (labeled by reason — logprobs / logit_bias /

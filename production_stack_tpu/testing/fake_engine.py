@@ -301,6 +301,11 @@ def build_fake_engine_app(state: FakeEngineState | None = None) -> web.Applicati
         ]) + vocab.render_labeled_counter(
             vocab.TPU_MULTISTEP_FALLBACK, "reason",
             dict.fromkeys(vocab.TPU_MULTISTEP_FALLBACK_REASONS, 0),
+        ) + vocab.render_labeled_counter(
+            # Fused speculative windows: no device, so no drafts — but
+            # the family must exist for the scrape contract (SC303).
+            vocab.TPU_SPEC_WINDOW_TOKENS, "outcome",
+            dict.fromkeys(vocab.TPU_SPEC_WINDOW_OUTCOMES, 0),
         ) + state.obs.render_metrics()
 
     async def debug_requests(_request: web.Request) -> web.Response:

@@ -143,6 +143,23 @@ def test_multi_step_matches_under_continuous_batching():
     assert run(1) == run(4)
 
 
+def test_legacy_spelling_composes_with_speculation():
+    """num_scheduler_steps > 1 + speculative_ngram (formerly mutually
+    exclusive) now routes speculation through the same fused window
+    machinery — greedy parity with single-token stepping holds."""
+    reqs = [
+        ("a", "the cat sat on the mat the cat sat", SamplingParams(
+            max_tokens=21)),
+        ("b", "pack my box with", SamplingParams(max_tokens=13)),
+    ]
+    ref, ref_fin = drain(make_engine(1), reqs)
+    engine = make_engine(4, speculative_ngram=3)
+    assert engine._spec_window_fn is not None
+    got, got_fin = drain(engine, reqs)
+    assert got == ref
+    assert got_fin == ref_fin
+
+
 def test_prefix_cache_not_polluted_by_overrun():
     """Discarded overrun tokens write KV past the kept sequence; those
     slots must never enter the prefix cache (full-block registration

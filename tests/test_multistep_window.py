@@ -80,14 +80,47 @@ def test_window_default_on_and_gate_off():
     assert SchedulerConfig().window_steps == 8
     assert SchedulerConfig(decode_window=4).window_steps == 4
     assert SchedulerConfig(multi_step_window=False).window_steps == 1
-    # Speculation owns the dispatch shape: the window auto-resolves off.
-    assert SchedulerConfig(speculative_ngram=3).window_steps == 1
-    with pytest.raises(ValueError):
-        SchedulerConfig(multi_step_window=True, speculative_ngram=3)
     with pytest.raises(ValueError):
         SchedulerConfig(num_scheduler_steps=4, multi_step_window=False)
     with pytest.raises(ValueError):
         SchedulerConfig(decode_window=0)
+
+
+def test_speculation_composes_with_window():
+    """The PR-11 fusion: speculative_ngram no longer resolves the window
+    off — the drafter runs INSIDE the scan, and the per-window token
+    ceiling budgets max acceptance (K x (ngram + 1))."""
+    cfg = SchedulerConfig(speculative_ngram=3)
+    assert cfg.window_steps == 8
+    assert cfg.spec_window_enabled
+    assert cfg.window_max_tokens == 8 * 4
+    assert cfg.pipeline_enabled and cfg.mixed_enabled
+    # Explicit window + speculation is a valid (formerly rejected) combo.
+    cfg = SchedulerConfig(multi_step_window=True, speculative_ngram=3)
+    assert cfg.spec_window_enabled
+    # The legacy num_scheduler_steps spelling composes the same way.
+    cfg = SchedulerConfig(num_scheduler_steps=4, speculative_ngram=4)
+    assert cfg.window_steps == 4 and cfg.spec_window_enabled
+    assert cfg.window_max_tokens == 4 * 5
+
+
+def test_legacy_spec_escape_hatch_resolution():
+    """--no-multi-step-window + speculative_ngram restores the legacy
+    host-side speculative path: window off, pipeline and mixed steps
+    auto-off (its wide verify dispatch is synchronous), and the explicit
+    conflicting gates still refuse."""
+    cfg = SchedulerConfig(speculative_ngram=3, multi_step_window=False)
+    assert cfg.window_steps == 1
+    assert not cfg.spec_window_enabled
+    assert not cfg.pipeline_enabled
+    assert not cfg.mixed_enabled
+    assert cfg.window_max_tokens == 1
+    with pytest.raises(ValueError, match="legacy host-side"):
+        SchedulerConfig(speculative_ngram=3, multi_step_window=False,
+                        pipeline_decode=True)
+    with pytest.raises(ValueError, match="legacy host-side"):
+        SchedulerConfig(speculative_ngram=3, multi_step_window=False,
+                        mixed_batch=True)
 
 
 def test_gate_off_restores_single_step_machinery():
@@ -378,6 +411,175 @@ def test_chained_windows_greedy_parity_across_block_boundaries():
     ref, _ = drain(make_engine(1), reqs)
     got, _ = drain(make_engine(8), reqs)
     assert got == ref
+
+
+# -- fused speculative windows (spec-in-window) -----------------------------
+
+
+def test_spec_window_greedy_parity():
+    """The PR-11 acceptance bar: greedy decode byte-identical across
+    {single-step, K=8 window, K=8 window + ngram=3} — the in-scan
+    verifier compares the model's own argmax, so acceptance can never
+    change the stream, only its cost."""
+    reqs = [
+        ("a", "the cat sat on the mat the cat sat on", SamplingParams(
+            max_tokens=33)),
+        ("b", "abc abc abc abc", SamplingParams(max_tokens=21)),
+    ]
+    ref, ref_fin = drain(make_engine(1), reqs)
+    win, win_fin = drain(make_engine(8), reqs)
+    eng = make_engine(8, speculative_ngram=3)
+    assert eng._spec_window_fn is not None
+    fused, fused_fin = drain(eng, reqs)
+    assert win == ref and win_fin == ref_fin
+    assert fused == ref and fused_fin == ref_fin
+    assert eng.multistep_fallback == {}
+
+
+def test_spec_window_acceptance_counters_consistent():
+    """Repetitive prompts draft on-device; accepted + rejected must
+    equal drafted, acceptance feeds the same tpu:spec_tokens_* family
+    the legacy path uses, and stats() mirrors the outcome split."""
+    eng = make_engine(8, speculative_ngram=3)
+    drain(eng, [("a", "one two three one two three one two three",
+                 SamplingParams(max_tokens=48, ignore_eos=True))])
+    sw = eng.spec_window_tokens
+    assert eng.spec_tokens_drafted > 0
+    assert 0 <= eng.spec_tokens_accepted <= eng.spec_tokens_drafted
+    assert sw["accepted"] == eng.spec_tokens_accepted
+    assert sw["accepted"] + sw["rejected"] == eng.spec_tokens_drafted
+    assert eng.stats()["spec_window_tokens"] == sw
+
+
+def test_spec_window_seeded_sampling_bit_identical():
+    """Sampled batches never draft (acceptance needs argmax): they run
+    the PLAIN window with the classic per-iteration key schedule, so
+    seeded streams stay bit-identical across window sizes with
+    speculation configured on."""
+    reqs = [
+        ("a", "stochastic stream one", SamplingParams(
+            max_tokens=17, temperature=0.9, top_p=0.9, seed=7)),
+        ("b", "stochastic stream two", SamplingParams(
+            max_tokens=17, temperature=0.8, top_k=40, seed=11)),
+    ]
+    ref, _ = drain(make_engine(1), reqs)
+    eng = make_engine(8, speculative_ngram=3)
+    got, _ = drain(eng, reqs)
+    assert got == ref
+    assert eng.spec_tokens_drafted == 0  # the drafter never engaged
+
+
+def test_spec_window_penalties_and_min_tokens_parity():
+    """Penalties and the min_tokens floor apply to EVERY accepted token
+    sequentially through the shared apply_penalties_state call site —
+    greedy parity with the single-step host path, no fallback."""
+    reqs = [
+        ("rep", "repeat repeat repeat repeat", SamplingParams(
+            max_tokens=19, repetition_penalty=1.3)),
+        ("pf", "penalize me twice", SamplingParams(
+            max_tokens=19, presence_penalty=0.7, frequency_penalty=0.4,
+            min_tokens=6)),
+    ]
+    ref, _ = drain(make_engine(1), reqs)
+    eng = make_engine(8, speculative_ngram=3)
+    got, _ = drain(eng, reqs)
+    assert eng.multistep_fallback == {}
+    assert got == ref
+
+
+def test_spec_window_lockstep_determinism():
+    """Two engine instances with identical seeds must produce identical
+    streams AND identical acceptance counters — the fused drafter is a
+    pure function of the shared weights and carried state (never wall
+    clock or instance identity), which is what lets lockstep replicas
+    speculate without desyncing."""
+    reqs = [
+        ("a", "replica determinism check one two one two", SamplingParams(
+            max_tokens=29, ignore_eos=True)),
+        ("b", "second stream second stream second", SamplingParams(
+            max_tokens=29, ignore_eos=True)),
+    ]
+    one = make_engine(8, seed=1234, speculative_ngram=3)
+    two = make_engine(8, seed=1234, speculative_ngram=3)
+    outs_one, fin_one = drain(one, reqs)
+    outs_two, fin_two = drain(two, reqs)
+    assert outs_one == outs_two and fin_one == fin_two
+    assert one.spec_tokens_drafted == two.spec_tokens_drafted
+    assert one.spec_tokens_accepted == two.spec_tokens_accepted
+    assert one.spec_window_tokens == two.spec_window_tokens
+
+
+def test_spec_stop_mid_window_zero_waste_and_clean_cache():
+    """A stop landing mid-window with accepted draft tokens freezes the
+    row inside the scan: no trailing tokens, zero waste, and the prefix
+    cache stays clean (a follow-up request sharing the prompt keeps
+    greedy parity — rejected-draft KV past the stop never registers)."""
+    prompt = "stop masking check"
+    stop_tok, prefix = _probe_stop_token(prompt)
+    eng = make_engine(8, speculative_ngram=3)
+    got, fin = drain(eng, [
+        ("a", prompt, SamplingParams(
+            max_tokens=40, ignore_eos=True, stop_token_ids=[stop_tok])),
+    ])
+    assert got["a"] == prefix + [-1]
+    assert fin["a"] == FinishReason.STOP
+    assert eng.multistep_wasted_tokens == 0
+    assert eng.spec_window_tokens["wasted"] == 0
+    # Prefix-cache cleanliness: the same engine re-serves the prompt.
+    sp_full = SamplingParams(max_tokens=24, ignore_eos=True)
+    reused, _ = drain(eng, [("b", prompt, sp_full)])
+    ref, _ = drain(make_engine(1), [("r", prompt, sp_full)])
+    assert reused["b"] == ref["r"]
+
+
+def test_spec_abort_mid_window_counts_wasted():
+    """Drafted-but-undelivered tokens of a sequence aborted while its
+    fused window flew are accounted (multistep waste + the spec-window
+    outcome split), never silently vanished."""
+    eng = make_engine(8, speculative_ngram=3)
+    eng.add_request("a", prompt="abort me mid window one two one two",
+                    sampling_params=SamplingParams(
+                        max_tokens=64, ignore_eos=True))
+    eng.add_request("b", prompt="keep me running along here",
+                    sampling_params=SamplingParams(
+                        max_tokens=64, ignore_eos=True))
+    for _ in range(3):
+        eng.step()
+    eng.abort_request("a")
+    while eng.has_unfinished() or eng.has_pending():
+        eng.step()
+        if not eng.has_unfinished():
+            break
+    while eng.has_pending():
+        eng.collect()
+    assert eng.multistep_wasted_tokens > 0
+    assert eng.spec_window_tokens["wasted"] == eng.multistep_wasted_tokens
+    assert eng.stats()["spec_window_tokens"]["wasted"] > 0
+
+
+def test_spec_window_admission_mid_stream_parity():
+    """Mixed batching composes with the fused speculative window: a
+    request arriving while spec windows chain breaks the chain cleanly
+    and keeps greedy parity for both streams."""
+    def run(spec):
+        eng = make_engine(8, speculative_ngram=spec)
+        eng.add_request("a", prompt="first stream first stream",
+                        sampling_params=SamplingParams(max_tokens=33))
+        outs = {}
+        fired = False
+        steps = 0
+        while eng.has_unfinished():
+            steps += 1
+            assert steps < 500
+            for out in eng.step():
+                outs.setdefault(out.seq_id, []).append(out.new_token_id)
+            if not fired and len(outs.get("a", [])) >= 5:
+                eng.add_request("b", prompt="late arrival stream",
+                                sampling_params=SamplingParams(max_tokens=33))
+                fired = True
+        return outs
+
+    assert run(3) == run(0)
 
 
 def test_admission_mid_stream_parity():

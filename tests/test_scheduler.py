@@ -208,3 +208,84 @@ def test_preemption_evicts_lowest_priority_running():
     # decided who lost the capacity race.
     assert victims[0] == "low"
     assert low_seq.preempt_count > 0
+
+
+def test_spec_window_budget_covers_max_acceptance():
+    """With speculation fused into the window, a pure-decode plan's
+    per-row TOKEN budget (and the block pre-allocation backing it) must
+    cover the max-acceptance growth K x (ngram + 1), clamped by
+    max_model_len / max_tokens room."""
+    sched, pool = make_scheduler(
+        num_blocks=128, decode_window=4, speculative_ngram=3,
+    )
+    s = seq("a", 6, max_tokens=40)
+    sched.add_seq(s)
+    sched.schedule()  # prefill (6 tokens -> 2 blocks)
+    s.output_token_ids.append(1)
+    plan = sched.schedule()
+    assert plan.decode is not None and plan.decode_window == 4
+    # 4 iterations x (3 drafts + 1 committed) = 16-token budget.
+    assert plan.decode.steps == [16]
+    # Blocks cover slots through num_tokens + budget - 1 = 7 + 16 - 1
+    # = 22 slots -> ceil(22/4) = 6 blocks.
+    assert len(s.block_table) == 6
+
+
+def test_spec_window_budget_clamped_by_room():
+    """The max-acceptance budget still respects max_tokens room: a
+    request 3 tokens from its cap gets a 3-token budget, not 16."""
+    sched, pool = make_scheduler(
+        num_blocks=128, decode_window=4, speculative_ngram=3,
+    )
+    s = seq("a", 6, max_tokens=4)
+    sched.add_seq(s)
+    sched.schedule()
+    s.output_token_ids.append(1)
+    plan = sched.schedule()
+    assert plan.decode.steps == [3]
+
+
+def test_provisional_spec_window_budgets_optimistically():
+    """Chained windows plan under full-acceptance optimism: the next
+    window's budget and block growth assume the in-flight window lands
+    its whole token budget."""
+    sched, pool = make_scheduler(
+        num_blocks=128, decode_window=4, speculative_ngram=3,
+    )
+    s = seq("a", 6, max_tokens=60)  # max_model_len is 64 (make_scheduler)
+    sched.add_seq(s)
+    sched.schedule()
+    s.output_token_ids.append(1)
+    plan = sched.schedule()
+    assert plan.decode.steps == [16]
+    nxt = sched.schedule_provisional_window(plan.decode.seqs, plan.decode.steps)
+    assert nxt is not None and nxt.provisional
+    # Optimistic base = 7 + 16 = 23 tokens; room to max_model_len=64
+    # leaves >= 16, so the full spec budget applies again.
+    assert nxt.decode.steps == [16]
+    # Table covers 23 + 16 - 1 = 38 slots -> ceil(38/4) = 10 blocks.
+    assert len(s.block_table) == 10
+
+
+def test_spec_budget_not_inflated_for_sampled_batches():
+    """The fused drafter only engages for all-greedy batches, so a
+    batch with a sampled row keeps the plain K-token window budget —
+    no blocks pre-allocated for drafts that cannot happen."""
+    sched, pool = make_scheduler(
+        num_blocks=128, decode_window=4, speculative_ngram=3,
+    )
+    g = seq("g", 6, max_tokens=40)
+    s = Sequence(
+        seq_id="s",
+        prompt_token_ids=list(range(6)),
+        sampling_params=SamplingParams(max_tokens=40, temperature=0.9),
+    )
+    sched.add_seq(g)
+    sched.add_seq(s)
+    sched.schedule()
+    sched.schedule()  # both prefills
+    g.output_token_ids.append(1)
+    s.output_token_ids.append(1)
+    plan = sched.schedule()
+    assert plan.decode is not None
+    assert plan.decode.steps == [4, 4]

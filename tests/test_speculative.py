@@ -1,4 +1,11 @@
-"""N-gram (prompt-lookup) speculative decoding.
+"""N-gram (prompt-lookup) speculative decoding — the LEGACY host-side
+path (`--no-multi-step-window` escape hatch).
+
+Since PR 11 the default path fuses the drafter INTO the K-step decode
+window scan (tests/test_multistep_window.py covers it); this file pins
+``multi_step_window=False`` so the host-side drafter + one-wide-verify-
+dispatch-per-step machinery stays parity-tested EXACTLY — it remains
+the fallback for host-state rows and the A/B baseline.
 
 Greedy outputs must be BIT-IDENTICAL with speculation on/off regardless
 of acceptance rate (verification compares the model's own argmax).  The
@@ -7,8 +14,6 @@ the model's true continuation — with a random-weight model, natural
 n-gram drafts rarely match, which is exactly why parity alone isn't
 enough coverage.
 """
-
-import pytest
 
 from production_stack_tpu.engine.config import (
     CacheConfig,
@@ -27,11 +32,12 @@ def make_engine(spec=0):
         scheduler=SchedulerConfig(
             max_num_seqs=2, prefill_buckets=(16, 32, 64), max_model_len=160,
             speculative_ngram=spec,
-            # spec=0 is this file's classic one-token-per-step reference
-            # (step-count assertions depend on it); the default K-step
-            # window must not compress its step count.  spec>0 resolves
-            # the window off on its own.
-            multi_step_window=False if spec == 0 else None,
+            # Pinned OFF for every engine here: spec=0 is the classic
+            # one-token-per-step reference (step-count assertions depend
+            # on it), and spec>0 must exercise the LEGACY host-side
+            # speculative path — with the window on, speculation now
+            # fuses into the scan and the host drafter never runs.
+            multi_step_window=False,
         ),
     ))
 
@@ -108,9 +114,15 @@ def test_eos_or_stop_mid_acceptance_truncates():
     assert got["r"] == ref["r"] and len(got["r"]) == 5
 
 
-def test_config_exclusivity():
-    with pytest.raises(ValueError, match="mutually exclusive"):
-        SchedulerConfig(num_scheduler_steps=4, speculative_ngram=4)
+def test_config_composition():
+    """The PR-1 mutual exclusion is lifted: speculation composes with
+    the window machinery (legacy num_scheduler_steps spelling included)
+    by fusing into the scan; only the explicit window-off escape hatch
+    keeps this file's host-side path."""
+    cfg = SchedulerConfig(num_scheduler_steps=4, speculative_ngram=4)
+    assert cfg.window_steps == 4 and cfg.spec_window_enabled
+    hatch = SchedulerConfig(speculative_ngram=4, multi_step_window=False)
+    assert not hatch.spec_window_enabled and hatch.window_steps == 1
 
 
 async def test_spec_counters_exported_at_metrics():
@@ -144,6 +156,14 @@ async def test_spec_counters_exported_at_metrics():
                 text = await resp.text()
         assert "tpu:spec_tokens_drafted" in text
         assert "tpu:spec_tokens_accepted" in text
+        # The fused-window outcome family renders with its closed label
+        # set from boot (this server runs the fused path: spec + the
+        # default K-step window).
+        for outcome in ("accepted", "rejected", "wasted"):
+            assert (
+                'tpu:spec_window_tokens_total{outcome="%s"}' % outcome
+                in text
+            )
         # Drafting is opportunistic (depends on n-gram hits in the random
         # model's output); the contract here is exported, parseable,
         # consistent counters.
