@@ -1180,6 +1180,12 @@ def bench_remote_prefix_ab(args, preset: str) -> dict:
             "shared_ttft_ms": round((ttft or 0.0) * 1e3, 2),
             "blocks_imported": eng.remote_prefix_blocks_fetched,
             "store_round_trips": ops,
+            # tpu:kv_wire_bytes_total view: bytes this import pulled
+            # over the remote boundary, by wire format.
+            "wire_bytes": {
+                f"{t}/{f}": b
+                for (t, f), b in eng.stats()["kv_wire_bytes"].items()
+            },
         }
         eng.offload.remote_client.close()
         del eng
@@ -1201,6 +1207,221 @@ def bench_remote_prefix_ab(args, preset: str) -> dict:
         # MGET batching: round-trips per imported chain, both modes.
         "round_trips_sync": sync["store_round_trips"],
         "round_trips_prefetch": prefetch["store_round_trips"],
+    }
+
+
+def bench_kv_capacity_ab(args, preset: str) -> dict:
+    """KV-capacity A/B at an EQUAL HBM block-byte budget: int8 KV vs
+    bf16 KV through the real engine.
+
+    The claim (ROADMAP item 2, SURVEY §5 — long-context is KV capacity
+    extension + reuse): at the same byte budget an int8 pool holds ~2x
+    the resident tokens, which shows up as (a) more admitted concurrency
+    under pool pressure, (b) a higher prefix hit rate once the bf16 pool
+    starts evicting cached blocks the int8 pool retains, and (c) decode
+    throughput that does not regress.  Model shapes use a head_dim-64
+    mini-llama (every flagship preset has head_dim >= 64; tiny-llama's
+    head_dim 16 is a test artifact that overweights the fp32 scale
+    plane).
+
+    Also proves the quantized WIRE end-to-end: one preemption
+    offload -> restore cycle on the int8-wire engine must reproduce the
+    in-HBM greedy output byte-for-byte (the native (data, scale) wire
+    transforms nothing), and the same cycle on the legacy fp32 wire
+    must stream ~4x the host-tier bytes — read from the new
+    tpu:kv_wire_bytes_total counters."""
+    import dataclasses as _dc
+    import gc
+
+    from production_stack_tpu.engine.config import (
+        CacheConfig,
+        EngineConfig,
+        ModelConfig,
+        SchedulerConfig,
+    )
+    from production_stack_tpu.engine.core.engine import LLMEngine
+    from production_stack_tpu.engine.core.sequence import SamplingParams
+
+    model = ModelConfig(
+        name="llama-kv-capacity-ab", vocab_size=384, hidden_size=128,
+        intermediate_size=256, num_layers=2, num_heads=2, num_kv_heads=2,
+        head_dim=64, max_model_len=2048, dtype="bfloat16",
+    )
+    bs = 16
+    # Per-block bytes by kv dtype (mirrors LLMEngine._kv_bytes): the
+    # budget is what a 96-block bf16 pool occupies; each arm gets as
+    # many blocks as fit in THAT byte budget.
+    dense_block = 2 * model.num_kv_heads * model.head_dim * 2 * model.num_layers * bs
+    int8_block = 2 * model.num_kv_heads * (model.head_dim + 4) * model.num_layers * bs
+    budget_bytes = 96 * dense_block
+    arm_blocks = {
+        "bf16": budget_bytes // dense_block,
+        "int8": budget_bytes // int8_block,
+    }
+
+    n_requests = 12
+    gen_tokens = 8
+    prompt_blocks = 16  # 256-token prompts: pool-bound admission
+    prompts = [
+        [(17 * i + 5 + j) % 101 for j in range(prompt_blocks * bs)]
+        for i in range(n_requests)
+    ]
+
+    def make(kv_dtype, num_blocks, max_seqs=n_requests, **cache_kw):
+        return LLMEngine(EngineConfig(
+            model=_dc.replace(model),
+            cache=CacheConfig(
+                block_size=bs, num_blocks=int(num_blocks),
+                kv_cache_dtype=kv_dtype, **cache_kw,
+            ),
+            scheduler=SchedulerConfig(
+                max_num_seqs=max_seqs,
+                prefill_buckets=(128, 256),
+                max_model_len=512,
+            ),
+        ))
+
+    def run_arm(arm: str) -> dict:
+        # Arm label -> CacheConfig.kv_cache_dtype ("auto" = the model
+        # dtype, bf16 here).
+        kv_dtype = "int8" if arm == "int8" else "auto"
+
+        # Phase 1 — admitted concurrency + decode tok/s: all requests
+        # arrive at once; the pool bounds how many run concurrently.
+        eng = make(kv_dtype, arm_blocks[arm])
+        for i, p in enumerate(prompts):
+            eng.add_request(
+                f"r{i}", prompt_token_ids=p,
+                sampling_params=SamplingParams(
+                    max_tokens=gen_tokens, ignore_eos=True
+                ),
+            )
+        max_running = 0
+        tokens = 0
+        steps = 0
+        t0 = time.perf_counter()
+        while eng.has_unfinished():
+            steps += 1
+            if steps > 8000:
+                break
+            outs = eng.step()
+            tokens += sum(1 for o in outs if o.new_token_id >= 0)
+            max_running = max(max_running, eng.scheduler.num_running)
+        dt = time.perf_counter() - t0
+        del eng
+        gc.collect()
+
+        # Phase 2 — prefix hit rate under eviction: two sequential
+        # rounds of a 10-chain working set (160 blocks).  Round 1
+        # registers every chain; the int8 pool (180 blocks) RETAINS the
+        # whole set and serves round 2 from cache, while the bf16 pool
+        # (96 blocks) LRU-thrashes — the classic cyclic-reuse cliff —
+        # and re-prefills everything.  This is the SURVEY §5 mechanism
+        # (more resident KV => higher hit rate) measured directly.
+        eng = make(kv_dtype, arm_blocks[arm], max_seqs=2)
+        for round_tag in ("w", "h"):
+            for i, p in enumerate(prompts[:10]):
+                eng.add_request(
+                    f"{round_tag}{i}", prompt_token_ids=p,
+                    sampling_params=SamplingParams(max_tokens=2),
+                )
+                steps = 0
+                while eng.has_unfinished():
+                    steps += 1
+                    assert steps < 4000
+                    eng.step()
+        hit_rate = eng.block_pool.prefix_hit_rate
+        del eng
+        gc.collect()
+
+        return {
+            "num_blocks": int(arm_blocks[arm]),
+            "resident_tokens": int(arm_blocks[arm]) * bs,
+            "admitted_concurrency": max_running,
+            "decode_tokens_per_s": round(tokens / max(dt, 1e-9), 1),
+            "replay_prefix_hit_rate": round(hit_rate, 3),
+        }
+
+    bf16 = run_arm("bf16")
+    int8 = run_arm("int8")
+
+    # Offload->restore greedy parity + wire bytes: int8 wire (native
+    # (data, scale) tuples) vs the legacy fp32 wire, same workload.
+    # remote_prefetch=False pins the deterministic synchronous save
+    # path so both wires snapshot identical block sets.
+    def offload_cycle(wire: str) -> dict:
+        def drain(eng, tag):
+            for i, p in enumerate(prompts[:4]):
+                eng.add_request(
+                    f"{tag}{i}", prompt_token_ids=p,
+                    sampling_params=SamplingParams(
+                        max_tokens=24, ignore_eos=True
+                    ),
+                )
+            out: dict = {}
+            steps = 0
+            while eng.has_unfinished():
+                steps += 1
+                assert steps < 8000
+                for o in eng.step():
+                    if o.new_token_id >= 0:
+                        out.setdefault(o.seq_id, []).append(o.new_token_id)
+            return out
+
+        roomy = make("int8", 256, max_seqs=4, kv_wire_format=wire)
+        want = drain(roomy, "c")
+        del roomy
+        gc.collect()
+        # Tight pool + host tier: the younger sequences preempt via
+        # offload and restore through the wire under test (4 seqs need
+        # ~72 blocks incl. generation growth; 52 forces paging).
+        tight = make("int8", 52, max_seqs=4, kv_wire_format=wire,
+                     host_offload_gb=0.25, remote_prefetch=False)
+        got = drain(tight, "c")
+        stats = tight.stats()
+        cycle = {
+            "saves": tight.offload.saves,
+            "restores": tight.offload.restores,
+            "greedy_parity": got == want,
+            "host_wire_bytes": {
+                f"{t}/{f}": b
+                for (t, f), b in stats["kv_wire_bytes"].items()
+            },
+        }
+        del tight
+        gc.collect()
+        return cycle
+
+    int8_wire = offload_cycle("auto")
+    fp32_wire = offload_cycle("fp32")
+    int8_bytes = sum(int8_wire["host_wire_bytes"].values())
+    fp32_bytes = sum(fp32_wire["host_wire_bytes"].values())
+    return {
+        "budget_bytes": int(budget_bytes),
+        "bf16": bf16,
+        "int8": int8,
+        # The headline: resident tokens at the same byte budget.
+        "capacity_ratio": round(
+            int8["resident_tokens"] / bf16["resident_tokens"], 2
+        ),
+        "concurrency_ratio": round(
+            int8["admitted_concurrency"]
+            / max(bf16["admitted_concurrency"], 1), 2
+        ),
+        "hit_rate_delta": round(
+            int8["replay_prefix_hit_rate"] - bf16["replay_prefix_hit_rate"],
+            3,
+        ),
+        "decode_tokens_ratio": round(
+            int8["decode_tokens_per_s"]
+            / max(bf16["decode_tokens_per_s"], 1e-9), 2
+        ),
+        "offload_cycle_int8_wire": int8_wire,
+        "offload_cycle_fp32_wire": fp32_wire,
+        # ~4x: the fp32 wire inflates every offloaded block.
+        "wire_bytes_ratio_fp32_over_int8": round(
+            fp32_bytes / max(int8_bytes, 1), 2
+        ),
     }
 
 
@@ -1697,6 +1918,16 @@ def _run_serving_phase(args) -> dict:
         return {"error": str(e)[:200]}
 
 
+# Optional A/B stages in value order (the --stages selector validates
+# against this; 'micro' additionally selects the microbench + serving
+# phases).
+AB_STAGES = (
+    "int8_ab", "kv_int8_ab", "kv_capacity_ab", "gather_ab", "pipeline_ab",
+    "mixed_ab", "multistep_ab", "spec_window_ab", "overload_ab",
+    "remote_prefix_ab", "disagg_ab", "fleet_surge_ab",
+)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--preset", default=None, help="model preset (default: by backend)")
@@ -1708,6 +1939,18 @@ def main() -> None:
         help="soft wall-clock budget: optional A/B stages are skipped "
         "when fewer than 120s remain, so the final JSON line always "
         "prints inside the driver's window",
+    )
+    ap.add_argument(
+        "--stages", default=None,
+        help="comma-separated A/B stage selector (e.g. "
+        "'int8_ab,kv_capacity_ab').  Selected stages run with PRIORITY: "
+        "the serving phase and repeat microbenches are skipped to "
+        "conserve budget, and a selected stage runs even when the soft "
+        "budget is exhausted (r05 silently budget-starved "
+        "int8_ab/kv_int8_ab; a requested stage can no longer be).  "
+        "Every skipped stage — unselected, quick-mode, or "
+        "budget-starved — is recorded loudly in detail.stages_skipped.  "
+        "Include 'micro' to keep the microbench + serving phases",
     )
     ap.add_argument(
         "--trace-report", action="store_true",
@@ -1749,13 +1992,28 @@ def main() -> None:
             os.environ["JAX_PLATFORMS"] = "cpu"
             os.environ[_FALLBACK_ENV] = "1"
 
+    # Stage selector (--stages): selected A/B stages run with priority —
+    # the serving phase and repeat microbenches are skipped so the
+    # budget goes to what was asked for, and a selected stage ignores
+    # the soft budget entirely (the r05 starvation fix).
+    selected = None
+    if args.stages:
+        selected = {s.strip() for s in args.stages.split(",") if s.strip()}
+        unknown = selected - set(AB_STAGES) - {"micro"}
+        if unknown:
+            raise SystemExit(
+                f"--stages: unknown stage(s) {sorted(unknown)}; "
+                f"known: {', '.join(AB_STAGES)} (+ 'micro' for the "
+                "microbench/serving phases)"
+            )
+
     # Phase 1 (before THIS process claims the chip): the north-star
     # serving bench with REAL process boundaries — engine server process
     # + router process + the multi-round-QA harness over HTTP.  Must run
     # first because the engine subprocess needs the TPU, and a PJRT
     # client in this process would hold it.
     serving_summary = None
-    if not args.quick:
+    if not args.quick and (selected is None or "micro" in selected):
         serving_summary = _run_serving_phase(args)
 
     # Initialize the backend with hang/crash protection: the tunnel can
@@ -1794,7 +2052,7 @@ def main() -> None:
     if serving_summary is not None:
         detail["serving"] = serving_summary
 
-    if not args.quick:
+    if not args.quick and (selected is None or "micro" in selected):
         detail["matmul_tflops"] = round(bench_matmul_tfs(jax, jnp, on_tpu), 1)
         detail["hbm_gbs"] = round(bench_hbm_gbs(jax, jnp, on_tpu), 1)
         detail["hbm_read_gbs"] = round(bench_hbm_read_gbs(jax, jnp, on_tpu), 1)
@@ -1911,10 +2169,24 @@ def main() -> None:
                 f"measured ceiling {measured_ceiling:.0f} GB/s; "
                 f"kv sweep {sweep}")
 
-    # Optional A/B stages, in value order, each gated on the remaining
-    # time budget: the driver runs this under a finite window and the
-    # JSON line with the core + serving numbers must always print.
-    def budget_left(stage: str) -> bool:
+    # Optional A/B stages, in value order, each gated on selection and
+    # the remaining time budget: the driver runs this under a finite
+    # window and the JSON line with the core + serving numbers must
+    # always print.  EVERY skipped stage is recorded loudly in
+    # detail.stages_skipped — r05 silently dropped int8_ab/kv_int8_ab
+    # and nobody noticed until the artifact diff.
+    def note_skip(stage: str, reason: str) -> None:
+        detail.setdefault("stages_skipped", []).append(
+            {"stage": stage, "reason": reason}
+        )
+
+    def run_stage(stage: str) -> bool:
+        if args.quick:
+            note_skip(stage, "quick")
+            return False
+        if selected is not None and stage not in selected:
+            note_skip(stage, "unselected")
+            return False
         # Probe/boot wait is excluded: a TPU tunnel outage must not eat
         # the stage budget (r05 lost int8_ab/kv_int8_ab to 3x420 s of
         # probe retries billed as bench time).
@@ -1922,14 +2194,23 @@ def main() -> None:
         remaining = args.budget_s - spent
         detail["budget_excluded_s"] = round(_BUDGET_EXCLUDED_S, 1)
         if remaining < 120.0:
+            if selected is not None and stage in selected:
+                # Requested stages preempt the budget: running over the
+                # soft window beats silently starving what was asked
+                # for.
+                log(f"{stage}: {remaining:.0f}s left of --budget-s "
+                    f"{args.budget_s}, but the stage was requested via "
+                    "--stages — running anyway")
+                return True
             log(f"skipping {stage}: {remaining:.0f}s left of "
                 f"--budget-s {args.budget_s} "
                 f"({_BUDGET_EXCLUDED_S:.0f}s probe/boot wait excluded)")
             detail[f"{stage}_skipped_budget"] = True
+            note_skip(stage, "budget")
             return False
         return True
 
-    if not args.quick and budget_left("int8_ab"):
+    if run_stage("int8_ab"):
         # Int8 weight-only A/B (model.quantization="int8"): decode is
         # HBM-bound, so halving the projection bytes should approach a 2x
         # step-time cut; report the measured ratio next to its own
@@ -1954,7 +2235,7 @@ def main() -> None:
             log(f"int8 decode bench failed: {e}")
             detail["int8_decode_error"] = str(e)[:200]
 
-    if not args.quick and budget_left("kv_int8_ab"):
+    if run_stage("kv_int8_ab"):
         # Int8 KV cache A/B (cache.kv_cache_dtype="int8"): the KV read is
         # the context-scaling term of decode bandwidth; int8 halves it
         # (and the pool bytes — capacity ratio reported alongside).
@@ -1985,21 +2266,52 @@ def main() -> None:
             log(f"kv int8 decode bench failed: {e}")
             detail["kv_int8_decode_error"] = str(e)[:200]
 
-    if not args.quick and on_tpu and budget_left("gather_ab"):
-        # A/B the full decode step with the gather attention path (the KV
-        # cache is loop-carried, so XLA cannot hoist the gather): this is
-        # the honest Pallas-kernel delta at engine level.
-        os.environ["PSTPU_DISABLE_PALLAS"] = "1"
+    if run_stage("kv_capacity_ab"):
+        # KV-capacity A/B (the quantized-tiering headline): same HBM
+        # block-byte budget, int8 vs bf16 KV — admitted concurrency,
+        # prefix hit rate, decode tok/s — plus offload->restore greedy
+        # parity through the native int8 wire and the fp32-vs-int8
+        # host-tier byte ratio from tpu:kv_wire_bytes_total.
         try:
-            t_gather = bench_decode(jax, jnp, cfg, params, kv, S, ctx, bmax, bs)
-        finally:
-            del os.environ["PSTPU_DISABLE_PALLAS"]
-        detail["decode_step_ms_gather"] = round(t_gather * 1e3, 3)
-        detail["pallas_decode_speedup"] = round(t_gather / t_decode, 2)
-        log(f"decode gather-path: {t_gather*1e3:.2f} ms/step "
-            f"(pallas speedup {t_gather/t_decode:.2f}x)")
+            detail["kv_capacity_ab"] = bench_kv_capacity_ab(args, preset)
+            ab = detail["kv_capacity_ab"]
+            log(f"kv capacity A/B: {ab['capacity_ratio']}x resident "
+                f"tokens at equal budget "
+                f"({ab['int8']['resident_tokens']} vs "
+                f"{ab['bf16']['resident_tokens']}), concurrency "
+                f"{ab['concurrency_ratio']}x, hit-rate delta "
+                f"{ab['hit_rate_delta']}, wire parity "
+                f"{ab['offload_cycle_int8_wire']['greedy_parity']}, "
+                f"fp32/int8 wire bytes "
+                f"{ab['wire_bytes_ratio_fp32_over_int8']}x")
+        except Exception as e:
+            log(f"kv capacity A/B failed: {e}")
+            detail["kv_capacity_ab_error"] = str(e)[:200]
 
-    if not args.quick and budget_left("pipeline_ab"):
+    if run_stage("gather_ab"):
+        if not on_tpu:
+            # Recorded, not silent: the gather A/B measures the Pallas
+            # kernel delta, which only exists on a TPU backend.
+            log("skipping gather_ab: needs a TPU backend")
+            note_skip("gather_ab", "needs_tpu")
+        else:
+            # A/B the full decode step with the gather attention path
+            # (the KV cache is loop-carried, so XLA cannot hoist the
+            # gather): this is the honest Pallas-kernel delta at engine
+            # level.
+            os.environ["PSTPU_DISABLE_PALLAS"] = "1"
+            try:
+                t_gather = bench_decode(
+                    jax, jnp, cfg, params, kv, S, ctx, bmax, bs
+                )
+            finally:
+                del os.environ["PSTPU_DISABLE_PALLAS"]
+            detail["decode_step_ms_gather"] = round(t_gather * 1e3, 3)
+            detail["pallas_decode_speedup"] = round(t_gather / t_decode, 2)
+            log(f"decode gather-path: {t_gather*1e3:.2f} ms/step "
+                f"(pallas speedup {t_gather/t_decode:.2f}x)")
+
+    if run_stage("pipeline_ab"):
         # Pipelined vs sync decode through the REAL engine — run last so
         # the bench's own params/kv can be freed first (two extra engine
         # boots of the flagship preset must fit in HBM).
@@ -2019,7 +2331,7 @@ def main() -> None:
             log(f"pipeline A/B failed: {e}")
             detail["pipeline_ab_error"] = str(e)[:200]
 
-    if not args.quick and budget_left("mixed_ab"):
+    if run_stage("mixed_ab"):
         # Mixed-batch A/B: chunked-prefill-integrated batching vs the
         # alternating scheduler under a Poisson mixed workload — the
         # ITL-under-load claim, measured.  Boots its own engines, so the
@@ -2045,7 +2357,7 @@ def main() -> None:
             log(f"mixed A/B failed: {e}")
             detail["mixed_ab_error"] = str(e)[:200]
 
-    if not args.quick and budget_left("multistep_ab"):
+    if run_stage("multistep_ab"):
         # K-step decode-window A/B: per-token host cost at K in {1,4,8}
         # plus the stop-mask wasted-token rate — the host-round-trip
         # amortization claim, measured (docs/engine.md StepPlan).
@@ -2069,7 +2381,7 @@ def main() -> None:
             log(f"multistep A/B failed: {e}")
             detail["multistep_ab_error"] = str(e)[:200]
 
-    if not args.quick and budget_left("spec_window_ab"):
+    if run_stage("spec_window_ab"):
         # Speculation x window grid: the fused in-scan draft-and-verify
         # vs window-only / legacy host speculation, on an
         # acceptance-friendly and an adversarial replay (PR-11,
@@ -2098,7 +2410,7 @@ def main() -> None:
             log(f"spec-window A/B failed: {e}")
             detail["spec_window_ab_error"] = str(e)[:200]
 
-    if not args.quick and budget_left("overload_ab"):
+    if run_stage("overload_ab"):
         # Overload shedding A/B: bounded admission vs the unbounded
         # legacy queue under a 2x-oversubscribed Poisson replay — the
         # admitted-ITL-stays-flat claim, measured (docs/robustness.md).
@@ -2122,7 +2434,7 @@ def main() -> None:
             log(f"overload A/B failed: {e}")
             detail["overload_ab_error"] = str(e)[:200]
 
-    if not args.quick and budget_left("remote_prefix_ab"):
+    if run_stage("remote_prefix_ab"):
         # Remote shared-prefix import A/B: synchronous per-block GETs
         # inside schedule() vs the async batched transfer plane, against
         # a latency-injected kvserver — the decode-ITL-flatness and
@@ -2147,7 +2459,7 @@ def main() -> None:
             log(f"remote prefix A/B failed: {e}")
             detail["remote_prefix_ab_error"] = str(e)[:200]
 
-    if not args.quick and budget_left("disagg_ab"):
+    if run_stage("disagg_ab"):
         # Disaggregated prefill/decode A/B: router + 1 prefill + 1 decode
         # engine (two-phase disagg policy over the KV plane) vs the same
         # 2 engines fused, one seeded Poisson mixed replay — the
@@ -2177,7 +2489,7 @@ def main() -> None:
             log(f"disagg A/B failed: {e}")
             detail["disagg_ab_error"] = str(e)[:200]
 
-    if not args.quick and budget_left("fleet_surge_ab"):
+    if run_stage("fleet_surge_ab"):
         # Fleet admission A/B: router-level shed (capacity model) vs
         # engine-level shed only, same seeded 10x diurnal surge with a
         # 2->N->2 scale cycle through drain — the admitted-ITL-stays-

@@ -80,7 +80,9 @@ const char* OpName(uint8_t op) {
 
 class KVStore {
  public:
-  explicit KVStore(size_t capacity_bytes) : capacity_(capacity_bytes) {}
+  explicit KVStore(size_t capacity_bytes, int max_snapshot_version = 2)
+      : capacity_(capacity_bytes),
+        max_snapshot_version_(max_snapshot_version) {}
 
   void Put(const std::string& key, std::string value) {
     auto it = map_.find(key);
@@ -124,12 +126,20 @@ class KVStore {
 
   std::string StatsJson() const {
     char buf[256];
+    // snapshot_versions: serde versions this DEPLOYMENT accepts —
+    // clients probe it before putting v2 (quantized) snapshot frames
+    // on the wire (kvserver/protocol.py versioning; values are opaque
+    // blobs to this server, the field is the mixed-fleet rollout
+    // switch: --max-snapshot-version 1 protects not-yet-upgraded
+    // consumer engines from frames they would misparse).
     snprintf(buf, sizeof(buf),
              "{\"keys\": %zu, \"used_bytes\": %zu, \"capacity_bytes\": %zu, "
-             "\"hits\": %llu, \"misses\": %llu, \"ops\": {",
+             "\"hits\": %llu, \"misses\": %llu, "
+             "\"snapshot_versions\": %s, \"ops\": {",
              map_.size(), used_, capacity_,
              static_cast<unsigned long long>(hits_),
-             static_cast<unsigned long long>(misses_));
+             static_cast<unsigned long long>(misses_),
+             max_snapshot_version_ >= 2 ? "[1, 2]" : "[1]");
     std::string out = buf;
     bool first = true;
     for (const auto& [name, count] : ops_) {
@@ -148,6 +158,7 @@ class KVStore {
     std::list<std::string>::iterator lru_it;
   };
   size_t capacity_;
+  int max_snapshot_version_;
   size_t used_ = 0;
   uint64_t hits_ = 0, misses_ = 0;
   // Per-op frame counts: one entry per network round-trip, so a client
@@ -367,7 +378,8 @@ void UpdateEpollOut(int epfd, Conn& c) {
   epoll_ctl(epfd, EPOLL_CTL_MOD, c.fd, &ev);
 }
 
-int RunServer(const char* host, int port, size_t capacity_bytes) {
+int RunServer(const char* host, int port, size_t capacity_bytes,
+              int max_snapshot_version) {
   signal(SIGINT, OnSignal);
   signal(SIGTERM, OnSignal);
   signal(SIGPIPE, SIG_IGN);
@@ -408,7 +420,7 @@ int RunServer(const char* host, int port, size_t capacity_bytes) {
   ev.events = EPOLLIN;
   epoll_ctl(epfd, EPOLL_CTL_ADD, listen_fd, &ev);
 
-  KVStore store(capacity_bytes);
+  KVStore store(capacity_bytes, max_snapshot_version);
   std::unordered_map<int, Conn> conns;
   std::vector<epoll_event> events(256);
   std::vector<uint8_t> rbuf(1 << 20);
@@ -507,6 +519,7 @@ int main(int argc, char** argv) {
   const char* host = "0.0.0.0";
   int port = 9400;
   double capacity_gb = 4.0;
+  int max_snapshot_version = 2;
   for (int i = 1; i < argc; ++i) {
     std::string arg = argv[i];
     auto next = [&]() -> const char* {
@@ -522,13 +535,24 @@ int main(int argc, char** argv) {
       port = atoi(next());
     } else if (arg == "--capacity-gb") {
       capacity_gb = atof(next());
+    } else if (arg == "--max-snapshot-version") {
+      // Mixed-fleet rollout switch: hold at 1 until every engine that
+      // reads this store speaks serde v2 (see StatsJson).
+      max_snapshot_version = atoi(next());
+      if (max_snapshot_version < 1 || max_snapshot_version > 2) {
+        fprintf(stderr, "--max-snapshot-version must be 1 or 2\n");
+        return 2;
+      }
     } else if (arg == "--help" || arg == "-h") {
-      printf("usage: kvserver [--host H] [--port P] [--capacity-gb G]\n");
+      printf(
+          "usage: kvserver [--host H] [--port P] [--capacity-gb G] "
+          "[--max-snapshot-version 1|2]\n");
       return 0;
     } else {
       fprintf(stderr, "unknown flag %s\n", arg.c_str());
       return 2;
     }
   }
-  return RunServer(host, port, static_cast<size_t>(capacity_gb * (1ull << 30)));
+  return RunServer(host, port, static_cast<size_t>(capacity_gb * (1ull << 30)),
+                   max_snapshot_version);
 }

@@ -299,11 +299,25 @@ class CacheConfig:
     # each cached K/V vector as int8 with a per-(token, head) fp32 scale:
     # KV HBM traffic and pool bytes roughly halve (decode is
     # KV-bandwidth-bound at long context, SURVEY §5 long-context story),
-    # so num_blocks roughly doubles at equal memory.  Host-offload /
-    # remote-store wire format is dense fp32 for int8 caches (exact
-    # requantization on restore — kv/quant.py); importers cast/quantize,
-    # so engines with different kv dtypes still share prefixes.
+    # so num_blocks roughly doubles at equal memory.  Importers
+    # cast/quantize, so engines with different kv dtypes still share
+    # prefixes; the offload/remote representation is kv_wire_format's
+    # call.
     kv_cache_dtype: str = "auto"
+    # Offload/remote wire representation for quantized caches.  "auto"
+    # (default): an int8 cache serializes its native (data, scale)
+    # tuples — no dequant round-trip on the D2H path, ~4x the resident
+    # tokens per host-DRAM byte vs the fp32 wire, and snapshot serde v2
+    # on the kvserver (the client probes the store once and falls back
+    # to v1 dense against a legacy deployment — kvserver/protocol.py).
+    # "int8" is auto plus strictness: invalid without an int8 cache,
+    # and a store that fails the serde-v2 probe logs a loud WARNING at
+    # downgrade (auto downgrades silently — by design, it is the
+    # rollout default).  "fp32" pins the legacy dense wire
+    # (bit-preserving via exact requantization — the rollout escape
+    # hatch and A/B baseline).  Dense (non-int8) caches always use the
+    # dense wire.
+    kv_wire_format: str = "auto"
 
     def __post_init__(self):
         if self.disagg_role not in (None, "prefill", "decode", "both"):
@@ -318,6 +332,18 @@ class CacheConfig:
                 f"Unknown kv_cache_dtype {self.kv_cache_dtype!r} "
                 "(auto | int8)"
             )
+        if self.kv_wire_format not in ("auto", "fp32", "int8"):
+            raise ValueError(
+                f"Unknown kv_wire_format {self.kv_wire_format!r} "
+                "(auto | fp32 | int8)"
+            )
+        if self.kv_wire_format == "int8" and self.kv_cache_dtype != "int8":
+            raise ValueError(
+                "kv_wire_format=int8 serializes the int8 cache's native "
+                "(data, scale) representation; it requires "
+                "kv_cache_dtype=int8 (a dense cache has nothing "
+                "quantized to put on the wire)"
+            )
         if self.prefetch_threads < 1:
             raise ValueError("prefetch_threads must be >= 1")
         if self.disagg_handoff_wait_s < 0:
@@ -330,6 +356,15 @@ class CacheConfig:
         if self.remote_prefetch is None:
             return self.remote_kv_url is not None
         return bool(self.remote_prefetch)
+
+    @property
+    def wire_quantized(self) -> bool:
+        """Resolved wire representation: True when offload/remote
+        snapshots carry the int8 cache's native (data, scale) tuples
+        (kv_cache_dtype=int8 with kv_wire_format auto/int8); False is
+        the dense wire — always for dense caches, and for int8 caches
+        pinned to the legacy fp32 wire."""
+        return self.kv_cache_dtype == "int8" and self.kv_wire_format != "fp32"
 
 
 @dataclasses.dataclass

@@ -223,12 +223,29 @@ class LLMEngine:
         )
 
         offload_bytes = int(config.cache.host_offload_gb * 2**30)
+        # Wire representation for offload/remote snapshots
+        # (cache.kv_wire_format): with an int8 cache the tiers carry the
+        # native (data, scale) tuples end-to-end; bytes crossing each
+        # tier boundary and serde versions feed
+        # tpu:kv_wire_bytes_total{tier,format} /
+        # tpu:kv_snapshot_format_total{version}.
+        from production_stack_tpu.kvserver.protocol import KVWireStats
+
+        self._wire_quantized = config.cache.wire_quantized
+        self.kv_wire_stats = KVWireStats()
         remote_client = None
         if config.cache.remote_kv_url:
             from production_stack_tpu.kvserver.client import RemoteKVClient
 
-            remote_client = RemoteKVClient(config.cache.remote_kv_url)
-        self.offload = HostOffloadManager(offload_bytes, remote_client)
+            remote_client = RemoteKVClient(
+                config.cache.remote_kv_url, wire_stats=self.kv_wire_stats,
+                require_v2=config.cache.kv_wire_format == "int8",
+            )
+        self.offload = HostOffloadManager(
+            offload_bytes, remote_client,
+            quantized_wire=self._wire_quantized,
+            wire_stats=self.kv_wire_stats,
+        )
         # Asynchronous batched KV transfer plane (cache.remote_prefetch):
         # admission-time remote-prefix prefetch on fetcher threads,
         # off-step offload staging, async restore page-in.  None when no
@@ -1877,10 +1894,18 @@ class LLMEngine:
         ids = jnp.asarray(restored, jnp.int32)
         for layer_idx, (k_host, v_host) in enumerate(entry.layers):
             k_cache, v_cache = self.kv_caches[layer_idx]
-            # set_blocks handles both dense and int8 (data, scale) sides.
+            # set_blocks handles dense hosts (quantizing into int8
+            # pools) and native (data, scale) wire tuples (adopted
+            # untransformed — the no-requantize restore path).
             self.kv_caches[layer_idx] = (
-                kv_quant.set_blocks(k_cache, ids, k_host[:usable_blocks]),
-                kv_quant.set_blocks(v_cache, ids, v_host[:usable_blocks]),
+                kv_quant.set_blocks(
+                    k_cache, ids,
+                    kv_quant.slice_host_side(k_host, usable_blocks),
+                ),
+                kv_quant.set_blocks(
+                    v_cache, ids,
+                    kv_quant.slice_host_side(v_host, usable_blocks),
+                ),
             )
         seq.block_table = restored
         seq.num_cached_tokens = usable_blocks * bs
@@ -2018,8 +2043,18 @@ class LLMEngine:
         try:
             idx = jnp.asarray(ids, jnp.int32)
             for layer_idx, (k_cache, v_cache) in enumerate(self.kv_caches):
-                k_host = np.stack([b[layer_idx][0][0] for _, b in ready])
-                v_host = np.stack([b[layer_idx][1][0] for _, b in ready])
+                # Wire sides may be dense or native int8 tuples (and a
+                # mixed fleet can interleave both within one chain):
+                # stack_wire_blocks normalizes to THIS pool's format, so
+                # int8 chains land in an int8 pool without a quantize
+                # pass and bf16 pools dequantize at import.
+                pool_q = kv_quant.is_quantized(k_cache)
+                k_host = kv_quant.stack_wire_blocks(
+                    [b[layer_idx][0] for _, b in ready], pool_q
+                )
+                v_host = kv_quant.stack_wire_blocks(
+                    [b[layer_idx][1] for _, b in ready], pool_q
+                )
                 self.kv_caches[layer_idx] = (
                     kv_quant.set_blocks(k_cache, idx, k_host),
                     kv_quant.set_blocks(v_cache, idx, v_host),
@@ -2121,8 +2156,13 @@ class LLMEngine:
         try:
             idx = jnp.asarray(ids, jnp.int32)
             for layer_idx, (k_cache, v_cache) in enumerate(self.kv_caches):
-                k_host = np.stack([f[layer_idx][0][0] for f in fetched])
-                v_host = np.stack([f[layer_idx][1][0] for f in fetched])
+                pool_q = kv_quant.is_quantized(k_cache)
+                k_host = kv_quant.stack_wire_blocks(
+                    [f[layer_idx][0] for f in fetched], pool_q
+                )
+                v_host = kv_quant.stack_wire_blocks(
+                    [f[layer_idx][1] for f in fetched], pool_q
+                )
                 self.kv_caches[layer_idx] = (
                     kv_quant.set_blocks(k_cache, idx, k_host),
                     kv_quant.set_blocks(v_cache, idx, v_host),
@@ -2385,21 +2425,31 @@ class LLMEngine:
             [seq.block_table[i] for i, _ in todo], jnp.int32
         )
         try:
-            # One device->host gather per layer for all exported blocks
-            # (dense model-dtype wire format; int8 caches dequantize here
-            # so peers with any kv dtype can import).
+            # One device->host gather per layer for all exported blocks.
+            # Quantized wire: the int8 cache's (data, scale) tuples go
+            # out natively (serde v2; the client's probe falls back to a
+            # dense v1 encode against a legacy store).  Dense wire:
+            # int8 caches dequantize here so any peer can import.
             host_layers = [
-                (kv_quant.gather_blocks_host(k_cache, ids),
-                 kv_quant.gather_blocks_host(v_cache, ids))
+                (kv_quant.to_host_side(kv_quant.gather_blocks_wire(
+                    k_cache, ids, self._wire_quantized)),
+                 kv_quant.to_host_side(kv_quant.gather_blocks_wire(
+                    v_cache, ids, self._wire_quantized)))
                 for k_cache, v_cache in self.kv_caches
             ]
         except Exception:
             logger.exception("prefix export gather failed; continuing")
             return
+
+        def _row(side, row):
+            if kv_quant.is_quantized(side):
+                return (side[0][row : row + 1], side[1][row : row + 1])
+            return side[row : row + 1]
+
         key_prefix = self._px_key_prefix()
         for row, (_, digest) in enumerate(todo):
             layers = [
-                (k[row : row + 1], v[row : row + 1]) for k, v in host_layers
+                (_row(k, row), _row(v, row)) for k, v in host_layers
             ]
             try:
                 self._export_queue.put_nowait(
@@ -3248,9 +3298,11 @@ class LLMEngine:
         t0 = time.time()
         try:
             ids = jnp.asarray(block_ids, jnp.int32)
+            # Quantized wire: the gather stays int8 (data, scale) — half
+            # the D2H bytes, and restore adopts the tuples untransformed.
             device_layers = [
-                (kv_quant.gather_blocks_device(k_cache, ids),
-                 kv_quant.gather_blocks_device(v_cache, ids))
+                (kv_quant.gather_blocks_wire(k_cache, ids, self._wire_quantized),
+                 kv_quant.gather_blocks_wire(v_cache, ids, self._wire_quantized))
                 for k_cache, v_cache in self.kv_caches
             ]
         except Exception:
@@ -3428,4 +3480,10 @@ class LLMEngine:
             # emitted-but-undeliverable window tokens.
             "multistep_fallback": dict(self.multistep_fallback),
             "multistep_wasted_tokens": self.multistep_wasted_tokens,
+            # Quantized KV tiering plane: bytes crossing each tier
+            # boundary by wire format, and snapshot serde versions put
+            # on the kvserver wire (tpu:kv_wire_bytes_total /
+            # tpu:kv_snapshot_format_total).
+            "kv_wire_bytes": self.kv_wire_stats.wire_bytes(),
+            "kv_snapshot_format": self.kv_wire_stats.snapshot_formats(),
         }

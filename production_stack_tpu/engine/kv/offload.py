@@ -37,15 +37,40 @@ import numpy as np
 
 if TYPE_CHECKING:
     from production_stack_tpu.kvserver.client import RemoteKVClient
+    from production_stack_tpu.kvserver.protocol import KVWireStats
 
 logger = logging.getLogger(__name__)
+
+
+def _side_nbytes(side) -> int:
+    """Bytes of a host wire side: dense ndarray or (data, scale) tuple
+    (kept module-local and numpy-only so sizing a snapshot never pulls
+    the jax import that kv/quant carries)."""
+    if isinstance(side, tuple):
+        return side[0].nbytes + side[1].nbytes
+    return side.nbytes
+
+
+def _layers_nbytes(layers) -> int:
+    return sum(_side_nbytes(k) + _side_nbytes(v) for k, v in layers)
+
+
+def _layers_wire_format(layers) -> str:
+    """Label for tpu:kv_wire_bytes_total{format}: "int8" when any side
+    rides the quantized wire, else "dense"."""
+    for k, v in layers:
+        if isinstance(k, tuple) or isinstance(v, tuple):
+            return "int8"
+    return "dense"
 
 
 @dataclasses.dataclass
 class OffloadEntry:
     seq_id: str
     num_tokens: int
-    # Per layer: (k_blocks, v_blocks) as host numpy arrays [nb, bs, K, D].
+    # Per layer: (k_blocks, v_blocks) host wire sides — dense numpy
+    # arrays [nb, bs, K, D], or native quantized (data int8 [nb, bs, K,
+    # D], scale fp32 [nb, bs, K]) tuples (cache.kv_wire_format).
     layers: List[Tuple[np.ndarray, np.ndarray]]
     nbytes: int
     saved_at: float = dataclasses.field(default_factory=time.time)
@@ -55,7 +80,15 @@ class HostOffloadManager:
     """Bounded host-DRAM pool of per-sequence KV block snapshots."""
 
     def __init__(self, capacity_bytes: int,
-                 remote_client: Optional["RemoteKVClient"] = None):
+                 remote_client: Optional["RemoteKVClient"] = None,
+                 quantized_wire: bool = False,
+                 wire_stats: Optional["KVWireStats"] = None):
+        # Quantized snapshots (cache.wire_quantized): the sync save path
+        # gathers the int8 cache's native (data, scale) tuples instead
+        # of dequantizing to the dense wire — ~4x the resident tokens
+        # per host-DRAM byte.
+        self.quantized_wire = bool(quantized_wire)
+        self.wire_stats = wire_stats
         self.capacity_bytes = int(capacity_bytes)
         self.used_bytes = 0
         self._entries: Dict[str, OffloadEntry] = {}
@@ -104,12 +137,19 @@ class HostOffloadManager:
         ids = np.asarray(block_ids, dtype=np.int32)
         layers: List[Tuple[np.ndarray, np.ndarray]] = []
         for k_cache, v_cache in kv_caches:
-            # Device-side gather then one contiguous DMA per layer
-            # (int8 caches dequantize to the dense host/wire format —
-            # the requantize on restore is exactly idempotent, quant.py).
-            k_host = kv_quant.gather_blocks_host(k_cache, ids)
-            v_host = kv_quant.gather_blocks_host(v_cache, ids)
-            layers.append((k_host, v_host))
+            # Device-side gather then one contiguous DMA per layer.  The
+            # quantized wire DMAs the int8 cache's native (data, scale)
+            # tuples; the dense (fp32) wire dequantizes first — its
+            # requantize on restore is exactly idempotent (quant.py).
+            k_dev = kv_quant.gather_blocks_wire(
+                k_cache, ids, self.quantized_wire
+            )
+            v_dev = kv_quant.gather_blocks_wire(
+                v_cache, ids, self.quantized_wire
+            )
+            layers.append(
+                (kv_quant.to_host_side(k_dev), kv_quant.to_host_side(v_dev))
+            )
         return self.insert_saved(seq_id, layers, num_tokens)
 
     def insert_saved(
@@ -121,7 +161,7 @@ class HostOffloadManager:
         """Record an already-gathered host snapshot (step thread via
         save(), or the OffloadStager writer thread) and mirror it to the
         remote tier when configured."""
-        nbytes = sum(k.nbytes + v.nbytes for k, v in layers)
+        nbytes = _layers_nbytes(layers)
         with self._lock:
             while (
                 self.used_bytes + nbytes > self.capacity_bytes
@@ -136,6 +176,12 @@ class HostOffloadManager:
             )
             self.used_bytes += nbytes
             self.saves += 1
+        # Counted only once the snapshot LANDED in the tier (an
+        # over-capacity rejection moved nothing).
+        if self.wire_stats is not None:
+            self.wire_stats.add_wire(
+                "host", _layers_wire_format(layers), nbytes
+            )
         if self.remote_client is not None:
             try:
                 self.remote_client.put_blocks(seq_id, layers, num_tokens)
@@ -179,7 +225,7 @@ class HostOffloadManager:
                     seq_id=seq_id,
                     num_tokens=num_tokens,
                     layers=layers,
-                    nbytes=sum(k.nbytes + v.nbytes for k, v in layers),
+                    nbytes=_layers_nbytes(layers),
                 )
         return None
 
@@ -192,7 +238,7 @@ class HostOffloadManager:
         """Cache a remote snapshot locally (the async restore fetcher's
         landing point): the next restore_local() finds it without any
         RPC.  Marks the seq as remote-resident so discard() still DELs."""
-        nbytes = sum(k.nbytes + v.nbytes for k, v in layers)
+        nbytes = _layers_nbytes(layers)
         entry = OffloadEntry(
             seq_id=seq_id, num_tokens=num_tokens, layers=layers, nbytes=nbytes
         )
@@ -420,8 +466,11 @@ class OffloadStager:
                 return
             seq_id, device_layers, num_tokens, t0 = item
             try:
+                from production_stack_tpu.engine.kv import quant as kv_quant
+
                 layers = [
-                    (np.asarray(k), np.asarray(v)) for k, v in device_layers
+                    (kv_quant.to_host_side(k), kv_quant.to_host_side(v))
+                    for k, v in device_layers
                 ]
                 with self._lock:
                     dead = self._dead

@@ -16,15 +16,20 @@ sharding work unchanged (scales shard over tp on the K axis exactly like
 the data).  Quantization is DYNAMIC per written vector (scale =
 max|x|/127 at write time), so appends never rescale existing entries.
 
-Host offload and the remote store keep a DENSE FP32 wire format: the
-fp32 dequantize/requantize round-trip is exactly idempotent (the
-dequantized vector's max-abs IS scale*127, so requantization reproduces
-the identical int8 data), which is what makes offload-restore
-bit-preserving; a model-dtype (bf16) wire would halve those bytes but
-round the values and break that guarantee.  The trade is deliberate:
-offload lives in host DRAM and the store on the datacenter network,
-where 2x bytes is cheaper than any restore-fidelity wobble.  Importers
-cast-or-quantize whatever arrives, so engines with different kv dtypes
+Host offload and the remote store carry the QUANTIZED representation
+end-to-end by default (cache.kv_wire_format="auto"): an int8 cache's
+(data, scale) tuples serialize natively — no dequant round-trip on the
+D2H path, ~4x more resident tokens per byte in the host tier than the
+retired fp32 wire, and restore is trivially bit-preserving because
+nothing is transformed.  The kvserver snapshot serde is versioned for
+this (kvserver/protocol.py: v1 = legacy dense fp32, v2 = int8 data +
+fp32 scales); dense caches still write v1 frames, and
+cache.kv_wire_format="fp32" pins an int8 cache to the legacy dense
+wire too — that fallback stays exactly idempotent (the dequantized
+vector's max-abs IS scale*127, so requantization reproduces the
+identical int8 data) and remains parity-tested.  Importers adopt
+natively or cast/quantize whatever arrives, so engines with different
+kv dtypes (and serde versions, via the client's probe-once fallback)
 interoperate either way.
 
 The reference has no analogue (KV precision lives inside its external
@@ -77,9 +82,10 @@ def dequantize(data: jax.Array, scale: jax.Array, dtype=None) -> jax.Array:
 
 # -- generic cache-side block transfer (engine / offload / disagg) ---------
 #
-# Host/wire blocks are DENSE [n, bs, K, D] arrays — the cache's own dtype
-# for plain caches, fp32 for quantized ones (exact requantization; see
-# module docstring).  These helpers are the single conversion boundary.
+# A host/wire block side is either a DENSE [n, bs, K, D] array (plain
+# caches; the fp32 legacy wire for quantized ones) or the quantized
+# (data int8 [n, bs, K, D], scale fp32 [n, bs, K]) tuple (the native
+# int8 wire).  These helpers are the single conversion boundary.
 
 
 def gather_blocks_device(side, ids: jax.Array) -> jax.Array:
@@ -101,9 +107,82 @@ def gather_blocks_host(side, ids: jax.Array) -> np.ndarray:
     return np.asarray(gather_blocks_device(side, ids))
 
 
+def gather_blocks_wire(side, ids: jax.Array, quantized_wire: bool):
+    """Device gather of whole blocks in WIRE format: for a quantized
+    cache with the int8 wire active this is the native (data, scale)
+    tuple — no dequant pass, half the D2H bytes; otherwise the dense
+    array gather_blocks_device produces.  Async like
+    gather_blocks_device: fresh buffers, no host sync."""
+    if quantized_wire and is_quantized(side):
+        data, scale = side
+        return (data[ids], scale[ids])
+    return gather_blocks_device(side, ids)
+
+
+def to_host_side(side):
+    """Device wire side -> host numpy side (blocks on the D2H wait);
+    tuple-aware."""
+    if is_quantized(side):
+        return (np.asarray(side[0]), np.asarray(side[1]))
+    return np.asarray(side)
+
+
+def slice_host_side(side, n: int):
+    """First ``n`` blocks of a host wire side; tuple-aware."""
+    if is_quantized(side):
+        return (side[0][:n], side[1][:n])
+    return side[:n]
+
+
+def stack_wire_blocks(rows, pool_quantized: bool):
+    """Stack single-block host wire sides (each [1, bs, K, D] dense or
+    ((data [1, bs, K, D], scale [1, bs, K]))) into one [n, ...] side in
+    the POOL's preferred host format, normalizing per block — a mixed-
+    precision fleet can interleave dense- and int8-wire blocks within
+    one prefix chain.  int8 pools get (data, scale) with dense rows
+    host-quantized (bit-identical to the device quantizer — protocol
+    quantize_np mirrors quantize_vectors); dense pools get fp32 rows
+    with quantized blocks host-dequantized."""
+    from production_stack_tpu.kvserver import protocol as proto
+
+    if pool_quantized:
+        datas, scales = [], []
+        for row in rows:
+            if is_quantized(row):
+                datas.append(np.asarray(row[0][0]))
+                scales.append(np.asarray(row[1][0], np.float32))
+            else:
+                d, s = proto.quantize_np(np.asarray(row[0]))
+                datas.append(d)
+                scales.append(s)
+        return (np.stack(datas), np.stack(scales))
+    dense = []
+    for row in rows:
+        if is_quantized(row):
+            dense.append(
+                proto.dequantize_np(np.asarray(row[0][0]), np.asarray(row[1][0]))
+            )
+        else:
+            dense.append(np.asarray(row[0]))
+    return np.stack(dense)
+
+
 def set_blocks(side, ids: jax.Array, host_blocks) -> object:
-    """Write dense host blocks [n, bs, K, D] into the cache side
-    (quantizing when the side is quantized).  Returns the new side."""
+    """Write host blocks into the cache side and return the new side.
+    ``host_blocks`` is a dense [n, bs, K, D] array (quantized sides
+    quantize it on write) or a native (data, scale) tuple — adopted
+    as-is by a quantized side (the no-requantize restore/import path),
+    dequantized for a dense side (mixed-precision import)."""
+    if isinstance(host_blocks, tuple):
+        q_host, s_host = host_blocks
+        if is_quantized(side):
+            data, scale = side
+            return (
+                data.at[ids].set(jnp.asarray(q_host, data.dtype)),
+                scale.at[ids].set(jnp.asarray(s_host, scale.dtype)),
+            )
+        dense = dequantize(jnp.asarray(q_host), jnp.asarray(s_host))
+        return side.at[ids].set(dense.astype(side.dtype))
     if is_quantized(side):
         data, scale = side
         q, s = quantize_vectors(jnp.asarray(host_blocks))

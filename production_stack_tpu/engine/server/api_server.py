@@ -387,6 +387,28 @@ def build_engine_app(
                     **s["spec_window_tokens"],
                 },
             )
+            # Quantized KV tiering plane: bytes per tier boundary by
+            # wire format, and snapshot serde versions on the kvserver
+            # wire (pre-seeded with the closed label sets so scrapers
+            # see stable series from boot).
+            + vocab.render_labeled_counter2(
+                vocab.TPU_KV_WIRE_BYTES, ("tier", "format"),
+                {
+                    **{
+                        (t, f): 0
+                        for t in vocab.TPU_KV_WIRE_TIERS
+                        for f in vocab.TPU_KV_WIRE_FORMATS
+                    },
+                    **s["kv_wire_bytes"],
+                },
+            )
+            + vocab.render_labeled_counter(
+                vocab.TPU_KV_SNAPSHOT_FORMAT, "version",
+                {
+                    **dict.fromkeys(vocab.TPU_KV_SNAPSHOT_VERSIONS, 0),
+                    **s["kv_snapshot_format"],
+                },
+            )
             + engine.engine.obs.render_metrics()
         )
         return web.Response(text=text)
@@ -1881,6 +1903,18 @@ def main(argv=None) -> None:
         "stores cached K/V as int8 with per-(token, head) scales — KV HBM "
         "bytes roughly halve, so the pool holds ~2x the tokens",
     )
+    parser.add_argument(
+        "--kv-wire-format",
+        default=None,
+        choices=["auto", "fp32", "int8"],
+        help="offload/remote wire representation for quantized KV caches: "
+        "auto (default) serializes an int8 cache's native (data, scale) "
+        "tuples — ~4x resident tokens per host-DRAM byte, kvserver serde "
+        "v2 with a probe-once dense-v1 fallback against legacy stores; "
+        "fp32 pins the legacy dense wire (rollout escape hatch / A/B "
+        "baseline); int8 is auto plus strictness (requires an int8 "
+        "cache; a non-v2 store logs a loud downgrade warning)",
+    )
     parser.add_argument("--dtype", default=None, help="override preset dtype")
     parser.add_argument(
         "--quantization",
@@ -1999,6 +2033,10 @@ def main(argv=None) -> None:
             **(
                 {"cache.kv_cache_dtype": args.kv_cache_dtype}
                 if args.kv_cache_dtype else {}
+            ),
+            **(
+                {"cache.kv_wire_format": args.kv_wire_format}
+                if args.kv_wire_format else {}
             ),
             **({"model.dtype": args.dtype} if args.dtype else {}),
             **(

@@ -14,6 +14,16 @@ ONE framed round-trip (protocol.py OP_MGET/OP_MPUT).  Against a server
 that predates the ops (e.g. an un-rebuilt native/kvserver binary) the
 first ST_ERROR reply flips a support flag and the call degrades to the
 serial per-key path — same results, just one RTT per key again.
+
+Snapshot serde versioning rides the same probe-once pattern: quantized
+(data, scale) payloads want the v2 tagged frame, but a v1-only fleet
+(an old store build, or old peer engines behind a store that never
+advertised v2) must never receive bytes it would misparse.  Before the
+first v2 encode the client asks the server's STAT for
+``snapshot_versions``; a store that doesn't list 2 latches the client
+to the dense v1 wire (quantized sides dequantize at encode — exactly
+requantizable, so nothing corrupts), and a transient STAT failure
+degrades THIS call without latching.
 """
 
 from __future__ import annotations
@@ -37,7 +47,14 @@ Snapshot = Tuple[List[Tuple[np.ndarray, np.ndarray]], int]
 
 
 class RemoteKVClient:
-    def __init__(self, url: str, timeout: float = 10.0, pool_size: int = 4):
+    def __init__(self, url: str, timeout: float = 10.0, pool_size: int = 4,
+                 wire_stats: Optional["proto.KVWireStats"] = None,
+                 require_v2: bool = False):
+        # require_v2 (cache.kv_wire_format="int8"): the operator asked
+        # for the quantized wire explicitly, so a store that fails the
+        # v2 probe triggers a WARNING at latch time — the downgrade to
+        # dense v1 still happens (degrading beats dying mid-export),
+        # but never silently.
         parsed = urlparse(url)
         if parsed.scheme not in ("kv", "tcp"):
             raise ValueError(f"Unsupported KV store URL scheme: {url}")
@@ -45,12 +62,17 @@ class RemoteKVClient:
         self.port = parsed.port or 9400
         self.timeout = timeout
         self.pool_size = max(1, int(pool_size))
+        self.wire_stats = wire_stats  # tpu:kv_wire_bytes_total feed
+        self.require_v2 = bool(require_v2)
         self._cv = threading.Condition()
         self._idle: List[socket.socket] = []
         self._live = 0  # connections checked out + idle
         # Batched-op support, cleared on the first ST_ERROR reply so a
         # legacy server costs exactly one failed probe per process.
         self._batch_ok = True
+        # Snapshot serde-v2 support: None = not yet probed; the answer
+        # is remembered (probe once) so a legacy fleet costs one STAT.
+        self._snapshot_v2: Optional[bool] = None
 
     # -- socket plumbing ---------------------------------------------------
 
@@ -159,16 +181,75 @@ class RemoteKVClient:
 
     # -- KV snapshot API ---------------------------------------------------
 
+    def snapshot_wire_version(self, layers) -> int:
+        """Serde version the next encode of ``layers`` will use: v2 for
+        quantized payloads IF the store advertises it, v1 otherwise.
+        The v2 probe (one STAT, answer remembered) only runs when a
+        quantized payload first needs it."""
+        quantized = any(
+            proto.is_quantized_side(k) or proto.is_quantized_side(v)
+            for k, v in layers
+        )
+        if not quantized:
+            return proto.SNAPSHOT_V1
+        with self._cv:
+            known = self._snapshot_v2
+        if known is None:
+            try:
+                versions = self.stat().get("snapshot_versions", [1])
+                known = proto.SNAPSHOT_V2 in versions
+            except Exception:
+                # Transient STAT failure: degrade THIS call to the safe
+                # dense wire without latching the answer.
+                return proto.SNAPSHOT_V1
+            with self._cv:
+                self._snapshot_v2 = known
+            if not known and self.require_v2:
+                logger.warning(
+                    "kv_wire_format=int8 requested but the KV store at "
+                    "%s:%d does not advertise snapshot serde v2 "
+                    "(legacy build, or pinned --max-snapshot-version 1):"
+                    " remote snapshots DOWNGRADE to the dense v1 wire "
+                    "(~4x the bytes) until the store is upgraded",
+                    self.host, self.port,
+                )
+        return proto.SNAPSHOT_V2 if known else proto.SNAPSHOT_V1
+
+    def _encode_snapshot(self, layers, num_tokens: int) -> bytes:
+        return proto.encode_kv_snapshot(
+            layers, num_tokens, version=self.snapshot_wire_version(layers)
+        )
+
+    def _note_wire(self, blob: bytes, sent: bool) -> None:
+        # Called only after a frame actually MOVED (PUT/MPUT accepted,
+        # GET/MGET payload received): a refused MPUT batch retried
+        # serially must count its snapshots once, not per encode.
+        if self.wire_stats is None:
+            return
+        try:
+            version = proto.snapshot_version(blob)
+        except ValueError:
+            return  # malformed frames are the decoder's error to raise
+        fmt = "int8" if version >= proto.SNAPSHOT_V2 else "dense"
+        self.wire_stats.add_wire("remote", fmt, len(blob))
+        if sent:
+            self.wire_stats.add_snapshot(version)
+
+    def _decode_snapshot(self, payload: bytes) -> Snapshot:
+        self._note_wire(payload, sent=False)
+        return proto.decode_kv_snapshot(payload)
+
     def put_blocks(
         self,
         seq_id: str,
         layers: List[Tuple[np.ndarray, np.ndarray]],
         num_tokens: int,
     ) -> None:
-        blob = proto.encode_kv_snapshot(layers, num_tokens)
+        blob = self._encode_snapshot(layers, num_tokens)
         status, _ = self._call(proto.OP_PUT, seq_id.encode(), blob)
         if status != proto.ST_OK:
             raise RuntimeError(f"KV PUT failed with status {status}")
+        self._note_wire(blob, sent=True)
 
     def get_blocks(self, seq_id: str) -> Optional[Snapshot]:
         status, payload = self._call(proto.OP_GET, seq_id.encode())
@@ -176,7 +257,7 @@ class RemoteKVClient:
             return None
         if status != proto.ST_OK:
             raise RuntimeError(f"KV GET failed with status {status}")
-        return proto.decode_kv_snapshot(payload)
+        return self._decode_snapshot(payload)
 
     def mget_blocks(self, keys: List[str]) -> List[Snapshot]:
         """Fetch the PRESENT PREFIX of a key chain: decoded snapshots for
@@ -206,7 +287,7 @@ class RemoteKVClient:
                 if status != proto.ST_OK:
                     raise RuntimeError(f"KV MGET failed with status {status}")
                 values = proto.unpack_value_list(payload)
-                out.extend(proto.decode_kv_snapshot(v) for v in values)
+                out.extend(self._decode_snapshot(v) for v in values)
                 if len(values) < len(chunk):
                     return out
             else:
@@ -230,7 +311,7 @@ class RemoteKVClient:
         blobs: List[bytes] = []
         size = 0
         for key, layers, num_tokens in entries:
-            blob = proto.encode_kv_snapshot(layers, num_tokens)
+            blob = self._encode_snapshot(layers, num_tokens)
             if keys and (
                 len(keys) >= proto.MAX_KEYS_PER_BATCH
                 or size + len(blob) > self._MPUT_BYTE_CAP
@@ -295,6 +376,8 @@ class RemoteKVClient:
                     break
                 if status != proto.ST_OK:
                     raise RuntimeError(f"KV MPUT failed with status {status}")
+                for blob in blobs:
+                    self._note_wire(blob, sent=True)
                 done += len(keys)
             else:
                 return

@@ -32,18 +32,37 @@ Batched ops (one framed round-trip for a whole hash chain):
     frame has a value field an old server would misparse, so clients must
     reset the connection after any MPUT error reply.
 
-The ``naive`` serde stores a sequence's KV snapshot as:
+KV snapshot serde is VERSIONED so mixed-precision fleets interop during
+a rollout:
 
-    num_tokens u32, num_layers u32, then per layer:
-      k: ndim u8, shape u32*ndim, dtype_code u8, data
-      v: same
+    v1 (legacy, untagged): num_tokens u32, num_layers u32, then per
+      layer k then v, each a DENSE array:
+        ndim u8, shape u32*ndim, dtype_code u8, data
+      dtype codes: 0=float32, 1=bfloat16(stored as u16), 2=float16,
+      3=int8.
 
-dtype codes: 0=float32, 1=bfloat16(stored as u16), 2=float16, 3=int8.
+    v2 (tagged, quantized wire): marker u32 = 0xFF000000|2 — the high
+      byte can never collide with a v1 ``num_tokens`` (bounded by
+      max_model_len, orders of magnitude below 2^24) — then
+      num_tokens u32, num_layers u32, and per layer k then v, each a
+      SIDE: kind u8 (0=dense -> one array as in v1; 1=int8-quantized ->
+      an int8 data array + an fp32 scale array, the cache's native
+      (data, scale) representation from engine/kv/quant.py).
+
+Dense snapshots always encode as v1, so fp32-wire configs stay
+byte-identical to the legacy format and a v1-only peer keeps reading
+them; v2 appears on the wire only for quantized payloads, and only
+after the client has probed the store for v2 support (STAT advertises
+``snapshot_versions`` — the PR-4 legacy-fallback pattern: probe once,
+remember, never corrupt).  Decoding is strict: an unknown version
+marker, a truncated frame, or trailing garbage raises ValueError
+loudly instead of yielding silently-wrong tensors.
 """
 
 from __future__ import annotations
 
 import struct
+import threading
 from typing import List, Tuple
 
 import numpy as np
@@ -65,6 +84,94 @@ MAX_KEYS_PER_BATCH = 512
 _DTYPES = {0: np.float32, 2: np.float16, 3: np.int8}
 _DTYPE_CODES = {np.dtype(np.float32): 0, np.dtype(np.float16): 2, np.dtype(np.int8): 3}
 _BF16_CODE = 1
+
+# -- KV snapshot versioning --------------------------------------------------
+
+SNAPSHOT_V1 = 1  # legacy untagged dense frame
+SNAPSHOT_V2 = 2  # tagged frame; sides may carry (int8 data, fp32 scale)
+SNAPSHOT_VERSIONS = (SNAPSHOT_V1, SNAPSHOT_V2)
+# v2+ frames open with 0xFF000000|version; a v1 frame opens with
+# num_tokens, which is bounded by max_model_len and can never reach the
+# marker range.
+_VERSION_MARKER_BASE = 0xFF000000
+_SIDE_DENSE = 0
+_SIDE_Q8 = 1
+
+
+def snapshot_version(blob: bytes) -> int:
+    """Peek a snapshot frame's serde version without decoding it."""
+    if len(blob) < 4:
+        raise ValueError("KV snapshot shorter than its header")
+    (head,) = struct.unpack_from("<I", blob, 0)
+    if head < _VERSION_MARKER_BASE:
+        return SNAPSHOT_V1
+    version = head - _VERSION_MARKER_BASE
+    if version not in SNAPSHOT_VERSIONS:
+        raise ValueError(f"unknown KV snapshot version {version}")
+    return version
+
+
+def is_quantized_side(side) -> bool:
+    """A wire-level cache side is a dense ndarray or an (int8 data,
+    fp32 scale) tuple — the same convention engine/kv/quant.py uses for
+    in-HBM sides."""
+    return isinstance(side, tuple)
+
+
+def dequantize_np(data: np.ndarray, scale: np.ndarray) -> np.ndarray:
+    """Host-side (numpy) dequantize of an (int8 [..., D], scale [...])
+    pair to fp32 — the v1 dense-wire fallback for quantized payloads.
+    Mirrors engine/kv/quant.py dequantize bit-for-bit (fp32 multiply)."""
+    return data.astype(np.float32) * np.asarray(scale, np.float32)[..., None]
+
+
+def quantize_np(x: np.ndarray):
+    """Host-side (numpy) per-vector symmetric int8 quantization over the
+    trailing axis; mirrors engine/kv/quant.py quantize_vectors (fp32
+    math, round-half-to-even) so host- and device-quantized blocks are
+    bit-identical."""
+    x32 = np.asarray(x, np.float32)
+    amax = np.max(np.abs(x32), axis=-1)
+    scale = amax / 127.0
+    safe = np.where(scale > 0, scale, 1.0)
+    data = np.clip(np.round(x32 / safe[..., None]), -127.0, 127.0).astype(
+        np.int8
+    )
+    return data, scale
+
+
+class KVWireStats:
+    """Thread-safe accounting of KV bytes crossing tier boundaries and
+    snapshot serde versions (feeds ``tpu:kv_wire_bytes_total{tier,
+    format}`` and ``tpu:kv_snapshot_format_total{version}``).  Shared by
+    the engine's offload manager (host tier) and its kvserver client
+    (remote tier); all writers are off-step worker threads plus the
+    legacy sync paths."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._wire_bytes: dict = {}  # (tier, format) -> bytes
+        self._snapshots: dict = {}  # "v1"/"v2" -> count
+
+    def add_wire(self, tier: str, fmt: str, nbytes: int) -> None:
+        with self._lock:
+            key = (tier, fmt)
+            self._wire_bytes[key] = self._wire_bytes.get(key, 0) + int(nbytes)
+
+    def add_snapshot(self, version: int) -> None:
+        with self._lock:
+            key = f"v{version}"
+            self._snapshots[key] = self._snapshots.get(key, 0) + 1
+
+    def wire_bytes(self) -> dict:
+        """{(tier, format): bytes} snapshot."""
+        with self._lock:
+            return dict(self._wire_bytes)
+
+    def snapshot_formats(self) -> dict:
+        """{"v1"/"v2": count} snapshot."""
+        with self._lock:
+            return dict(self._snapshots)
 
 
 def _encode_array(arr: np.ndarray) -> bytes:
@@ -102,25 +209,100 @@ def _decode_array(buf: memoryview, offset: int) -> Tuple[np.ndarray, int]:
     return arr, offset
 
 
+def _encode_side(side, version: int) -> bytes:
+    """One cache side in the chosen serde version.  Quantized (data,
+    scale) sides encode natively under v2; under v1 they dequantize to
+    the legacy dense fp32 wire (exactly requantizable — quant.py)."""
+    if is_quantized_side(side):
+        data, scale = np.asarray(side[0]), np.asarray(side[1])
+        if version >= SNAPSHOT_V2:
+            return (
+                struct.pack("<B", _SIDE_Q8)
+                + _encode_array(data)
+                + _encode_array(np.asarray(scale, np.float32))
+            )
+        return _encode_array(dequantize_np(data, scale))
+    arr = np.asarray(side)
+    if version >= SNAPSHOT_V2:
+        return struct.pack("<B", _SIDE_DENSE) + _encode_array(arr)
+    return _encode_array(arr)
+
+
 def encode_kv_snapshot(
-    layers: List[Tuple[np.ndarray, np.ndarray]], num_tokens: int
+    layers: List[Tuple[np.ndarray, np.ndarray]],
+    num_tokens: int,
+    version: int = None,
 ) -> bytes:
-    parts = [struct.pack("<II", num_tokens, len(layers))]
+    """Serialize per-layer (k, v) sides.  A side is a dense ndarray or a
+    quantized (int8 data, fp32 scale) tuple.  ``version`` None = auto:
+    v2 iff any side is quantized (dense frames stay v1-identical to the
+    legacy wire); version=1 forces the dense fp32 legacy frame
+    (dequantizing quantized sides — the v1-only-peer fallback)."""
+    if version is None:
+        quantized = any(
+            is_quantized_side(k) or is_quantized_side(v) for k, v in layers
+        )
+        version = SNAPSHOT_V2 if quantized else SNAPSHOT_V1
+    if version not in SNAPSHOT_VERSIONS:
+        raise ValueError(f"unknown KV snapshot version {version}")
+    parts = []
+    if version >= SNAPSHOT_V2:
+        parts.append(struct.pack("<I", _VERSION_MARKER_BASE + version))
+    parts.append(struct.pack("<II", num_tokens, len(layers)))
     for k, v in layers:
-        parts.append(_encode_array(np.asarray(k)))
-        parts.append(_encode_array(np.asarray(v)))
+        parts.append(_encode_side(k, version))
+        parts.append(_encode_side(v, version))
     return b"".join(parts)
 
 
+def _decode_side(buf: memoryview, offset: int, version: int):
+    if version == SNAPSHOT_V1:
+        return _decode_array(buf, offset)
+    if offset >= len(buf):
+        raise ValueError("truncated KV snapshot (missing side kind)")
+    kind = buf[offset]
+    offset += 1
+    if kind == _SIDE_DENSE:
+        return _decode_array(buf, offset)
+    if kind == _SIDE_Q8:
+        data, offset = _decode_array(buf, offset)
+        scale, offset = _decode_array(buf, offset)
+        if data.dtype != np.int8 or scale.dtype != np.float32:
+            raise ValueError(
+                "malformed quantized KV side: expected int8 data + fp32 "
+                f"scales, got {data.dtype}/{scale.dtype}"
+            )
+        if data.shape[:-1] != scale.shape:
+            raise ValueError(
+                "malformed quantized KV side: scale shape "
+                f"{scale.shape} does not match data {data.shape}"
+            )
+        return (data, scale), offset
+    raise ValueError(f"unknown KV snapshot side kind {kind}")
+
+
 def decode_kv_snapshot(data: bytes) -> Tuple[List[Tuple[np.ndarray, np.ndarray]], int]:
+    """Strict decode of either serde version.  Returned sides are dense
+    ndarrays (v1, or v2 dense sides) or (int8 data, fp32 scale) tuples
+    (v2 quantized sides); truncated or trailing-garbage frames raise
+    ValueError instead of yielding silently-wrong tensors."""
+    version = snapshot_version(data)
     buf = memoryview(data)
-    num_tokens, num_layers = struct.unpack_from("<II", buf, 0)
-    offset = 8
+    offset = 4 if version >= SNAPSHOT_V2 else 0
+    if len(buf) < offset + 8:
+        raise ValueError("truncated KV snapshot header")
+    num_tokens, num_layers = struct.unpack_from("<II", buf, offset)
+    offset += 8
     layers = []
-    for _ in range(num_layers):
-        k, offset = _decode_array(buf, offset)
-        v, offset = _decode_array(buf, offset)
-        layers.append((k, v))
+    try:
+        for _ in range(num_layers):
+            k, offset = _decode_side(buf, offset, version)
+            v, offset = _decode_side(buf, offset, version)
+            layers.append((k, v))
+    except (struct.error, IndexError, KeyError) as e:
+        raise ValueError(f"truncated or malformed KV snapshot: {e}") from e
+    if offset != len(buf):
+        raise ValueError("trailing bytes after KV snapshot")
     return layers, num_tokens
 
 

@@ -22,7 +22,14 @@ logger = logging.getLogger(__name__)
 
 
 class KVStore:
-    def __init__(self, capacity_bytes: int):
+    def __init__(self, capacity_bytes: int, max_snapshot_version: int = 2):
+        # The serde-rollout switch (--max-snapshot-version): the store
+        # advertises which snapshot versions the DEPLOYMENT accepts, and
+        # clients probe it before putting v2 (quantized) frames on the
+        # wire.  Hold it at 1 until every engine that READS this store
+        # speaks v2 — values are opaque blobs to the store itself; the
+        # field protects not-yet-upgraded consumer peers.
+        self.max_snapshot_version = int(max_snapshot_version)
         self.capacity_bytes = capacity_bytes
         self.used = 0
         self._data: Dict[bytes, Tuple[bytes, float]] = {}
@@ -65,6 +72,15 @@ class KVStore:
             "hits": self.hits,
             "misses": self.misses,
             "ops": dict(self.ops),
+            # Snapshot serde versions this deployment accepts: clients
+            # probe this before putting v2 (quantized) frames on the
+            # wire, so a fleet behind a legacy store — or one pinned to
+            # --max-snapshot-version 1 mid-rollout — stays on dense v1
+            # (protocol.py versioning).
+            "snapshot_versions": [
+                v for v in proto.SNAPSHOT_VERSIONS
+                if v <= self.max_snapshot_version
+            ],
         }
 
 
@@ -175,9 +191,10 @@ async def handle_client(
 
 
 async def serve(
-    host: str, port: int, capacity_bytes: int, latency_s: float = 0.0
+    host: str, port: int, capacity_bytes: int, latency_s: float = 0.0,
+    max_snapshot_version: int = 2,
 ) -> None:
-    store = KVStore(capacity_bytes)
+    store = KVStore(capacity_bytes, max_snapshot_version=max_snapshot_version)
     server = await asyncio.start_server(
         lambda r, w: handle_client(store, r, w, latency_s=latency_s),
         host, port,
@@ -197,12 +214,20 @@ def main(argv=None) -> None:
         help="per-frame service delay for latency testing (never set in "
         "production)",
     )
+    parser.add_argument(
+        "--max-snapshot-version", type=int, default=2, choices=[1, 2],
+        help="highest KV snapshot serde version to advertise via STAT "
+        "(the mixed-fleet rollout switch: hold at 1 until every engine "
+        "that reads this store speaks v2, so quantized writers keep "
+        "encoding the dense v1 frames old readers can parse)",
+    )
     parser.add_argument("--log-level", default="info")
     args = parser.parse_args(argv)
     init_logger("production_stack_tpu", args.log_level)
     asyncio.run(serve(
         args.host, args.port, int(args.capacity_gb * 2**30),
         latency_s=args.inject_latency_ms / 1e3,
+        max_snapshot_version=args.max_snapshot_version,
     ))
 
 

@@ -202,6 +202,22 @@ REGISTRY = {
                 "out-of-band finish mid-window; device stop-mask keeps "
                 "ordinary stops at zero waste)",
     },
+    "tpu:kv_wire_bytes_total": {
+        "kind": "counter", "layer": "engine", "labels": ("tier", "format"),
+        "mirrors": ("fake_engine", "dashboard", "docs"),
+        "help": "KV snapshot bytes crossing a tier boundary (tier: host "
+                "| remote) by wire representation (format: dense | int8 "
+                "— int8 is the native quantized (data, scale) wire; a "
+                "quantized-cache fleet stuck on dense is paying the "
+                "retired fp32 round-trip)",
+    },
+    "tpu:kv_snapshot_format_total": {
+        "kind": "counter", "layer": "engine", "labels": ("version",),
+        "mirrors": ("fake_engine", "dashboard", "docs"),
+        "help": "KV snapshots encoded onto the kvserver wire by serde "
+                "version (v1: legacy untagged dense fp32; v2: tagged "
+                "int8 data + fp32 scales — kvserver/protocol.py)",
+    },
     # -- engine request-level histograms (obs layer) -----------------------
     "tpu:ttft_seconds": {
         "kind": "histogram", "layer": "engine",

@@ -157,6 +157,20 @@ TPU_MULTISTEP_WASTED_TOKENS = "tpu:multistep_wasted_tokens_total"
 TPU_DISAGG_PREFILL_PRIMES = "tpu:disagg_prefill_primes_total"
 TPU_DISAGG_HANDOFF_HITS = "tpu:disagg_handoff_hits_total"
 TPU_DISAGG_HANDOFF_MISSES = "tpu:disagg_handoff_misses_total"
+# Quantized KV tiering plane (engine/kv/quant.py, kvserver/protocol.py
+# serde versioning): bytes crossing each tier boundary (tier ∈ host /
+# remote) by wire representation (format ∈ dense / int8 — int8 is the
+# native (data, scale) quantized wire, dense the legacy fp32/model-dtype
+# wire), and KV snapshots encoded onto the kvserver wire by serde
+# version (v1 = untagged dense, v2 = tagged quantized).  A quantized-
+# cache fleet stuck on {format="dense"} / {version="v1"} means the
+# store never advertised serde v2 — the rollout is incomplete and every
+# offload/export is paying the retired 4x fp32 byte tax.
+TPU_KV_WIRE_BYTES = "tpu:kv_wire_bytes_total"
+TPU_KV_WIRE_TIERS = ("host", "remote")
+TPU_KV_WIRE_FORMATS = ("dense", "int8")
+TPU_KV_SNAPSHOT_FORMAT = "tpu:kv_snapshot_format_total"
+TPU_KV_SNAPSHOT_VERSIONS = ("v1", "v2")
 TPU_COUNTERS = frozenset({
     TPU_TOTAL_PROMPT_TOKENS,
     TPU_TOTAL_GENERATED_TOKENS,
@@ -252,4 +266,19 @@ def render_labeled_counter(name: str, label: str, values) -> str:
     lines = [f"# TYPE {name} counter"]
     for key in sorted(values):
         lines.append(f'{name}{{{label}="{key}"}} {float(values[key])}')
+    return "\n".join(lines) + "\n"
+
+
+def render_labeled_counter2(name: str, labels, values) -> str:
+    """Two-label sibling of render_labeled_counter: ``values`` maps
+    (label1_value, label2_value) tuples to counts.  Same stable-TYPE-
+    header contract; shared by the real engine server and the fake
+    engine."""
+    l1, l2 = labels
+    lines = [f"# TYPE {name} counter"]
+    for key in sorted(values):
+        lines.append(
+            f'{name}{{{l1}="{key[0]}",{l2}="{key[1]}"}} '
+            f"{float(values[key])}"
+        )
     return "\n".join(lines) + "\n"

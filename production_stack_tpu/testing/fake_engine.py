@@ -306,6 +306,18 @@ def build_fake_engine_app(state: FakeEngineState | None = None) -> web.Applicati
             # the family must exist for the scrape contract (SC303).
             vocab.TPU_SPEC_WINDOW_TOKENS, "outcome",
             dict.fromkeys(vocab.TPU_SPEC_WINDOW_OUTCOMES, 0),
+        ) + vocab.render_labeled_counter2(
+            # Quantized KV tiering plane: no KV tiers in the fake, but
+            # both families must exist for the scrape contract (SC303).
+            vocab.TPU_KV_WIRE_BYTES, ("tier", "format"),
+            {
+                (t, f): 0
+                for t in vocab.TPU_KV_WIRE_TIERS
+                for f in vocab.TPU_KV_WIRE_FORMATS
+            },
+        ) + vocab.render_labeled_counter(
+            vocab.TPU_KV_SNAPSHOT_FORMAT, "version",
+            dict.fromkeys(vocab.TPU_KV_SNAPSHOT_VERSIONS, 0),
         ) + state.obs.render_metrics()
 
     async def debug_requests(_request: web.Request) -> web.Response:

@@ -6,7 +6,8 @@ reference's only lever is LMCache offload capacity,
 deployment-vllm-multi.yaml:154-178).  Covered here:
 
 * quantize/dequantize numerics incl. the idempotent requantize round-trip
-  the dense host/wire format depends on,
+  the legacy dense (kv_wire_format=fp32) host/wire format depends on
+  (the native int8 wire is covered in tests/test_kv_wire_format.py),
 * engine generation parity: int8-KV output stays close to fp32-KV greedy
   output on a real engine, and the e2e feature set (prefix cache, offload
   restore, disagg import/export, multi-step, sharded mesh) runs,
@@ -57,7 +58,8 @@ def test_quantize_zero_vectors_exact():
 
 def test_requantize_is_idempotent():
     """dequantize -> quantize must reproduce identical int8 data + scale:
-    offload/disagg keep a dense wire format and requantize on import."""
+    the legacy dense wire (kv_wire_format=fp32, and any v1-only-peer
+    fallback encode) requantizes on import."""
     rng = np.random.default_rng(1)
     x = jnp.asarray(rng.standard_normal((4, 16, 2, 64)), jnp.float32)
     d1, s1 = quant.quantize_vectors(x)
@@ -142,10 +144,12 @@ def test_decide_num_blocks_doubles_capacity(monkeypatch):
 
 
 def test_int8_offload_restore_roundtrip():
-    """Preemption offload -> restore through the dense host format must
-    not change int8 greedy generation: the restore requantization is
-    exactly idempotent (test_requantize_is_idempotent), so the restored
-    cache is bit-identical to the offloaded one."""
+    """Preemption offload -> restore (now the native int8 wire by
+    default) must not change int8 greedy generation: the (data, scale)
+    tuples roundtrip untransformed, so the restored cache is
+    bit-identical to the offloaded one (the legacy fp32 wire's
+    idempotent-requantize parity is pinned per-wire in
+    tests/test_kv_wire_format.py)."""
 
     def build(num_blocks):
         return LLMEngine(EngineConfig(
@@ -169,7 +173,8 @@ def test_int8_offload_restore_roundtrip():
 
 def test_int8_disagg_export_import(tmp_path):
     """Cross-engine prefix sharing with an int8 producer AND an fp32
-    consumer: the dense wire format makes kv dtypes interoperable."""
+    consumer: the versioned serde (v2 quantized frames, dequantized by
+    the dense importer) keeps kv dtypes interoperable."""
     from production_stack_tpu.kvserver.server import KVStore, handle_client
 
     store = KVStore(capacity_bytes=32 << 20)

@@ -1,0 +1,470 @@
+"""Quantized KV through the whole tiering plane (cache.kv_wire_format).
+
+The KV snapshot serde is versioned (kvserver/protocol.py: v1 = legacy
+untagged dense fp32, v2 = tagged int8 data + fp32 scales) so
+mixed-precision fleets interop during a rollout.  Covered here:
+
+* serde: v1/v2 roundtrips, auto version selection, the forced-v1
+  dequantizing fallback, and LOUD rejection of truncated / garbage /
+  trailing-byte v2 frames,
+* the client's probe-once version negotiation: a store that advertises
+  ``snapshot_versions`` gets v2 frames, a legacy store latches the
+  client to dense v1 — one STAT each way, never corrupting a v1 peer,
+* offload->restore through the native int8 wire: greedy parity with the
+  in-HBM path (nothing is transformed, so restore is trivially
+  bit-preserving) and ~4x fewer host-tier bytes than the fp32 wire,
+* mixed-precision interop on a loopback kvserver: int8 engine exports
+  (v2 on the wire), bf16 engine imports, and the reverse — greedy
+  parity both directions,
+* the new tpu:kv_wire_bytes_total / tpu:kv_snapshot_format_total
+  counters feeding engine stats.
+"""
+
+import asyncio
+import contextlib
+import threading
+
+import numpy as np
+import pytest
+
+from production_stack_tpu.engine.config import (
+    CacheConfig,
+    EngineConfig,
+    ModelConfig,
+    SchedulerConfig,
+)
+from production_stack_tpu.engine.core.engine import LLMEngine
+from production_stack_tpu.engine.core.sequence import SamplingParams
+from production_stack_tpu.kvserver import protocol as proto
+from production_stack_tpu.kvserver.client import RemoteKVClient
+
+
+def _dense_layers(rng, layers=2, nb=3, bs=4, k=2, d=8):
+    return [
+        (
+            rng.standard_normal((nb, bs, k, d)).astype(np.float32),
+            rng.standard_normal((nb, bs, k, d)).astype(np.float32),
+        )
+        for _ in range(layers)
+    ]
+
+
+def _quantized_layers(rng, **kw):
+    return [
+        (proto.quantize_np(k), proto.quantize_np(v))
+        for k, v in _dense_layers(rng, **kw)
+    ]
+
+
+# -- serde versioning --------------------------------------------------------
+
+
+def test_dense_snapshot_stays_v1():
+    """Dense frames keep the legacy untagged format byte-for-byte, so a
+    v1-only peer keeps reading fp32-wire traffic unchanged."""
+    layers = _dense_layers(np.random.default_rng(0))
+    blob = proto.encode_kv_snapshot(layers, 12)
+    assert proto.snapshot_version(blob) == proto.SNAPSHOT_V1
+    legacy = proto.encode_kv_snapshot(layers, 12, version=proto.SNAPSHOT_V1)
+    assert blob == legacy
+    got, num_tokens = proto.decode_kv_snapshot(blob)
+    assert num_tokens == 12
+    for (k, v), (gk, gv) in zip(layers, got):
+        np.testing.assert_array_equal(k, gk)
+        np.testing.assert_array_equal(v, gv)
+
+
+def test_quantized_snapshot_roundtrips_v2_exactly():
+    layers = _quantized_layers(np.random.default_rng(1))
+    blob = proto.encode_kv_snapshot(layers, 48)
+    assert proto.snapshot_version(blob) == proto.SNAPSHOT_V2
+    got, num_tokens = proto.decode_kv_snapshot(blob)
+    assert num_tokens == 48
+    for (k, v), (gk, gv) in zip(layers, got):
+        for side, gside in ((k, gk), (v, gv)):
+            assert proto.is_quantized_side(gside)
+            np.testing.assert_array_equal(side[0], gside[0])
+            np.testing.assert_array_equal(side[1], gside[1])
+            assert gside[0].dtype == np.int8
+            assert gside[1].dtype == np.float32
+
+
+def test_forced_v1_dequantizes_quantized_sides():
+    """The v1-only-peer fallback: a quantized payload forced onto the
+    dense wire dequantizes at the boundary, and requantizing the result
+    reproduces the identical int8 data (idempotent — nothing corrupts)."""
+    layers = _quantized_layers(np.random.default_rng(2))
+    blob = proto.encode_kv_snapshot(layers, 16, version=proto.SNAPSHOT_V1)
+    assert proto.snapshot_version(blob) == proto.SNAPSHOT_V1
+    got, _ = proto.decode_kv_snapshot(blob)
+    for (k, _v), (gk, _gv) in zip(layers, got):
+        assert not proto.is_quantized_side(gk)
+        assert gk.dtype == np.float32
+        rd, rs = proto.quantize_np(gk)
+        np.testing.assert_array_equal(rd, k[0])
+        np.testing.assert_allclose(rs, k[1], rtol=1e-6)
+
+
+def test_v2_mixed_dense_and_quantized_sides():
+    """A v2 frame may interleave dense and quantized sides (mixed fleet
+    mid-rollout)."""
+    rng = np.random.default_rng(3)
+    dense = rng.standard_normal((2, 4, 2, 8)).astype(np.float32)
+    q = proto.quantize_np(rng.standard_normal((2, 4, 2, 8)).astype(np.float32))
+    blob = proto.encode_kv_snapshot([(dense, q)], 8)
+    got, _ = proto.decode_kv_snapshot(blob)
+    (gk, gv) = got[0]
+    assert not proto.is_quantized_side(gk)
+    assert proto.is_quantized_side(gv)
+    np.testing.assert_array_equal(gk, dense)
+    np.testing.assert_array_equal(gv[0], q[0])
+
+
+def test_truncated_and_garbage_v2_frames_rejected_loudly():
+    layers = _quantized_layers(np.random.default_rng(4))
+    blob = proto.encode_kv_snapshot(layers, 8)
+    # Truncation at every region boundary-ish cut must raise, never
+    # return silently-wrong tensors.
+    for cut in (1, 3, 5, 9, 13, len(blob) // 2, len(blob) - 1):
+        with pytest.raises(ValueError):
+            proto.decode_kv_snapshot(blob[:cut])
+    # Trailing garbage after a well-formed v2 frame.
+    with pytest.raises(ValueError):
+        proto.decode_kv_snapshot(blob + b"\x00")
+    # ... and after a well-formed v1 frame (strictness is not
+    # version-conditional: two concatenated frames from a buggy writer
+    # must not decode silently as the first one).
+    v1 = proto.encode_kv_snapshot(
+        _dense_layers(np.random.default_rng(10)), 8
+    )
+    with pytest.raises(ValueError):
+        proto.decode_kv_snapshot(v1 + b"\x00")
+    # Unknown version marker.
+    import struct
+
+    bad = struct.pack("<I", 0xFF000000 + 9) + blob[4:]
+    with pytest.raises(ValueError):
+        proto.decode_kv_snapshot(bad)
+    # Unknown side kind inside a v2 frame.
+    mangled = bytearray(blob)
+    mangled[12] = 7  # first side-kind byte (marker 4 + header 8)
+    with pytest.raises(ValueError):
+        proto.decode_kv_snapshot(bytes(mangled))
+
+
+def test_np_quantizer_matches_device_quantizer():
+    """Host (numpy) and device (jnp) quantizers must agree bit-for-bit:
+    the import path host-quantizes dense wire blocks into int8 pools."""
+    import jax.numpy as jnp
+
+    from production_stack_tpu.engine.kv import quant
+
+    rng = np.random.default_rng(5)
+    x = rng.standard_normal((3, 4, 2, 16)).astype(np.float32) * 2.5
+    nd, ns = proto.quantize_np(x)
+    jd, js = quant.quantize_vectors(jnp.asarray(x))
+    np.testing.assert_array_equal(nd, np.asarray(jd))
+    np.testing.assert_allclose(ns, np.asarray(js), rtol=1e-6)
+
+
+# -- loopback kvserver harness ----------------------------------------------
+
+
+@contextlib.contextmanager
+def loopback_store(advertise_v2=True, capacity=64 << 20,
+                   max_snapshot_version=2):
+    """In-process asyncio kvserver on a daemon thread.  With
+    ``advertise_v2=False`` the STAT reply omits ``snapshot_versions`` —
+    exactly what a legacy (pre-versioning) store build answers;
+    ``max_snapshot_version=1`` is the upgraded build's mixed-fleet
+    rollout switch (--max-snapshot-version)."""
+    from production_stack_tpu.kvserver.server import KVStore, handle_client
+
+    store = KVStore(capacity, max_snapshot_version=max_snapshot_version)
+    if not advertise_v2:
+        legacy_stats = store.stats
+
+        def stats():
+            out = legacy_stats()
+            out.pop("snapshot_versions", None)
+            return out
+
+        store.stats = stats
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+    state = {}
+
+    def run():
+        asyncio.set_event_loop(loop)
+
+        async def boot():
+            server = await asyncio.start_server(
+                lambda r, w: handle_client(store, r, w), "127.0.0.1", 0
+            )
+            state["port"] = server.sockets[0].getsockname()[1]
+            started.set()
+
+        loop.run_until_complete(boot())
+        loop.run_forever()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert started.wait(10)
+    try:
+        yield store, f"kv://127.0.0.1:{state['port']}"
+    finally:
+        loop.call_soon_threadsafe(loop.stop)
+        t.join(timeout=10)
+
+
+def test_client_probes_v2_once_then_remembers():
+    layers = _quantized_layers(np.random.default_rng(6))
+    with loopback_store(advertise_v2=True) as (store, url):
+        client = RemoteKVClient(url)
+        client.put_blocks("a", layers, 8)
+        client.put_blocks("b", layers, 8)
+        # Exactly ONE STAT probe for two quantized PUTs.
+        assert store.ops.get("stat", 0) == 1
+        got, _ = client.get_blocks("a")
+        assert proto.is_quantized_side(got[0][0])
+        client.close()
+
+
+def test_client_falls_back_to_v1_against_legacy_store():
+    """A store that never advertised snapshot_versions latches the
+    client to dense v1 encodes — the quantized payload dequantizes at
+    the boundary and ANY v1 peer can read it back."""
+    layers = _quantized_layers(np.random.default_rng(7))
+    with loopback_store(advertise_v2=False) as (store, url):
+        writer = RemoteKVClient(url)
+        writer.put_blocks("a", layers, 8)
+        assert store.ops.get("stat", 0) == 1
+        reader = RemoteKVClient(url)
+        got, _ = reader.get_blocks("a")
+        # Dense fp32 on the wire; requantization reproduces the source.
+        assert not proto.is_quantized_side(got[0][0])
+        rd, _rs = proto.quantize_np(got[0][0])
+        np.testing.assert_array_equal(rd, layers[0][0][0])
+        writer.close()
+        reader.close()
+
+
+def test_require_v2_warns_loudly_on_downgrade(caplog):
+    """kv_wire_format=int8 is auto plus strictness: a store that fails
+    the v2 probe still downgrades the wire to dense v1 (degrading beats
+    dying mid-export) but logs a WARNING — never silently."""
+    import logging
+
+    layers = _quantized_layers(np.random.default_rng(12))
+    with loopback_store(advertise_v2=False) as (_store, url):
+        client = RemoteKVClient(url, require_v2=True)
+        with caplog.at_level(
+            logging.WARNING, logger="production_stack_tpu.kvserver.client"
+        ):
+            client.put_blocks("a", layers, 8)
+            client.put_blocks("b", layers, 8)  # latch: warn once, not twice
+        got, _ = client.get_blocks("a")
+        assert not proto.is_quantized_side(got[0][0])
+        client.close()
+    warnings = [r for r in caplog.records if "DOWNGRADE" in r.getMessage()]
+    assert len(warnings) == 1
+
+
+def test_rollout_switch_pins_fleet_to_v1():
+    """--max-snapshot-version 1 on an UPGRADED store is the mixed-fleet
+    rollout brake: quantized writers probe, see [1], and keep encoding
+    dense v1 frames old reader engines can parse."""
+    layers = _quantized_layers(np.random.default_rng(11))
+    with loopback_store(max_snapshot_version=1) as (store, url):
+        assert store.stats()["snapshot_versions"] == [1]
+        client = RemoteKVClient(url)
+        client.put_blocks("a", layers, 8)
+        got, _ = client.get_blocks("a")
+        assert not proto.is_quantized_side(got[0][0])  # dense v1 frame
+        client.close()
+
+
+def test_client_counts_wire_bytes_and_versions():
+    stats = proto.KVWireStats()
+    with loopback_store() as (_store, url):
+        client = RemoteKVClient(url, wire_stats=stats)
+        client.put_blocks(
+            "q", _quantized_layers(np.random.default_rng(8)), 8
+        )
+        client.put_blocks("d", _dense_layers(np.random.default_rng(9)), 8)
+        client.get_blocks("q")
+        client.close()
+    wire = stats.wire_bytes()
+    assert wire[("remote", "int8")] > 0
+    assert wire[("remote", "dense")] > 0
+    assert stats.snapshot_formats() == {"v1": 1, "v2": 1}
+
+
+# -- engine-level: offload/restore + mixed-precision interop -----------------
+
+
+def make_engine(kv_dtype="auto", num_blocks=128, **cache_kw):
+    return LLMEngine(EngineConfig(
+        model=ModelConfig(dtype="float32"),
+        cache=CacheConfig(block_size=4, num_blocks=num_blocks,
+                          kv_cache_dtype=kv_dtype, **cache_kw),
+        scheduler=SchedulerConfig(
+            max_num_seqs=2, prefill_buckets=(16, 32, 64), max_model_len=128
+        ),
+    ))
+
+
+def drain(engine, prompts, max_tokens=16):
+    for i, p in enumerate(prompts):
+        engine.add_request(f"r{i}", prompt=p,
+                           sampling_params=SamplingParams(
+                               max_tokens=max_tokens, ignore_eos=True))
+    out = {}
+    steps = 0
+    while engine.has_unfinished():
+        steps += 1
+        assert steps < 400
+        for o in engine.step():
+            if o.new_token_id >= 0:
+                out.setdefault(o.seq_id, []).append(o.new_token_id)
+    return out
+
+
+PROMPTS = ["alpha bravo charlie forever", "delta echo foxtrot forevers"]
+
+
+@pytest.mark.parametrize("wire", ["auto", "fp32"])
+def test_int8_offload_restore_parity_both_wires(wire):
+    """Preemption offload -> restore must not change int8 greedy
+    generation on EITHER wire: the native int8 wire transforms nothing,
+    and the legacy fp32 wire requantizes idempotently."""
+    ref = drain(make_engine("int8", 128, kv_wire_format=wire), PROMPTS)
+    tight = make_engine("int8", 20, kv_wire_format=wire,
+                        host_offload_gb=0.25)
+    got = drain(tight, PROMPTS)
+    assert tight.scheduler.num_preemptions > 0
+    assert tight.offload.saves > 0 and tight.offload.restores > 0
+    assert got == ref
+    fmt = "int8" if wire == "auto" else "dense"
+    wire_bytes = tight.kv_wire_stats.wire_bytes()
+    assert wire_bytes[("host", fmt)] > 0
+    assert ("host", "dense" if fmt == "int8" else "int8") not in wire_bytes
+
+
+def test_int8_wire_shrinks_host_tier_bytes():
+    """Same preemption workload: the native wire's host-tier bytes are
+    (4*D)/(D+4) times smaller than the fp32 wire's (D=16 here -> 3.2x;
+    flagship head_dim 64+ -> ~3.8x).  remote_prefetch=False pins the
+    deterministic synchronous save path so both runs snapshot the
+    identical block sets."""
+    per_wire = {}
+    saves = {}
+    for wire in ("auto", "fp32"):
+        eng = make_engine("int8", 20, kv_wire_format=wire,
+                          host_offload_gb=0.25, remote_prefetch=False)
+        drain(eng, PROMPTS)
+        assert eng.offload.saves > 0
+        saves[wire] = eng.offload.saves
+        per_wire[wire] = sum(eng.kv_wire_stats.wire_bytes().values())
+    assert saves["auto"] == saves["fp32"]
+    d = ModelConfig().head_dim
+    assert per_wire["fp32"] / per_wire["auto"] == pytest.approx(
+        (4 * d) / (d + 4), rel=0.05
+    )
+
+
+def _produce_then_consume(producer_dtype, consumer_dtype, url, wire="auto"):
+    """One interop leg through a loopback store: returns (producer out,
+    consumer out, producer engine stats snapshot)."""
+    producer = make_engine(producer_dtype, remote_kv_url=url,
+                           disagg_role="both", kv_wire_format=wire)
+    out_a = drain(producer, [PROMPTS[0]])
+    producer.flush_prefix_exports(timeout=30.0)
+    assert producer.remote_prefix_blocks_exported > 0
+    formats = producer.kv_wire_stats.snapshot_formats()
+    producer.offload.remote_client.close()
+
+    consumer = make_engine(consumer_dtype, remote_kv_url=url,
+                           disagg_role="both")
+    out_b = drain(consumer, [PROMPTS[0]])
+    consumer.flush_prefix_imports()
+    fetched = consumer.remote_prefix_blocks_fetched
+    consumer.offload.remote_client.close()
+    assert fetched > 0
+    assert len(out_b["r0"]) == len(out_a["r0"])
+    return out_a, out_b, formats
+
+
+def test_int8_to_dense_interop_v2_wire_matches_legacy_wire():
+    """int8 engine exports, fp32 engine imports — through the v2
+    quantized wire AND through the pinned legacy fp32 wire.  The
+    consumer's greedy output must be IDENTICAL either way: dequantizing
+    a v2 (data, scale) frame at import yields exactly the fp32 values
+    the legacy wire would have carried, so any divergence is a
+    wrong-value corruption in the new serde."""
+    with loopback_store() as (_s1, url1):
+        _, out_v2, formats = _produce_then_consume(
+            "int8", "auto", url1, wire="auto"
+        )
+        # The quantized wire actually engaged (serde v2 frames).
+        assert formats.get("v2", 0) > 0
+    with loopback_store() as (_s2, url2):
+        _, out_v1, formats = _produce_then_consume(
+            "int8", "auto", url2, wire="fp32"
+        )
+        assert formats.get("v2", 0) == 0
+    assert out_v2["r0"] == out_v1["r0"]
+
+
+def test_dense_to_int8_interop_parity_with_local():
+    """fp32 engine exports dense v1 frames, int8 engine imports — the
+    host quantizer that lands them in the int8 pool is bit-identical to
+    the device quantizer its own prefill would have used, so the
+    consumer's greedy output must equal its local-only generation."""
+    out_local = drain(make_engine("int8"), [PROMPTS[0]])
+    with loopback_store() as (_store, url):
+        _, out_b, formats = _produce_then_consume("auto", "int8", url)
+        assert formats.get("v2", 0) == 0  # dense caches stay on v1
+    assert out_b["r0"] == out_local["r0"]
+
+
+def test_legacy_store_mixed_interop_degrades_cleanly():
+    """The whole interop still works against a legacy (no
+    snapshot_versions) store: the int8 producer degrades to dense v1
+    frames and the dense consumer reads them untouched."""
+    with loopback_store(advertise_v2=False) as (_store, url):
+        producer = make_engine("int8", remote_kv_url=url,
+                               disagg_role="both")
+        out_a = drain(producer, [PROMPTS[0]])
+        producer.flush_prefix_exports(timeout=30.0)
+        assert producer.remote_prefix_blocks_exported > 0
+        assert producer.kv_wire_stats.snapshot_formats().get("v2", 0) == 0
+        assert producer.kv_wire_stats.snapshot_formats().get("v1", 0) > 0
+        producer.offload.remote_client.close()
+
+        consumer = make_engine("auto", remote_kv_url=url,
+                               disagg_role="both")
+        out_b = drain(consumer, [PROMPTS[0]])
+        consumer.offload.remote_client.close()
+        assert consumer.remote_prefix_blocks_fetched > 0
+        assert len(out_b["r0"]) == len(out_a["r0"])
+
+
+def test_engine_stats_expose_wire_families():
+    eng = make_engine("int8", 20, host_offload_gb=0.25)
+    drain(eng, PROMPTS)
+    s = eng.stats()
+    assert ("host", "int8") in s["kv_wire_bytes"]
+    assert isinstance(s["kv_snapshot_format"], dict)
+
+
+def test_kv_wire_format_validation():
+    with pytest.raises(ValueError, match="kv_wire_format"):
+        CacheConfig(kv_wire_format="int4")
+    with pytest.raises(ValueError, match="requires"):
+        CacheConfig(kv_wire_format="int8", kv_cache_dtype="auto")
+    assert CacheConfig(kv_cache_dtype="int8").wire_quantized
+    assert not CacheConfig(
+        kv_cache_dtype="int8", kv_wire_format="fp32"
+    ).wire_quantized
+    assert not CacheConfig().wire_quantized

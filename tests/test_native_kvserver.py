@@ -197,9 +197,62 @@ def test_native_stat_json_shape(native_server):
     stats = client.stat()
     assert set(stats) == {
         "keys", "used_bytes", "capacity_bytes", "hits", "misses", "ops",
+        "snapshot_versions",
     }
     assert json.dumps(stats)  # serializable round-trip
     assert stats["ops"].get("stat") == 1
+    # Serde capability advertisement: clients probe this before putting
+    # v2 (quantized) snapshot frames on the wire (protocol.py).
+    assert stats["snapshot_versions"] == [1, 2]
+    client.close()
+
+
+def test_native_rollout_switch_pins_v1(kvserver_binary):
+    """--max-snapshot-version 1 on the C++ build: STAT advertises [1]
+    and a quantized writer degrades to dense v1 frames (the mixed-fleet
+    rollout brake protecting not-yet-upgraded reader engines)."""
+    proc = subprocess.Popen(
+        [str(kvserver_binary), "--host", "127.0.0.1", "--port", "0",
+         "--capacity-gb", str(1 / 1024), "--max-snapshot-version", "1"],
+        stdout=subprocess.PIPE,
+        text=True,
+    )
+    try:
+        line = proc.stdout.readline().strip()
+        assert line.startswith("LISTENING ")
+        client = RemoteKVClient(f"kv://127.0.0.1:{int(line.split()[1])}")
+        assert client.stat()["snapshot_versions"] == [1]
+        qlayers = [
+            (proto.quantize_np(k), proto.quantize_np(v))
+            for k, v in make_layers(nb=1)
+        ]
+        client.put_blocks("q0", qlayers, 4)
+        got, _ = client.get_blocks("q0")
+        assert not proto.is_quantized_side(got[0][0])  # dense v1 frame
+        client.close()
+    finally:
+        proc.terminate()
+        proc.wait(timeout=5)
+
+
+def test_native_quantized_v2_roundtrip(native_server):
+    """Serde-v2 (quantized) snapshots through the production C++ server:
+    the STAT capability probe engages (one frame), the v2 blob stores as
+    an opaque value, and the (data, scale) tuples roundtrip exactly."""
+    client = RemoteKVClient(f"kv://127.0.0.1:{native_server}")
+    qlayers = [
+        (proto.quantize_np(k), proto.quantize_np(v))
+        for k, v in make_layers(nb=2)
+    ]
+    client.put_blocks("q0", qlayers, 8)
+    assert client.stat()["ops"].get("stat", 0) >= 1
+    got, num_tokens = client.get_blocks("q0")
+    assert num_tokens == 8
+    for (k, v), (gk, gv) in zip(qlayers, got):
+        for side, gside in ((k, gk), (v, gv)):
+            assert proto.is_quantized_side(gside)
+            np.testing.assert_array_equal(side[0], gside[0])
+            np.testing.assert_array_equal(side[1], gside[1])
     client.close()
 
 
