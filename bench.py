@@ -1768,6 +1768,325 @@ def bench_fleet_surge_ab(
     }
 
 
+def bench_multi_round_ab(args, preset=None, fake_only: bool = False,
+                         small: bool = False) -> dict:
+    """The north-star workload (BASELINE.md / SURVEY §6): multi-round QA
+    at fleet scale, A/B'd across the full routing ladder — round-robin
+    vs session-affinity vs kv_aware vs kv_aware+popularity — on fleet KV
+    hit rate, TTFT p50/p95, and output tok/s.
+
+    Two rigs:
+
+      fake_fleet: the PR-10 FleetHarness (12 fake engines behind the
+        REAL router, chunk-chain prefix-cache + prefill cost model) runs
+        the CI-scaled canonical workload (26 users x 5 rounds, 1000-word
+        shared system prompt, heterogeneous answer lengths, 4s join
+        ramp) per policy.  Each arm runs TWICE on a fresh fleet and the
+        TTFT samples/hit tokens are POOLED — seeded percentile
+        comparisons must dominate asyncio loop noise.  A fifth rung runs
+        popularity WITH the shared KV store, where replica growth warms
+        the hot prefix by import instead of recompute.
+
+      real_engines (skipped with ``fake_only``): 2 CPU tiny-llama
+        engines behind the real router, the same ladder at small scale
+        with per-arm content salts (fresh-prefix A/B without rebooting
+        engines), plus the GREEDY PARITY gate: one replayed conversation
+        through every policy must produce byte-identical outputs —
+        routing choice must never change generated bytes.
+
+    Acceptance (recorded under ``criteria``): kv_aware+popularity beats
+    plain kv_aware on fleet KV hit rate and TTFT p50, and beats
+    session-affinity on both."""
+    import asyncio
+    import dataclasses as _dc
+
+    from production_stack_tpu.testing.multi_round import (
+        MultiRoundFleetConfig,
+        ROUTING_LADDER,
+        run_fleet_multi_round,
+    )
+
+    cfg = MultiRoundFleetConfig()
+    repeats = 2
+    if small:
+        cfg = _dc.replace(
+            cfg, num_engines=6, num_users=13, num_rounds=3, qps=14.0,
+            join_window_s=2.0,
+        )
+        repeats = 1
+
+    def pooled(rows: list) -> dict:
+        samples = sorted(s for r in rows for s in r["ttft_samples"])
+        hit = sum(r["hit_tokens"] for r in rows)
+        query = sum(r["query_tokens"] for r in rows)
+
+        def pct(p):
+            if not samples:
+                return 0.0
+            return samples[min(len(samples) - 1,
+                               round(p / 100 * (len(samples) - 1)))]
+
+        out = {
+            "runs": len(rows),
+            "requests": sum(r["requests"] for r in rows),
+            "failed": sum(r["failed"] for r in rows),
+            "kv_hit_rate": round(hit / query, 4) if query else 0.0,
+            "ttft_p50_ms": round(pct(50) * 1e3, 1),
+            "ttft_p95_ms": round(pct(95) * 1e3, 1),
+            "output_tok_s": round(
+                sum(r["output_tok_s"] for r in rows) / max(len(rows), 1), 1
+            ),
+            "shared_prefix_backends": max(
+                r["shared_prefix_backends"] for r in rows
+            ),
+        }
+        if any("popularity" in r for r in rows):
+            out["popularity"] = rows[-1].get("popularity")
+        return out
+
+    table = {}
+    for policy in ROUTING_LADDER:
+        rows = []
+        for rep in range(repeats):
+            rows.append(asyncio.run(run_fleet_multi_round(policy, cfg)))
+        table[policy] = pooled(rows)
+        log(f"multi_round[{policy}]: kv_hit={table[policy]['kv_hit_rate']} "
+            f"ttft_p50={table[policy]['ttft_p50_ms']}ms "
+            f"tok/s={table[policy]['output_tok_s']}")
+
+    # Store-warming rung: the same popularity policy with the PR-4 shared
+    # KV plane simulated — replica growth imports the hot prefix at ~4x
+    # the prefill rate instead of recomputing it.
+    store_cfg = _dc.replace(cfg, shared_store=True)
+    store_row = asyncio.run(
+        run_fleet_multi_round("kv_aware_popularity", store_cfg)
+    )
+    table["kv_aware_popularity_store"] = pooled([store_row])
+    log("multi_round[popularity+store]: "
+        f"kv_hit={table['kv_aware_popularity_store']['kv_hit_rate']} "
+        f"ttft_p50={table['kv_aware_popularity_store']['ttft_p50_ms']}ms")
+
+    pop = table["kv_aware_popularity"]
+    kv = table["kv_aware"]
+    sess = table["session"]
+    criteria = {
+        "pop_beats_kv_aware_hit": pop["kv_hit_rate"] > kv["kv_hit_rate"],
+        "pop_beats_kv_aware_ttft_p50":
+            pop["ttft_p50_ms"] < kv["ttft_p50_ms"],
+        "pop_beats_session_hit": pop["kv_hit_rate"] > sess["kv_hit_rate"],
+        "pop_beats_session_ttft_p50":
+            pop["ttft_p50_ms"] < sess["ttft_p50_ms"],
+        "shared_prefix_on_multiple_backends":
+            pop["shared_prefix_backends"] > 1,
+    }
+    detail = {
+        "workload": {
+            "num_engines": cfg.num_engines, "num_users": cfg.num_users,
+            "num_rounds": cfg.num_rounds, "qps": cfg.qps,
+            "system_prompt_len": cfg.system_prompt_len,
+            "user_info_len": cfg.user_info_len,
+            "answer_len": cfg.answer_len,
+            "heavy_answer_len": cfg.heavy_answer_len,
+            "heavy_every": cfg.heavy_every,
+            "seed": cfg.seed, "repeats_pooled": repeats,
+        },
+        "fake_fleet": table,
+        "criteria": criteria,
+    }
+    if not fake_only:
+        try:
+            detail["real_engines"] = bench_multi_round_real(args, preset)
+        except Exception as e:
+            log(f"multi_round real-engine ladder failed: {e}")
+            detail["real_engines_error"] = str(e)[:200]
+    return detail
+
+
+def bench_multi_round_real(args, preset: str) -> dict:
+    """The multi-round ladder on REAL CPU tiny-llama engines: 2 engines
+    boot ONCE; each routing-policy arm gets a fresh router and a SALTED
+    system prompt (per-arm content can never hit a previous arm's prefix
+    cache, so every arm measures from cold without rebooting/recompiling
+    engines).  Fleet KV hit rate is read from the engines' own BlockPool
+    token counters (deltas per arm).  Ends with the greedy-parity gate:
+    one conversation replayed through every policy must generate
+    byte-identical text."""
+    import asyncio
+    import dataclasses as _dc
+
+    from production_stack_tpu.testing.multi_round import (
+        ROUTING_LADDER,
+        load_multi_round_module,
+    )
+
+    num_users = 4
+    num_rounds = 3
+    answer_len = 16
+    # Big enough that the router's affinity chain resolves several
+    # chunks per prompt (with --kv-chunk-chars 256 below), small enough
+    # that round-3 histories stay under max_model_len on the byte
+    # tokenizer (~3 tok/word).
+    sys_words = 250
+    info_words = 150
+
+    async def run() -> dict:
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from production_stack_tpu.engine.config import (
+            CacheConfig,
+            EngineConfig,
+            PRESETS,
+            SchedulerConfig,
+        )
+        from production_stack_tpu.engine.server.api_server import (
+            build_engine_app,
+        )
+        from production_stack_tpu.engine.server.async_engine import AsyncEngine
+        from production_stack_tpu.router.app import build_app
+        from production_stack_tpu.router.parser import (
+            parse_args as parse_router_args,
+        )
+
+        mod = load_multi_round_module()
+        engines = [
+            AsyncEngine(EngineConfig(
+                model=_dc.replace(PRESETS[preset]),
+                cache=CacheConfig(num_blocks=1536),
+                scheduler=SchedulerConfig(
+                    max_num_seqs=4,
+                    prefill_buckets=(128, 256, 512, 1024),
+                    max_model_len=2048,
+                ),
+            ))
+            for _ in range(2)
+        ]
+        servers = []
+        for eng in engines:
+            s = TestServer(build_engine_app(eng, preset))
+            await s.start_server()
+            servers.append(s)
+        urls = [str(s.make_url("")).rstrip("/") for s in servers]
+
+        async def with_router(policy_argv):
+            router_server = TestServer(build_app(parse_router_args([
+                "--static-backends", ",".join(urls),
+                "--static-models", ",".join([preset] * 2),
+                "--engine-stats-interval", "1",
+                *policy_argv,
+            ])))
+            await router_server.start_server()
+            return router_server
+
+        def pool_counters():
+            return (
+                sum(e.engine.block_pool.hit_tokens for e in engines),
+                sum(e.engine.block_pool.query_tokens for e in engines),
+            )
+
+        out: dict = {"engines": 2, "preset": preset}
+        try:
+            # Warm compile caches off the clock: each engine sees every
+            # prefill bucket + the decode shapes once, directly.
+            warm_router = await with_router(["--routing-logic", "roundrobin"])
+            warm_client = TestClient(warm_router)
+            for words in (64, 200, 320):
+                for _ in range(2):
+                    resp = await warm_client.post(
+                        "/v1/completions",
+                        json={"model": preset,
+                              "prompt": " ".join(
+                                  f"warm{j}" for j in range(words)),
+                              "max_tokens": 4, "ignore_eos": True},
+                    )
+                    await resp.read()
+            await warm_client.close()
+
+            ladder = {}
+            for policy, (logic, extra) in ROUTING_LADDER.items():
+                router_server = await with_router(
+                    ["--routing-logic", logic, *extra,
+                     # CPU-scale prompts are ~1-2k chars; resolve the
+                     # affinity chain at finer granularity than the 1k
+                     # default or the kv arms see a 1-chunk chain.
+                     "--kv-chunk-chars", "256"])
+                hit0, query0 = pool_counters()
+                wl = mod.WorkloadConfig(
+                    base_url=str(router_server.make_url("")).rstrip("/"),
+                    model=preset,
+                    num_users=num_users, num_rounds=num_rounds, qps=2.0,
+                    system_prompt_len=sys_words, user_info_len=info_words,
+                    answer_len=answer_len,
+                    prompt_salt=f"[arm {policy}] ",
+                    request_timeout=300.0,
+                )
+                result = await mod.run_benchmark(wl)
+                hit1, query1 = pool_counters()
+                summary = result["summary"]
+                ttfts = sorted(
+                    r.ttft for r in result["records"] if r.error is None
+                )
+                p50 = ttfts[len(ttfts) // 2] if ttfts else 0.0
+                ladder[policy] = {
+                    "requests": summary["requests_finished"],
+                    "failed": summary["requests_failed"],
+                    "kv_hit_rate": round(
+                        (hit1 - hit0) / max(query1 - query0, 1), 4
+                    ),
+                    "ttft_p50_ms": round(p50 * 1e3, 1),
+                    "output_tok_s": summary["output_tokens_per_s"],
+                }
+                log(f"multi_round real[{policy}]: "
+                    f"kv_hit={ladder[policy]['kv_hit_rate']} "
+                    f"ttft_p50={ladder[policy]['ttft_p50_ms']}ms")
+                await router_server.close()
+            out["ladder"] = ladder
+
+            # Greedy-parity gate: ONE conversation replayed through every
+            # policy; the generated bytes must not depend on routing.
+            parity_outputs = {}
+            for policy, (logic, extra) in ROUTING_LADDER.items():
+                router_server = await with_router(
+                    ["--routing-logic", logic, *extra])
+                client = TestClient(router_server)
+                history = []
+                transcript = []
+                for round_id in (1, 2):
+                    history.append({
+                        "role": "user",
+                        "content": (
+                            "Replay the fleet parity conversation, round "
+                            f"{round_id}: summarize the production stack."
+                        ),
+                    })
+                    resp = await client.post(
+                        "/v1/chat/completions",
+                        json={"model": preset, "messages": history,
+                              "temperature": 0, "max_tokens": 16,
+                              "ignore_eos": True},
+                        headers={"x-user-id": "parity-user"},
+                    )
+                    body = await resp.json()
+                    assert resp.status == 200, body
+                    text = body["choices"][0]["message"]["content"]
+                    transcript.append(text)
+                    history.append({"role": "assistant", "content": text})
+                parity_outputs[policy] = "\n".join(transcript)
+                await client.close()
+            texts = set(parity_outputs.values())
+            out["greedy_parity_ok"] = len(texts) == 1
+            out["parity_chars"] = len(next(iter(texts)))
+            if len(texts) != 1:
+                out["parity_outputs"] = {
+                    k: v[:120] for k, v in parity_outputs.items()
+                }
+            return out
+        finally:
+            for s in servers:
+                await s.close()
+
+    return asyncio.run(run())
+
+
 # -- trace report ----------------------------------------------------------
 
 
@@ -1922,6 +2241,10 @@ def _run_serving_phase(args) -> dict:
 # against this; 'micro' additionally selects the microbench + serving
 # phases).
 AB_STAGES = (
+    # multi_round leads: it is the paper's headline comparison (BASELINE
+    # multi-round QA across the routing ladder) and the standing
+    # regression gate — it must run before the budget can starve it.
+    "multi_round",
     "int8_ab", "kv_int8_ab", "kv_capacity_ab", "gather_ab", "pipeline_ab",
     "mixed_ab", "multistep_ab", "spec_window_ab", "overload_ab",
     "remote_prefix_ab", "disagg_ab", "fleet_surge_ab",
@@ -1930,6 +2253,18 @@ AB_STAGES = (
 
 def main() -> None:
     ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "mode", nargs="?", choices=["multi_round"], default=None,
+        help="optional stage shorthand: 'multi_round' == --stages "
+        "multi_round (with --fake-fleet: the CI smoke path — fake-fleet "
+        "routing-ladder A/B only, no jax, small config)",
+    )
+    ap.add_argument(
+        "--fake-fleet", action="store_true",
+        help="with 'multi_round': run ONLY the fake-fleet routing-ladder "
+        "A/B at small config and print the JSON line — no jax import, no "
+        "TPU probe, CI-runnable in ~1 min (the lint.yml smoke job)",
+    )
     ap.add_argument("--preset", default=None, help="model preset (default: by backend)")
     ap.add_argument("--batch", type=int, default=8)
     ap.add_argument("--ctx", type=int, default=2048)
@@ -1965,6 +2300,24 @@ def main() -> None:
         "classic per-token stepping on a directly-attached chip)",
     )
     args = ap.parse_args()
+
+    if args.fake_fleet:
+        # CI smoke path (lint.yml multi-round-smoke): fake-fleet ladder
+        # only, small config, no jax/TPU anywhere near the process.
+        if args.mode != "multi_round":
+            raise SystemExit("--fake-fleet requires the 'multi_round' mode")
+        report = bench_multi_round_ab(args, fake_only=True, small=True)
+        pop = report["fake_fleet"]["kv_aware_popularity"]
+        print(json.dumps({
+            "metric": "multi_round_fleet_kv_hit_rate",
+            "value": pop["kv_hit_rate"],
+            "unit": "fraction",
+            "vs_baseline": 0.0,
+            "detail": {"multi_round": report},
+        }), flush=True)
+        return
+    if args.mode == "multi_round" and not args.stages:
+        args.stages = "multi_round"
 
     if args.trace_report:
         report = run_trace_report()
@@ -2209,6 +2562,37 @@ def main() -> None:
             note_skip(stage, "budget")
             return False
         return True
+
+    # The north-star workload: multi-round QA across the routing ladder
+    # (fake fleet pooled percentiles + real CPU engines + greedy
+    # parity).  Acceptance: kv_aware+popularity beats plain kv_aware AND
+    # session-affinity on fleet KV hit rate and TTFT p50
+    # (detail.multi_round.criteria).  This stage is the headline
+    # comparison and the standing regression gate, so it is exempt from
+    # the soft budget: the fake-fleet half always runs (pure asyncio,
+    # ~2.5 min); only the real-engine ladder degrades to skipped under
+    # budget pressure (recorded, never silent — the r05 lesson).
+    if not args.quick and (selected is None or "multi_round" in selected):
+        mr_remaining = args.budget_s - (time.time() - _T0 - _BUDGET_EXCLUDED_S)
+        mr_fake_only = mr_remaining < 180.0 and (
+            selected is None or "multi_round" not in selected
+        )
+        if mr_fake_only:
+            log(f"multi_round: {mr_remaining:.0f}s left of --budget-s "
+                f"{args.budget_s} — running the fake-fleet ladder only "
+                "(real-engine ladder skipped, recorded)")
+            note_skip("multi_round_real_engines", "budget")
+        try:
+            detail["multi_round"] = bench_multi_round_ab(
+                args, preset, fake_only=mr_fake_only)
+            mr = detail["multi_round"]
+            log(f"multi_round criteria: {mr['criteria']}; "
+                f"parity={mr.get('real_engines', {}).get('greedy_parity_ok')}")
+        except Exception as e:
+            log(f"multi_round bench failed: {e}")
+            detail["multi_round_error"] = str(e)[:200]
+    else:
+        note_skip("multi_round", "quick" if args.quick else "unselected")
 
     if run_stage("int8_ab"):
         # Int8 weight-only A/B (model.quantization="int8"): decode is

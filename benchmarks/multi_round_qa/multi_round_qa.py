@@ -63,6 +63,25 @@ class WorkloadConfig:
     # a JAX engine: the first hit on each prefill bucket / decode program
     # compiles (~tens of seconds) and must not land in TTFT percentiles.
     warmup_requests: int = 0
+    # Heterogeneous answer lengths: every ``heavy_every``-th user gets
+    # ``heavy_answer_len``-token answers (0 disables both).  Real QA
+    # answers vary hugely; a few long-generation users are what separates
+    # load-aware placement from hash placement — two heavy users hashed
+    # onto one backend is a sustained hot pocket no rebalancing fixes.
+    heavy_answer_len: int = 0
+    heavy_every: int = 0
+    # Spread user joins across this many seconds (the canonical run ramps
+    # 320 users up over minutes, not at t=0; None keeps the legacy
+    # one-gap stagger).  A continuous arrival stream is what lets
+    # load-aware placement policies keep repairing fleet balance —
+    # all-at-once joins freeze placement after round 1.
+    join_window: Optional[float] = None
+    # Content salt folded into the shared system prompt: back-to-back A/B
+    # arms over the SAME engines (bench.py multi_round real-engine
+    # ladder) salt each arm so arm N's prompts can never hit arm N-1's
+    # prefix cache — every arm measures from cold content without
+    # rebooting engines.
+    prompt_salt: str = ""
     # Replay real conversations instead of the synthetic workload
     # (reference ShareGPT mode, multi-round-qa.py:181-260,373-381): a JSON
     # list of {"num_round": int, "conversations": [{"value": str,
@@ -130,7 +149,7 @@ class UserSession:
 
     def _system_prompt(self) -> str:
         return (
-            f"Hi, here's some system prompt: "
+            f"{self.config.prompt_salt}Hi, here's some system prompt: "
             f"{_dummy_text(self.config.system_prompt_len)}. "
             f"For user {self.user_id}, here are some other context: "
             f"{_dummy_text(self.config.user_info_len)}."
@@ -159,6 +178,12 @@ class UserSession:
             turn = self.dialogue["conversations"][2 * (round_id - 1) + 1]
             n = turn.get("num_tokens") or (len(turn.get("value", "")) // 4)
             return max(1, min(int(n), 2048))
+        if (
+            self.config.heavy_every
+            and self.config.heavy_answer_len
+            and self.user_id % self.config.heavy_every == 0
+        ):
+            return self.config.heavy_answer_len
         return self.config.answer_len
 
     def seed_history(self, rounds: int) -> None:
@@ -390,6 +415,8 @@ async def run_benchmark(config: WorkloadConfig) -> Dict:
             (config.num_users / config.qps) / config.num_users
             if config.qps > 0 else 0.0
         )
+        if config.join_window is not None and config.num_users > 1:
+            gap_between_users = config.join_window / (config.num_users - 1)
         start = time.time()
 
         async def launch_user(idx: int) -> UserSession:

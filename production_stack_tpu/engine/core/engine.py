@@ -3428,6 +3428,14 @@ class LLMEngine:
             "num_requests_waiting": self.scheduler.num_waiting,
             "hbm_kv_usage_perc": self.block_pool.usage,
             "prefix_cache_hit_rate": self.block_pool.prefix_hit_rate,
+            # Prefix-cache truth counters/size (token granularity): the
+            # router's fleet popularity view scrapes these to compute the
+            # fleet-wide hit rate and to reconcile its prefix-owner map
+            # against reality (a restarted engine's cache is empty no
+            # matter what the router's routing history says).
+            "prefix_cache_hit_tokens": self.block_pool.hit_tokens,
+            "prefix_cache_query_tokens": self.block_pool.query_tokens,
+            "prefix_cache_blocks": self.block_pool.num_cached_blocks,
             "host_kv_usage_perc": self.offload.usage,
             "duty_cycle": self._duty_cycle(),
             "total_prompt_tokens": self.total_prompt_tokens,

@@ -80,6 +80,16 @@ class BlockPool:
             return 0.0
         return self.hit_tokens / self.query_tokens
 
+    @property
+    def num_cached_blocks(self) -> int:
+        """Blocks whose content is reusable through the prefix cache
+        (referenced or cached-free).  Exported as the
+        ``tpu:prefix_cache_blocks`` gauge — the router's popularity view
+        reconciles its owner map against this truth: a collapse to ~0
+        means the engine restarted (or flushed) and every prefix the
+        router believes resident there is gone."""
+        return len(self._block_to_hash)
+
     # -- allocation --------------------------------------------------------
 
     def can_allocate(self, n: int) -> bool:

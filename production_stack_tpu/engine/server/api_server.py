@@ -336,6 +336,12 @@ def build_engine_app(
             (vocab.TPU_NUM_REQUESTS_WAITING, s["num_requests_waiting"]),
             (vocab.TPU_HBM_KV_USAGE_PERC, s["hbm_kv_usage_perc"]),
             (vocab.TPU_PREFIX_CACHE_HIT_RATE, s["prefix_cache_hit_rate"]),
+            # Prefix-cache truth for the router's fleet popularity view:
+            # hit/query token counters + resident content-blocks gauge.
+            (vocab.TPU_PREFIX_CACHE_HIT_TOKENS, s["prefix_cache_hit_tokens"]),
+            (vocab.TPU_PREFIX_CACHE_QUERY_TOKENS,
+             s["prefix_cache_query_tokens"]),
+            (vocab.TPU_PREFIX_CACHE_BLOCKS, s["prefix_cache_blocks"]),
             (vocab.TPU_HOST_KV_USAGE_PERC, s["host_kv_usage_perc"]),
             (vocab.TPU_DUTY_CYCLE, s["duty_cycle"]),
             (vocab.TPU_DECODE_HOST_GAP_MS, s["decode_host_gap_ms"]),

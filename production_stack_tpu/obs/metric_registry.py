@@ -94,7 +94,28 @@ REGISTRY = {
         "help": "Prompt tokens held by waiting+preempted sequences (the "
                 "bound admission enforces)",
     },
+    "tpu:prefix_cache_blocks": {
+        "kind": "gauge", "layer": "engine",
+        "mirrors": ("fake_engine", "dashboard", "docs"),
+        "help": "Content-valid blocks resident in the prefix cache (the "
+                "truth the router's popularity view reconciles its "
+                "owner map against: a collapse to ~0 means the engine "
+                "restarted and its cache is empty)",
+    },
     # -- engine counters ---------------------------------------------------
+    "tpu:prefix_cache_hit_tokens_total": {
+        "kind": "counter", "layer": "engine",
+        "mirrors": ("fake_engine", "dashboard", "docs"),
+        "help": "Prompt tokens served from the prefix cache since boot "
+                "(fleet KV hit rate = sum hit / sum query across "
+                "backends — the BASELINE.md north-star metric)",
+    },
+    "tpu:prefix_cache_query_tokens_total": {
+        "kind": "counter", "layer": "engine",
+        "mirrors": ("fake_engine", "dashboard", "docs"),
+        "help": "Prompt tokens queried against the prefix cache since "
+                "boot (the hit-rate denominator)",
+    },
     "tpu:total_prompt_tokens": {
         "kind": "counter", "layer": "engine",
         "mirrors": ("fake_engine", "dashboard", "docs"),
@@ -388,6 +409,29 @@ REGISTRY = {
         "mirrors": ("dashboard", "docs"),
         "help": "Free-capacity fraction per backend (1 = idle, 0 = "
                 "saturated or inside an engine-429 Retry-After window)",
+    },
+    # -- fleet prefix-popularity view (routing kv_aware_popularity) --------
+    "tpu_router:prefix_hot_total": {
+        "kind": "counter", "layer": "router",
+        "mirrors": ("dashboard", "docs"),
+        "help": "Prefixes promoted to HOT by the popularity view (their "
+                "decayed request frequency crossed the threshold; each "
+                "is served by a replica set from then on)",
+    },
+    "tpu_router:prefix_replica_set_size": {
+        "kind": "gauge", "layer": "router",
+        "mirrors": ("dashboard", "docs"),
+        "help": "Largest live hot-prefix replica set — the shared system "
+                "prompt's replication degree (grows under member load, "
+                "shrinks by TTL decay)",
+    },
+    "tpu_router:fleet_prefix_hit_rate": {
+        "kind": "gauge", "layer": "router",
+        "mirrors": ("dashboard", "docs"),
+        "help": "Fleet-wide token-weighted KV prefix hit rate from the "
+                "engines' scraped tpu:prefix_cache_{hit,query}_tokens_"
+                "total truth counters (the BASELINE.md headline metric, "
+                "at one scrape point)",
     },
     "tpu_router:semantic_cache_size": {
         "kind": "gauge", "layer": "router",

@@ -52,6 +52,29 @@ from production_stack_tpu.utils.registry import ServiceRegistry
 logger = logging.getLogger(__name__)
 
 
+def routing_kwargs_from_args(routing_logic: str, args) -> dict:
+    """CLI flags -> routing-logic constructor kwargs, for the given
+    logic.  Shared by boot (initialize_all) AND the dynamic-config
+    watcher's routing reconfigure — a hot-reload that rebuilt the
+    kv_aware/popularity router from library defaults would silently
+    discard every tuned --kv-* knob."""
+    kwargs: dict = {}
+    if routing_logic == "session":
+        kwargs["session_key"] = args.session_key
+    if routing_logic in ("kv_aware", "kv_aware_popularity"):
+        kwargs["load_tradeoff"] = args.kv_affinity_tradeoff
+        kwargs["chunk_chars"] = args.kv_chunk_chars
+    if routing_logic == "kv_aware_popularity":
+        kwargs.update(
+            hot_threshold=args.kv_popularity_hot_threshold,
+            popularity_halflife_s=args.kv_popularity_halflife_s,
+            max_replicas=args.kv_popularity_max_replicas,
+            replica_ttl_s=args.kv_popularity_replica_ttl_s,
+            hot_credit_cap=args.kv_popularity_hot_credit_cap,
+        )
+    return kwargs
+
+
 def initialize_all(app: web.Application, args) -> ServiceRegistry:
     """Wire every service into the app registry
     (reference initialize_all, app.py:97-207)."""
@@ -75,10 +98,10 @@ def initialize_all(app: web.Application, args) -> ServiceRegistry:
     scraper = EngineStatsScraper(discovery, scrape_interval=args.engine_stats_interval)
     registry.set(ENGINE_STATS_SCRAPER, scraper)
 
-    routing_kwargs = {}
-    if args.routing_logic == "session":
-        routing_kwargs["session_key"] = args.session_key
-    initialize_routing_logic(registry, args.routing_logic, **routing_kwargs)
+    initialize_routing_logic(
+        registry, args.routing_logic,
+        **routing_kwargs_from_args(args.routing_logic, args),
+    )
 
     aliases = parse_static_aliases(args.model_aliases) if args.model_aliases else None
     registry.set(REQUEST_REWRITER, get_request_rewriter(args.request_rewriter, aliases))

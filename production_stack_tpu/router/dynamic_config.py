@@ -190,7 +190,12 @@ class DynamicConfigWatcher:
             await old.close()
 
     def _reconfigure_routing(self, config: DynamicRouterConfig) -> None:
-        kwargs = {}
+        # Same flag->kwargs mapping boot uses, so a hot-reload keeps the
+        # CLI-tuned kv-affinity/popularity knobs instead of silently
+        # rebuilding the router from library defaults.
+        from production_stack_tpu.router.app import routing_kwargs_from_args
+
+        kwargs = routing_kwargs_from_args(config.routing_logic, self.args)
         if config.routing_logic == "session":
             kwargs["session_key"] = config.session_key or self.args.session_key
         reconfigure_routing_logic(self.registry, config.routing_logic, **kwargs)

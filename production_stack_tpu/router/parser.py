@@ -78,6 +78,55 @@ def parse_args(argv=None) -> argparse.Namespace:
     parser.add_argument(
         "--session-key", default=None, help="Session-affinity header name"
     )
+    # KV-affinity scoring (routing logics kv_aware / kv_aware_popularity).
+    parser.add_argument(
+        "--kv-chunk-chars", type=int, default=1024,
+        help="prefix-chunk granularity (chars) for the KV-affinity hash "
+        "chain; smaller chunks resolve affinity on shorter prompts at "
+        "more tracking overhead",
+    )
+    parser.add_argument(
+        "--kv-affinity-tradeoff", type=float, default=2.0,
+        help="how many matched prefix chunks one unit of backend queue "
+        "depth is worth in the load-vs-affinity score; higher = stickier "
+        "(fewer history re-prefills), lower = more load-balanced",
+    )
+    # Fleet prefix-popularity view (routing logic kv_aware_popularity;
+    # routing/kv_aware.py module docstring): hot-prefix classification +
+    # replica-set replication knobs.  Harmless on other routing logics.
+    parser.add_argument(
+        "--kv-popularity-hot-threshold", type=float, default=8.0,
+        help="decayed per-prefix request count past which a prefix is HOT "
+        "and served by a replica set instead of one sticky owner (the "
+        "multi-round-QA shared system prompt crosses this within its "
+        "first seconds of fleet traffic)",
+    )
+    parser.add_argument(
+        "--kv-popularity-halflife-s", type=float, default=60.0,
+        help="exponential-decay half-life of the per-prefix popularity "
+        "counters; also paces hot->cold demotion",
+    )
+    parser.add_argument(
+        "--kv-popularity-max-replicas", type=int, default=8,
+        help="replica-set size cap per hot prefix (growth is load-driven: "
+        "a new member joins only when every current member is degraded "
+        "enough to lose the load-vs-affinity score)",
+    )
+    parser.add_argument(
+        "--kv-popularity-replica-ttl-s", type=float, default=300.0,
+        help="replica-set members not routed to for this long decay out "
+        "(the shrink half of the grow/shrink contract)",
+    )
+    parser.add_argument(
+        "--kv-popularity-hot-credit-cap", type=float, default=0.5,
+        help="affinity-credit cap (in chunks) for fleet-SHARED prefixes "
+        "(content at/before a >=3-way chain divergence, e.g. the shared "
+        "system prompt): shared content is replicable, so its match "
+        "credit is bounded — a replica-set member may queue at most "
+        "tradeoff*cap deeper than an idle backend before the prefix "
+        "replicates onto a new member; user-private chunks (tails) keep "
+        "full per-chunk credit even when hot",
+    )
     parser.add_argument(
         "--model-aliases",
         default=None,
@@ -270,6 +319,20 @@ def validate_args(args: argparse.Namespace) -> None:
                     )
     if args.routing_logic == "session" and not args.session_key:
         raise ValueError("--routing-logic session requires --session-key")
+    if args.kv_chunk_chars < 1:
+        raise ValueError("--kv-chunk-chars must be >= 1")
+    if args.kv_affinity_tradeoff < 0:
+        raise ValueError("--kv-affinity-tradeoff must be >= 0")
+    if args.kv_popularity_hot_threshold <= 0:
+        raise ValueError("--kv-popularity-hot-threshold must be > 0")
+    if args.kv_popularity_halflife_s <= 0:
+        raise ValueError("--kv-popularity-halflife-s must be > 0")
+    if args.kv_popularity_max_replicas < 1:
+        raise ValueError("--kv-popularity-max-replicas must be >= 1")
+    if args.kv_popularity_replica_ttl_s <= 0:
+        raise ValueError("--kv-popularity-replica-ttl-s must be > 0")
+    if args.kv_popularity_hot_credit_cap < 0:
+        raise ValueError("--kv-popularity-hot-credit-cap must be >= 0")
     if (
         args.routing_logic == "disagg"
         and args.service_discovery == "static"

@@ -14,6 +14,7 @@ from prometheus_client import CONTENT_TYPE_LATEST, generate_latest
 
 from production_stack_tpu.obs.histogram import render_labeled_histograms
 from production_stack_tpu.router.capacity import CAPACITY_MODEL
+from production_stack_tpu.router.routing import ROUTING_SERVICE
 from production_stack_tpu.router.service_discovery import (
     DISCOVERY_SERVICE,
     decode_capable,
@@ -103,6 +104,25 @@ async def metrics(request: web.Request) -> web.Response:
             es.prefix_cache_hit_rate
         )
         ms.engine_queue_depth.labels(server=server).set(es.num_queuing_requests)
+
+    # Fleet-wide KV hit rate from the engines' scraped truth counters
+    # (token-weighted — the BASELINE.md north-star metric, one scrape
+    # point for the whole fleet).
+    total_hit = sum(es.prefix_cache_hit_tokens for es in engine_stats.values())
+    total_query = sum(
+        es.prefix_cache_query_tokens for es in engine_stats.values()
+    )
+    ms.fleet_prefix_hit_rate.set(total_hit / total_query if total_query else 0.0)
+
+    # Prefix-popularity view (routing logic kv_aware_popularity): retire
+    # owner-map/replica-set state for departed backends (pod churn, the
+    # CapacityModel.prune contract) and export the replication degree.
+    routing = registry.get(ROUTING_SERVICE)
+    if routing is not None and discovery is not None and hasattr(routing, "prune"):
+        routing.prune([ep.url for ep in discovery.get_endpoint_info()])
+    if routing is not None and hasattr(routing, "popularity_snapshot"):
+        snap = routing.popularity_snapshot()
+        ms.prefix_replica_set_size.set(snap["replica_set_max"])
 
     # Fleet capacity model (router/capacity.py): refresh from the live
     # stats plane so a scrape always reflects current headroom, then

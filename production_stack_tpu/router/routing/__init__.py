@@ -11,6 +11,11 @@ Algorithms:
 * ``kv_aware`` — prefix-affinity + load-aware scoring; maximizes TPU HBM
   KV-cache reuse (capability the reference only gets implicitly through
   session stickiness).
+* ``kv_aware_popularity`` — ``kv_aware`` plus the fleet-level
+  prefix-popularity view: hot prefixes (the multi-round-QA shared system
+  prompt) are served by a load-grown replica SET instead of one sticky
+  owner, while long per-user tails stay session-sticky (kv_aware.py
+  module docstring).
 * ``disagg`` — two-phase disaggregated prefill/decode over the shared KV
   plane: prime a prefill-pool backend, hand the prefix chain off, decode
   on a decode-pool backend (DistServe/Splitwise analogue; the reference
@@ -25,7 +30,10 @@ from production_stack_tpu.router.routing.base import RoutingInterface
 from production_stack_tpu.router.routing.round_robin import RoundRobinRouter
 from production_stack_tpu.router.routing.session import SessionRouter
 from production_stack_tpu.router.routing.least_loaded import LeastLoadedRouter
-from production_stack_tpu.router.routing.kv_aware import KVAwareRouter
+from production_stack_tpu.router.routing.kv_aware import (
+    KVAwareRouter,
+    PopularityKVAwareRouter,
+)
 from production_stack_tpu.router.routing.disagg import DisaggRouter
 
 ROUTING_SERVICE = "routing_logic"
@@ -35,6 +43,7 @@ _ALGORITHMS = {
     "session": SessionRouter,
     "least_loaded": LeastLoadedRouter,
     "kv_aware": KVAwareRouter,
+    "kv_aware_popularity": PopularityKVAwareRouter,
     "disagg": DisaggRouter,
 }
 

@@ -77,6 +77,28 @@ def deprioritize_backpressured(
     return relieved if relieved else endpoints
 
 
+def effective_load(url: str, engine_stats, request_stats) -> float:
+    """Backend load for routing decisions: the MAX of the scraped engine
+    running+waiting queue depth and the router's own synchronous
+    in-flight count for that backend.  Scrape-only reads go stale for a
+    whole scrape interval — a burst arriving between scrapes would pile
+    onto one "least loaded" backend until the next scrape catches up;
+    the router's own in-flight counter moves per request, so the fresh
+    local lower bound caps the pileup.  (In multi-router deployments the
+    scraped value still contributes the OTHER routers' load — hence max,
+    not replacement.)  Shared by LeastLoadedRouter and KVAwareRouter so
+    the invariant cannot drift between them."""
+    scraped = 0.0
+    if url in engine_stats:
+        es = engine_stats[url]
+        scraped = float(es.num_running_requests + es.num_queuing_requests)
+    local = 0.0
+    if url in request_stats:
+        rs = request_stats[url]
+        local = float(rs.in_prefill_requests + rs.in_decoding_requests)
+    return max(scraped, local)
+
+
 def lowest_qps_url(
     endpoints: List[EndpointInfo], request_stats: Dict[str, RequestStats]
 ) -> str:

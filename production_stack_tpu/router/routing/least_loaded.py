@@ -19,6 +19,7 @@ from typing import Any, Dict, List, Optional
 
 from production_stack_tpu.router.routing.base import (
     RoutingInterface,
+    effective_load,
     exclude_prefill_role,
     require_endpoints,
 )
@@ -37,16 +38,9 @@ class LeastLoadedRouter(RoutingInterface):
         endpoints = require_endpoints(exclude_prefill_role(endpoints))
         engine_stats = engine_stats or {}
         request_stats = request_stats or {}
-
-        def load(ep: EndpointInfo) -> float:
-            scraped = 0.0
-            if ep.url in engine_stats:
-                es = engine_stats[ep.url]
-                scraped = float(es.num_running_requests + es.num_queuing_requests)
-            local = 0.0
-            if ep.url in request_stats:
-                rs = request_stats[ep.url]
-                local = float(rs.in_prefill_requests + rs.in_decoding_requests)
-            return max(scraped, local)
-
-        return min(endpoints, key=lambda ep: (load(ep), ep.url)).url
+        return min(
+            endpoints,
+            key=lambda ep: (
+                effective_load(ep.url, engine_stats, request_stats), ep.url
+            ),
+        ).url

@@ -94,6 +94,29 @@ backend_capacity_score = Gauge(
     ["server"],
 )
 
+# -- fleet prefix-popularity view (routing logic kv_aware_popularity) ------
+# Prefixes promoted to HOT (decayed request frequency crossed the
+# threshold; each one is served by a replica set from then on).
+prefix_hot_total = Counter(
+    "tpu_router:prefix_hot_total",
+    "Prefixes promoted to hot by the popularity view (replica-set serving)",
+)
+# Largest live replica set across hot prefixes — the shared system
+# prompt's replication degree.  1 under light load (no replication
+# needed), grows toward --kv-popularity-max-replicas as the owner pool
+# saturates, shrinks back by TTL decay.
+prefix_replica_set_size = Gauge(
+    "tpu_router:prefix_replica_set_size",
+    "Largest live hot-prefix replica set (popularity view)",
+)
+# Fleet-wide token-weighted KV prefix hit rate, computed from the
+# engines' scraped tpu:prefix_cache_{hit,query}_tokens_total truth
+# counters — the BASELINE.md north-star KV metric, at one scrape point.
+fleet_prefix_hit_rate = Gauge(
+    "tpu_router:fleet_prefix_hit_rate",
+    "Fleet-wide prefix-cache hit rate (sum scraped hit/query tokens)",
+)
+
 # -- disaggregated prefill/decode serving (routing policy `disagg`) --------
 # Handoff latency: the whole prefill phase as the router sees it — prime
 # connect + engine prefill + eager chain export + handoff-token response.

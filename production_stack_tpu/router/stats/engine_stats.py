@@ -45,6 +45,15 @@ class EngineStats:
     # Cumulative engine admission 429s (counter): the capacity model
     # reads its growth as saturation evidence from OTHER routers' traffic.
     admission_rejected_total: float = 0.0
+    # Prefix-cache truth (routing/kv_aware.py popularity view): matched /
+    # queried prompt tokens since boot (counters — the fleet KV hit rate
+    # is sum(hit)/sum(query) across backends) and content-valid blocks
+    # resident right now (gauge — a collapse to ~0 between scrapes means
+    # the engine restarted and its cache is empty, whatever the router's
+    # owner map believes).
+    prefix_cache_hit_tokens: float = 0.0
+    prefix_cache_query_tokens: float = 0.0
+    prefix_cache_blocks: float = 0.0
     scraped_at: float = 0.0
 
     # Sample-name suffixes that belong to histogram/summary internals.

@@ -60,6 +60,20 @@ ENGINE_METRIC_CANDIDATES: Dict[str, List[str]] = {
     "admission_rejected_total": [
         "tpu:admission_rejected_total",
     ],
+    # Prefix-cache truth counters/size.  The router's fleet popularity
+    # view (routing/kv_aware.py) computes the fleet-wide KV hit rate
+    # from the hit/query token counters and reconciles its prefix-owner
+    # map against the cached-blocks gauge: a collapse to ~0 means the
+    # engine restarted and every "resident" prefix there is gone.
+    "prefix_cache_hit_tokens": [
+        "tpu:prefix_cache_hit_tokens_total",
+    ],
+    "prefix_cache_query_tokens": [
+        "tpu:prefix_cache_query_tokens_total",
+    ],
+    "prefix_cache_blocks": [
+        "tpu:prefix_cache_blocks",
+    ],
 }
 
 # Names our own engine exports (used by the engine server and the fake
@@ -68,6 +82,13 @@ TPU_NUM_REQUESTS_RUNNING = "tpu:num_requests_running"
 TPU_NUM_REQUESTS_WAITING = "tpu:num_requests_waiting"
 TPU_HBM_KV_USAGE_PERC = "tpu:hbm_kv_usage_perc"
 TPU_PREFIX_CACHE_HIT_RATE = "tpu:prefix_cache_hit_rate"
+# Prefix-cache truth: cumulative matched/queried prompt tokens (counters
+# — rates stay derivable after engine restarts, unlike the rolling-ratio
+# gauge above) and content-valid blocks resident right now (gauge — the
+# cache SIZE the router's popularity view reconciles owner maps against).
+TPU_PREFIX_CACHE_HIT_TOKENS = "tpu:prefix_cache_hit_tokens_total"
+TPU_PREFIX_CACHE_QUERY_TOKENS = "tpu:prefix_cache_query_tokens_total"
+TPU_PREFIX_CACHE_BLOCKS = "tpu:prefix_cache_blocks"
 TPU_HOST_KV_USAGE_PERC = "tpu:host_kv_usage_perc"
 TPU_DUTY_CYCLE = "tpu:duty_cycle"
 TPU_LOADED_LORAS = "tpu:loaded_loras"
@@ -172,6 +193,8 @@ TPU_KV_WIRE_FORMATS = ("dense", "int8")
 TPU_KV_SNAPSHOT_FORMAT = "tpu:kv_snapshot_format_total"
 TPU_KV_SNAPSHOT_VERSIONS = ("v1", "v2")
 TPU_COUNTERS = frozenset({
+    TPU_PREFIX_CACHE_HIT_TOKENS,
+    TPU_PREFIX_CACHE_QUERY_TOKENS,
     TPU_TOTAL_PROMPT_TOKENS,
     TPU_TOTAL_GENERATED_TOKENS,
     TPU_TOTAL_FINISHED_REQUESTS,

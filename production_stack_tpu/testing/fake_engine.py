@@ -43,6 +43,11 @@ class FakeEngineState:
         disagg_role: str | None = None,
         shared_store: set | None = None,
         prefetch_outcome: str | None = None,
+        prefix_chunk_chars: int = 64,
+        prefill_chars_per_sec: float | None = None,
+        prefill_scales_with_load: bool = False,
+        remote_store_import: bool = False,
+        store_import_chars_per_sec: float | None = None,
     ):
         self.model = model
         self.tokens_per_sec = tokens_per_sec
@@ -55,10 +60,32 @@ class FakeEngineState:
         self.total_prompt_tokens = 0
         self.total_generated_tokens = 0  # bumped per emitted token
         self.total_finished = 0  # bumped at completion (real-engine semantics)
-        self.prefix_hits = 0
-        self.prefix_queries = 0
+        # -- prefix-cache simulation (chunk-chain granularity) -------------
+        # ``note_prompt`` walks the prompt's chained chunk digests
+        # (fake_prefix_chain) against the set this engine has "cached":
+        # the matched leading run counts as hit tokens, the rest as cold
+        # prefill — the same token-weighted accounting the real engine's
+        # BlockPool keeps, so fleet KV hit rates measured against fakes
+        # respond to routing affinity the way real engines do.
+        self.prefix_chunk_chars = int(prefix_chunk_chars)
+        self.prefix_hit_tokens = 0
+        self.prefix_query_tokens = 0
+        # Prefill cost model: with ``prefill_chars_per_sec`` set, TTFT
+        # grows with the UNCACHED prompt tail (cold prefill); with
+        # ``prefill_scales_with_load`` + capacity, it additionally
+        # stretches with oversubscription (prefill queueing).  Both
+        # default off, preserving the constant-TTFT legacy fake exactly.
+        self.prefill_chars_per_sec = prefill_chars_per_sec
+        self.prefill_scales_with_load = bool(prefill_scales_with_load)
+        # Remote-store warming (the PR-4 plane, simulated): computed
+        # chunks are exported to ``shared_store`` and store-resident
+        # chunks import instead of recomputing (a cache hit at a cheaper
+        # per-char cost) — how a popularity-grown replica warms a hot
+        # prefix without paying the full prefill.
+        self.remote_store_import = bool(remote_store_import)
+        self.store_import_chars_per_sec = store_import_chars_per_sec
         self._rng = random.Random(seed)
-        self._seen_prefixes: set = set()
+        self._seen_chunks: set = set()
         # Same obs contract as the real engine (EngineObs): tracing tests
         # and the bench trace_report run against this in CI.
         self.obs = EngineObs()
@@ -150,20 +177,68 @@ class FakeEngineState:
             return base * max(1.0, self.in_flight / self.capacity)
         return base
 
-    def note_prompt(self, prompt_text: str) -> None:
-        """Rough prefix-cache simulation so hit-rate metrics move in CI."""
-        key = hash(prompt_text[:2048])
-        self.prefix_queries += 1
-        if key in self._seen_prefixes:
-            self.prefix_hits += 1
-        else:
-            self._seen_prefixes.add(key)
+    def note_prompt(self, prompt_text: str) -> tuple:
+        """Chunk-chain prefix-cache simulation.
+
+        Walks the prompt's chained chunk digests against this engine's
+        cached set: the matched leading run is a local hit; with
+        ``remote_store_import``, a contiguous store-resident extension
+        imports (counted as hit — the real prefetch plane lands imports
+        in the prefix cache before schedule, so ``match_prefix`` serves
+        them); the rest is cold prefill.  Returns
+        ``(uncached_chars, imported_chars)`` for the TTFT cost model.
+        """
+        cc = self.prefix_chunk_chars
+        chain = fake_prefix_chain(prompt_text, cc)
+        matched = 0
+        for digest in chain:
+            if digest not in self._seen_chunks:
+                break
+            matched += 1
+        imported = 0
+        if self.remote_store_import:
+            for digest in chain[matched:]:
+                if digest not in self.shared_store:
+                    break
+                imported += 1
+        total_chars = max(len(prompt_text), 1)
+        hit_chars = min((matched + imported) * cc, total_chars)
+        self.prefix_query_tokens += max(1, total_chars // 4)
+        self.prefix_hit_tokens += hit_chars // 4
+        self._seen_chunks.update(chain)
+        if self.remote_store_import:
+            self.shared_store.update(chain)  # px-export of computed chunks
+        uncached_chars = max(0, total_chars - hit_chars)
+        imported_chars = min(imported * cc, total_chars)
+        return uncached_chars, imported_chars
+
+    def prefill_seconds(self, uncached_chars: int, imported_chars: int) -> float:
+        """TTFT beyond the base: cold-prefill the uncached tail, import
+        the store-warmed span (cheaper), stretch with oversubscription
+        when the load model is on.  0.0 with the cost model off."""
+        if not self.prefill_chars_per_sec:
+            return 0.0
+        import_rate = (
+            self.store_import_chars_per_sec or 4.0 * self.prefill_chars_per_sec
+        )
+        cost = (
+            uncached_chars / self.prefill_chars_per_sec
+            + imported_chars / import_rate
+        )
+        if self.prefill_scales_with_load and self.capacity:
+            cost *= max(1.0, (self.in_flight + 1) / self.capacity)
+        return cost
 
     @property
     def prefix_hit_rate(self) -> float:
-        if not self.prefix_queries:
+        if not self.prefix_query_tokens:
             return 0.0
-        return self.prefix_hits / self.prefix_queries
+        return self.prefix_hit_tokens / self.prefix_query_tokens
+
+    @property
+    def prefix_cached_chunks(self) -> int:
+        """Resident content chunks — the tpu:prefix_cache_blocks mirror."""
+        return len(self._seen_chunks)
 
     @property
     def kv_usage(self) -> float:
@@ -250,6 +325,12 @@ def build_fake_engine_app(state: FakeEngineState | None = None) -> web.Applicati
             (vocab.TPU_NUM_REQUESTS_WAITING, waiting),
             (vocab.TPU_HBM_KV_USAGE_PERC, state.kv_usage),
             (vocab.TPU_PREFIX_CACHE_HIT_RATE, state.prefix_hit_rate),
+            # Prefix-cache truth (live values from the chunk-chain sim):
+            # the router's fleet popularity view scrapes these, so the
+            # whole reconcile/fleet-hit-rate path runs in CI on fakes.
+            (vocab.TPU_PREFIX_CACHE_HIT_TOKENS, state.prefix_hit_tokens),
+            (vocab.TPU_PREFIX_CACHE_QUERY_TOKENS, state.prefix_query_tokens),
+            (vocab.TPU_PREFIX_CACHE_BLOCKS, state.prefix_cached_chunks),
             (vocab.TPU_HOST_KV_USAGE_PERC, 0.0),
             (vocab.TPU_DUTY_CYCLE, min(1.0, state.num_running * 0.1)),
             (vocab.TPU_TOTAL_PROMPT_TOKENS, state.total_prompt_tokens),
@@ -448,7 +529,7 @@ def build_fake_engine_app(state: FakeEngineState | None = None) -> web.Applicati
             prompt_text = json.dumps(body.get("messages", ""))
         else:
             prompt_text = str(body.get("prompt", ""))
-        state.note_prompt(prompt_text)
+        uncached_chars, imported_chars = state.note_prompt(prompt_text)
         # Honor the router-assigned request id + trace context (the real
         # engine does the same), so router and engine timelines join.
         request_id = (
@@ -504,7 +585,9 @@ def build_fake_engine_app(state: FakeEngineState | None = None) -> web.Applicati
         # prefill work, so the TTFT sleep is skipped.  Any other outcome
         # keeps the full TTFT (the in-place recompute fallback).
         disagg_outcome = None
-        ttft_s = state.ttft
+        ttft_s = state.ttft + state.prefill_seconds(
+            uncached_chars, imported_chars
+        )
         handoff_hdr = request.headers.get("x-disagg-handoff")
         if handoff_hdr:
             try:

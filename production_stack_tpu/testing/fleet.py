@@ -122,6 +122,8 @@ class FleetHarness:
         fleet_admission: bool = True,
         default_slots: float = 8.0,
         routing_logic: str = "least_loaded",
+        engine_kwargs: Optional[Dict] = None,
+        base_port: Optional[int] = None,
     ):
         self.num_engines = int(num_engines)
         self.seed = int(seed)
@@ -134,6 +136,15 @@ class FleetHarness:
         self.fleet_admission = bool(fleet_admission)
         self.default_slots = float(default_slots)
         self.routing_logic = routing_logic
+        # Extra FakeEngineState kwargs (e.g. the prefix-cache/prefill
+        # cost model the multi-round workload turns on) applied to every
+        # backend at start().
+        self.engine_kwargs = dict(engine_kwargs or {})
+        # Fixed backend ports (base_port + index) instead of ephemeral
+        # ones: consistent-hash placement (SessionRouter) hashes backend
+        # URLs, so random ports make hash placement — and therefore every
+        # seeded A/B against it — nondeterministic across runs.
+        self.base_port = base_port
         self.rng = random.Random(self.seed)
         self.backends: List[FleetBackend] = []
         self.outcomes: List[Outcome] = []
@@ -165,8 +176,14 @@ class FleetHarness:
                 seed=self.seed + i,
                 capacity=self.capacity,
                 max_queued=self.max_queued,
+                **self.engine_kwargs,
             )
-            server = TestServer(build_fake_engine_app(state))
+            if self.base_port is not None:
+                server = TestServer(
+                    build_fake_engine_app(state), port=self.base_port + i
+                )
+            else:
+                server = TestServer(build_fake_engine_app(state))
             await server.start_server()
             be = FleetBackend(index=i, state=state, server=server)
             be.url = str(server.make_url("")).rstrip("/")

@@ -322,6 +322,9 @@ def _call(name: str, args: List[Any], env: _Env) -> Any:
         return args[0] > args[1]
     if name == "lt":
         return args[0] < args[1]
+    if name == "hasPrefix":
+        # sprig argument order: (hasPrefix PREFIX STRING).
+        return str(args[1] or "").startswith(str(args[0] or ""))
     if name == "int":
         v = args[0]
         return int(v) if v not in (None, "") else 0

@@ -79,6 +79,47 @@ async def test_reconfigure_swaps_discovery_and_routing(tmp_path, registry):
     assert scraper.service_discovery is discovery
 
 
+async def test_reconfigure_preserves_kv_routing_knobs(tmp_path, registry):
+    """A hot-reload rebuilding the kv_aware_popularity router must keep
+    the CLI-tuned --kv-* knobs instead of silently reverting to library
+    defaults (regression: _reconfigure_routing used to forward only
+    session_key)."""
+    from production_stack_tpu.router.routing import initialize_routing_logic
+    from production_stack_tpu.router.service_discovery import (
+        StaticServiceDiscovery,
+    )
+
+    cfg_path = tmp_path / "dyn.json"
+    args = parse_args([
+        "--static-backends", "http://127.0.0.1:9001",
+        "--static-models", "m",
+        "--dynamic-config-json", str(cfg_path),
+        "--routing-logic", "kv_aware_popularity",
+        "--kv-affinity-tradeoff", "10",
+        "--kv-popularity-hot-credit-cap", "0.17",
+        "--kv-chunk-chars", "256",
+    ])
+    registry.set(
+        DISCOVERY_SERVICE,
+        StaticServiceDiscovery(["http://127.0.0.1:9001"], [["m"]]),
+    )
+    initialize_routing_logic(registry, "roundrobin")
+    watcher = DynamicConfigWatcher(str(cfg_path), registry, args)
+    write_config(
+        cfg_path,
+        service_discovery="static",
+        routing_logic="kv_aware_popularity",
+        static_backends="http://127.0.0.1:9002",
+        static_models="m",
+    )
+    await watcher._check_once()
+    router = registry.get(ROUTING_SERVICE)
+    assert type(router).__name__ == "PopularityKVAwareRouter"
+    assert router.load_tradeoff == 10.0
+    assert router.hot_credit_cap == 0.17
+    assert router.chunk_chars == 256
+
+
 async def test_bad_json_keeps_old_config(tmp_path, registry):
     cfg_path = tmp_path / "dyn.json"
     args = base_args(cfg_path)

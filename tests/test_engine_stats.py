@@ -21,6 +21,12 @@ tpu:prefix_cache_hit_rate 0.87
 tpu:host_kv_usage_perc 0.11
 # TYPE tpu:duty_cycle gauge
 tpu:duty_cycle 0.93
+# TYPE tpu:prefix_cache_hit_tokens_total counter
+tpu:prefix_cache_hit_tokens_total 12345.0
+# TYPE tpu:prefix_cache_query_tokens_total counter
+tpu:prefix_cache_query_tokens_total 20000.0
+# TYPE tpu:prefix_cache_blocks gauge
+tpu:prefix_cache_blocks 417.0
 """
 
 VLLM_METRICS = """\
@@ -43,6 +49,38 @@ def test_parse_tpu_vocabulary():
     assert abs(s.prefix_cache_hit_rate - 0.87) < 1e-9
     assert abs(s.kv_offload_usage_perc - 0.11) < 1e-9
     assert abs(s.accelerator_utilization - 0.93) < 1e-9
+    # Prefix-cache truth series (the router popularity view's inputs).
+    assert s.prefix_cache_hit_tokens == 12345.0
+    assert s.prefix_cache_query_tokens == 20000.0
+    assert s.prefix_cache_blocks == 417.0
+
+
+def test_parse_fake_engine_prefix_truth_mirror():
+    """The fake engine exports live prefix-cache truth series that the
+    scraper resolves into EngineStats — the same contract as the real
+    engine (stackcheck SC303 pins the mirror's existence; this pins the
+    values flowing)."""
+    from production_stack_tpu.testing.fake_engine import (
+        FakeEngineState,
+        build_fake_engine_app,
+    )
+
+    state = FakeEngineState(prefix_chunk_chars=64)
+    state.note_prompt("p" * 640)
+    state.note_prompt("p" * 640)
+    app = build_fake_engine_app(state)  # noqa: F841 (render path below)
+    # Render through the same function the /metrics route uses.
+    from production_stack_tpu.router.stats import vocabulary as vocab
+
+    text = vocab.render_prometheus([
+        (vocab.TPU_PREFIX_CACHE_HIT_TOKENS, state.prefix_hit_tokens),
+        (vocab.TPU_PREFIX_CACHE_QUERY_TOKENS, state.prefix_query_tokens),
+        (vocab.TPU_PREFIX_CACHE_BLOCKS, state.prefix_cached_chunks),
+    ])
+    s = EngineStats.from_prometheus_text(text)
+    assert s.prefix_cache_hit_tokens == 160.0
+    assert s.prefix_cache_query_tokens == 320.0
+    assert s.prefix_cache_blocks == 10.0
 
 
 def test_parse_vllm_vocabulary_compat():

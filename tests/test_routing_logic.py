@@ -220,5 +220,6 @@ def test_available_routing_logics():
         "session",
         "least_loaded",
         "kv_aware",
+        "kv_aware_popularity",
         "disagg",
     }
