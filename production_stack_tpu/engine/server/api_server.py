@@ -261,6 +261,14 @@ def build_engine_app(
     def _watchdog_problem() -> Optional[str]:
         if not engine.step_thread_healthy:
             return "engine step thread died"
+        # Slice-group liveness conjunction (docs/robustness.md "Slice
+        # lifecycle contract"): the leader IS the slice's one discovery
+        # endpoint, so a silent member fails the WHOLE slice's health
+        # here — within --slice-member-timeout-s, well before the step
+        # watchdog would notice the wedged collective.
+        slice_problem = engine.slice_problem()
+        if slice_problem is not None:
+            return slice_problem
         wd = engine.engine.config.scheduler.step_watchdog_s
         age = engine.last_step_age_s
         if wd and age > wd:
@@ -331,6 +339,7 @@ def build_engine_app(
 
     async def metrics(_req: web.Request) -> web.Response:
         s = engine.stats()
+        monitor = engine.slice_monitor
         pairs = [
             (vocab.TPU_NUM_REQUESTS_RUNNING, s["num_requests_running"]),
             (vocab.TPU_NUM_REQUESTS_WAITING, s["num_requests_waiting"]),
@@ -373,6 +382,12 @@ def build_engine_app(
             # K-step decode windows: emitted-but-undeliverable tokens
             # (the labeled fallback family renders below).
             (vocab.TPU_MULTISTEP_WASTED_TOKENS, s["multistep_wasted_tokens"]),
+            # Slice-group lifecycle (0 on single-host engines): the group
+            # epoch steps on every group restart, and drain relays count
+            # follower-initiated slice-wide drains (docs/robustness.md).
+            (vocab.TPU_LOCKSTEP_GROUP_EPOCH, engine.slice_epoch),
+            (vocab.TPU_SLICE_DRAIN_RELAYS,
+             monitor.drain_relays if monitor is not None else 0),
         ]
         # Latency histogram families (TTFT/ITL/e2e + step phases) ride the
         # same exposition; rendered even at zero observations so the
@@ -413,6 +428,23 @@ def build_engine_app(
                 {
                     **dict.fromkeys(vocab.TPU_KV_SNAPSHOT_VERSIONS, 0),
                     **s["kv_snapshot_format"],
+                },
+            )
+            # Slice-group member liveness (empty member set single-host;
+            # the TYPE headers still render so the scrape contract is
+            # stable across single- and multi-host engines).
+            + vocab.render_labeled_gauge(
+                vocab.TPU_LOCKSTEP_MEMBER_LAST_ACK, "member",
+                {} if monitor is None else {
+                    str(pid): age
+                    for pid, age in monitor.member_ack_ages().items()
+                },
+            )
+            + vocab.render_labeled_counter(
+                vocab.TPU_LOCKSTEP_MEMBER_FAILURES, "reason",
+                {
+                    **dict.fromkeys(vocab.TPU_LOCKSTEP_FAILURE_REASONS, 0),
+                    **({} if monitor is None else monitor.member_failures),
                 },
             )
             + engine.engine.obs.render_metrics()
@@ -1660,6 +1692,17 @@ def build_engine_app(
 
     async def lifecycle(app):
         await engine.start()
+        # Follower->leader drain relay (slice-wide drain): a follower's
+        # SIGTERM/preStop never leaves the collectives — it relays to
+        # the leader, and the LEADER runs the one drain the whole group
+        # follows (in-flight streams finish, then the step loop's
+        # shutdown publish releases every member to exit 0 in order).
+        # The relay fires on the monitor thread; begin() needs the loop.
+        if engine.slice_monitor is not None:
+            loop = asyncio.get_running_loop()
+            engine.slice_monitor.on_drain_relay = (
+                lambda: loop.call_soon_threadsafe(drain.begin)
+            )
         yield
         await engine.close()
 
@@ -1718,10 +1761,40 @@ def _serve_health(health_loop, health_app, host, port) -> None:
                 health_loop.close()
 
 
+# stackcheck: thread=slice-guard
+def _slice_guard(channel, stop_event) -> None:
+    """Follower-side group-fail watcher: the leader's monitor writes a
+    group-fail marker on the control-plane side channel when a member
+    dies, and THIS thread is how a live follower sees it — the main
+    thread is blocked inside a collective the dead member will never
+    join, so only an off-collective poll can release it.  fatal_exit
+    (never sys.exit): the wedged collective would hang atexit teardown."""
+    from production_stack_tpu.engine.parallel import distributed
+
+    while not stop_event.wait(0.5):
+        reason = channel.group_failed()
+        if reason is not None:
+            logger.error(
+                "slice group marked failed (%s); exiting for a parallel "
+                "group restart", reason,
+            )
+            distributed.fatal_exit(1)
+            return  # unreachable except under monkeypatched exit
+
+
 def _run_follower(config, denv, args) -> None:
-    """Follower process of a multi-host slice group: tiny /health app for
-    k8s probes (the StatefulSet has one pod template, so every ordinal
-    must answer probes) + the lockstep step loop."""
+    """Follower process of a multi-host slice group: tiny probe app for
+    k8s (the StatefulSet has one pod template, so every ordinal must
+    answer probes AND the preStop /drain hook) + the lockstep step loop.
+
+    Drain contract (docs/robustness.md "Slice lifecycle contract"):
+    SIGTERM or POST /drain on a follower RELAYS the drain intent to the
+    leader through the control-plane side channel — the follower keeps
+    stepping (it never unilaterally leaves the collectives, which would
+    kill every in-flight stream on the slice) until the leader finishes
+    the in-flight streams and announces shutdown, releasing the whole
+    group to exit 0 in order."""
+    import signal
     import threading
 
     from production_stack_tpu.engine.core.engine import LLMEngine
@@ -1729,7 +1802,9 @@ def _run_follower(config, denv, args) -> None:
 
     health_app = web.Application()
     engine = LLMEngine(config)
-    channel = distributed.LockstepChannel(denv)
+    channel = distributed.LockstepChannel(
+        denv, member_timeout_s=args.slice_member_timeout_s
+    )
 
     async def health(_req: web.Request) -> web.Response:
         if channel.stale():
@@ -1746,7 +1821,56 @@ def _run_follower(config, denv, args) -> None:
              "process_id": denv.process_id}
         )
 
+    async def ready(_req: web.Request) -> web.Response:
+        """Follower readiness: 503 once a drain was relayed (the pod is
+        on its way out; the client Service only selects ordinal 0, but
+        operators and preStop ordering read this) or when the leader
+        went stale."""
+        if channel.drain_relayed:
+            return web.json_response(
+                {"status": "draining", "role": "follower"}, status=503
+            )
+        if channel.stale():
+            return web.json_response(
+                {"status": "unhealthy", "role": "follower"}, status=503
+            )
+        return web.json_response({"status": "ready", "role": "follower"})
+
+    def _relay_drain(source: str) -> bool:
+        relayed = channel.relay_drain()
+        if relayed:
+            logger.info(
+                "follower %d: %s -> drain relayed to the leader; stepping "
+                "until the group shutdown", denv.process_id, source,
+            )
+        else:
+            logger.warning(
+                "follower %d: %s but no control-plane side channel; "
+                "relying on the leader's own drain/staleness path",
+                denv.process_id, source,
+            )
+        return relayed
+
+    async def drain_endpoint(_req: web.Request) -> web.Response:
+        """POST /drain (helm preStop — one pod template, every ordinal
+        gets the hook): relay to the leader, never exit unilaterally."""
+        relayed = _relay_drain("POST /drain")
+        return web.json_response({
+            "draining": True, "role": "follower", "relayed": relayed,
+        })
+
     health_app.router.add_get("/health", health)
+    health_app.router.add_get("/ready", ready)
+    health_app.router.add_post("/drain", drain_endpoint)
+
+    # SIGTERM (kubelet pod termination) converges on the same relay.
+    # signal.signal works here: _run_follower runs on the main thread.
+    try:
+        signal.signal(
+            signal.SIGTERM, lambda _sig, _frm: _relay_drain("SIGTERM")
+        )
+    except (ValueError, OSError):  # non-main thread (tests) / platform
+        pass
 
     health_loop = asyncio.new_event_loop()
 
@@ -1756,6 +1880,12 @@ def _run_follower(config, denv, args) -> None:
         name="health-serve", daemon=True,
     )
     health_thread.start()
+    guard_stop = threading.Event()
+    guard_thread = threading.Thread(
+        target=_slice_guard, args=(channel, guard_stop),
+        name="slice-guard", daemon=True,
+    )
+    guard_thread.start()
     logger.info(
         "tpu-engine follower %d/%d ready (leader owns the HTTP surface)",
         denv.process_id, denv.num_processes,
@@ -1768,6 +1898,8 @@ def _run_follower(config, denv, args) -> None:
         # follower restart never strands queued remote work.  The loop
         # may already be closed (_serve_health died on a bind error);
         # engine.close() must run regardless.
+        guard_stop.set()
+        guard_thread.join(5)
         try:
             health_loop.call_soon_threadsafe(health_loop.stop)
         except RuntimeError:
@@ -1965,6 +2097,15 @@ def main(argv=None) -> None:
         "iterated in this many seconds (hung device dispatch); 0 disables",
     )
     parser.add_argument(
+        "--slice-member-timeout-s", type=float, default=10.0,
+        help="multi-host slice groups: fail the leader's /health (and "
+        "fatal-exit the whole group into a parallel restart) when a "
+        "member's lockstep acks stop advancing for this long — well "
+        "under --step-watchdog-s, so a dead follower fails the slice in "
+        "seconds instead of wedging collectives until the watchdog; "
+        "0 disables group liveness (staleness-window behavior only)",
+    )
+    parser.add_argument(
         "--drain-grace-s", type=float, default=30.0,
         help="on SIGTERM or POST /drain: stop admitting (503 + "
         "Connection: close), flip /ready to 503, let in-flight streams "
@@ -2105,7 +2246,12 @@ def main(argv=None) -> None:
     if denv is not None and not denv.is_leader:
         _run_follower(config, denv, args)
         return
-    lockstep = distributed.LockstepChannel(denv) if denv is not None else None
+    lockstep = (
+        distributed.LockstepChannel(
+            denv, member_timeout_s=args.slice_member_timeout_s
+        )
+        if denv is not None else None
+    )
 
     engine = AsyncEngine(config, lockstep=lockstep)
     if args.chat_template:

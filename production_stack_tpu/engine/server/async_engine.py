@@ -76,6 +76,24 @@ class AsyncEngine:
         # all replicas' jitted launches identical (SPMD requirement).
         self.engine = LLMEngine(config)
         self._lockstep = lockstep
+        # Group liveness (docs/robustness.md "Slice lifecycle contract"):
+        # a real lockstep channel with a control-plane side channel gets
+        # a member-liveness monitor — the slice's health becomes the
+        # conjunction of its members' through /health.  Recording stubs
+        # in tests carry no denv and stay monitor-free.
+        from production_stack_tpu.engine.parallel.distributed import (
+            GroupLivenessMonitor,
+        )
+
+        self._slice_monitor: Optional[GroupLivenessMonitor] = None
+        denv = getattr(lockstep, "denv", None)
+        if (
+            denv is not None
+            and denv.num_processes > 1
+            and getattr(lockstep, "ack_store", None) is not None
+            and getattr(lockstep, "member_timeout_s", 0) > 0
+        ):
+            self._slice_monitor = GroupLivenessMonitor(lockstep)
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._queues: Dict[str, asyncio.Queue] = {}
         self._pending: List = []  # (request_id, prompt_ids, sampling_params)
@@ -106,10 +124,16 @@ class AsyncEngine:
             target=self._run_loop, name="engine-step-loop", daemon=True
         )
         self._thread.start()
+        if self._slice_monitor is not None:
+            self._slice_monitor.start()
 
     async def close(self) -> None:
         self._shutdown.set()
         self._wakeup.set()
+        if self._slice_monitor is not None:
+            # Before the step-thread join: a member dying mid-close must
+            # not fatal_exit a process already shutting down cleanly.
+            await asyncio.to_thread(self._slice_monitor.stop)
         if self._thread is not None:
             await asyncio.to_thread(self._thread.join, 30)
         # Release the engine's own workers AFTER the step thread is gone
@@ -225,6 +249,30 @@ class AsyncEngine:
         if ts is None:
             return 0.0
         return max(0.0, time.time() - ts)
+
+    # -- slice-group liveness reads (docs/robustness.md) --------------------
+
+    @property
+    def slice_monitor(self):
+        return self._slice_monitor
+
+    def slice_problem(self) -> Optional[str]:
+        """Non-None when the slice group lost a member (the leader's
+        /health conjoins this with the step watchdog, so the WHOLE slice
+        fails liveness within --slice-member-timeout-s of the member
+        going silent — the router's breaker routes around it in
+        seconds).  None on single-host engines."""
+        if self._slice_monitor is None:
+            return None
+        return self._slice_monitor.problem()
+
+    @property
+    def slice_epoch(self) -> int:
+        """The group epoch (leader boot nonce; 0 single-host) —
+        tpu:lockstep_group_epoch."""
+        if self._lockstep is None:
+            return 0
+        return getattr(self._lockstep, "epoch", 0)
 
     @property
     def step_thread_healthy(self) -> bool:

@@ -239,6 +239,38 @@ REGISTRY = {
                 "version (v1: legacy untagged dense fp32; v2: tagged "
                 "int8 data + fp32 scales — kvserver/protocol.py)",
     },
+    "tpu:lockstep_member_last_ack_seconds": {
+        "kind": "gauge", "layer": "engine", "labels": ("member",),
+        "mirrors": ("fake_engine", "dashboard", "docs"),
+        "help": "Per-member seconds since the follower's lockstep acks "
+                "last advanced (leader of a multi-host slice group; a "
+                "member frozen near --slice-member-timeout-s is about "
+                "to fail the whole slice's /health)",
+    },
+    "tpu:lockstep_group_epoch": {
+        "kind": "gauge", "layer": "engine",
+        "mirrors": ("fake_engine", "dashboard", "docs"),
+        "help": "Slice group epoch (leader boot nonce carried in every "
+                "lockstep event batch; strictly larger after every "
+                "group restart — a step in this line IS a restart "
+                "marker, and the split-brain guard's ordering)",
+    },
+    "tpu:lockstep_member_failures_total": {
+        "kind": "counter", "layer": "engine", "labels": ("reason",),
+        "mirrors": ("fake_engine", "dashboard", "docs"),
+        "help": "Slice members declared failed (reason: member_silent — "
+                "acks stopped past the member timeout; epoch_mismatch — "
+                "a member observed a different group incarnation); each "
+                "failure restarts the whole group in parallel",
+    },
+    "tpu:slice_drain_relays_total": {
+        "kind": "counter", "layer": "engine",
+        "mirrors": ("fake_engine", "dashboard", "docs"),
+        "help": "Follower-initiated drains relayed to the leader "
+                "(preStop/SIGTERM on a follower drains the WHOLE slice "
+                "through the leader; followers keep stepping until the "
+                "group shutdown so in-flight streams finish)",
+    },
     # -- engine request-level histograms (obs layer) -----------------------
     "tpu:ttft_seconds": {
         "kind": "histogram", "layer": "engine",

@@ -192,6 +192,21 @@ TPU_KV_WIRE_TIERS = ("host", "remote")
 TPU_KV_WIRE_FORMATS = ("dense", "int8")
 TPU_KV_SNAPSHOT_FORMAT = "tpu:kv_snapshot_format_total"
 TPU_KV_SNAPSHOT_VERSIONS = ("v1", "v2")
+# Slice-coherent lifecycle (multi-host lockstep groups; docs/robustness.md
+# "Slice lifecycle contract").  The leader exports group liveness truth:
+# per-member seconds since the last lockstep ack advanced (a member
+# frozen near --slice-member-timeout-s is about to fail the slice),
+# the group epoch (leader boot nonce — strictly larger after every group
+# restart, so a flat line that steps is a restart marker), member
+# failures by reason, and follower->leader drain relays (preStop/SIGTERM
+# on a follower drains the WHOLE slice through the leader).
+TPU_LOCKSTEP_MEMBER_LAST_ACK = "tpu:lockstep_member_last_ack_seconds"
+TPU_LOCKSTEP_GROUP_EPOCH = "tpu:lockstep_group_epoch"
+TPU_LOCKSTEP_MEMBER_FAILURES = "tpu:lockstep_member_failures_total"
+# The closed reason set, pre-seeded as zero-valued series so scrapers,
+# dashboards, and rate() see stable label sets from boot.
+TPU_LOCKSTEP_FAILURE_REASONS = ("member_silent", "epoch_mismatch")
+TPU_SLICE_DRAIN_RELAYS = "tpu:slice_drain_relays_total"
 TPU_COUNTERS = frozenset({
     TPU_PREFIX_CACHE_HIT_TOKENS,
     TPU_PREFIX_CACHE_QUERY_TOKENS,
@@ -212,6 +227,7 @@ TPU_COUNTERS = frozenset({
     TPU_DISAGG_PREFILL_PRIMES,
     TPU_DISAGG_HANDOFF_HITS,
     TPU_DISAGG_HANDOFF_MISSES,
+    TPU_SLICE_DRAIN_RELAYS,
 })
 
 
@@ -287,6 +303,17 @@ def render_labeled_counter(name: str, label: str, values) -> str:
     contract render_prometheus gives unlabeled families).  Shared by the
     real engine server and the fake engine."""
     lines = [f"# TYPE {name} counter"]
+    for key in sorted(values):
+        lines.append(f'{name}{{{label}="{key}"}} {float(values[key])}')
+    return "\n".join(lines) + "\n"
+
+
+def render_labeled_gauge(name: str, label: str, values) -> str:
+    """Serialize one LABELED gauge family ({label="key"} series from a
+    plain dict) — the gauge sibling of render_labeled_counter, with the
+    same stable-TYPE-header contract.  Shared by the real engine server
+    and the fake engine."""
+    lines = [f"# TYPE {name} gauge"]
     for key in sorted(values):
         lines.append(f'{name}{{{label}="{key}"}} {float(values[key])}')
     return "\n".join(lines) + "\n"

@@ -29,6 +29,96 @@ from production_stack_tpu.obs.trace import parse_traceparent
 from production_stack_tpu.router.stats import vocabulary as vocab
 
 
+class FakeSliceGroup:
+    """Simulated multi-host slice group behind ONE fake leader endpoint
+    (docs/robustness.md "Slice lifecycle contract", jax-free).
+
+    Mirrors the real contract exactly enough for the router/fleet plane
+    to be chaos-tested in tier-1: followers "ack" continuously while
+    alive; :meth:`kill_member` freezes a member's acks, so after
+    ``member_timeout_s`` the leader's /health fails (the slice is ONE
+    endpoint whose health is the conjunction of its members) and the
+    data plane starts refusing connections (the leader fatal-exits in
+    production).  :meth:`restart` models the parallel k8s group restart:
+    a STRICTLY larger epoch, members revived, drains cleared.  A
+    follower's POST /drain relays to the leader — the leader drains the
+    whole group.
+    """
+
+    def __init__(
+        self,
+        num_members: int = 4,
+        member_timeout_s: float = 1.0,
+        clock=time.monotonic,
+    ):
+        from production_stack_tpu.engine.parallel.distributed import new_epoch
+
+        self._new_epoch = new_epoch
+        self.num_members = int(num_members)
+        self.member_timeout_s = float(member_timeout_s)
+        self._clock = clock
+        self.epoch = new_epoch()
+        self._last_ack = {
+            pid: clock() for pid in range(1, self.num_members)
+        }
+        self._killed: set = set()
+        self._problem: str | None = None
+        self.member_failures: dict = {}  # reason -> count
+        self.drain_relays = 0
+        self.drain_relayed = False
+        self.restarts = 0
+
+    def member_ack_ages(self) -> dict:
+        """Live members ack continuously (age ~0); killed members' ages
+        grow in real time — the tpu:lockstep_member_last_ack_seconds
+        truth the leader exports."""
+        now = self._clock()
+        for pid in self._last_ack:
+            if pid not in self._killed:
+                self._last_ack[pid] = now
+        return {pid: max(0.0, now - t) for pid, t in self._last_ack.items()}
+
+    def kill_member(self, pid: int) -> None:
+        if pid not in self._last_ack:
+            raise ValueError(f"no such member ordinal {pid}")
+        self._killed.add(pid)
+
+    def problem(self) -> str | None:
+        """Non-None once any member has been silent past the timeout
+        (first detection counts one member_silent failure, like the real
+        GroupLivenessMonitor)."""
+        if self._problem is None:
+            for pid, age in self.member_ack_ages().items():
+                if age > self.member_timeout_s:
+                    self._problem = (
+                        f"slice member {pid} silent for {age:.1f}s "
+                        f"(member timeout {self.member_timeout_s:.1f}s)"
+                    )
+                    self.member_failures["member_silent"] = (
+                        self.member_failures.get("member_silent", 0) + 1
+                    )
+                    break
+        return self._problem
+
+    def relay_drain(self, pid: int) -> None:
+        self.drain_relays += 1
+        self.drain_relayed = True
+
+    def restart(self) -> None:
+        """The parallel group restart k8s performs after a failure: every
+        member comes back into ONE fresh incarnation whose epoch is
+        strictly larger — a restarted member can never replay into it."""
+        self.epoch = self._new_epoch()
+        assert self.epoch > 0
+        self._killed.clear()
+        self._problem = None
+        self.drain_relayed = False
+        now = self._clock()
+        for pid in self._last_ack:
+            self._last_ack[pid] = now
+        self.restarts += 1
+
+
 class FakeEngineState:
     def __init__(
         self,
@@ -48,6 +138,7 @@ class FakeEngineState:
         prefill_scales_with_load: bool = False,
         remote_store_import: bool = False,
         store_import_chars_per_sec: float | None = None,
+        slice_group: FakeSliceGroup | None = None,
     ):
         self.model = model
         self.tokens_per_sec = tokens_per_sec
@@ -137,6 +228,13 @@ class FakeEngineState:
         self.disagg_prefill_primes = 0
         self.disagg_handoff_hits = 0
         self.disagg_handoff_misses = 0
+        # -- multi-host slice-group emulation (FakeSliceGroup) -------------
+        # This state becomes the LEADER (ordinal 0) of a simulated slice:
+        # /health conjoins member liveness, a failed group refuses data-
+        # plane connections (the fatal-exited leader as the router sees
+        # it), and build_fake_follower_app() serves the follower
+        # ordinals' probe/drain surface against the same group object.
+        self.slice_group = slice_group
 
     def inject(self, kind: str, **params) -> None:
         """Arm a fault: ``refuse`` (close the connection pre-response;
@@ -290,6 +388,17 @@ def build_fake_engine_app(state: FakeEngineState | None = None) -> web.Applicati
         )
 
     async def health(_request: web.Request) -> web.Response:
+        if state.slice_group is not None:
+            problem = state.slice_group.problem()
+            if problem is not None:
+                # The slice is ONE endpoint whose health is the
+                # conjunction of its members (the real leader's
+                # /health conjoins GroupLivenessMonitor.problem()).
+                return web.json_response(
+                    {"status": "unhealthy", "problem": problem,
+                     "epoch": state.slice_group.epoch},
+                    status=503,
+                )
         return web.json_response({"status": "ok", "last_step_age_s": 0.0})
 
     async def ready(_request: web.Request) -> web.Response:
@@ -399,6 +508,30 @@ def build_fake_engine_app(state: FakeEngineState | None = None) -> web.Applicati
         ) + vocab.render_labeled_counter(
             vocab.TPU_KV_SNAPSHOT_FORMAT, "version",
             dict.fromkeys(vocab.TPU_KV_SNAPSHOT_VERSIONS, 0),
+        ) + vocab.render_prometheus([
+            # Slice-group lifecycle: live values in slice mode so the
+            # whole group-liveness contract (epoch steps on restart,
+            # relays count) is scrapeable against fakes in CI; zeros —
+            # but stable families — single-host (SC303).
+            (vocab.TPU_LOCKSTEP_GROUP_EPOCH,
+             state.slice_group.epoch if state.slice_group else 0),
+            (vocab.TPU_SLICE_DRAIN_RELAYS,
+             state.slice_group.drain_relays if state.slice_group else 0),
+        ]) + vocab.render_labeled_gauge(
+            vocab.TPU_LOCKSTEP_MEMBER_LAST_ACK, "member",
+            {} if state.slice_group is None else {
+                str(pid): age
+                for pid, age in state.slice_group.member_ack_ages().items()
+            },
+        ) + vocab.render_labeled_counter(
+            vocab.TPU_LOCKSTEP_MEMBER_FAILURES, "reason",
+            {
+                **dict.fromkeys(vocab.TPU_LOCKSTEP_FAILURE_REASONS, 0),
+                **(
+                    state.slice_group.member_failures
+                    if state.slice_group else {}
+                ),
+            },
         ) + state.obs.render_metrics()
 
     async def debug_requests(_request: web.Request) -> web.Response:
@@ -457,6 +590,17 @@ def build_fake_engine_app(state: FakeEngineState | None = None) -> web.Applicati
             if request.transport is not None:
                 request.transport.close()
             raise ConnectionResetError("injected connection refusal")
+        if (
+            state.slice_group is not None
+            and state.slice_group.problem() is not None
+        ):
+            # A failed slice's leader fatal-exits within the member
+            # timeout: the router sees connection refusals (breaker
+            # opens, retry budget fails the request over to healthy
+            # backends) — never a clean 5xx from a half-dead group.
+            if request.transport is not None:
+                request.transport.close()
+            raise ConnectionResetError("slice group failed (leader exited)")
         inj = state._take_injection("error_5xx")
         if inj is not None:
             return web.json_response(
@@ -760,6 +904,53 @@ def build_fake_engine_app(state: FakeEngineState | None = None) -> web.Applicati
     app.router.add_get("/debug/requests/{request_id}", debug_request)
     app.router.add_post("/v1/chat/completions", chat_completions)
     app.router.add_post("/v1/completions", completions)
+    return app
+
+
+def build_fake_follower_app(
+    leader_state: FakeEngineState, ordinal: int
+) -> web.Application:
+    """Probe/drain surface of one follower ordinal in a fake slice group
+    (the real follower serves exactly /health + /ready + POST /drain —
+    api_server._run_follower).  POST /drain RELAYS to the leader: the
+    whole slice drains through the leader's data plane, and the follower
+    keeps "stepping" (stays healthy) until the group exits together."""
+    group = leader_state.slice_group
+    if group is None:
+        raise ValueError("leader state has no slice_group")
+    app = web.Application()
+
+    async def health(_request: web.Request) -> web.Response:
+        problem = group.problem()
+        if problem is not None:
+            return web.json_response(
+                {"status": "unhealthy", "role": "follower",
+                 "problem": problem},
+                status=503,
+            )
+        return web.json_response(
+            {"status": "ok", "role": "follower", "process_id": ordinal}
+        )
+
+    async def ready(_request: web.Request) -> web.Response:
+        if group.drain_relayed:
+            return web.json_response(
+                {"status": "draining", "role": "follower"}, status=503
+            )
+        return web.json_response({"status": "ready", "role": "follower"})
+
+    async def drain_endpoint(_request: web.Request) -> web.Response:
+        group.relay_drain(ordinal)
+        # The LEADER drains the group: it stops admitting and finishes
+        # the in-flight streams; members exit together afterwards.
+        leader_state.draining = True
+        return web.json_response({
+            "draining": True, "role": "follower", "relayed": True,
+        })
+
+    app.router.add_get("/health", health)
+    app.router.add_get("/ready", ready)
+    app.router.add_post("/drain", drain_endpoint)
     return app
 
 

@@ -63,7 +63,9 @@ from production_stack_tpu.router.services.request_service.request import (
 )
 from production_stack_tpu.testing.fake_engine import (
     FakeEngineState,
+    FakeSliceGroup,
     build_fake_engine_app,
+    build_fake_follower_app,
 )
 
 MODEL = "fleet/fake-llama"
@@ -124,6 +126,8 @@ class FleetHarness:
         routing_logic: str = "least_loaded",
         engine_kwargs: Optional[Dict] = None,
         base_port: Optional[int] = None,
+        slice_members: int = 0,
+        slice_member_timeout_s: float = 0.5,
     ):
         self.num_engines = int(num_engines)
         self.seed = int(seed)
@@ -145,6 +149,15 @@ class FleetHarness:
         # URLs, so random ports make hash placement — and therefore every
         # seeded A/B against it — nondeterministic across runs.
         self.base_port = base_port
+        # Multi-host slice emulation: with slice_members >= 2, backend 0
+        # becomes the LEADER of a fake slice group — ONE discovery
+        # endpoint whose health is the conjunction of its members — and
+        # the follower ordinals get health-only endpoints OUTSIDE
+        # discovery (k8s only exposes the ordinal-0 client service).
+        self.slice_members = int(slice_members)
+        self.slice_member_timeout_s = float(slice_member_timeout_s)
+        self.slice_group: Optional[FakeSliceGroup] = None
+        self.slice_follower_servers: List[TestServer] = []
         self.rng = random.Random(self.seed)
         self.backends: List[FleetBackend] = []
         self.outcomes: List[Outcome] = []
@@ -168,6 +181,11 @@ class FleetHarness:
     # -- lifecycle ---------------------------------------------------------
 
     async def start(self, active: int = 2) -> None:
+        if self.slice_members >= 2:
+            self.slice_group = FakeSliceGroup(
+                num_members=self.slice_members,
+                member_timeout_s=self.slice_member_timeout_s,
+            )
         for i in range(self.num_engines):
             state = FakeEngineState(
                 model=MODEL,
@@ -176,6 +194,7 @@ class FleetHarness:
                 seed=self.seed + i,
                 capacity=self.capacity,
                 max_queued=self.max_queued,
+                slice_group=self.slice_group if i == 0 else None,
                 **self.engine_kwargs,
             )
             if self.base_port is not None:
@@ -188,6 +207,18 @@ class FleetHarness:
             be = FleetBackend(index=i, state=state, server=server)
             be.url = str(server.make_url("")).rstrip("/")
             self.backends.append(be)
+
+        if self.slice_group is not None:
+            # Follower probe endpoints (ordinals 1..n-1): live servers so
+            # probe/drain paths are real HTTP, but never in discovery —
+            # the slice is ONE endpoint fronted by its leader.
+            leader_state = self.backends[0].state
+            for ordinal in range(1, self.slice_members):
+                fsrv = TestServer(
+                    build_fake_follower_app(leader_state, ordinal)
+                )
+                await fsrv.start_server()
+                self.slice_follower_servers.append(fsrv)
 
         initial = self.backends[:active]
         for be in initial:
@@ -234,6 +265,8 @@ class FleetHarness:
             await self._client.close()
         for be in self.backends:
             await be.server.close()
+        for fsrv in self.slice_follower_servers:
+            await fsrv.close()
 
     @property
     def client(self) -> TestClient:
@@ -312,6 +345,25 @@ class FleetHarness:
     def clear_injection(self, index: int, kind: str) -> None:
         self.backends[index].state.clear_injection(kind)
         self.fault_timeline.append((self.now(), index, False))
+
+    def kill_slice_member(self, ordinal: int) -> None:
+        """Kill one follower of the fake slice group: its acks freeze,
+        the leader's /health fails within the member-timeout window, and
+        the slice's data plane starts refusing (the fatal-exited leader
+        as the router sees it).  The whole slice — one endpoint, backend
+        0 — contributes zero oracle capacity while failed."""
+        assert self.slice_group is not None, "harness has no slice group"
+        self.slice_group.kill_member(ordinal)
+        self.fault_timeline.append((self.now(), 0, True))
+
+    def restart_slice(self) -> None:
+        """The parallel k8s group restart: members revive into one fresh
+        incarnation with a STRICTLY larger epoch and the endpoint serves
+        again (the breaker's half-open probe re-admits it)."""
+        assert self.slice_group is not None, "harness has no slice group"
+        self.slice_group.restart()
+        self.backends[0].state.draining = False
+        self.fault_timeline.append((self.now(), 0, False))
 
     # -- traffic -----------------------------------------------------------
 

@@ -440,6 +440,86 @@ async def test_fleet_chaos_replay_2_N_2():
         await h.close()
 
 
+async def test_fleet_slice_group_member_kill_and_restart():
+    """Slice-coherent lifecycle at fleet scale (docs/robustness.md
+    "Slice lifecycle contract"): one fake slice group (leader + 2
+    follower ordinals, member timeout 0.4s) serves among single-host
+    replicas as ONE discovery endpoint.  Kill a follower mid-replay:
+    the slice's /health fails within the member-timeout window, the
+    router sheds ZERO 500s (breaker + retry budget + fleet admission
+    absorb the refusals), and the group restarts and rejoins with a
+    STRICTLY larger epoch."""
+    import time as _time
+
+    h = FleetHarness(
+        num_engines=5, seed=11,
+        capacity=2, max_queued=8,
+        tokens_per_sec=80.0, ttft=0.01, max_tokens=5,
+        default_slots=8.0,
+        slice_members=3, slice_member_timeout_s=0.4,
+    )
+    await h.start(active=4)
+    try:
+        assert h.slice_group is not None
+        epoch0 = h.slice_group.epoch
+        leader_url = h.backends[0].url
+        health_503 = {}
+
+        async def kill_follower():
+            h.kill_slice_member(1)
+            t_kill = _time.monotonic()
+            # Poll the leader's /health until the member failure fails
+            # the WHOLE slice (the conjunction contract).
+            async def poll():
+                while True:
+                    async with h.client.session.get(
+                        f"{leader_url}/health"
+                    ) as resp:
+                        if resp.status == 503:
+                            health_503["elapsed"] = (
+                                _time.monotonic() - t_kill
+                            )
+                            return
+                    await asyncio.sleep(0.05)
+
+            await asyncio.wait_for(poll(), timeout=5.0)
+
+        async def restart_group():
+            h.restart_slice()
+
+        await h.replay(
+            duration_s=4.0, base_qps=4.0, peak_qps=14.0,
+            events=[
+                (1.0, kill_follower),
+                (2.6, restart_group),
+            ],
+        )
+        await h.wait_background()
+
+        # 1. The slice's health failed within the member-timeout window
+        # (generous CI slack on top of the 0.4s timeout).
+        assert "elapsed" in health_503, "leader /health never went 503"
+        assert health_503["elapsed"] < 0.4 + 1.5, health_503
+
+        # 2. Zero 500s at the router: every request either completed or
+        # was a structured shed — the breaker and retry budget absorbed
+        # the failed slice's refusals, and nothing mid-stream dropped.
+        report = h.report()
+        assert report["total"] > 20, report
+        assert report["error"] == 0, report
+        assert report["dropped"] == 0, report
+        assert report["completed"] > 0, report
+
+        # 3. The group restarted and rejoined with a strictly larger
+        # epoch, and the slice serves again.
+        assert h.slice_group.epoch > epoch0
+        async with h.client.session.get(f"{leader_url}/health") as resp:
+            assert resp.status == 200
+        assert h.slice_group.member_failures == {"member_silent": 1}
+    finally:
+        await h.close()
+
+
 async def test_harness_report_and_oracle_units():
     """Pure-math harness helpers: classification, oracle integration,
     shed-ordering detection (no servers involved)."""

@@ -361,6 +361,54 @@ def test_multihost_slice_renders_statefulset_pod_group():
     assert "statefulset.kubernetes.io/pod-name" not in headless["spec"]["selector"]
 
 
+def test_multihost_slice_pdb_and_liveness_contract():
+    """The slice-coherent lifecycle's chart half: slice pods carry the
+    slice-group label, the generic release PDB EXCLUDES them (one
+    voluntary eviction must never decapitate a live slice), a per-slice
+    maxUnavailable: 0 PDB covers them, and --slice-member-timeout-s is
+    threaded onto the StatefulSet command (stackcheck SC709 pins the
+    same invariants statically)."""
+    with open(os.path.join(CHART_DIR, "values-multihost-example.yaml")) as f:
+        values = yaml.safe_load(f)
+    objs = load_manifests(render_chart(CHART_DIR, values, release_name="ms"))
+
+    sts = by_kind(objs, "StatefulSet")[0]
+    assert sts["metadata"]["labels"][
+        "app.production-stack-tpu/slice-group"] == "llama-3-8b"
+    assert sts["spec"]["selector"]["matchLabels"][
+        "app.production-stack-tpu/slice-group"] == "llama-3-8b"
+    assert sts["spec"]["template"]["metadata"]["labels"][
+        "app.production-stack-tpu/slice-group"] == "llama-3-8b"
+    container = sts["spec"]["template"]["spec"]["containers"][0]
+    cmd = container["command"]
+    assert cmd[cmd.index("--slice-member-timeout-s") + 1] == "10"
+    # preStop + termination grace cover every ordinal (the follower's
+    # /drain relays to the leader — api_server._run_follower).
+    assert "/drain" in json.dumps(container["lifecycle"]["preStop"])
+    assert sts["spec"]["template"]["spec"][
+        "terminationGracePeriodSeconds"] == 60
+
+    pdbs = {p["metadata"]["name"]: p
+            for p in by_kind(objs, "PodDisruptionBudget")}
+    assert set(pdbs) == {"ms-pdb", "ms-llama-3-8b-slice-pdb"}
+    generic = pdbs["ms-pdb"]
+    assert generic["spec"]["selector"]["matchExpressions"] == [
+        {"key": "app.production-stack-tpu/slice-group",
+         "operator": "DoesNotExist"}
+    ]
+    slice_pdb = pdbs["ms-llama-3-8b-slice-pdb"]
+    assert slice_pdb["spec"]["maxUnavailable"] == 0
+    assert slice_pdb["spec"]["selector"]["matchLabels"][
+        "app.production-stack-tpu/slice-group"] == "llama-3-8b"
+
+    # Knob off: no slice PDB rendered (exclusion stays — slice pods are
+    # never under the generic budget either way).
+    values["servingEngineSpec"]["slicePodDisruptionBudget"] = False
+    objs = load_manifests(render_chart(CHART_DIR, values, release_name="ms"))
+    names = [p["metadata"]["name"] for p in by_kind(objs, "PodDisruptionBudget")]
+    assert names == ["ms-pdb"]
+
+
 def test_single_host_unchanged_by_multihost_support():
     """tpuNumWorkers absent or 1 keeps the plain-Deployment rendering."""
     values = tpu_values()
@@ -373,6 +421,14 @@ def test_single_host_unchanged_by_multihost_support():
     for d in by_kind(objs, "Deployment"):
         env = d["spec"]["template"]["spec"]["containers"][0].get("env", [])
         assert "PSTPU_NUM_PROCESSES" not in {e["name"] for e in env}
+        # Single-host pods never carry the slice-group label (they must
+        # stay under the generic PDB's DoesNotExist selector) nor the
+        # slice liveness flag.
+        labels = d["spec"]["template"]["metadata"]["labels"]
+        assert "app.production-stack-tpu/slice-group" not in labels
+        cmd = d["spec"]["template"]["spec"]["containers"][0].get(
+            "command", [])
+        assert "--slice-member-timeout-s" not in cmd
 
 
 def test_router_dynamic_config_mount():
@@ -515,6 +571,16 @@ def test_stackcheck_bad_chart_renders_but_flags_every_seeded_break():
     # selects — the chart deploys, role discovery returns None for every
     # pod, and the fleet silently runs fused.
     assert ("SC707", "role_label:app.disagg-role!=app.role") in details
+    # SC709 (ISSUE seeds): pod-group invariants that deploy fine and
+    # deadlock at the first collective (or die at the first eviction).
+    assert ("SC709", "mesh_product:slice") in details
+    assert ("SC709", "slice_label_missing") in details
+    assert ("SC709", "client_service_unpinned") in details
+    assert ("SC709", "headless_not_ready_unpublished") in details
+    assert ("SC709", "sts_prestop_missing") in details
+    assert ("SC709", "sts_termination_missing") in details
+    assert ("SC709", "generic_pdb_includes_slices") in details
+    assert ("SC709", "slice_pdb_missing") in details
     # SC708: the adapter queries a family the registry doesn't know
     # (renamed series — matches nothing, HPA never scales) ...
     assert ("SC708", "tpu:num_requests_wating") in details
@@ -577,6 +643,36 @@ def test_stackcheck_sc707_invalid_role_value_flags(tmp_path):
         v.rule == "SC707" and v.detail == "role_value:prefil"
         for v in violations
     ), violations
+
+
+def test_stackcheck_sc709_mesh_mutation_flags(tmp_path):
+    """Mutating the GOOD chart's slice mesh (tp 8 -> 4 under 2x4 chips)
+    validates against any schema and renders fine — the slice only
+    deadlocks at its first collective.  SC709 catches it statically, and
+    a values-side allow records a deliberate divergence."""
+    import shutil
+
+    from tools.stackcheck import run_checks
+
+    root = tmp_path / "tree"
+    shutil.copytree(os.path.join(STACKCHECK_HELM, "good"), root)
+    values = root / "helm" / "values.yaml"
+    broken = values.read_text().replace(
+        "tensorParallel: 8", "tensorParallel: 4"
+    )
+    values.write_text(broken)
+    violations = run_checks(_sc7_config(root), families=["deployment"])
+    assert any(
+        v.rule == "SC709" and v.detail == "mesh_product:slice"
+        for v in violations
+    ), violations
+
+    values.write_text(broken.replace(
+        "modelSpec:",
+        "# stackcheck: allow=SC709 reason=fixture divergence test\n"
+        "  modelSpec:",
+    ))
+    assert run_checks(_sc7_config(root), families=["deployment"]) == []
 
 
 def test_role_pools_render_per_role_deployments():

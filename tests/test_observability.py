@@ -99,6 +99,17 @@ async def scrape_router_metrics():
 def emitted_names(metrics_text):
     names = set()
     for line in metrics_text.splitlines():
+        if line.startswith("# TYPE "):
+            # A TYPE header with zero series is still an emitted family:
+            # label sets that are open (e.g. per-slice-member gauges on a
+            # single-host engine) render the stable family header with no
+            # samples — the documented scrape contract
+            # (vocabulary.render_labeled_gauge/counter).  Headers carry
+            # exact family names, so this keeps the no-truncation rule.
+            parts = line.split()
+            if len(parts) >= 3:
+                names.add(parts[2])
+            continue
         if line.startswith("#") or not line.strip():
             continue
         token = METRIC_TOKEN_RE.match(line)

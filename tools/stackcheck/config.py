@@ -125,9 +125,13 @@ DEFAULT_LIFECYCLE_ROOTS: Tuple[str, ...] = (
 # calls are too ambiguous for by-name resolution): caller suffix ->
 # callee suffixes.
 DEFAULT_LIFECYCLE_EXTRA_EDGES: Dict[str, List[str]] = {
-    # AsyncEngine.close() -> self.engine.close() (attr call, untyped).
+    # AsyncEngine.close() -> self.engine.close() (attr call, untyped),
+    # and -> the slice-group liveness monitor's stop/join (the attr is
+    # Optional[GroupLivenessMonitor] behind a multi-host gate, so the
+    # strict-typed resolver cannot prove the edge).
     "engine.server.async_engine:AsyncEngine.close": [
         "engine.core.engine:LLMEngine.close",
+        "engine.parallel.distributed:GroupLivenessMonitor.stop",
     ],
     # LLMEngine.close() walks the KV plane: prefetch fetchers, offload
     # stager writer, deleter thread, export thread, remote client.
@@ -174,6 +178,27 @@ DEFAULT_ROLE_CONTRACT = RoleContract(
     engine_argparse_file="production_stack_tpu/engine/server/api_server.py",
     router_template="helm/templates/deployment-router.yaml",
     router_argparse_file="production_stack_tpu/router/parser.py",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class SliceContract:
+    """The multi-host pod-group contract (SC709): a mis-grouped slice
+    deploys fine and deadlocks at the first collective (or gets
+    decapitated by the first voluntary eviction) — exactly the failure
+    shape stackcheck exists to catch pre-deploy."""
+
+    engine_template: str
+    pdb_template: str
+    modelspec_values_path: str = "servingEngineSpec.modelSpec"
+    workers_key: str = "tpuNumWorkers"
+    chips_key: str = "requestTPU"
+    slice_label_key: str = "app.production-stack-tpu/slice-group"
+
+
+DEFAULT_SLICE_CONTRACT = SliceContract(
+    engine_template="helm/templates/deployment-engine.yaml",
+    pdb_template="helm/templates/poddisruptionbudget.yaml",
 )
 
 
@@ -274,6 +299,8 @@ class Config:
     # SC707 disagg role-pool contract; None disables (fixture trees
     # without a router surface).
     role_contract: Optional[RoleContract] = DEFAULT_ROLE_CONTRACT
+    # SC709 multi-host pod-group contract; None disables.
+    slice_contract: Optional[SliceContract] = DEFAULT_SLICE_CONTRACT
     # -- SC708: autoscaling PromQL contract --------------------------------
     # YAML surfaces whose tpu:/tpu_router: family references must exist
     # in the metric registry, and whose HPA custom-metric names must be

@@ -45,6 +45,20 @@ SC707  the disagg role-pool contract is broken: the role label key the
        value in a shipped values file is outside the engine binary's
        ``--disagg-role`` choices.  Both deploy fine and silently run the
        fleet fused — role discovery returns None for every pod.
+SC709  the multi-host pod-group contract is broken: a modelSpec entry's
+       engine mesh (dp·tp·sp) does not equal ``tpuNumWorkers ×
+       requestTPU`` (the slice deploys fine and deadlocks at the FIRST
+       collective — jax sees a different chip count than the mesh
+       expects); the client Service is not pinned to ordinal 0 (clients
+       would round-robin onto followers that serve only probes); the
+       headless bootstrap service does not publish not-ready addresses
+       (workers must resolve each other BEFORE any passes readiness —
+       the group can never form); slice pods are not labeled/covered by
+       a ``maxUnavailable: 0`` slice PDB or not excluded from the
+       generic release PDB (one voluntary eviction decapitates a live
+       slice); or the StatefulSet branch lacks the preStop drain hook /
+       terminationGracePeriodSeconds (a follower SIGTERM would kill the
+       slice's in-flight collectives with no drain relay).
 SC708  the autoscaling PromQL contract is broken: a
        ``tpu:``/``tpu_router:`` family referenced by an
        ``observability/*.yaml`` surface or a helm HPA template does not
@@ -399,6 +413,214 @@ def _check_role_contract(
     return out
 
 
+def _yaml_docs(text: str) -> List[Tuple[int, str]]:
+    """Split template source into YAML documents on `---` lines,
+    returning (start_line, doc_text) pairs — template-source-level, so
+    every branch of every document is covered."""
+    docs: List[Tuple[int, str]] = []
+    start = 1
+    current: List[str] = []
+    for i, line in enumerate(text.splitlines()):
+        if line.strip() == "---":
+            if any(ln.strip() for ln in current):
+                docs.append((start, "\n".join(current)))
+            current = []
+            start = i + 2
+        else:
+            current.append(line)
+    if any(ln.strip() for ln in current):
+        docs.append((start, "\n".join(current)))
+    return docs
+
+
+def _as_int(value: object, default: Optional[int] = None) -> Optional[int]:
+    """Strict int coercion for YAML scalars (bool is NOT an int here)."""
+    if value is None:
+        return default
+    if isinstance(value, bool):
+        return default
+    if isinstance(value, int):
+        return value
+    if isinstance(value, float):
+        return int(value)
+    if isinstance(value, str):
+        try:
+            return int(value)
+        except ValueError:
+            return default
+    return default
+
+
+def _check_slice_contract(
+    cfg: C.Config,
+    overlays: List[Tuple[str, "miniyaml.YamlValue", List[str], Dict[str, int]]],
+) -> List[Violation]:
+    """SC709 — see module docstring."""
+    out: List[Violation] = []
+    sc = cfg.slice_contract
+    if sc is None:
+        return out
+
+    # (a) mesh-product arithmetic in every shipped values file: the
+    # engine rejects a bad mesh at boot only AFTER the pods scheduled —
+    # and a mesh that merely mismatches the chip count deadlocks at the
+    # first collective instead.  tpuNumWorkers × requestTPU must equal
+    # dp·tp·sp.
+    for rel, merged, file_lines, file_key_lines in overlays:
+        models = miniyaml.get_path(merged, sc.modelspec_values_path)
+        if not isinstance(models, list):
+            continue
+        for entry in models:
+            if not isinstance(entry, dict):
+                continue
+            workers = _as_int(entry.get(sc.workers_key), default=1)
+            if workers is None or workers <= 1:
+                continue
+            name = str(entry.get("name", "?"))
+            line = file_key_lines.get(
+                sc.modelspec_values_path,
+                file_key_lines.get(sc.modelspec_values_path.split(".")[0], 1),
+            )
+            chips = _as_int(entry.get(sc.chips_key))
+            if chips is None:
+                continue  # CPU/fake slice: no chip arithmetic to check
+            eng_raw = entry.get("engineConfig")
+            eng: Dict[object, object] = (
+                eng_raw if isinstance(eng_raw, dict) else {}
+            )
+            mesh = 1
+            for axis in ("dataParallel", "tensorParallel",
+                         "sequenceParallel"):
+                mesh *= _as_int(eng.get(axis), default=1) or 1
+            if mesh != workers * chips:
+                if _yaml_allowed(file_lines, line, "SC709"):
+                    continue
+                out.append(Violation(
+                    rule="SC709", file=rel, line=line,
+                    qualname=sc.modelspec_values_path,
+                    message=(
+                        f"modelSpec '{name}': engine mesh dp*tp*sp = "
+                        f"{mesh} but the slice provides {sc.workers_key} "
+                        f"({workers}) x {sc.chips_key} ({chips}) = "
+                        f"{workers * chips} chips — the group deploys "
+                        "fine and deadlocks at the first collective"
+                    ),
+                    detail=f"mesh_product:{name}",
+                ))
+
+    # (b)/(c) template-structure checks, active only when the engine
+    # template renders a pod-group (StatefulSet) branch at all.
+    engine_tmpl = cfg.resolve(sc.engine_template)
+    if engine_tmpl is None or not engine_tmpl.exists():
+        return out
+    engine_text = engine_tmpl.read_text()
+    engine_lines = engine_text.splitlines()
+    sts_kind_re = re.compile(r"^\s*kind:\s*StatefulSet\s*$", re.M)
+    sts_docs = [
+        (ln, doc) for ln, doc in _yaml_docs(engine_text)
+        if sts_kind_re.search(doc)
+    ]
+    if not sts_docs:
+        return out  # no pod-group mode in this chart
+
+    def _flag(
+        file: str, line: int, lines: List[str], message: str, detail: str
+    ) -> None:
+        if not _yaml_allowed(lines, line, "SC709"):
+            out.append(Violation(
+                rule="SC709", file=file, line=line,
+                qualname=sc.engine_template, message=message, detail=detail,
+            ))
+
+    if sc.slice_label_key not in engine_text:
+        _flag(
+            sc.engine_template, sts_docs[0][0], engine_lines,
+            f"pod-group branch renders no `{sc.slice_label_key}` label — "
+            "slice pods are indistinguishable from single-host pods, so "
+            "neither the generic-PDB exclusion nor the slice PDB can "
+            "select them",
+            "slice_label_missing",
+        )
+    if "statefulset.kubernetes.io/pod-name" not in engine_text:
+        _flag(
+            sc.engine_template, sts_docs[0][0], engine_lines,
+            "client-facing Service is not pinned to ordinal 0 "
+            "(statefulset.kubernetes.io/pod-name): clients would "
+            "round-robin onto followers that serve only probes",
+            "client_service_unpinned",
+        )
+    has_published_headless = any(
+        "clusterIP: None" in doc and "publishNotReadyAddresses: true" in doc
+        for _, doc in _yaml_docs(engine_text)
+    )
+    if not has_published_headless:
+        _flag(
+            sc.engine_template, sts_docs[0][0], engine_lines,
+            "no headless service with `publishNotReadyAddresses: true`: "
+            "workers must resolve each other BEFORE any passes readiness "
+            "(coordination precedes serving) — the jax.distributed "
+            "bootstrap can never form the group",
+            "headless_not_ready_unpublished",
+        )
+    for ln, doc in sts_docs:
+        if "preStop" not in doc:
+            _flag(
+                sc.engine_template, ln, engine_lines,
+                "StatefulSet branch has no preStop drain hook: a member "
+                "SIGTERM would kill the slice's in-flight collectives "
+                "with no drain relay",
+                "sts_prestop_missing",
+            )
+        if "terminationGracePeriodSeconds" not in doc:
+            _flag(
+                sc.engine_template, ln, engine_lines,
+                "StatefulSet branch does not set "
+                "terminationGracePeriodSeconds: kubelet's default 30s "
+                "SIGKILLs a slice-wide drain that relays through the "
+                "leader",
+                "sts_termination_missing",
+            )
+
+    pdb_tmpl = cfg.resolve(sc.pdb_template)
+    pdb_text = (
+        pdb_tmpl.read_text()
+        if pdb_tmpl is not None and pdb_tmpl.exists() else ""
+    )
+    pdb_lines = pdb_text.splitlines()
+    pdb_docs = [
+        (ln, doc) for ln, doc in _yaml_docs(pdb_text)
+        if "PodDisruptionBudget" in doc
+    ]
+    zero_re = re.compile(r"maxUnavailable:\s*0\s*$", re.M)
+    slice_pdbs = [
+        (ln, doc) for ln, doc in pdb_docs
+        if zero_re.search(doc) and sc.slice_label_key in doc
+    ]
+    generic_pdbs = [
+        (ln, doc) for ln, doc in pdb_docs if (ln, doc) not in slice_pdbs
+    ]
+    if not slice_pdbs:
+        _flag(
+            sc.pdb_template or "<missing>", 1, pdb_lines,
+            "no slice-group PodDisruptionBudget with `maxUnavailable: 0` "
+            f"selecting `{sc.slice_label_key}`: voluntary evictions can "
+            "take a member of a live slice (the group wedges at its next "
+            "collective and restarts)",
+            "slice_pdb_missing",
+        )
+    for ln, doc in generic_pdbs:
+        if sc.slice_label_key in doc and "DoesNotExist" in doc:
+            continue
+        _flag(
+            sc.pdb_template, ln, pdb_lines,
+            "generic release PDB does not exclude slice pods "
+            f"(`{sc.slice_label_key}` DoesNotExist): its maxUnavailable "
+            "budget lets ONE eviction decapitate a live slice",
+            "generic_pdb_includes_slices",
+        )
+    return out
+
+
 # HPA custom-metric reference: `metric:` followed by its `name:` key.
 _HPA_METRIC_NAME_RE = re.compile(
     r"metric:\s*\n\s*name:\s*\"?([A-Za-z0-9_:-]+)\"?"
@@ -743,6 +965,8 @@ def check_deployment(cfg: C.Config) -> List[Violation]:
     out.extend(_check_role_contract(
         cfg, values, values_lines, value_key_lines, overlay_paths
     ))
+    # -- SC709: multi-host pod-group contract ------------------------------
+    out.extend(_check_slice_contract(cfg, overlay_paths))
 
     drain_specs = sorted({
         s.drain_values_spec
