@@ -787,6 +787,258 @@ def bench_engine_multistep_ab(args, preset: str) -> dict:
     }
 
 
+def bench_engine_mixed_window_ab(args, preset: str) -> dict:
+    """Mixed K-step window A/B through the REAL engine: a seeded
+    Poisson continuous-arrival replay (prompts keep arriving while
+    resident streams decode — the north-star sustained-traffic regime,
+    where the old window-selection rule pinned the engine at K=1) over
+    the {K=1 mixed, K=8 mixed} x {ngram 0, 3} grid.  The primary
+    metric is the per-token HOST cost expressed as host round-trips
+    per produced token — each round-trip is one synchronous
+    host<->device cycle (a blocking K=1 mixed step, or one pipelined
+    window dispatch+collect pair), costing scheduling, H2D array
+    staging, a device sync, and host sampling post-processing; the
+    mixed window amortizes exactly this, turning one round-trip per
+    TOKEN into one per WINDOW while prompts wait.  On CPU (where host
+    and "device" share the same cores) wall-clock cannot isolate that
+    serialization, so the round-trip count is the honest structural
+    measure; the decode host-gap ms/token and the step-phase sums ride
+    along as timing detail, and on TPU the gap becomes the real
+    device-idle cost.  Also reports TTFT p50/p95 of the arrivals (the
+    admission-boundary guarantee: windows end when a prompt completes,
+    so TTFT must stay within 1.10x of the K=1 arm) and decode ITL p95
+    of the resident streams (reported honestly: windowed tokens arrive
+    in bursts, so token-granular p95 reflects delivery batching, not
+    lost throughput).  Arrivals are scheduled in GENERATED-TOKEN time
+    (seeded exponential gaps), so the workload is identical across
+    arms and greedy byte-identity is assertable across every grid
+    cell."""
+    import dataclasses as _dc
+    import gc
+    import random
+
+    from production_stack_tpu.engine.config import (
+        CacheConfig,
+        EngineConfig,
+        PRESETS,
+        SchedulerConfig,
+    )
+    from production_stack_tpu.engine.core.engine import LLMEngine
+    from production_stack_tpu.engine.core.sequence import SamplingParams
+
+    S_RES = 2           # resident decode streams
+    RES_CTX = 96        # resident prompt length
+    CHUNK = 64          # one static chunk bucket: 512-token prompts = 8 chunks
+    ARRIVAL_PROMPT = 512
+    ARRIVAL_GEN = 4     # tokens generated per admitted arrival
+    N_WARM = 3          # arrivals before measurement (XLA compile)
+    N_MEAS = 8          # measured arrivals
+    HOST_PHASES = ("schedule", "dispatch", "sample")
+
+    rng = random.Random(20260804)
+    # Seeded Poisson (exponential inter-arrival gaps) in resident
+    # generated-token time: deterministic across arms, and tight enough
+    # (mean gap ~9 resident tokens vs 8 prefill chunks + 4 generated
+    # tokens per arrival) that a prompt is nearly ALWAYS waiting — the
+    # sustained regime the mixed window exists for.
+    meas_gaps = [max(6, int(rng.expovariate(1 / 9))) for _ in range(N_MEAS)]
+    meas_at = []
+    acc = 0
+    for g in meas_gaps:
+        acc += g
+        meas_at.append(acc)
+    # Warm arrivals are pinned, not sampled: one lone prompt, then two
+    # near-simultaneous ones (a queue-depth-2 moment) so every window
+    # variant — full-K and adaptive-clamp scan lengths, both decode
+    # buckets — XLA-compiles BEFORE measurement; a first-use compile in
+    # the measured segment would charge seconds to one arrival's TTFT.
+    # The measured replay only starts once all warm work has drained
+    # (its thresholds are relative to the drain point), so warm backlog
+    # never queues ahead of a measured arrival.
+    warm_at = [8, 26, 26][:N_WARM]
+    arrival_prompts = [
+        [(7 * i + 13 * n + 1) % 101 for i in range(ARRIVAL_PROMPT)]
+        for n in range(N_WARM + N_MEAS)
+    ]
+    res_prompts = [
+        [(5 * i + 3 * r) % 103 for i in range(RES_CTX)] for r in range(S_RES)
+    ]
+
+    def run(k: int, ngram: int) -> tuple:
+        sched = dict(
+            max_num_seqs=4,
+            prefill_buckets=(128, 256, 512),
+            prefill_chunk_buckets=(CHUNK,),
+            max_model_len=768,
+            speculative_ngram=ngram,
+        )
+        if k == 1:
+            sched["mixed_window"] = False
+        else:
+            sched["decode_window"] = k
+        eng = LLMEngine(EngineConfig(
+            model=_dc.replace(PRESETS[preset]),
+            cache=CacheConfig(num_blocks=420),
+            scheduler=SchedulerConfig(**sched),
+        ))
+        res_budget = warm_at[-1] + meas_at[-1] + 96
+        for r in range(S_RES):
+            eng.add_request(
+                f"res{r}", prompt_token_ids=list(res_prompts[r]),
+                sampling_params=SamplingParams(
+                    max_tokens=res_budget, ignore_eos=True),
+            )
+        outs: dict = {}
+        ttft_s: dict = {}
+        added_t: dict = {}
+        last_tok_t: dict = {}
+        itl_gaps: list = []
+        finished: set = set()
+        next_arrival = 0
+        meas_base = None
+        measuring = False
+        sums0 = dict.fromkeys(HOST_PHASES, 0.0)
+        produced0 = 0
+        gap0 = 0.0
+        rt0 = 0
+        # Host round-trips: synchronous mixed steps (the "mixed" phase
+        # histogram observes each _run_mixed) + pipelined
+        # dispatch/collect cycles (the "collect" phase observes each).
+        rt_count = lambda: (
+            eng.obs.step_hists["mixed"].count
+            + eng.obs.step_hists["collect"].count
+        )
+        steps = 0
+        while eng.has_unfinished():
+            steps += 1
+            assert steps < 30000, "engine failed to drain"
+            for out in eng.step():
+                now = time.perf_counter()
+                rid = out.seq_id
+                outs.setdefault(rid, []).append(out.new_token_id)
+                if out.finished:
+                    finished.add(rid)
+                if rid in added_t and rid not in ttft_s:
+                    ttft_s[rid] = now - added_t.pop(rid)
+                if rid.startswith("res") and measuring:
+                    if rid in last_tok_t:
+                        itl_gaps.append(now - last_tok_t[rid])
+                    last_tok_t[rid] = now
+            driver = len(outs.get("res0", []))
+            if meas_base is None and next_arrival >= N_WARM and all(
+                f"arr{n}" in finished for n in range(N_WARM)
+            ):
+                # All warm work drained: every executable variant is
+                # compiled, the queue holds only residents — start the
+                # measurement clocks and anchor the measured thresholds.
+                measuring = True
+                meas_base = driver
+                sums0 = {
+                    p: eng.obs.step_hists[p].sum for p in HOST_PHASES
+                }
+                produced0 = eng.stats()["total_generated_tokens"]
+                gap0 = eng._gap_total_s
+                rt0 = rt_count()
+                last_tok_t.clear()
+            while True:
+                # Admit every due arrival in ONE pass: the pinned warm
+                # pair must land as a genuine queue-depth-2 moment (the
+                # adaptive clamp's shorter-window variants compile
+                # here, not inside the measured segment).
+                if next_arrival >= N_WARM + N_MEAS:
+                    due = False
+                elif next_arrival < N_WARM:
+                    due = driver >= warm_at[next_arrival]
+                elif meas_base is None:
+                    due = False
+                else:
+                    due = (
+                        driver
+                        >= meas_base + meas_at[next_arrival - N_WARM]
+                    )
+                if not due:
+                    break
+                rid = f"arr{next_arrival}"
+                added_t[rid] = time.perf_counter()
+                eng.add_request(
+                    rid,
+                    prompt_token_ids=list(arrival_prompts[next_arrival]),
+                    sampling_params=SamplingParams(
+                        max_tokens=ARRIVAL_GEN, ignore_eos=True),
+                )
+                next_arrival += 1
+        stats = eng.stats()
+        produced = stats["total_generated_tokens"] - produced0
+        host_s = sum(
+            eng.obs.step_hists[p].sum - sums0[p] for p in HOST_PHASES
+        )
+        gap_s = eng._gap_total_s - gap0
+        meas_ttfts = sorted(
+            ttft_s[f"arr{n}"] for n in range(N_WARM, N_WARM + N_MEAS)
+        )
+
+        def pct(sorted_vals, q):
+            if not sorted_vals:
+                return 0.0
+            i = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1)))
+            return sorted_vals[i]
+
+        round_trips = rt_count() - rt0
+        result = {
+            "host_round_trips_per_token": round(
+                round_trips / max(produced, 1), 4
+            ),
+            "host_gap_ms_per_token": round(
+                gap_s / max(produced, 1) * 1e3, 4
+            ),
+            "step_phase_host_ms_per_token": round(
+                host_s / max(produced, 1) * 1e3, 4
+            ),
+            "ttft_p50_ms": round(pct(meas_ttfts, 0.50) * 1e3, 1),
+            "ttft_p95_ms": round(pct(meas_ttfts, 0.95) * 1e3, 1),
+            "decode_itl_p95_ms": round(
+                pct(sorted(itl_gaps), 0.95) * 1e3, 1
+            ),
+            "mixed_window_chunk_tokens": int(
+                stats["mixed_window_chunk_tokens"]
+            ),
+            "prefill_chunk_tokens": int(stats["prefill_chunk_tokens"]),
+            "fallbacks": dict(stats["multistep_fallback"]),
+            "wasted_tokens": int(stats["multistep_wasted_tokens"]),
+        }
+        del eng
+        gc.collect()
+        return result, outs
+
+    results = {}
+    parity = True
+    ref_outs = None
+    for k in (1, 8):
+        for ngram in (0, 3):
+            cell = f"k{k}_ng{ngram}"
+            results[cell], outs = run(k, ngram)
+            if ref_outs is None:
+                ref_outs = outs
+            elif outs != ref_outs:
+                parity = False
+    k1, k8 = results["k1_ng0"], results["k8_ng0"]
+    return {
+        **results,
+        # The acceptance bars: >= 3x per-token host-cost cut (host
+        # round-trips per token) for K=8 mixed vs K=1 mixed under
+        # continuous arrivals, with arrival TTFT p95 within 1.10x
+        # (windows end at admission boundaries).
+        "host_cost_cut_k8_vs_k1": round(
+            k1["host_round_trips_per_token"]
+            / max(k8["host_round_trips_per_token"], 1e-9), 2
+        ),
+        "ttft_p95_ratio_k8_vs_k1": round(
+            k8["ttft_p95_ms"] / max(k1["ttft_p95_ms"], 1e-9), 3
+        ),
+        "greedy_parity": parity,
+    }
+
+
 def bench_engine_spec_window_ab(args, preset: str) -> dict:
     """Speculation x window grid through the REAL engine
     (K in {1, 8} x ngram in {0, 3}): the PR-11 fusion claim, measured.
@@ -2246,7 +2498,8 @@ AB_STAGES = (
     # regression gate — it must run before the budget can starve it.
     "multi_round",
     "int8_ab", "kv_int8_ab", "kv_capacity_ab", "gather_ab", "pipeline_ab",
-    "mixed_ab", "multistep_ab", "spec_window_ab", "overload_ab",
+    "mixed_ab", "multistep_ab", "mixed_window_ab", "spec_window_ab",
+    "overload_ab",
     "remote_prefix_ab", "disagg_ab", "fleet_surge_ab",
 )
 
@@ -2764,6 +3017,37 @@ def main() -> None:
         except Exception as e:
             log(f"multistep A/B failed: {e}")
             detail["multistep_ab_error"] = str(e)[:200]
+
+    if run_stage("mixed_window_ab"):
+        # Mixed K-step window grid: {K=1 mixed, K=8 mixed} x {ngram 0,3}
+        # under a seeded Poisson continuous-arrival replay — the
+        # sustained-arrival host-amortization claim, measured, with the
+        # TTFT admission-boundary bound and greedy parity across every
+        # cell (docs/engine.md StepPlan, mixed K-step windows).
+        try:
+            try:
+                del params, kv
+            except NameError:
+                pass
+            import gc as _gc
+
+            _gc.collect()
+            detail["mixed_window_ab"] = bench_engine_mixed_window_ab(
+                args, preset
+            )
+            ab = detail["mixed_window_ab"]
+            log(f"mixed-window A/B: host round-trips/token "
+                f"{ab['k1_ng0']['host_round_trips_per_token']} @K=1 vs "
+                f"{ab['k8_ng0']['host_round_trips_per_token']} @K=8 "
+                f"({ab['host_cost_cut_k8_vs_k1']}x cut), TTFT p95 ratio "
+                f"{ab['ttft_p95_ratio_k8_vs_k1']}, "
+                f"{ab['k8_ng0']['mixed_window_chunk_tokens']} chunk "
+                f"tokens rode windows, fallbacks "
+                f"{ab['k8_ng0']['fallbacks']}, parity "
+                f"{ab['greedy_parity']}")
+        except Exception as e:
+            log(f"mixed-window A/B failed: {e}")
+            detail["mixed_window_ab_error"] = str(e)[:200]
 
     if run_stage("spec_window_ab"):
         # Speculation x window grid: the fused in-scan draft-and-verify

@@ -472,6 +472,24 @@ class SchedulerConfig:
     # step — the A/B baseline and the fallback the host-state rows use).
     # 0 = off.
     speculative_ngram: int = 0
+    # Mixed K-step windows: a waiting prompt's prefill chunks ride the
+    # device-resident decode scan instead of forcing K=1 steps — each
+    # scan iteration runs the packed [decode + chunk] mixed forward
+    # (decode rows advance one token from the carried state; the head
+    # prompt's NEXT chunk rides the same forward with its chunk cursor
+    # carried in-graph), so under sustained arrivals the fleet keeps the
+    # K-fold host-round-trip amortization it used to forfeit whenever a
+    # prompt waited.  The window length is min(decode_window, chunks
+    # remaining for the head prompt, an adaptive clamp halving per
+    # extra waiter) so the window ALWAYS ends at an admission boundary
+    # — greedy streams stay byte-identical and seeded streams
+    # bit-identical to the K=1 mixed path, and TTFT never regresses
+    # more than one window's worth.  None = auto (ON whenever mixed
+    # steps and K-step windows are both active); False
+    # (--no-mixed-window) restores the K=1 mixed scheduling exactly
+    # (waiting head -> K=1 steps, tpu:multistep_fallback_total
+    # {reason="waiting_head"}).
+    mixed_window: Optional[bool] = None
     # Bounded admission (overload protection): once the waiting queue
     # holds this many requests (or prompt tokens), the API server rejects
     # new work with a structured 429 + Retry-After instead of queueing it
@@ -538,6 +556,17 @@ class SchedulerConfig:
                 "with multi_step_window=False) assumes one plan shape per "
                 "dispatch — drop --no-multi-step-window or "
                 "--no-mixed-batch"
+            )
+        if self.mixed_window and self.multi_step_window is False:
+            raise ValueError(
+                "mixed_window=True requests prefill chunks riding the "
+                "K-step decode scan but multi_step_window=False disables "
+                "the window machinery; drop one of the two"
+            )
+        if self.mixed_window and self.mixed_batch is False:
+            raise ValueError(
+                "mixed_window=True requires mixed_batch (the chunk "
+                "machinery); drop --no-mixed-batch or --mixed-window"
             )
         if not self.prefill_chunk_buckets:
             raise ValueError("prefill_chunk_buckets must be non-empty")
@@ -621,6 +650,26 @@ class SchedulerConfig:
         if self.mixed_batch is None:
             return not (self.speculative_ngram and self.window_steps == 1)
         return self.mixed_batch
+
+    @property
+    def mixed_window_enabled(self) -> bool:
+        """Resolved mixed K-step window gate: auto (None) turns on
+        whenever BOTH parents are active — mixed steps (the chunk
+        machinery) and K>1 windows (the scan machinery).  An explicit
+        True still requires both parents: the fused plan shape does not
+        exist without them."""
+        if self.mixed_window is False:
+            return False
+        return self.mixed_enabled and self.window_steps > 1
+
+    def mixed_window_clamp(self, num_waiting: int) -> int:
+        """Adaptive per-window iteration clamp keyed to waiting-queue
+        depth: the head prompt gets the full window to itself, and each
+        EXTRA waiter halves it (deep queue -> shorter windows -> more
+        frequent admission re-evaluation), so no waiter's TTFT regresses
+        more than one window's worth behind the head's chunks."""
+        extra = max(0, num_waiting - 1)
+        return max(1, self.window_steps >> min(extra, 8))
 
     @property
     def admission_enabled(self) -> bool:

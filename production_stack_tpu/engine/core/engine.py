@@ -50,6 +50,7 @@ from production_stack_tpu.engine.core.sequence import (
     Sequence,
     SequenceStatus,
     StepOutput,
+    host_state_flags as seq_host_state_flags,
 )
 from production_stack_tpu.engine.kv.block_pool import (
     BlockPool,
@@ -98,6 +99,14 @@ class _PendingStep:
     # (drafted [K, S], accepted [K, S]) device counters collect() folds
     # into tpu:spec_tokens_* and tpu:spec_window_tokens_total.
     spec_stats: Optional[tuple] = None
+    # Mixed K-step windows: the chunk schedule that rode the scan (one
+    # PrefillPlan per live iteration), the final chunk's still-in-flight
+    # tail logits [V] (None when the window left the prompt mid-prefill),
+    # and the step-counter ordinal of the final-chunk iteration — the
+    # PRNG key the K=1 path would sample the prompt's first token with.
+    chunk_sched: Optional[List] = None
+    chunk_logits: Optional[object] = None
+    chunk_ordinal: int = 0
 
 
 class LLMEngine:
@@ -303,7 +312,7 @@ class LLMEngine:
             partial(self.model.decode, cfg=cfg, mesh=self.mesh),
             donate_argnames=("kv_caches",),
         )
-        # Fused mixed prefill+decode step (scheduler MixedPlan): one
+        # Fused mixed prefill+decode step (StepPlan decode+chunk): one
         # executable per (decode bucket, chunk bucket) pair — jit retraces
         # per shape, and both axes come from small bucket sets.
         self._mixed_fn = None
@@ -732,6 +741,192 @@ class LLMEngine:
             self._win_occurrence_fn = jax.jit(
                 partial(sampling_lib.occurrence_state, vocab_size=vocab)
             )
+
+        # MIXED K-step windows (the sustained-arrival fusion): a waiting
+        # prompt's prefill chunks ride the device-resident decode scan —
+        # each scan iteration runs the packed [S_dec + chunk] mixed
+        # forward (llama.mixed_step, the SAME executable shape the K=1
+        # mixed path compiles), decode rows advancing one token from the
+        # carried state exactly like multi_window while the chunk cursor
+        # (cached_len, valid_len, new-block row) advances through the
+        # precomputed per-iteration schedule carried as scan xs.  The
+        # chunk's accumulated-prefix block table is ONE static [P] array
+        # whose validity the in-graph cursor masks (a block written by
+        # iteration t is attended by iteration t+1 with no host trip).
+        # The final chunk's tail-row logits are captured into the carry
+        # and sampled ON THE HOST at collect through the identical
+        # _finalize_final_prefill path K=1 mixed stepping uses — first
+        # tokens are bit-identical by construction.  The drafter never
+        # engages here (drafting is a pure-decode-window feature);
+        # penalties / min_tokens / stop masks run in-scan as in
+        # multi_window.  Scan length is a static arg bucketed to powers
+        # of two by the dispatcher, so the inventory stays
+        # |chunk buckets| x |decode buckets| x O(log K).
+        self._mixed_window_fn = None
+        if (
+            self._window_steps > 1
+            and self._mixed_fn is not None
+            and config.scheduler.mixed_window_enabled
+        ):
+            model_mixed = partial(self.model.mixed_step, cfg=cfg, mesh=self.mesh)
+            bs = config.cache.block_size
+            vocab = cfg.vocab_size
+
+            def mixed_window(
+                params, tokens, positions, ctx_lens, done, min_left,
+                block_tables, max_steps, kv_caches,
+                temps, top_ps, top_ks, min_ps, seq_seeds,
+                stop_ids, key_base, counts, seen,
+                presence, frequency, repetition,
+                pf_tokens, pf_cached, pf_valid, pf_new_blocks,
+                pf_prefix_ids, pf_final_iter,
+                n_steps, use_penalties, use_min_floor,
+                hist=None, lora=None, adapter_idx=None, pf_adapter=None,
+            ):
+                stop_valid = stop_ids >= 0
+                stop_mask = None
+                if use_min_floor:
+                    stop_mask = jax.vmap(
+                        lambda ids, v: jnp.zeros(
+                            (vocab,), jnp.bool_
+                        ).at[jnp.where(v, ids, 0)].max(v)
+                    )(stop_ids, stop_valid)
+                S = tokens.shape[0]
+                T = pf_tokens.shape[1]
+                if lora is not None:
+                    # Mixed row layout: [S decode rows + T chunk rows
+                    # sharing ONE adapter] — the _run_mixed layout.
+                    packed_adapter = jnp.concatenate(
+                        [adapter_idx,
+                         jnp.full((T,), pf_adapter, jnp.int32)]
+                    )
+
+                def body(carry, xs):
+                    (tokens, positions, ctx_lens, done, min_left,
+                     counts, seen, hist_c, chunk_logits, kv_caches) = carry
+                    t, pft, pfc, pfv, pfnb = xs
+                    active = jnp.logical_and(~done, t < max_steps)
+                    blk = jnp.take_along_axis(
+                        block_tables, (positions // bs)[:, None], axis=1
+                    )[:, 0]
+                    extra = (
+                        {"lora": lora, "adapter_idx": packed_adapter}
+                        if lora is not None else {}
+                    )
+                    logits, kv_caches = model_mixed(
+                        params,
+                        dec_tokens=tokens,
+                        dec_positions=positions,
+                        dec_block_tables=block_tables,
+                        dec_ctx_lens=ctx_lens,
+                        # Frozen/done rows park their KV write on null
+                        # block 0 — same contract as multi_window.
+                        dec_slot_block_ids=jnp.where(active, blk, 0),
+                        dec_slot_offsets=positions % bs,
+                        pf_tokens=pft,
+                        pf_cached_len=pfc,
+                        pf_prefix_block_ids=pf_prefix_ids,
+                        pf_new_block_ids=pfnb,
+                        pf_valid_len=pfv,
+                        kv_caches=kv_caches,
+                        **extra,
+                    )
+                    # The chunk tail row (only meaningful on the final
+                    # chunk's iteration; -1 = no final chunk this window).
+                    chunk_logits = jnp.where(
+                        t == pf_final_iter, logits[-1], chunk_logits
+                    )
+                    dlogits = logits[:S]
+                    if use_penalties:
+                        dlogits = sampling_lib.apply_penalties_state(
+                            dlogits, counts, seen,
+                            presence, frequency, repetition,
+                        )
+                    if use_min_floor:
+                        bias = (
+                            jnp.logical_and(
+                                stop_mask, (min_left > 0)[:, None]
+                            ).astype(jnp.float32) * -1e9
+                        )
+                        dlogits = dlogits + bias
+                    # Key schedule: iteration t of a window dispatched
+                    # at counter c uses PRNGKey(seed + c + t) — the
+                    # ordinal the K=1 mixed step at counter c+t burns.
+                    sampled = sample_tokens(
+                        dlogits, temps, top_ps, top_ks,
+                        jax.random.PRNGKey(key_base + t), seq_seeds,
+                        min_p=min_ps,
+                    )
+                    stop_hit = jnp.logical_and(
+                        active,
+                        jnp.any(
+                            jnp.logical_and(
+                                sampled[:, None] == stop_ids, stop_valid
+                            ),
+                            axis=1,
+                        ),
+                    )
+                    emitted = jnp.where(active, sampled, -1)
+                    appended = jnp.logical_and(active, ~stop_hit)
+                    if use_penalties:
+                        rows = jnp.arange(counts.shape[0])
+                        counts = counts.at[rows, sampled].add(
+                            appended.astype(jnp.int16)
+                        )
+                        seen = seen.at[rows, sampled].max(appended)
+                    if hist_c is not None:
+                        # Keep the speculative drafter's carried history
+                        # warm across mixed windows (one committed token
+                        # per active row per iteration) so a chained
+                        # pure-decode window drafts from fresh context.
+                        H = hist_c.shape[1]
+                        cat = jnp.concatenate(
+                            [hist_c, jnp.maximum(emitted, 0)[:, None]],
+                            axis=1,
+                        )
+                        hidx = (
+                            jnp.arange(H)[None, :]
+                            + active.astype(jnp.int32)[:, None]
+                        )
+                        hist_c = jnp.take_along_axis(cat, hidx, axis=1)
+                    step = active.astype(jnp.int32)
+                    return (
+                        jnp.where(active, sampled, tokens),
+                        positions + step,
+                        ctx_lens + step,
+                        jnp.logical_or(done, stop_hit),
+                        jnp.maximum(min_left - step, 0),
+                        counts, seen, hist_c, chunk_logits, kv_caches,
+                    ), emitted
+
+                init = (
+                    tokens, positions, ctx_lens, done, min_left,
+                    counts, seen, hist,
+                    jnp.zeros((vocab,), jnp.float32), kv_caches,
+                )
+                xs = (
+                    jnp.arange(n_steps), pf_tokens, pf_cached, pf_valid,
+                    pf_new_blocks,
+                )
+                carry, emitted = jax.lax.scan(body, init, xs)
+                (tokens, positions, ctx_lens, done, min_left,
+                 counts, seen, hist, chunk_logits, kv_caches) = carry
+                state = {
+                    "tokens": tokens, "positions": positions,
+                    "ctx_lens": ctx_lens, "done": done,
+                    "min_left": min_left, "counts": counts, "seen": seen,
+                }
+                if hist is not None:
+                    state["hist"] = hist
+                return emitted, chunk_logits, state, kv_caches
+
+            self._mixed_window_fn = jax.jit(
+                mixed_window,
+                static_argnames=(
+                    "n_steps", "use_penalties", "use_min_floor",
+                ),
+                donate_argnames=("kv_caches",),
+            )
         self._penalties_fn = jax.jit(sampling_lib.apply_penalties)
         self._argmax_fn = jax.jit(
             lambda logits: jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -782,6 +977,11 @@ class LLMEngine:
         # removal signal: nonzero means prompts are chunking alongside
         # live decodes instead of stalling them).
         self.prefill_chunk_tokens = 0
+        # The subset of prefill_chunk_tokens that rode a mixed K-STEP
+        # window (tpu:mixed_window_chunk_tokens_total): nonzero means
+        # sustained arrivals are amortizing the host round-trip instead
+        # of forcing K=1 steps.  Step-thread-only writer.
+        self.mixed_window_chunk_tokens = 0
         # Overload-protection counters (docs/robustness.md): requests the
         # API server shed with a structured 429 (bounded admission), and
         # requests shed or aborted because their client deadline expired.
@@ -1133,10 +1333,16 @@ class LLMEngine:
             # the pipeline when the engine drains.  (For windows this is
             # the host side of the all-finished predicate: the device
             # carry's rows are all frozen no-ops, so the successor is
-            # discarded without a second sync.)
+            # discarded without a second sync.)  A MIXED window is never
+            # droppable this way: its chunk head is not a decode row, so
+            # "every row finished" says nothing about the chunk schedule
+            # — dropping it would skip the final chunk's first-token
+            # finalization (and the chunk/waste accounting) for a prompt
+            # whose KV the device already wrote.
             while (
                 self._pending
                 and self._pending[0].sampled is not None
+                and self._pending[0].chunk_sched is None
                 and all(s.is_finished for s in self._pending[0].seqs)
             ):
                 self._pending.popleft()
@@ -1182,6 +1388,13 @@ class LLMEngine:
                 # stackcheck: allow=SC101 reason=1ms idle backoff while async transfers land; the device is idle here by definition (nothing scheduled) so this is pacing, not a data wait
                 time.sleep(0.001)
             return False
+        if plan.window_fallback:
+            # A waiting head forced K=1 stepping (the mixed-window path
+            # could not serve it): the forfeited amortization is
+            # visible, like every other window fallback reason.
+            self.multistep_fallback[plan.window_fallback] = (
+                self.multistep_fallback.get(plan.window_fallback, 0) + 1
+            )
         if plan.decode is None:
             outputs = self._run_prefill(plan.prefill_chunk)
             self._step_counter += 1
@@ -1190,12 +1403,21 @@ class LLMEngine:
                 _PendingStep(outputs=outputs, host_s=time.time() - t0)
             )
             return True
+        if plan.chunk_schedule is not None:
+            # Mixed K-step window: the head prompt's chunks ride the
+            # decode scan (chunk cursor carried in-graph); the final
+            # chunk's first token is sampled at collect through the K=1
+            # finalize path.
+            self._pending.append(
+                self._dispatch_mixed_window(plan, chain_from=None)
+            )
+            return True
         if plan.prefill_chunk is not None:
             # Fused decode+prefill-chunk step: synchronous (the chunk's
             # admission/finalization needs collected state), so the
             # lookahead pipeline pauses for the step and resumes on the
             # next pure-decode plan.
-            outputs = self._run_mixed(plan.mixed)
+            outputs = self._run_mixed(plan)
             self._step_counter += 1
             # stackcheck: allow=SC201 reason=host_s is a stats field (host-gap metric); no plan state reads it
             self._pending.append(_PendingStep(
@@ -1237,6 +1459,14 @@ class LLMEngine:
                 self.obs.step_phase("schedule", time.time() - t0)
             if plan is None:
                 return False
+            if plan.chunk_schedule is not None:
+                # A waiting head's chunks chain onto the in-flight
+                # carry as a mixed window — the pipeline never drains
+                # through the admission.
+                self._pending.append(
+                    self._dispatch_mixed_window(plan, chain_from=prev)
+                )
+                return True
             self._pending.append(self._dispatch_window(plan, chain_from=prev))
             return True
         if not self._can_pipeline(prev.seqs):
@@ -1258,34 +1488,12 @@ class LLMEngine:
     # over a request's life) plus ONE dynamic bit — the pending
     # min_tokens floor — which _append_and_check clears exactly once at
     # the boundary crossing.
-    @staticmethod
-    def _host_state_flags(seq: Sequence):
-        """(window_fallback, classic_fallback, greedy) cached verdicts.
-        window_fallback: features the K-step window cannot serve
-        on-device (logprobs, logit_bias, guided — penalties and the
-        min_tokens floor now run inside the scan).  classic_fallback:
-        the stricter single-step-pipeline set (its sampler has no
-        penalty path).  greedy: temperature <= 0 — the fused
-        speculative window drafts only for all-greedy batches
-        (acceptance compares the model's own argmax; sampled batches
-        run the plain window with the classic key schedule, so seeded
-        streams stay bit-identical across window sizes)."""
-        flags = getattr(seq, "_hs_flags", None)
-        if flags is None:
-            sp = seq.sampling_params
-            window = bool(
-                sp.logprobs or sp.logit_bias or seq.guide is not None
-            )
-            classic = window or bool(
-                sp.presence_penalty
-                or sp.frequency_penalty
-                or sp.repetition_penalty != 1.0
-            )
-            seq._hs_flags = flags = (window, classic, sp.temperature <= 0)
-            seq._min_tok_pending = (
-                sp.min_tokens > len(seq.output_token_ids)
-            )
-        return flags
+    # (window_fallback, classic_fallback, greedy) cached verdicts — the
+    # taxonomy itself moved to sequence.host_state_flags so the
+    # scheduler's mixed-window planner reads the SAME verdicts the
+    # dispatch gates below do (it must never plan a K-step mixed window
+    # the engine would have to fall back out of).
+    _host_state_flags = staticmethod(seq_host_state_flags)
 
     def _batch_uses_host_state(self, seqs: List[Sequence]) -> bool:
         """True when any sequence needs host-visible per-token state the
@@ -1743,6 +1951,137 @@ class LLMEngine:
             win_state=state, spec_stats=spec_stats,
         )
 
+    # stackcheck: root=step-thread
+    def _dispatch_mixed_window(
+        self, plan, chain_from: Optional[_PendingStep] = None
+    ) -> _PendingStep:
+        """Enqueue one MIXED K-step window: each of the
+        K = len(plan.chunk_schedule) scan iterations runs the packed
+        [decode + chunk] mixed forward — decode rows advance from the
+        carried state exactly like ``_dispatch_window`` while the head
+        prompt's next chunk rides the same forward, its cursor
+        (cached_len / valid_len / new-block row) precomputed per
+        iteration and carried as scan xs.  ``chain_from`` chains the
+        decode carry from the previous window (pure or mixed) with no
+        host round-trip; the chunk arrays are fresh per window either
+        way.  The scan length is the next power of two >= K (a static
+        compile bucket — trailing iterations are no-ops frozen by
+        ``max_steps`` and a zero-valid chunk row)."""
+        t0 = time.time()
+        decode = plan.decode
+        seqs = decode.seqs
+        sched = plan.chunk_schedule
+        k_eff = len(sched)
+        n_scan = self._pow2_bucket(k_eff, 1)
+        head = sched[0].seq
+        if self.obs.enabled and head.first_scheduled_time is None:
+            head.first_scheduled_time = t0
+            self.obs.on_first_scheduled(head, t0)
+        if chain_from is None:
+            state = self._window_build(seqs, decode.steps)
+            self._note_decode_launch()
+        else:
+            state = self._window_chain(chain_from, seqs, decode.steps)
+            self._gap_steps += 1  # device busy: zero gap by construction
+            self._last_decode_end = None
+
+        # Per-iteration chunk schedule (host-precomputed, rides as scan
+        # xs).  All chunks share ONE bucket T (static scan shape); dead
+        # pow-2 padding iterations carry valid_len 0, new blocks parked
+        # on null block 0, and the END cursor as cached_len (their
+        # masked rows compute garbage that lands only on the null
+        # block, exactly like frozen decode rows).
+        bs = self.block_pool.block_size
+        T = sched[0].bucket_len
+        pf_tokens = np.zeros((n_scan, T), np.int32)
+        pf_cached = np.zeros((n_scan,), np.int32)
+        pf_valid = np.zeros((n_scan,), np.int32)
+        pf_new_blocks = np.zeros((n_scan, T // bs), np.int32)
+        final_iter = -1
+        for i, cp in enumerate(sched):
+            toks = head.prompt_token_ids[
+                cp.cached_len : cp.cached_len + cp.num_new_tokens
+            ]
+            pf_tokens[i, : len(toks)] = toks
+            pf_cached[i] = cp.cached_len
+            pf_valid[i] = cp.num_new_tokens
+            pf_new_blocks[i, : len(cp.new_block_ids)] = cp.new_block_ids
+            if cp.is_final:
+                final_iter = i
+        end_cursor = sched[-1].cached_len + sched[-1].num_new_tokens
+        pf_cached[k_eff:] = end_cursor
+        # ONE accumulated-prefix table for the whole window: the fullest
+        # chunk's prefix + its new blocks; iteration i's cached_len
+        # masks validity, so a block written by iteration t is attended
+        # from iteration t+1 on — in-graph, no host trip.
+        pmax = max(self._bmax, 1)
+        prefix_ids = np.zeros((pmax,), np.int32)
+        full = list(sched[-1].prefix_block_ids) + list(sched[-1].new_block_ids)
+        prefix_ids[: len(full)] = full
+
+        lora_kwargs = {}
+        if self.lora_registry is not None:
+            lora_kwargs = {
+                "lora": self.lora_registry.params,
+                "adapter_idx": state["adapter"],
+                "pf_adapter": np.int32(head.adapter_idx),
+            }
+        emitted, chunk_logits, out_state, self.kv_caches = (
+            self._mixed_window_fn(
+                self.params,
+                tokens=state["tokens"],
+                positions=state["positions"],
+                ctx_lens=state["ctx_lens"],
+                done=state["done"],
+                min_left=state["min_left"],
+                block_tables=state["tables"],
+                max_steps=state["max_steps"],
+                kv_caches=self.kv_caches,
+                temps=state["temps"],
+                top_ps=state["top_ps"],
+                top_ks=state["top_ks"],
+                min_ps=state["min_ps"],
+                seq_seeds=state["seeds"],
+                stop_ids=state["stop_ids"],
+                # Same 31-bit masking rationale as _dispatch_window.
+                key_base=jnp.int32(
+                    (self.config.seed + self._step_counter) & 0x7FFFFFFF
+                ),
+                counts=state["counts"],
+                seen=state["seen"],
+                presence=state["presence"],
+                frequency=state["frequency"],
+                repetition=state["repetition"],
+                pf_tokens=self._put(pf_tokens, P()),
+                pf_cached=self._put(pf_cached, P()),
+                pf_valid=self._put(pf_valid, P()),
+                pf_new_blocks=self._put(pf_new_blocks, P()),
+                pf_prefix_ids=self._put(prefix_ids, P()),
+                pf_final_iter=jnp.int32(final_iter),
+                n_steps=n_scan,
+                use_penalties=state["use_penalties"],
+                use_min_floor=state["use_min_floor"],
+                hist=state.get("hist"),
+                **lora_kwargs,
+            )
+        )
+        # The final chunk's iteration f is K=1 step (counter + f): the
+        # collect-side first-token sample burns exactly that ordinal.
+        chunk_ordinal = self._step_counter + max(final_iter, 0)
+        # K_eff live iterations = K_eff single-step equivalents (dead
+        # pow-2 padding iterations burn no ordinal anywhere).
+        self._step_counter += k_eff
+        state.update(out_state)
+        # stackcheck: allow=SC201 reason=host_s is a stats field (host-gap metric); no plan state reads it
+        return _PendingStep(
+            seqs=list(seqs), sampled=emitted, is_decode=True,
+            host_s=time.time() - t0, steps=list(decode.steps),
+            win_state=state,
+            chunk_sched=list(sched),
+            chunk_logits=chunk_logits if final_iter >= 0 else None,
+            chunk_ordinal=chunk_ordinal,
+        )
+
     def _collect_window(self, p: _PendingStep, t0: float) -> List[StepOutput]:
         """Read one window's emitted tokens back ([K, S] plain, or
         [K, W, S] from the fused speculative scan — flattened to the
@@ -1802,6 +2141,27 @@ class LLMEngine:
             wasted += int((arr[:, i] >= 0).sum()) - delivered[i]
         if wasted:
             self.multistep_wasted_tokens += wasted
+        if p.chunk_sched is not None:
+            # Mixed window: account the chunk tokens that rode the scan
+            # and finalize the head prompt's admission when its final
+            # chunk landed — the identical _finalize_final_prefill path
+            # (and PRNG ordinal) the K=1 mixed step uses, so the first
+            # token is bit-identical by construction.
+            head = p.chunk_sched[0].seq
+            chunk_tokens = sum(cp.num_new_tokens for cp in p.chunk_sched)
+            if head.is_finished:
+                # Aborted / deadline-shed while the window flew: the
+                # written chunk KV is unreachable — counted, never
+                # silently vanished.
+                self.multistep_wasted_tokens += chunk_tokens
+            else:
+                self.prefill_chunk_tokens += chunk_tokens
+                self.mixed_window_chunk_tokens += chunk_tokens
+                if p.chunk_logits is not None:
+                    outputs.extend(self._finalize_final_prefill(
+                        head, p.chunk_logits,
+                        step_ordinal=p.chunk_ordinal,
+                    ))
         if spec:
             # Per-window speculation accounting: drafted/accepted feed
             # the existing acceptance-rate counters; the outcome split
@@ -2572,11 +2932,16 @@ class LLMEngine:
         prefix_ids[: len(plan.prefix_block_ids)] = plan.prefix_block_ids
         return tokens, new_block_ids, prefix_ids
 
-    def _finalize_final_prefill(self, seq: Sequence, last_logits) -> List[StepOutput]:
-        """Shared tail of every FINAL prefill — dedicated executable or
-        mixed-step chunk: prefix export, the max_tokens==0 scoring
-        sentinel, or sampling the request's first token from the last
-        valid row's logits [V]."""
+    def _finalize_final_prefill(
+        self, seq: Sequence, last_logits, step_ordinal: Optional[int] = None
+    ) -> List[StepOutput]:
+        """Shared tail of every FINAL prefill — dedicated executable,
+        mixed-step chunk, or a mixed WINDOW's final chunk (which passes
+        ``step_ordinal``: the first token must burn the PRNG ordinal of
+        the K=1 step its iteration corresponds to, not the post-window
+        counter): prefix export, the max_tokens==0 scoring sentinel, or
+        sampling the request's first token from the last valid row's
+        logits [V]."""
         if self._exports:
             self._export_prefix_blocks(seq)
         if seq.sampling_params.max_tokens == 0:
@@ -2593,7 +2958,9 @@ class LLMEngine:
                 num_prompt_tokens=seq.num_prompt_tokens,
                 num_output_tokens=0,
             )]
-        token_ids, logprob_info = self._sample_batch(last_logits[None, :], [seq])
+        token_ids, logprob_info = self._sample_batch(
+            last_logits[None, :], [seq], step_ordinal=step_ordinal
+        )
         return self._append_and_check(
             [seq], token_ids, first_token=True, logprob_info=logprob_info
         )
@@ -2634,19 +3001,20 @@ class LLMEngine:
         return min(b, self._smax)
 
     # stackcheck: root=step-thread
-    def _run_mixed(self, mixed) -> List[StepOutput]:
+    def _run_mixed(self, step_plan) -> List[StepOutput]:
         """One fused step over the packed [decode bucket + chunk bucket]
-        token batch: every running sequence decodes exactly as in
-        _run_decode (paged attention, then the full host sampling
+        token batch (a StepPlan with both ``decode`` and
+        ``prefill_chunk`` set): every running sequence decodes exactly
+        as in _run_decode (paged attention, then the full host sampling
         surface), and the head waiting sequence's prefill chunk rides
         along paying only its attention/KV-write cost — the projection
         and MLP weight streaming is shared.  Only a FINAL chunk samples
         the prefill tail row (mid-prompt logits have no consumer),
         mirroring _run_prefill's chunked contract."""
         t_start = time.time()
-        plan = mixed.prefill_chunk
+        plan = step_plan.prefill_chunk
         seq = plan.seq
-        seqs = mixed.decode.seqs
+        seqs = step_plan.decode.seqs
         if self.obs.enabled and seq.first_scheduled_time is None:
             seq.first_scheduled_time = t_start
             self.obs.on_first_scheduled(seq, t_start)
@@ -2903,9 +3271,16 @@ class LLMEngine:
         )
         return temps, top_ps, top_ks, min_ps, seeds
 
-    def _sample_batch(self, logits: jax.Array, seqs: List[Sequence]):
+    def _sample_batch(
+        self, logits: jax.Array, seqs: List[Sequence],
+        step_ordinal: Optional[int] = None,
+    ):
         """Returns (token_ids, logprob_info) where logprob_info is a list of
-        None or (chosen_logprob, [(token_id, logprob), ...]) per sequence."""
+        None or (chosen_logprob, [(token_id, logprob), ...]) per sequence.
+        ``step_ordinal`` overrides the live step counter for the PRNG key
+        (a mixed window's final-chunk first token samples with the
+        ordinal of the iteration it landed in — the counter has already
+        advanced past the whole window by collect time)."""
         S = logits.shape[0]
         pad = S - len(seqs)
 
@@ -3011,7 +3386,10 @@ class LLMEngine:
             logits = logits + self._bias_cache[1]
 
         temps, top_ps, top_ks, min_ps, seeds = self._sampling_arrays(seqs, S)
-        step_key = jax.random.PRNGKey(self.config.seed + self._step_counter)
+        ordinal = (
+            self._step_counter if step_ordinal is None else step_ordinal
+        )
+        step_key = jax.random.PRNGKey(self.config.seed + ordinal)
         out = self._sample_fn(
             logits,
             jnp.asarray(temps),
@@ -3442,8 +3820,10 @@ class LLMEngine:
             "total_generated_tokens": self.total_generated_tokens,
             "total_finished": self.total_finished,
             # Prompt tokens prefilled inside fused mixed steps (decode
-            # never stalled for them).
+            # never stalled for them), and the subset that rode a mixed
+            # K-step window.
             "prefill_chunk_tokens": self.prefill_chunk_tokens,
+            "mixed_window_chunk_tokens": self.mixed_window_chunk_tokens,
             "num_preemptions": self.scheduler.num_preemptions,
             # Overload protection: structured 429s issued by bounded
             # admission, and requests shed/aborted on an expired client

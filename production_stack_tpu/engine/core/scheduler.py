@@ -26,7 +26,11 @@ from collections import deque
 from typing import Deque, List, Optional
 
 from production_stack_tpu.engine.config import SchedulerConfig
-from production_stack_tpu.engine.core.sequence import Sequence, SequenceStatus
+from production_stack_tpu.engine.core.sequence import (
+    Sequence,
+    SequenceStatus,
+    host_state_flags,
+)
 from production_stack_tpu.engine.kv.block_pool import BlockPool
 
 logger = logging.getLogger(__name__)
@@ -58,21 +62,11 @@ class DecodePlan:
 
 
 @dataclasses.dataclass
-class MixedPlan:
-    """Compatibility view of a fused decode+prefill-chunk step (the
-    unified :class:`StepPlan` carries the fields directly; this shape is
-    what ``plan.mixed`` returns for callers written against the PR-3
-    plan taxonomy)."""
-
-    decode: DecodePlan
-    prefill_chunk: Optional[PrefillPlan] = None
-
-
-@dataclasses.dataclass
 class StepPlan:
-    """THE one step-plan type (unifies the former prefill / decode /
-    MixedPlan / provisional taxonomy).  Exactly one execution shape per
-    plan, read off two fields:
+    """THE one step-plan type (unifying the former four-way plan
+    taxonomy; the PR-8 compat views are retired — callers read the
+    fields directly).
+    Exactly one execution shape per plan, read off three fields:
 
       decode only                     pure decode — ``decode_window`` (K)
                                       iterations per row budgeted in
@@ -81,33 +75,41 @@ class StepPlan:
       prefill_chunk only              one prefill step (bucketed, maybe
                                       chunked)
       decode + prefill_chunk          fused mixed step (always K=1: the
-                                      chunk's admission needs collected
-                                      state every step)
+                                      chunk either completes admission
+                                      this step or the window machinery
+                                      declined)
+      decode + chunk_schedule         MIXED K-step window: each of the
+                                      K = len(chunk_schedule) scan
+                                      iterations runs the packed
+                                      [decode + chunk] mixed forward —
+                                      decode rows advance one token from
+                                      the carried state while the head
+                                      prompt's next chunk rides the same
+                                      forward, chunk cursor carried
+                                      in-graph.  The window always ends
+                                      at an admission boundary (the
+                                      schedule's last chunk is final, or
+                                      the prompt continues next window).
 
     ``provisional`` marks plans made while the previous window is still
     in flight (optimistic no-finish assumption; the engine rolls back
-    at collect)."""
+    at collect).  ``window_fallback`` names the reason a pass that
+    WANTED a K>1 window was forced to K=1 (currently only
+    ``"waiting_head"``); the engine folds it into
+    ``tpu:multistep_fallback_total``."""
 
     decode: Optional[DecodePlan] = None
     prefill_chunk: Optional[PrefillPlan] = None
     decode_window: int = 1
     provisional: bool = False
+    # Mixed K-step window: one PrefillPlan per scan iteration, all at
+    # ONE chunk bucket (static scan shape); only the last may be final.
+    chunk_schedule: Optional[List[PrefillPlan]] = None
+    window_fallback: Optional[str] = None
 
     @property
     def is_empty(self) -> bool:
         return self.decode is None and self.prefill_chunk is None
-
-    @property
-    def prefill(self) -> Optional[PrefillPlan]:
-        """A dedicated (non-fused) prefill step's plan, else None."""
-        return self.prefill_chunk if self.decode is None else None
-
-    @property
-    def mixed(self) -> Optional[MixedPlan]:
-        """Compatibility view: the fused decode+chunk pair, else None."""
-        if self.decode is not None and self.prefill_chunk is not None:
-            return MixedPlan(decode=self.decode, prefill_chunk=self.prefill_chunk)
-        return None
 
 
 class Scheduler:
@@ -228,9 +230,12 @@ class Scheduler:
 
     def _window_for_pass(self) -> int:
         """Window-selection rule: K > 1 pure-decode windows only when no
-        prompt is waiting to prefill (a waiting head needs K=1 steps so
-        admission — mixed chunk or dedicated prefill — is re-evaluated
-        every token, not every K tokens)."""
+        prompt is waiting to prefill.  A waiting head is first offered a
+        MIXED K-step window (its chunks ride the decode scan — see
+        ``_try_schedule_mixed_window``); only when that declines does
+        the pass drop to K=1 steps so admission — mixed chunk or
+        dedicated prefill — is re-evaluated every token, not every K
+        tokens (counted as ``window_fallback="waiting_head"``)."""
         window = self.config.window_steps
         if window > 1 and self.num_waiting:
             return 1
@@ -240,14 +245,33 @@ class Scheduler:
     def schedule(self) -> StepPlan:
         """Emit one unified :class:`StepPlan`.  With ``mixed_batch`` on
         and sequences decoding, a fused decode+chunk plan keeps arriving
-        prompts from stalling the decoders; otherwise prefer admitting a
-        prefill when a batch slot is open, else decode every running
-        sequence — as a K-step window when no prompt waits (the
-        device-resident fast path), single-token steps otherwise."""
+        prompts from stalling the decoders — as a mixed K-step window
+        when the head prompt has several chunks to go (decode keeps its
+        host-cost amortization under sustained arrivals), else a K=1
+        mixed step; otherwise prefer admitting a prefill when a batch
+        slot is open, else decode every running sequence — as a K-step
+        window when no prompt waits (the device-resident fast path),
+        single-token steps otherwise."""
         window = self._window_for_pass()
         if self.config.mixed_enabled and self.running:
+            plan = self._try_schedule_mixed_window()
+            if plan is not None:
+                return plan
             plan = self._try_schedule_mixed(window)
             if plan is not None:
+                if (
+                    self.config.window_steps > 1
+                    and window == 1
+                    and not (
+                        plan.prefill_chunk is not None
+                        and plan.prefill_chunk.is_final
+                    )
+                ):
+                    # A waiting prompt forced single-stepping and the
+                    # pass did NOT complete its admission (a final
+                    # chunk IS the optimal full-service step): the
+                    # window amortization was forfeited, visibly.
+                    plan.window_fallback = "waiting_head"
                 return plan
         plan = self._try_schedule_prefill()
         if plan is not None:
@@ -343,6 +367,209 @@ class Scheduler:
         if chunk is None:
             return StepPlan(decode=decode, decode_window=window)
         return StepPlan(decode=decode, prefill_chunk=chunk)
+
+    # -- mixed K-step windows ----------------------------------------------
+
+    def _mixed_window_head(self) -> Optional[Sequence]:
+        """The admission head a mixed K-step window could chunk, or None
+        when the pass must stay on the K=1 machinery: no head / no open
+        batch slot, a head needing the prompt-logprobs prefill
+        executable, an offloaded head (the restore state machine lives
+        on the K=1 path), or any running row using host-sampled
+        features the engine would fall back out of the window for."""
+        if not self.config.mixed_window_enabled or not self.running:
+            return None
+        if len(self.running) >= self.config.max_num_seqs:
+            return None
+        queue = self._admission_queue()
+        head = queue[0] if queue else None
+        if head is None or head.offloaded:
+            return None
+        sp = head.sampling_params
+        if sp.echo and sp.logprobs:
+            return None
+        if any(host_state_flags(s)[0] for s in self.running):
+            return None
+        return head
+
+    def _chunk_buckets_in_budget(self) -> List[int]:
+        """Chunk buckets admissible beside the current decode batch
+        under the per-iteration token budget (each scan iteration is
+        one mixed step: decode tokens + one chunk <= the budget, so the
+        window's total is K x (decode + chunk))."""
+        budget = self.config.batched_tokens_budget - len(self.running)
+        return [b for b in self.config.prefill_chunk_buckets if b <= budget]
+
+    def _extend_chunk_schedule(
+        self, head: Sequence, first: PrefillPlan, buckets: List[int],
+        k_cap: int,
+    ) -> List[PrefillPlan]:
+        """Grow a window's chunk schedule past its (non-final) first
+        chunk, one ``_try_schedule_prefill`` chunk at a time — the SAME
+        bucket rule K=1 mixed stepping iterates, so the planned chunk
+        shapes (and therefore the compiled forwards, and the streams)
+        are identical to the escape-hatch path.  Stops at ``k_cap``, at
+        pool pressure (the window ends non-final and the next window
+        continues), or when the K=1 rule would pick a DIFFERENT bucket
+        for the final chunk (one scan has ONE static chunk shape; the
+        mismatched final chunk runs as the next pass's K=1 mixed step
+        instead — bit-identical either way)."""
+        schedule = [first]
+        T = first.bucket_len
+        budget_buckets = [b for b in buckets]
+        while len(schedule) < k_cap and not schedule[-1].is_final:
+            remaining = head.num_prompt_tokens - head.num_cached_tokens
+            fit = [b for b in budget_buckets if b >= remaining]
+            if fit and fit[0] != T:
+                break
+            nxt = self._try_schedule_prefill(
+                chunk_budget=self.config.batched_tokens_budget
+                - len(self.running)
+            )
+            if nxt is None:
+                break
+            schedule.append(nxt)
+        return schedule
+
+    def _mixed_window_decode_steps(self, seqs, k_eff, bases=None):
+        """Per-row decode token budgets for a mixed K-step window: the
+        plain iteration count (the in-window drafter never engages in a
+        mixed window — drafting is a pure-decode-window feature), capped
+        by each row's max_model_len / max_tokens room.  0 freezes the
+        row for the whole window (its stream is length-done; the K=1
+        world would have retired it, and collect() does the same)."""
+        steps = []
+        for i, seq in enumerate(seqs):
+            base_tokens, base_gen = (
+                bases[i] if bases is not None
+                else (seq.num_tokens, seq.num_generated)
+            )
+            room_len = self.config.max_model_len - base_tokens
+            room_out = seq.sampling_params.max_tokens - base_gen
+            steps.append(max(0, min(k_eff, room_len, room_out)))
+        return steps
+
+    def _try_schedule_mixed_window(self) -> Optional[StepPlan]:
+        """Plan a MIXED K-step window: K = min(window_steps, chunks the
+        head prompt needs, the adaptive queue-depth clamp) scan
+        iterations, each running the packed [decode + chunk] mixed
+        forward.  The window always ends at an admission boundary (its
+        last chunk is final, or the prompt keeps chunking next window),
+        which is what keeps greedy streams byte-identical and seeded
+        streams bit-identical to K=1 mixed stepping: iteration t of a
+        window dispatched at step counter c IS step c+t of the K=1
+        world, chunk shapes included.  Returns None to fall back to the
+        K=1 machinery (which owns preemption, restore, and the
+        echo+logprobs special cases); a planned single-chunk outcome is
+        emitted in the K=1 shape directly (nothing to amortize)."""
+        head = self._mixed_window_head()
+        if head is None:
+            return None
+        buckets = self._chunk_buckets_in_budget()
+        if not buckets:
+            return None
+        k_cap = min(
+            self.config.window_steps,
+            self.config.mixed_window_clamp(self.num_waiting),
+        )
+        if k_cap < 2:
+            # Deep waiting queue: the adaptive clamp demands per-token
+            # admission re-evaluation — today's K=1 behavior.
+            return None
+        # Multi-chunk precheck before committing any state: a head that
+        # fits one chunk bucket admits completely in one K=1 mixed step
+        # (a false positive from an unknown prefix hit just ends the
+        # window early at the final chunk).
+        remaining_max = head.num_prompt_tokens - (
+            head.num_cached_tokens if head.partial_prefill else 0
+        )
+        if remaining_max <= buckets[-1]:
+            return None
+        decode = self._mixed_window_decode_plan(k_cap)
+        if decode is None:
+            return None
+        first = self._try_schedule_prefill(
+            chunk_budget=self.config.batched_tokens_budget
+            - len(decode.seqs)
+        )
+        if first is None or first.is_final:
+            # Pool pressure / restore retry, or a prefix hit shrank the
+            # prompt to one final chunk: emit the exact K=1 mixed shape
+            # (decode blocks are over-allocated for the declined window
+            # — they sit in the block tables and back later steps).
+            self._recap_steps_k1(decode)
+            return StepPlan(
+                decode=decode, prefill_chunk=first, decode_window=1,
+                window_fallback=(
+                    None if first is not None and first.is_final
+                    else "waiting_head"
+                ),
+            )
+        schedule = self._extend_chunk_schedule(head, first, buckets, k_cap)
+        k_eff = len(schedule)
+        if k_eff == 1:
+            # Couldn't extend (pool pressure / bucket-mismatched final
+            # chunk): the planned chunk runs as today's K=1 mixed step.
+            self._recap_steps_k1(decode)
+            return StepPlan(
+                decode=decode, prefill_chunk=first, decode_window=1,
+                window_fallback="waiting_head",
+            )
+        decode.steps = self._mixed_window_decode_steps(decode.seqs, k_eff)
+        return StepPlan(
+            decode=decode, chunk_schedule=schedule, decode_window=k_eff,
+        )
+
+    def _recap_steps_k1(self, decode: DecodePlan) -> None:
+        """Re-budget a declined mixed window's decode rows for a K=1
+        emission.  The K=1 budget is NOT always 1: with the legacy
+        host-side speculative path active, ``_step_budget(seq, 1)`` is
+        ngram+1 — which can exceed the k_cap-iteration block allocation
+        ``_mixed_window_decode_plan`` made (a deep-queue clamp can push
+        k_cap below the draft budget), and the speculative dispatch
+        indexes the block table for its whole budget.  Top the
+        allocation up; under pool pressure trim the budget to the
+        blocks held instead (the drafter derives its draft count from
+        the budget, so a trimmed row just drafts less — greedy output
+        is unchanged, acceptance merely caps earlier)."""
+        bs = self.block_pool.block_size
+        steps = []
+        for seq in decode.seqs:
+            k = self._step_budget(seq, 1)
+            slots = seq.num_tokens + k - 1
+            need = max(0, -(-slots // bs) - len(seq.block_table))
+            if need:
+                if self.block_pool.can_allocate(need):
+                    seq.block_table.extend(self.block_pool.allocate(need))
+                else:
+                    k = max(
+                        1,
+                        len(seq.block_table) * bs - seq.num_tokens + 1,
+                    )
+            steps.append(k)
+        decode.steps = steps
+
+    def _mixed_window_decode_plan(self, k_cap: int) -> Optional[DecodePlan]:
+        """Decode rows for a mixed K-step window, blocks pre-allocated
+        for the whole k_cap budget.  Declines instead of preempting —
+        pool pressure falls back to the K=1 path, which owns the
+        preemption/rollback recovery machinery (and whose victim choice
+        must not depend on whether a window was attempted)."""
+        if not self.running:
+            return None
+        bs = self.block_pool.block_size
+        steps = self._mixed_window_decode_steps(self.running, k_cap)
+        needs = []
+        for seq, k in zip(self.running, steps):
+            slots = seq.num_tokens + max(k, 1) - 1
+            needs.append(max(0, -(-slots // bs) - len(seq.block_table)))
+        total = sum(needs)
+        if total and not self.block_pool.can_allocate(total):
+            return None
+        for seq, need in zip(self.running, needs):
+            if need:
+                seq.block_table.extend(self.block_pool.allocate(need))
+        return DecodePlan(seqs=list(self.running), steps=steps)
 
     def _try_schedule_prefill(
         self, chunk_budget: Optional[int] = None
@@ -525,9 +752,13 @@ class Scheduler:
         keeps actually-stopped rows frozen; the engine discards their
         overrun on readback).  Declines (None) whenever the pipeline
         must break and replan synchronously: the running set changed, an
-        admission is pending (window selection must drop to K=1 mixed
-        steps), every row's remaining budget is zero, or backing the
-        window would require preemption."""
+        admission is pending that a MIXED window cannot serve (window
+        selection must drop to K=1 mixed steps), every row's remaining
+        budget is zero, or backing the window would require preemption.
+        A waiting head whose chunks CAN ride the scan chains a MIXED
+        window off the in-flight carry instead of breaking the pipeline
+        — the sustained-arrival case that used to serialize every
+        window boundary into K=1 host round-trips."""
         window = self.config.window_steps
         if window <= 1:
             return None
@@ -538,9 +769,7 @@ class Scheduler:
         if not self.running:
             return None
         if self.waiting or self.preempted:
-            # A waiting prompt demands K=1 steps (mixed admission) —
-            # chaining another K-step window would starve it.
-            return None
+            return self._provisional_mixed_window(inflight_steps)
         bs = self.block_pool.block_size
         # Per-window per-row token ceiling: K x (ngram + 1) under the
         # fused speculative window at max acceptance (all-greedy batch),
@@ -572,6 +801,89 @@ class Scheduler:
         return StepPlan(
             decode=DecodePlan(seqs=list(self.running), steps=steps),
             decode_window=window,
+            provisional=True,
+        )
+
+    def _provisional_mixed_window(
+        self, inflight_steps: List[int]
+    ) -> Optional[StepPlan]:
+        """Chain a MIXED K-step window off the in-flight carry for a
+        waiting head: decode budgets are planned from the optimistic
+        post-window base exactly like the pure provisional path, and the
+        head's chunk schedule continues from its plan-time cursor (the
+        in-flight window's chunks already advanced it).  Unlike the
+        synchronous planner this EMITS single-chunk windows too — a
+        1-iteration mixed scan is bit-identical to the K=1 mixed step
+        and keeps the pipeline streaming through the admission.
+        Declines (sync replan at the boundary) when the head cannot
+        chunk at all."""
+        cfg = self.config
+        head = self._mixed_window_head()
+        if head is None:
+            return None
+        buckets = self._chunk_buckets_in_budget()
+        if not buckets:
+            return None
+        k_cap = min(
+            cfg.window_steps, cfg.mixed_window_clamp(self.num_waiting)
+        )
+        # Single-chunk heads decline (pipeline break -> the sync K=1
+        # mixed step admits them whole): a 1-iteration scan would mint
+        # a whole executable variant for zero amortization.  A prefix
+        # hit discovered at chunk planning can still shrink a
+        # multi-chunk head to one final chunk — that rare case emits
+        # the 1-iteration window below rather than rolling back
+        # committed plan state.
+        remaining_max = head.num_prompt_tokens - (
+            head.num_cached_tokens if head.partial_prefill else 0
+        )
+        if remaining_max <= buckets[-1]:
+            return None
+        bs = self.block_pool.block_size
+        bases = [
+            (seq.num_tokens + prev_k, seq.num_generated + prev_k)
+            for seq, prev_k in zip(self.running, inflight_steps)
+        ]
+        steps = self._mixed_window_decode_steps(
+            self.running, k_cap, bases=bases
+        )
+        needs = []
+        for (base_tokens, _), k, seq in zip(bases, steps, self.running):
+            slots = base_tokens + k - 1
+            needs.append(max(0, -(-slots // bs) - len(seq.block_table)))
+        total = sum(needs)
+        if total and not self.block_pool.can_allocate(total):
+            return None
+        for seq, need in zip(self.running, needs):
+            if need:
+                seq.block_table.extend(self.block_pool.allocate(need))
+        # Snapshot BEFORE chunk planning: a final chunk pops the head
+        # into self.running at plan time, and the popped head has no
+        # decode row in THIS window (it joins at the next boundary).
+        decode_seqs = list(self.running)
+        first = self._try_schedule_prefill(
+            chunk_budget=cfg.batched_tokens_budget - len(decode_seqs)
+        )
+        if first is None:
+            # Nothing chunkable (pool pressure / restore retry): break
+            # the pipeline so the sync pass re-evaluates at K=1.  The
+            # decode blocks above stay in the block tables and back the
+            # replanned step.
+            return None
+        if first.is_final:
+            schedule = [first]
+        else:
+            schedule = self._extend_chunk_schedule(
+                head, first, buckets, k_cap
+            )
+        k_eff = len(schedule)
+        return StepPlan(
+            decode=DecodePlan(
+                seqs=decode_seqs,
+                steps=[min(s, k_eff) for s in steps],
+            ),
+            chunk_schedule=schedule,
+            decode_window=k_eff,
             provisional=True,
         )
 

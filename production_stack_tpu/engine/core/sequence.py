@@ -156,6 +156,37 @@ class Sequence:
         return (self.num_tokens + block_size) // block_size
 
 
+def host_state_flags(seq: Sequence) -> tuple:
+    """(window_fallback, classic_fallback, greedy) cached verdicts —
+    THE one place the host-state taxonomy lives, shared by the engine's
+    dispatch gates and the scheduler's mixed-window planner (the
+    scheduler must not plan a K-step mixed window the engine would have
+    to fall back out of).  window_fallback: features the K-step window
+    cannot serve on-device (logprobs, logit_bias, guided — penalties
+    and the min_tokens floor run inside the scan).  classic_fallback:
+    the stricter single-step-pipeline set (its sampler has no penalty
+    path).  greedy: temperature <= 0 — the fused speculative window's
+    drafting predicate.  All three are static over a request's life;
+    the companion ``_min_tok_pending`` dynamic bit is armed here and
+    cleared by the engine at the boundary crossing."""
+    flags = seq._hs_flags
+    if flags is None:
+        sp = seq.sampling_params
+        window = bool(
+            sp.logprobs or sp.logit_bias or seq.guide is not None
+        )
+        classic = window or bool(
+            sp.presence_penalty
+            or sp.frequency_penalty
+            or sp.repetition_penalty != 1.0
+        )
+        seq._hs_flags = flags = (window, classic, sp.temperature <= 0)
+        seq._min_tok_pending = (
+            sp.min_tokens > len(seq.output_token_ids)
+        )
+    return flags
+
+
 @dataclasses.dataclass
 class StepOutput:
     """One engine step's result for one sequence."""

@@ -374,6 +374,8 @@ def build_engine_app(
             (vocab.TPU_SPEC_TOKENS_DRAFTED, s["spec_tokens_drafted"]),
             (vocab.TPU_SPEC_TOKENS_ACCEPTED, s["spec_tokens_accepted"]),
             (vocab.TPU_PREFILL_CHUNK_TOKENS, s["prefill_chunk_tokens"]),
+            (vocab.TPU_MIXED_WINDOW_CHUNK_TOKENS,
+             s["mixed_window_chunk_tokens"]),
             # Overload protection + step-loop watchdog (docs/robustness.md).
             (vocab.TPU_ADMISSION_REJECTED, s["admission_rejected_total"]),
             (vocab.TPU_DEADLINE_EXPIRED, s["deadline_expired_total"]),
@@ -1995,12 +1997,23 @@ def main(argv=None) -> None:
         "--no-multi-step-window) and dp/sp meshes",
     )
     parser.add_argument(
+        "--no-mixed-window",
+        action="store_true",
+        help="disable mixed K-step windows (a waiting prompt's prefill "
+        "chunks riding the device-resident decode scan) and restore the "
+        "K=1 mixed scheduling exactly: a waiting head forces "
+        "single-token steps, counted under tpu:multistep_fallback_total"
+        '{reason="waiting_head"} — A/B baseline / debugging',
+    )
+    parser.add_argument(
         "--max-num-batched-tokens",
         type=int,
         default=None,
         help="token budget per fused mixed step (decode tokens count "
-        "first, the prefill chunk gets the remainder); default admits "
-        "the largest chunk bucket beside a full decode batch",
+        "first, the prefill chunk gets the remainder; a mixed K-step "
+        "window applies it per scan iteration, so the window total is "
+        "K x the budget); default admits the largest chunk bucket "
+        "beside a full decode batch",
     )
     parser.add_argument("--host-offload-gb", type=float, default=0.0)
     parser.add_argument("--remote-kv-url", default=None)
@@ -2160,6 +2173,10 @@ def main(argv=None) -> None:
             **(
                 {"scheduler.mixed_batch": False}
                 if args.no_mixed_batch else {}
+            ),
+            **(
+                {"scheduler.mixed_window": False}
+                if args.no_mixed_window else {}
             ),
             **(
                 {"scheduler.max_num_batched_tokens": args.max_num_batched_tokens}

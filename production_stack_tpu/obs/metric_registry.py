@@ -205,7 +205,18 @@ REGISTRY = {
         "mirrors": ("fake_engine", "dashboard", "docs"),
         "help": "K-step decode-window dispatches dropped to single-step "
                 "because a co-scheduled request needed host-sampled "
-                "features (reason: logprobs | logit_bias | guided)",
+                "features (reason: logprobs | logit_bias | guided) or "
+                "because a waiting prompt forced K=1 admission cadence "
+                "and the mixed K-step window could not serve it "
+                "(reason: waiting_head)",
+    },
+    "tpu:mixed_window_chunk_tokens_total": {
+        "kind": "counter", "layer": "engine",
+        "mirrors": ("fake_engine", "dashboard", "docs"),
+        "help": "Prompt tokens whose prefill chunks rode the "
+                "device-resident decode scan (mixed K-step windows) — "
+                "the subset of tpu:prefill_chunk_tokens that paid no "
+                "per-chunk host round-trip",
     },
     "tpu:spec_window_tokens_total": {
         "kind": "counter", "layer": "engine", "labels": ("outcome",),

@@ -159,15 +159,28 @@ TPU_SPEC_WINDOW_OUTCOMES = ("accepted", "rejected", "wasted")
 # K-step decode windows (scheduler multi_step_window): dispatches that
 # fell back to single-step because a co-scheduled request needed
 # host-sampled features (labeled by reason — logprobs / logit_bias /
-# guided; one such request de-optimizes every co-scheduled stream), and
-# window tokens emitted but undeliverable (sequence aborted or finished
-# out-of-band while the window flew; ordinary stops cost zero under the
-# device stop-mask).  waste/total_generated is the amortization tax.
+# guided; one such request de-optimizes every co-scheduled stream) or
+# because a waiting prompt forced K=1 admission cadence and the mixed
+# K-step window could not serve it (waiting_head — with mixed windows
+# on and chunkable traffic this series should sit at ZERO under load;
+# a climbing rate means sustained arrivals are forfeiting the window
+# amortization), and window tokens emitted but undeliverable (sequence
+# aborted or finished out-of-band while the window flew; ordinary stops
+# cost zero under the device stop-mask).  waste/total_generated is the
+# amortization tax.
 TPU_MULTISTEP_FALLBACK = "tpu:multistep_fallback_total"
 # The closed reason set, pre-seeded as zero-valued series so scrapers,
 # dashboards, and rate() see stable label sets from boot.
-TPU_MULTISTEP_FALLBACK_REASONS = ("guided", "logit_bias", "logprobs")
+TPU_MULTISTEP_FALLBACK_REASONS = (
+    "guided", "logit_bias", "logprobs", "waiting_head",
+)
 TPU_MULTISTEP_WASTED_TOKENS = "tpu:multistep_wasted_tokens_total"
+# Mixed K-step windows (scheduler mixed_window): prompt tokens whose
+# prefill chunks rode the device-resident decode scan — the subset of
+# tpu:prefill_chunk_tokens that did NOT pay a per-chunk host
+# round-trip.  Its ratio to tpu:prefill_chunk_tokens is the window
+# coverage of sustained-arrival prefill traffic.
+TPU_MIXED_WINDOW_CHUNK_TOKENS = "tpu:mixed_window_chunk_tokens_total"
 # Disaggregated prefill/decode serving (docs/engine.md "Disaggregated
 # data path"): prefill-phase prime completions served (the handoff
 # producer side), and decode-phase handoff prefetch outcomes — a hit
@@ -224,6 +237,7 @@ TPU_COUNTERS = frozenset({
     TPU_ADMISSION_REJECTED,
     TPU_DEADLINE_EXPIRED,
     TPU_MULTISTEP_WASTED_TOKENS,
+    TPU_MIXED_WINDOW_CHUNK_TOKENS,
     TPU_DISAGG_PREFILL_PRIMES,
     TPU_DISAGG_HANDOFF_HITS,
     TPU_DISAGG_HANDOFF_MISSES,

@@ -466,9 +466,10 @@ def build_fake_engine_app(state: FakeEngineState | None = None) -> web.Applicati
             (vocab.TPU_SPEC_TOKENS_DRAFTED, 0),
             (vocab.TPU_SPEC_TOKENS_ACCEPTED, 0),
             # The fake engine serves every prompt instantly, so no mixed
-            # chunking ever happens — but the counter must exist so the
-            # scrape contract matches the real engine.
+            # chunking ever happens (windowed or not) — but the counters
+            # must exist so the scrape contract matches the real engine.
             (vocab.TPU_PREFILL_CHUNK_TOKENS, 0),
+            (vocab.TPU_MIXED_WINDOW_CHUNK_TOKENS, 0),
             # Async KV transfer plane: the fake engine has no remote
             # store, but the families must exist for the scrape contract
             # (obs.render_metrics below adds the matching

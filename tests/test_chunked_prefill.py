@@ -51,21 +51,21 @@ def test_scheduler_emits_chunked_plans():
     seq = Sequence("s", list(range(100)), SamplingParams())
     sched.add_seq(seq)
 
-    plan1 = sched.schedule().prefill
+    plan1 = sched.schedule().prefill_chunk
     assert plan1 is not None and not plan1.is_final
     assert plan1.num_new_tokens == 32 and plan1.cached_len == 0
     assert seq.partial_prefill and sched.num_running == 0
 
-    plan2 = sched.schedule().prefill
+    plan2 = sched.schedule().prefill_chunk
     assert not plan2.is_final
     assert plan2.cached_len == 32 and plan2.num_new_tokens == 32
     # Chunk 2 continues from chunk 1's blocks.
     assert plan2.prefix_block_ids == plan1.new_block_ids
 
-    plan3 = sched.schedule().prefill
+    plan3 = sched.schedule().prefill_chunk
     assert not plan3.is_final and plan3.cached_len == 64
 
-    plan4 = sched.schedule().prefill
+    plan4 = sched.schedule().prefill_chunk
     assert plan4.is_final
     assert plan4.cached_len == 96 and plan4.num_new_tokens == 4
     assert not seq.partial_prefill and sched.num_running == 1

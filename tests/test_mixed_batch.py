@@ -83,24 +83,27 @@ MID = [(3 * i + 1) % 101 for i in range(25)]
 
 
 def test_scheduler_emits_mixed_plans_under_budget():
+    # mixed_window=False pins the K=1 mixed machinery this test is
+    # about; the K-step windowed shape is covered in
+    # tests/test_mixed_window.py.
     pool = BlockPool(num_blocks=256, block_size=4)
     cfg = SchedulerConfig(
         max_num_seqs=4, prefill_buckets=(16, 32, 64),
         prefill_chunk_buckets=(16, 32), max_model_len=512,
-        max_num_batched_tokens=36,
+        max_num_batched_tokens=36, mixed_window=False,
     )
     sched = Scheduler(cfg, pool)
     running = Sequence("run", list(SHORT), SamplingParams(max_tokens=64))
     sched.add_seq(running)
-    assert sched.schedule().prefill is not None  # no running yet: classic
+    assert sched.schedule().prefill_chunk is not None  # no running yet: classic
     running.output_token_ids.append(1)
 
     waiting = Sequence("wait", list(LONG_A), SamplingParams(max_tokens=4))
     sched.add_seq(waiting)
     plan = sched.schedule()
-    assert plan.mixed is not None
-    assert [s.seq_id for s in plan.mixed.decode.seqs] == ["run"]
-    chunk = plan.mixed.prefill_chunk
+    assert plan.decode is not None and plan.prefill_chunk is not None
+    assert [s.seq_id for s in plan.decode.seqs] == ["run"]
+    chunk = plan.prefill_chunk
     assert chunk.seq is waiting
     # Budget 36 minus 1 decode token leaves 35: the 32 bucket fits, and
     # 90 remaining tokens > 32 makes this a non-final chunk.
@@ -112,15 +115,15 @@ def test_scheduler_emits_mixed_plans_under_budget():
     cfg.max_num_batched_tokens = 16
     running.output_token_ids.append(2)
     plan = sched.schedule()
-    assert plan.mixed is None and plan.decode is not None
+    assert plan.prefill_chunk is None and plan.decode is not None
     # Restore and finish the chunking: final chunk joins running.
     cfg.max_num_batched_tokens = None
     for _ in range(10):
         running.output_token_ids.append(3)
         plan = sched.schedule()
-        if plan.mixed is None:
+        if plan.prefill_chunk is None or plan.decode is None:
             break
-        chunk = plan.mixed.prefill_chunk
+        chunk = plan.prefill_chunk
     assert not waiting.partial_prefill
     assert waiting in sched.running
 
@@ -137,13 +140,13 @@ def test_mixed_off_restores_alternating_plans():
     b = Sequence("b", list(MID), SamplingParams(max_tokens=8))
     sched.add_seq(a)
     plan1 = sched.schedule()
-    assert plan1.prefill is not None and plan1.mixed is None
+    assert plan1.prefill_chunk is not None and plan1.decode is None
     a.output_token_ids.append(1)
     sched.add_seq(b)
     # Alternating path admits the waiting prefill FIRST (decode stalls).
     plan2 = sched.schedule()
-    assert plan2.prefill is not None and plan2.prefill.seq is b
-    assert plan2.mixed is None
+    assert plan2.prefill_chunk is not None and plan2.prefill_chunk.seq is b
+    assert plan2.decode is None
 
 
 def test_greedy_parity_mixed_vs_alternating():
@@ -173,6 +176,10 @@ def test_decode_continues_every_step_while_long_prompt_prefills():
         prefill_buckets=(16, 32, 64, 128, 2048),
         prefill_chunk_buckets=(128, 256),
         max_model_len=4096,
+        # Pin the K=1 mixed cadence this step-granular assertion is
+        # about (with mixed windows on, several chunks ride ONE step's
+        # scan — tests/test_mixed_window.py covers that contract).
+        mixed_window=False,
     )
     engine.add_request("run", prompt_token_ids=list(SHORT),
                        sampling_params=SamplingParams(max_tokens=256,
@@ -213,17 +220,17 @@ def test_mixed_respects_batch_slot_cap():
         prefill_chunk_buckets=(16, 32), max_model_len=512,
     ), pool)
     sched.add_seq(Sequence("a", list(SHORT), SamplingParams(max_tokens=8)))
-    assert sched.schedule().prefill is not None  # no running yet: classic
+    assert sched.schedule().prefill_chunk is not None  # no running yet: classic
     sched.running[-1].output_token_ids.append(1)
     sched.add_seq(Sequence("b", list(SHORT), SamplingParams(max_tokens=8)))
     plan = sched.schedule()  # open slot: "b" chunks in through a mixed plan
-    assert plan.mixed is not None
-    assert plan.mixed.prefill_chunk.seq.seq_id == "b"
+    assert plan.decode is not None and plan.prefill_chunk is not None
+    assert plan.prefill_chunk.seq.seq_id == "b"
     for s in sched.running:
         s.output_token_ids.append(1)
     sched.add_seq(Sequence("c", list(LONG_A), SamplingParams(max_tokens=4)))
     plan = sched.schedule()
-    assert plan.mixed is None and plan.prefill is None
+    assert plan.prefill_chunk is None and plan.chunk_schedule is None
     assert plan.decode is not None and len(plan.decode.seqs) == 2
     assert sched.num_waiting == 1  # "c" admitted nothing, not even blocks
 

@@ -1,0 +1,561 @@
+"""Mixed K-step windows (SchedulerConfig.mixed_window): a waiting
+prompt's prefill chunks ride the device-resident decode scan, so
+sustained arrivals stop forcing K=1 steps.
+
+The tentpole contract (docs/engine.md, "Unified step plan"): when a
+multi-chunk prompt waits, ``schedule()`` emits a StepPlan with a
+``chunk_schedule`` — K = min(decode_window, chunks needed, adaptive
+queue-depth clamp) scan iterations, each running the packed
+[decode + chunk] mixed forward with the chunk cursor carried in-graph —
+and the window always ENDS at an admission boundary, which is what
+keeps greedy streams byte-identical and seeded streams bit-identical to
+the ``--no-mixed-window`` K=1 escape hatch (iteration t of a window
+dispatched at counter c IS step c+t of the K=1 world, chunk shapes
+included).  ``schedule_provisional_window`` chains mixed windows off
+the in-flight carry so the pipeline never drains through an admission.
+"""
+
+import pathlib
+import re
+
+import pytest
+
+from production_stack_tpu.engine.config import (
+    CacheConfig,
+    EngineConfig,
+    ModelConfig,
+    SchedulerConfig,
+)
+from production_stack_tpu.engine.core.engine import LLMEngine
+from production_stack_tpu.engine.core.scheduler import Scheduler, StepPlan
+from production_stack_tpu.engine.core.sequence import (
+    SamplingParams,
+    Sequence,
+)
+from production_stack_tpu.engine.kv.block_pool import BlockPool
+
+
+def make_engine(mixed_window=True, seed=0, **sched_kw):
+    """mixed_window=False is the --no-mixed-window escape hatch: the
+    K=1 mixed scheduling of PR 3/8, byte-for-byte."""
+    sched = dict(
+        max_num_seqs=2,
+        prefill_buckets=(16, 32, 64, 128),
+        prefill_chunk_buckets=(16,),
+        max_model_len=256,
+    )
+    if not mixed_window:
+        sched["mixed_window"] = False
+    sched.update(sched_kw)
+    return LLMEngine(EngineConfig(
+        model=ModelConfig(dtype="float32"),
+        cache=CacheConfig(block_size=4, num_blocks=160),
+        scheduler=SchedulerConfig(**sched),
+        seed=seed,
+    ))
+
+
+RUN_PROMPT = [(7 * i) % 101 for i in range(24)]
+LONG_PROMPT = [(3 * i + 1) % 97 for i in range(80)]  # 5 chunks of 16
+
+
+def run_midstream(eng, sp_kwargs=None, arrive_after=5, late_prompt=None):
+    """One running stream; a (long) prompt arrives after the stream has
+    emitted ``arrive_after`` tokens — the sustained-arrival shape."""
+    sp_kwargs = sp_kwargs or {}
+    eng.add_request(
+        "a", prompt_token_ids=list(RUN_PROMPT),
+        sampling_params=SamplingParams(
+            max_tokens=40, ignore_eos=True, **sp_kwargs),
+    )
+    outs = {}
+    fired = False
+    steps = 0
+    while eng.has_unfinished():
+        steps += 1
+        assert steps < 800, "engine failed to drain"
+        for out in eng.step():
+            outs.setdefault(out.seq_id, []).append(out.new_token_id)
+        if not fired and len(outs.get("a", [])) >= arrive_after:
+            eng.add_request(
+                "b",
+                prompt_token_ids=list(late_prompt or LONG_PROMPT),
+                sampling_params=SamplingParams(
+                    max_tokens=20, ignore_eos=True, **sp_kwargs),
+            )
+            fired = True
+    return outs
+
+
+# -- config resolution ------------------------------------------------------
+
+
+def test_mixed_window_default_on_and_gate_off():
+    cfg = SchedulerConfig()
+    assert cfg.mixed_window_enabled
+    assert not SchedulerConfig(mixed_window=False).mixed_window_enabled
+    # Requires both parents: no window machinery -> no mixed windows.
+    assert not SchedulerConfig(
+        multi_step_window=False).mixed_window_enabled
+    assert not SchedulerConfig(mixed_batch=False).mixed_window_enabled
+    # Directly contradictory explicit combos refuse loudly.
+    with pytest.raises(ValueError, match="mixed_window"):
+        SchedulerConfig(mixed_window=True, multi_step_window=False)
+    with pytest.raises(ValueError, match="mixed_window"):
+        SchedulerConfig(mixed_window=True, mixed_batch=False)
+
+
+def test_adaptive_clamp_halves_per_extra_waiter():
+    cfg = SchedulerConfig(decode_window=8)
+    # The head prompt gets the full window to itself; each EXTRA waiter
+    # halves it — a deep queue degrades to today's K=1 admission cadence.
+    assert [cfg.mixed_window_clamp(n) for n in (0, 1, 2, 3, 4, 20)] == [
+        8, 8, 4, 2, 1, 1,
+    ]
+
+
+def test_escape_hatches_compose():
+    """--no-mixed-window composes with the legacy escape hatches."""
+    cfg = SchedulerConfig(mixed_window=False, multi_step_window=False)
+    assert cfg.window_steps == 1 and not cfg.mixed_window_enabled
+    cfg = SchedulerConfig(mixed_window=False, mixed_batch=False)
+    assert not cfg.mixed_enabled and not cfg.mixed_window_enabled
+
+
+# -- scheduler plan shapes --------------------------------------------------
+
+
+def _scheduler(**kw):
+    pool = BlockPool(num_blocks=256, block_size=4)
+    cfg = SchedulerConfig(
+        max_num_seqs=kw.pop("max_num_seqs", 4),
+        prefill_buckets=(16, 32, 64),
+        prefill_chunk_buckets=kw.pop("prefill_chunk_buckets", (16,)),
+        max_model_len=512,
+        **kw,
+    )
+    return Scheduler(cfg, pool), pool
+
+
+def test_mixed_window_plan_shape_and_boundary():
+    sched, _ = _scheduler()
+    run = Sequence("run", list(RUN_PROMPT), SamplingParams(max_tokens=64))
+    sched.add_seq(run)
+    assert sched.schedule().prefill_chunk is not None  # classic prefill
+    run.output_token_ids.append(1)
+    sched.add_seq(
+        Sequence("wait", list(LONG_PROMPT), SamplingParams(max_tokens=8))
+    )
+    plan = sched.schedule()
+    assert isinstance(plan, StepPlan)
+    # 80 tokens / 16-token chunks = 5 chunks <= K=8: ONE window covers
+    # the whole prefill and ends AT the admission boundary (last chunk
+    # final) — never past it.
+    assert plan.chunk_schedule is not None
+    assert plan.decode_window == len(plan.chunk_schedule) == 5
+    assert all(not cp.is_final for cp in plan.chunk_schedule[:-1])
+    assert plan.chunk_schedule[-1].is_final
+    # Every chunk shares ONE static bucket and the cursor advances by
+    # exactly the chunk length (the in-graph carry's schedule).
+    assert {cp.bucket_len for cp in plan.chunk_schedule} == {16}
+    cursors = [cp.cached_len for cp in plan.chunk_schedule]
+    assert cursors == [16 * i for i in range(5)]
+    # Decode rows got the whole window as budget.
+    assert plan.decode is not None and plan.decode.steps == [5]
+    assert plan.window_fallback is None
+
+
+def test_longer_prompt_chunks_across_chained_windows():
+    sched, _ = _scheduler(prefill_chunk_buckets=(16,), max_num_seqs=2)
+    run = Sequence("run", list(RUN_PROMPT), SamplingParams(max_tokens=64))
+    sched.add_seq(run)
+    sched.schedule()
+    run.output_token_ids.append(1)
+    long = Sequence(
+        "wait", [(5 * i) % 89 for i in range(300)],
+        SamplingParams(max_tokens=8),
+    )
+    sched.add_seq(long)
+    plan = sched.schedule()
+    # 300 tokens needs 19 chunks > K=8: the window fills its K=8 budget
+    # with non-final chunks and the prompt continues next window.
+    assert plan.chunk_schedule is not None
+    assert len(plan.chunk_schedule) == 8
+    assert not plan.chunk_schedule[-1].is_final
+    assert long.partial_prefill
+    assert long.num_cached_tokens == 8 * 16
+
+
+def test_deep_queue_clamps_to_k1():
+    """The adaptive clamp: 3 extra waiters -> clamp 1 -> today's K=1
+    mixed step, counted as a waiting_head fallback (TTFT of the extra
+    waiters never regresses more than one window's worth)."""
+    sched, _ = _scheduler()
+    run = Sequence("run", list(RUN_PROMPT), SamplingParams(max_tokens=64))
+    sched.add_seq(run)
+    sched.schedule()
+    run.output_token_ids.append(1)
+    for i in range(4):
+        sched.add_seq(Sequence(
+            f"w{i}", list(LONG_PROMPT), SamplingParams(max_tokens=8)
+        ))
+    plan = sched.schedule()
+    assert plan.chunk_schedule is None
+    assert plan.decode_window == 1
+    assert plan.prefill_chunk is not None  # head still chunks, at K=1
+    assert plan.window_fallback == "waiting_head"
+
+
+def test_single_chunk_head_is_not_a_fallback():
+    """A head that fits one chunk bucket admits completely in one K=1
+    mixed step — nothing was forfeited, so waiting_head must NOT count
+    (the CI smoke asserts the series stays zero on a loaded run)."""
+    sched, _ = _scheduler(prefill_chunk_buckets=(16, 32))
+    run = Sequence("run", list(RUN_PROMPT), SamplingParams(max_tokens=64))
+    sched.add_seq(run)
+    sched.schedule()
+    run.output_token_ids.append(1)
+    sched.add_seq(Sequence("short", [1, 2, 3, 4, 5, 6],
+                           SamplingParams(max_tokens=8)))
+    plan = sched.schedule()
+    assert plan.chunk_schedule is None and plan.decode_window == 1
+    assert plan.prefill_chunk is not None and plan.prefill_chunk.is_final
+    assert plan.window_fallback is None
+
+
+def test_no_mixed_window_restores_k1_plans():
+    sched, _ = _scheduler(mixed_window=False)
+    run = Sequence("run", list(RUN_PROMPT), SamplingParams(max_tokens=64))
+    sched.add_seq(run)
+    sched.schedule()
+    run.output_token_ids.append(1)
+    sched.add_seq(
+        Sequence("wait", list(LONG_PROMPT), SamplingParams(max_tokens=8))
+    )
+    plan = sched.schedule()
+    assert plan.chunk_schedule is None and plan.decode_window == 1
+    assert plan.prefill_chunk is not None
+    assert plan.window_fallback == "waiting_head"
+
+
+# -- engine parity ----------------------------------------------------------
+
+
+def test_greedy_parity_and_windows_engage():
+    eng = make_engine(True)
+    got = run_midstream(eng)
+    assert eng._mixed_window_fn is not None
+    assert eng.mixed_window_chunk_tokens == len(LONG_PROMPT)
+    assert eng.multistep_fallback == {}
+    ref_eng = make_engine(False)
+    ref = run_midstream(ref_eng)
+    assert ref_eng.multistep_fallback.get("waiting_head", 0) > 0
+    assert ref_eng.mixed_window_chunk_tokens == 0
+    assert got == ref, "greedy divergence mixed-window vs K=1"
+
+
+def test_seeded_sampling_bit_identical():
+    """The window ends at the admission boundary, so the key-ordinal
+    stream (PRNGKey(seed + c + t) per iteration, the final chunk's
+    first token at its iteration's ordinal) is exactly the K=1 path's."""
+    sp = dict(temperature=0.9, top_p=0.9, seed=7)
+    ref = run_midstream(make_engine(False), sp)
+    got = run_midstream(make_engine(True), sp)
+    assert got == ref
+
+
+def test_penalties_min_tokens_through_mixed_windows():
+    sp = dict(repetition_penalty=1.3, presence_penalty=0.5, min_tokens=6)
+    ref = run_midstream(make_engine(False), sp)
+    eng = make_engine(True)
+    got = run_midstream(eng, sp)
+    assert eng.multistep_fallback == {}
+    assert got == ref
+
+
+def test_spec_ngram_composes_with_mixed_windows():
+    """The {K=8 mixed + ngram=3} grid cell: drafting engages in
+    pure-decode windows, mixed windows keep the plain per-iteration
+    advance, and greedy streams stay byte-identical to the K=1 path."""
+    ref = run_midstream(make_engine(False))
+    eng = make_engine(True, speculative_ngram=3)
+    got = run_midstream(eng)
+    assert got == ref
+    assert eng.multistep_fallback == {}
+    assert eng.mixed_window_chunk_tokens == len(LONG_PROMPT)
+
+
+def test_logprobs_decode_row_declines_window():
+    """A host-state decode row (logprobs) must keep the batch off the
+    window scan — the scheduler reads the SAME host_state_flags the
+    engine's dispatch gate does, so it never plans a mixed window the
+    engine would fall back out of."""
+    eng = make_engine(True)
+    eng.add_request(
+        "a", prompt_token_ids=list(RUN_PROMPT),
+        sampling_params=SamplingParams(
+            max_tokens=30, ignore_eos=True, logprobs=True, top_logprobs=2),
+    )
+    outs = {}
+    fired = False
+    steps = 0
+    while eng.has_unfinished():
+        steps += 1
+        assert steps < 800
+        for out in eng.step():
+            outs.setdefault(out.seq_id, []).append(out.new_token_id)
+        if not fired and len(outs.get("a", [])) >= 5:
+            eng.add_request(
+                "b", prompt_token_ids=list(LONG_PROMPT),
+                sampling_params=SamplingParams(max_tokens=8))
+            fired = True
+    assert eng.mixed_window_chunk_tokens == 0
+    assert eng.multistep_fallback.get("logprobs", 0) > 0
+    assert len(outs["b"]) == 8
+
+
+def test_cross_instance_lockstep_determinism_with_chunk_in_flight():
+    """Two engine instances with identical seeds must produce identical
+    sampled streams AND identical window/chunk accounting while a chunk
+    schedule rides the scan — the mixed window's carry is a pure
+    function of config seed + step counter + carried state, never
+    instance identity or wall clock (the multi-host lockstep bar)."""
+    sp = dict(temperature=1.0, top_p=0.95, seed=42)
+    one = make_engine(True, seed=1234)
+    two = make_engine(True, seed=1234)
+    outs_one = run_midstream(one, sp)
+    outs_two = run_midstream(two, sp)
+    assert outs_one == outs_two
+    assert one.mixed_window_chunk_tokens == two.mixed_window_chunk_tokens
+    assert one.multistep_fallback == two.multistep_fallback
+    # A different config seed actually changes the sampled streams.
+    other = run_midstream(make_engine(True, seed=99), sp)
+    assert other != outs_one
+
+
+def test_abort_mid_mixed_window_counts_chunk_waste():
+    """A prompt aborted while its chunk schedule is in flight: the
+    chunk KV already written on-device is unreachable — counted into
+    tpu:multistep_wasted_tokens_total, never silently vanished."""
+    eng = make_engine(True)
+    eng.add_request(
+        "a", prompt_token_ids=list(RUN_PROMPT),
+        sampling_params=SamplingParams(max_tokens=64, ignore_eos=True),
+    )
+    # Let the stream settle into decoding, then drain the pipeline so
+    # the next dispatch is the mixed window.
+    for _ in range(4):
+        eng.step()
+    while eng.has_pending():
+        eng.collect()
+    eng.add_request(
+        "b", prompt_token_ids=list(LONG_PROMPT),
+        sampling_params=SamplingParams(max_tokens=8, ignore_eos=True),
+    )
+    assert eng.dispatch()
+    pending = list(eng._pending)
+    assert any(p.chunk_sched is not None for p in pending), (
+        "mixed window did not dispatch"
+    )
+    wasted0 = eng.multistep_wasted_tokens
+    eng.abort_request("b")
+    while eng.has_pending():
+        eng.collect()
+    chunk_in_flight = sum(
+        sum(cp.num_new_tokens for cp in p.chunk_sched)
+        for p in pending if p.chunk_sched is not None
+    )
+    assert eng.multistep_wasted_tokens - wasted0 >= chunk_in_flight
+    assert eng.mixed_window_chunk_tokens == 0
+    # The survivor drains cleanly.
+    while eng.has_unfinished():
+        eng.step()
+
+
+def test_mixed_windows_chain_through_pipeline():
+    """Sustained arrivals keep the pipeline full: a mixed window chains
+    off the in-flight carry (provisional path) instead of draining the
+    device at the admission."""
+    eng = make_engine(True)
+    eng.add_request(
+        "a", prompt_token_ids=list(RUN_PROMPT),
+        sampling_params=SamplingParams(max_tokens=64, ignore_eos=True),
+    )
+    saw_chained_mixed = False
+    outs = {}
+    fired = False
+    steps = 0
+    while eng.has_unfinished():
+        steps += 1
+        assert steps < 800
+        eng.dispatch()
+        if (
+            len(eng._pending) == 2
+            and eng._pending[1].chunk_sched is not None
+        ):
+            saw_chained_mixed = True
+        for out in eng.collect():
+            outs.setdefault(out.seq_id, []).append(out.new_token_id)
+        if not fired and len(outs.get("a", [])) >= 5:
+            eng.add_request(
+                "b", prompt_token_ids=list(LONG_PROMPT),
+                sampling_params=SamplingParams(
+                    max_tokens=8, ignore_eos=True))
+            fired = True
+    assert saw_chained_mixed, (
+        "no mixed window chained off an in-flight carry"
+    )
+
+
+def test_ttft_steps_bounded_under_sustained_arrivals():
+    """The north-star regime: prompts keep arriving, and each one's
+    first token still lands within a bounded number of engine steps of
+    its arrival (admission is re-evaluated at every window boundary;
+    the window length is capped by the chunk count, so a waiter is
+    never stuck behind more than one window)."""
+    eng = make_engine(True, max_num_seqs=4)
+    eng.add_request(
+        "a", prompt_token_ids=list(RUN_PROMPT),
+        sampling_params=SamplingParams(max_tokens=60, ignore_eos=True),
+    )
+    arrivals = {}  # rid -> step index at arrival
+    first_tok = {}
+    step = 0
+    next_idx = 0
+    while eng.has_unfinished():
+        step += 1
+        assert step < 1000
+        for out in eng.step():
+            if out.seq_id not in first_tok:
+                first_tok[out.seq_id] = step
+        if next_idx < 3 and step % 6 == 0:
+            rid = f"p{next_idx}"
+            eng.add_request(
+                rid, prompt_token_ids=list(LONG_PROMPT),
+                sampling_params=SamplingParams(
+                    max_tokens=6, ignore_eos=True))
+            arrivals[rid] = step
+            next_idx += 1
+    for rid, t0 in arrivals.items():
+        # One in-flight window + its own chunk window + pipeline slack.
+        assert first_tok[rid] - t0 <= 12, (
+            f"{rid} waited {first_tok[rid] - t0} steps for TTFT"
+        )
+
+
+def test_all_finished_drop_never_discards_a_chunked_window():
+    """collect()'s drop-successors shortcut ("every decode row finished
+    -> the queued window is a pure no-op") must NOT apply to a mixed
+    window: its chunk head is not a decode row, and dropping it would
+    skip the final chunk's first-token finalization for a prompt whose
+    KV the device already wrote."""
+    import numpy as np
+
+    from production_stack_tpu.engine.core.engine import _PendingStep
+    from production_stack_tpu.engine.core.sequence import (
+        FinishReason,
+        SequenceStatus,
+    )
+
+    eng = make_engine(True)
+    done = Sequence("done", [1, 2, 3], SamplingParams(max_tokens=4))
+    done.status = SequenceStatus.FINISHED
+    done.finish_reason = FinishReason.ABORT
+    head = Sequence("head", list(range(32)), SamplingParams(max_tokens=4))
+    from production_stack_tpu.engine.core.scheduler import PrefillPlan
+
+    chunk = PrefillPlan(
+        seq=head, bucket_len=16, new_block_ids=[0] * 4,
+        prefix_block_ids=[], num_new_tokens=16, cached_len=0,
+        is_final=False,
+    )
+    prev = _PendingStep(
+        seqs=[done], sampled=np.full((2, 1), -1, np.int32), steps=[2],
+        is_decode=True,
+    )
+    succ_plain = _PendingStep(
+        seqs=[done], sampled=np.full((2, 1), -1, np.int32), steps=[2],
+        is_decode=True,
+    )
+    succ_mixed = _PendingStep(
+        seqs=[done], sampled=np.full((2, 1), -1, np.int32), steps=[2],
+        is_decode=True, chunk_sched=[chunk],
+    )
+    eng._pending.extend([prev, succ_plain, succ_mixed])
+    eng.collect()  # pops prev; the drop loop inspects the successors
+    assert not any(p is succ_plain for p in eng._pending), (
+        "all-finished plain successor should have been dropped"
+    )
+    assert any(p is succ_mixed for p in eng._pending), (
+        "mixed window with a live chunk schedule must survive the drop"
+    )
+    eng._pending.clear()
+
+
+def test_k1_fallback_respects_spec_budget_block_invariant():
+    """A declined mixed window re-emitted at K=1 must leave every
+    decode row's block table covering its K=1 budget — which under the
+    legacy host-side speculative path is ngram+1 tokens, MORE than the
+    clamp-bounded window allocation (the speculative dispatch indexes
+    the table for its whole budget; a short table is a step-thread
+    crash)."""
+    pool = BlockPool(num_blocks=256, block_size=4)
+    cfg = SchedulerConfig(
+        max_num_seqs=8, prefill_buckets=(16, 32, 64),
+        prefill_chunk_buckets=(16, 32), max_model_len=512,
+        decode_window=8, speculative_ngram=3, pipeline_decode=False,
+    )
+    sched = Scheduler(cfg, pool)
+    run = Sequence("run", list(RUN_PROMPT), SamplingParams(max_tokens=64))
+    sched.add_seq(run)
+    sched.schedule()
+    run.output_token_ids.append(1)
+    # Head: 40 tokens -> chunk1 at bucket 32 (non-final), remaining 8
+    # fits bucket 16 != 32 -> bucket-mismatched final -> k_eff == 1
+    # fallback.  Two extra waiters clamp k_cap to 2 < the speculative
+    # K=1 budget of ngram+1 = 4.
+    sched.add_seq(Sequence("head", list(range(40)),
+                           SamplingParams(max_tokens=8)))
+    for i in range(2):
+        sched.add_seq(Sequence(f"w{i}", list(range(40)),
+                               SamplingParams(max_tokens=8)))
+    plan = sched.schedule()
+    assert plan.decode_window == 1 and plan.chunk_schedule is None
+    assert plan.prefill_chunk is not None
+    bs = pool.block_size
+    for seq, k in zip(plan.decode.seqs, plan.decode.steps):
+        assert k >= 1
+        slots = seq.num_tokens + k - 1
+        assert len(seq.block_table) * bs >= slots, (
+            f"{seq.seq_id}: budget {k} not block-backed"
+        )
+        # The speculative budget survived (blocks were topped up, not
+        # the budget trimmed — the pool has room).
+        assert k == 4
+
+
+# -- compat-shim retirement -------------------------------------------------
+
+
+def test_mixedplan_compat_shim_is_gone():
+    """The PR-8 compatibility views are retired: no MixedPlan class, no
+    `.mixed` / bare `.prefill` plan views anywhere in the package —
+    every caller reads StepPlan fields directly."""
+    root = pathlib.Path(__file__).resolve().parents[1]
+    pkg = root / "production_stack_tpu"
+    offenders = []
+    for path in pkg.rglob("*.py"):
+        text = path.read_text()
+        if re.search(r"\bMixedPlan\b", text):
+            offenders.append(f"{path}: MixedPlan")
+        # The retired StepPlan views (plan.mixed / plan.prefill); real
+        # attribute accesses like `.prefill_chunk`, `self.prefill`, or
+        # module functions (llama.prefill) are fine — match the plan
+        # variable idiom specifically.
+        for m in re.finditer(r"\bplan\.(mixed|prefill)\b(?!_)", text):
+            offenders.append(f"{path}: {m.group(0)}")
+    assert not offenders, offenders
+    import production_stack_tpu.engine.core.scheduler as sched_mod
+    assert not hasattr(sched_mod, "MixedPlan")
+    assert not hasattr(StepPlan, "mixed")
+    assert not hasattr(StepPlan, "prefill")

@@ -371,7 +371,7 @@ def test_step_plan_window_selection_rule():
     plan = sched.schedule()
     assert isinstance(plan, StepPlan)
     assert plan.decode is not None and plan.decode_window == 8
-    assert plan.prefill is None and plan.mixed is None
+    assert plan.prefill_chunk is None and plan.chunk_schedule is None
     # A waiting prompt forces K=1 (here: the mixed/classic admission
     # path runs, never an 8-step window).
     eng.add_request("b", prompt="newly arrived prompt",

@@ -32,10 +32,10 @@ def test_prefill_scheduled_first():
     sched, pool = make_scheduler()
     sched.add_seq(seq("a", 6))
     plan = sched.schedule()
-    assert plan.prefill is not None
-    assert plan.prefill.bucket_len == 8
-    assert plan.prefill.num_new_tokens == 6
-    assert len(plan.prefill.new_block_ids) == 2  # ceil(6/4)
+    assert plan.prefill_chunk is not None
+    assert plan.prefill_chunk.bucket_len == 8
+    assert plan.prefill_chunk.num_new_tokens == 6
+    assert len(plan.prefill_chunk.new_block_ids) == 2  # ceil(6/4)
     assert sched.num_running == 1
 
 
@@ -67,13 +67,13 @@ def test_prefill_admission_respects_batch_cap():
     sched, pool = make_scheduler(max_num_seqs=2, mixed_batch=False)
     for i in range(3):
         sched.add_seq(seq(f"s{i}", 4))
-    assert sched.schedule().prefill is not None
-    assert sched.schedule().prefill is not None
+    assert sched.schedule().prefill_chunk is not None
+    assert sched.schedule().prefill_chunk is not None
     # Batch full: third stays waiting, decode is scheduled instead.
     for s in sched.running:
         s.output_token_ids.append(1)
     plan = sched.schedule()
-    assert plan.prefill is None and plan.decode is not None
+    assert plan.prefill_chunk is None and plan.decode is not None
     assert sched.num_waiting == 1
 
 
@@ -89,8 +89,8 @@ def test_preemption_when_pool_exhausted():
     s2 = seq("young", 8, t=2.0)  # 2 blocks
     sched.add_seq(s1)
     sched.add_seq(s2)
-    assert sched.schedule().prefill.seq is s1
-    assert sched.schedule().prefill.seq is s2
+    assert sched.schedule().prefill_chunk.seq is s1
+    assert sched.schedule().prefill_chunk.seq is s2
     # Fill the pool so decode growth must preempt.
     pool.allocate(pool.num_free_blocks)
     s1.output_token_ids.append(1)  # needs block
@@ -110,7 +110,7 @@ def test_preempted_resumes_before_waiting():
     sched.preempted.append(s1)
     sched.add_seq(seq("fresh", 8))
     plan = sched.schedule()
-    assert plan.prefill.seq is s1
+    assert plan.prefill_chunk.seq is s1
 
 
 def test_finish_registers_prefix_and_frees():
