@@ -1039,6 +1039,241 @@ def bench_engine_mixed_window_ab(args, preset: str) -> dict:
     }
 
 
+def bench_engine_mixed_window_depth_grid(args, preset: str) -> dict:
+    """Queue-depth scaling of packed multi-prompt mixed windows through
+    the REAL engine: the tokens/s-monotone-in-depth claim, measured.
+    Each cell holds the waiting queue at a target depth d in {1, 4, 16}
+    (continuous refill from a fixed 16-arrival pool the moment the queue
+    dips below d) while two resident streams decode, over drafter arms
+    {ngram 0, ngram 3}.  Arrival prompts are LONGER than the largest
+    whole-prefill bucket, so every cell admits through mixed windows —
+    the grid isolates PACKING: at depth 1 each window carries one
+    prompt's 2 chunks (a short scan, one host dispatch+collect round
+    trip per prompt); depth 4 fills 8 of a K=16 window's iterations;
+    depth 16 packs all 16 with 8 prompts' chunk cursors back-to-back,
+    so deeper queues amortize the same per-window host round-trip over
+    more admitted tokens: tokens/s (arrival prompt tokens + generated
+    tokens over the measured wall-clock) must be monotone NON-DECREASING
+    in depth, within a 2% measurement-noise band per step (CPU timing
+    jitter).  A reference cell re-runs depth 16 with
+    --no-multi-prompt-window (the single-head planner + adaptive
+    deep-queue clamp) to pin the packed path's waiting_head count at
+    ZERO against the clamp's nonzero fallbacks.  Greedy parity is a
+    sha256 digest over every arrival's full token stream (identical
+    prompts + greedy sampling = byte-identical streams across every
+    cell, packed or not); resident streams are checked as
+    PREFIX-consistent instead (cells stop at different points, so
+    lengths differ — a delivery-schedule artifact, not sampling
+    divergence).  The warm phase is TWO full dress-rehearsal segments
+    of the same refill policy over equal-sized pools, each drained
+    completely.  Two, not one: the first segment starts cold (resident
+    prefill transient), so its (decode-bucket x window-length) shape
+    sequence differs from steady state — but every LATER segment
+    starts from the same macro-state (residents decoding, waiting
+    queue empty), and arrival dynamics are step-synchronous and
+    deterministic, so segment 2 replays segment 3's shape sequence
+    exactly and every XLA executable the measured segment needs is
+    compiled before the clock starts."""
+    import dataclasses as _dc
+    import gc
+    import hashlib
+
+    from production_stack_tpu.engine.config import (
+        CacheConfig,
+        EngineConfig,
+        PRESETS,
+        SchedulerConfig,
+    )
+    from production_stack_tpu.engine.core.engine import LLMEngine
+    from production_stack_tpu.engine.core.sequence import SamplingParams
+
+    S_RES = 2            # resident decode streams
+    RES_CTX = 96         # resident prompt length == the one prefill bucket
+    CHUNK = 64           # one static chunk bucket: arrivals = 2 chunks
+    ARRIVAL_PROMPT = 128  # 2 chunks -> up to 8 prompts pack per K=16 window
+    # First token at the admitting window's collect + ONE windowed
+    # decode token (exercises the join path), then the slot frees: the
+    # grid measures packed ADMISSION throughput, with decode realism
+    # carried by the two long-lived residents.  Longer tails would
+    # couple depth to drafter row-compute on CPU (verify rows are only
+    # free on HBM-bound hardware) and measure that instead.
+    ARRIVAL_GEN = 2
+    N_WARM = 32          # TWO dress-rehearsal segments (see docstring)
+    N_MEAS = 32
+    RES_BUDGET = 600
+
+    arrival_prompts = [
+        [(11 * i + 17 * n + 3) % 101 for i in range(ARRIVAL_PROMPT)]
+        for n in range(N_WARM + N_MEAS)
+    ]
+    res_prompts = [
+        [(5 * i + 3 * r) % 103 for i in range(RES_CTX)] for r in range(S_RES)
+    ]
+
+    def run(depth: int, ngram: int, packed: bool = True) -> dict:
+        sched = dict(
+            # 8 arrival slots beside the 2 residents: a K=16 window can
+            # pack exactly 8 two-chunk arrivals, so queue DEPTH is what
+            # fills the scan — depth 16 packs all 16 iterations, depth
+            # 4 fills 8, depth 1 rides 2 — and every window boundary
+            # the deep queue saves is measured amortization, not a
+            # batch-size ceiling artifact.
+            max_num_seqs=10,
+            # The largest whole-prefill bucket (96, the residents') is
+            # SMALLER than an arrival prompt, so arrivals always admit
+            # through mixed windows — depth 1 included.
+            prefill_buckets=(RES_CTX,),
+            prefill_chunk_buckets=(CHUNK,),
+            max_model_len=768,
+            decode_window=16,
+            speculative_ngram=ngram,
+        )
+        if not packed:
+            sched["multi_prompt_window"] = False
+        eng = LLMEngine(EngineConfig(
+            model=_dc.replace(PRESETS[preset]),
+            cache=CacheConfig(num_blocks=420),
+            scheduler=SchedulerConfig(**sched),
+        ))
+        for r in range(S_RES):
+            eng.add_request(
+                f"res{r}", prompt_token_ids=list(res_prompts[r]),
+                sampling_params=SamplingParams(
+                    max_tokens=RES_BUDGET, ignore_eos=True),
+            )
+        outs: dict = {}
+        ttft_s: dict = {}
+        added_t: dict = {}
+        finished: set = set()
+        next_arrival = 0
+
+        def refill(pool_end: int) -> None:
+            nonlocal next_arrival
+            while (next_arrival < pool_end
+                   and eng.scheduler.num_waiting < depth):
+                rid = f"arr{next_arrival}"
+                added_t[rid] = time.perf_counter()
+                eng.add_request(
+                    rid,
+                    prompt_token_ids=list(arrival_prompts[next_arrival]),
+                    sampling_params=SamplingParams(
+                        max_tokens=ARRIVAL_GEN, ignore_eos=True),
+                )
+                next_arrival += 1
+
+        def drive(pool_end: int) -> None:
+            steps = 0
+            while not all(
+                f"arr{n}" in finished for n in range(pool_end)
+            ):
+                steps += 1
+                assert steps < 30000, "engine failed to drain"
+                refill(pool_end)
+                for out in eng.step():
+                    rid = out.seq_id
+                    outs.setdefault(rid, []).append(out.new_token_id)
+                    if out.finished:
+                        finished.add(rid)
+                    if rid in added_t and rid not in ttft_s:
+                        ttft_s[rid] = time.perf_counter() - added_t.pop(rid)
+
+        # Warm: cold-start segment (resident prefill + first arrivals),
+        # then one steady-state dress rehearsal that replays the
+        # measured segment's exact shape sequence.  Each drains fully.
+        drive(N_WARM // 2)
+        drive(N_WARM)
+        t0 = time.perf_counter()
+        s0 = eng.stats()
+        gen0 = s0["total_generated_tokens"]
+        fb0 = dict(s0["multistep_fallback"]).get("waiting_head", 0)
+        hist0 = (eng.mixed_window_prompts_hist.count,
+                 eng.mixed_window_prompts_hist.sum)
+        drive(N_WARM + N_MEAS)
+        elapsed = time.perf_counter() - t0
+        s1 = eng.stats()
+        for r in range(S_RES):
+            eng.abort_request(f"res{r}")
+        while eng.has_unfinished():
+            for out in eng.step():
+                outs.setdefault(out.seq_id, []).append(out.new_token_id)
+        win_n = eng.mixed_window_prompts_hist.count - hist0[0]
+        win_sum = eng.mixed_window_prompts_hist.sum - hist0[1]
+        gen_delta = s1["total_generated_tokens"] - gen0
+        tokens = N_MEAS * ARRIVAL_PROMPT + gen_delta
+        meas_ttfts = sorted(
+            ttft_s[f"arr{n}"] for n in range(N_WARM, N_WARM + N_MEAS)
+        )
+
+        def pct(sorted_vals, q):
+            if not sorted_vals:
+                return 0.0
+            i = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1)))
+            return sorted_vals[i]
+
+        digest = hashlib.sha256()
+        for n in range(N_WARM + N_MEAS):
+            digest.update(
+                f"arr{n}:{','.join(map(str, outs[f'arr{n}']))};".encode()
+            )
+        result = {
+            "tokens_per_s": round(tokens / max(elapsed, 1e-9), 1),
+            "ttft_p50_ms": round(pct(meas_ttfts, 0.50) * 1e3, 1),
+            "ttft_p95_ms": round(pct(meas_ttfts, 0.95) * 1e3, 1),
+            "waiting_head": int(
+                dict(s1["multistep_fallback"]).get("waiting_head", 0) - fb0
+            ),
+            "prompts_per_window_mean": round(win_sum / max(win_n, 1), 2),
+            "transfer_overlap_s": round(
+                s1["window_transfer_overlap_seconds"], 4
+            ),
+            "greedy_digest": digest.hexdigest()[:16],
+            "_res_streams": [list(outs.get(f"res{r}", []))
+                             for r in range(S_RES)],
+        }
+        del eng
+        gc.collect()
+        return result
+
+    results = {}
+    for depth in (1, 4, 16):
+        for ngram in (0, 3):
+            results[f"d{depth}_ng{ngram}"] = run(depth, ngram)
+    results["d16_ng0_nopack"] = run(16, 0, packed=False)
+
+    digests = {c: r["greedy_digest"] for c, r in results.items()}
+    parity = len(set(digests.values())) == 1
+    res_parity = True
+    for r in range(S_RES):
+        streams = [c["_res_streams"][r] for c in results.values()]
+        shortest = min(streams, key=len)
+        res_parity &= all(s[: len(shortest)] == shortest for s in streams)
+    for cell in results.values():
+        del cell["_res_streams"]
+    monotone = all(
+        results[f"d1_ng{g}"]["tokens_per_s"]
+        <= results[f"d4_ng{g}"]["tokens_per_s"] * 1.02
+        and results[f"d4_ng{g}"]["tokens_per_s"]
+        <= results[f"d16_ng{g}"]["tokens_per_s"] * 1.02
+        for g in (0, 3)
+    )
+    return {
+        **results,
+        # The acceptance bars: tokens/s monotone non-decreasing in queue
+        # depth (2% CPU-noise band per step), ZERO waiting_head
+        # fallbacks on the packed path at depth 16, and greedy streams
+        # byte-identical across every cell including the unpacked
+        # reference.
+        "tokens_per_s_monotone": monotone,
+        "waiting_head_at_depth16": results["d16_ng0"]["waiting_head"],
+        "greedy_parity": parity,
+        "resident_prefix_parity": res_parity,
+        "depth_speedup_d16_vs_d1": round(
+            results["d16_ng0"]["tokens_per_s"]
+            / max(results["d1_ng0"]["tokens_per_s"], 1e-9), 2
+        ),
+    }
+
+
 def bench_engine_spec_window_ab(args, preset: str) -> dict:
     """Speculation x window grid through the REAL engine
     (K in {1, 8} x ngram in {0, 3}): the PR-11 fusion claim, measured.
@@ -3048,6 +3283,32 @@ def main() -> None:
         except Exception as e:
             log(f"mixed-window A/B failed: {e}")
             detail["mixed_window_ab_error"] = str(e)[:200]
+        # Queue-depth scaling of packed multi-prompt windows: tokens/s
+        # must be monotone non-decreasing in depth {1, 4, 16}, packed
+        # waiting_head pinned at zero at depth 16, greedy digests
+        # byte-identical across every cell incl. the unpacked reference.
+        try:
+            import gc as _gc
+
+            _gc.collect()
+            detail["mixed_window_depth"] = (
+                bench_engine_mixed_window_depth_grid(args, preset)
+            )
+            dg = detail["mixed_window_depth"]
+            log(f"mixed-window depth grid: tokens/s "
+                f"{dg['d1_ng0']['tokens_per_s']} @d1 / "
+                f"{dg['d4_ng0']['tokens_per_s']} @d4 / "
+                f"{dg['d16_ng0']['tokens_per_s']} @d16 "
+                f"(monotone {dg['tokens_per_s_monotone']}, "
+                f"{dg['depth_speedup_d16_vs_d1']}x d16/d1), "
+                f"{dg['d16_ng0']['prompts_per_window_mean']} prompts/"
+                f"window @d16, waiting_head "
+                f"{dg['waiting_head_at_depth16']} packed vs "
+                f"{dg['d16_ng0_nopack']['waiting_head']} unpacked, "
+                f"parity {dg['greedy_parity']}")
+        except Exception as e:
+            log(f"mixed-window depth grid failed: {e}")
+            detail["mixed_window_depth_error"] = str(e)[:200]
 
     if run_stage("spec_window_ab"):
         # Speculation x window grid: the fused in-scan draft-and-verify

@@ -490,6 +490,22 @@ class SchedulerConfig:
     # (waiting head -> K=1 steps, tpu:multistep_fallback_total
     # {reason="waiting_head"}).
     mixed_window: Optional[bool] = None
+    # Multi-prompt packed mixed windows: each scan iteration of a mixed
+    # K-step window may carry a chunk cursor from a DIFFERENT waiting
+    # prompt (ragged per-iteration cursors over the same static
+    # prefill_chunk_buckets shapes — steady-state serving never
+    # recompiles), so deep queues fill the window instead of shrinking
+    # it.  The packed path retires the adaptive K-halving clamp
+    # (mixed_window_clamp) and runs full-K pure-decode windows when the
+    # batch is slot-full (no admission is possible mid-window anyway),
+    # driving {reason="waiting_head"} fallbacks to zero under surge.
+    # Admission still happens only at window boundaries, so greedy
+    # streams stay byte-identical and seeded streams bit-identical to
+    # the single-head path.  None = auto (ON whenever
+    # mixed_window_enabled); False (--no-multi-prompt-window) restores
+    # the PR-15 single-head window + adaptive clamp exactly,
+    # plan-by-plan.
+    multi_prompt_window: Optional[bool] = None
     # Bounded admission (overload protection): once the waiting queue
     # holds this many requests (or prompt tokens), the API server rejects
     # new work with a structured 429 + Retry-After instead of queueing it
@@ -567,6 +583,12 @@ class SchedulerConfig:
             raise ValueError(
                 "mixed_window=True requires mixed_batch (the chunk "
                 "machinery); drop --no-mixed-batch or --mixed-window"
+            )
+        if self.multi_prompt_window and self.mixed_window is False:
+            raise ValueError(
+                "multi_prompt_window=True packs prompts into mixed K-step "
+                "windows but mixed_window=False disables those windows; "
+                "drop --no-mixed-window or --multi-prompt-window"
             )
         if not self.prefill_chunk_buckets:
             raise ValueError("prefill_chunk_buckets must be non-empty")
@@ -661,6 +683,17 @@ class SchedulerConfig:
         if self.mixed_window is False:
             return False
         return self.mixed_enabled and self.window_steps > 1
+
+    @property
+    def multi_prompt_window_enabled(self) -> bool:
+        """Resolved packed-window gate: auto (None) rides
+        mixed_window_enabled — packing is the default whenever mixed
+        K-step windows exist.  False (--no-multi-prompt-window) keeps
+        the windows but restores the PR-15 single-head planner and its
+        adaptive clamp exactly."""
+        if self.multi_prompt_window is False:
+            return False
+        return self.mixed_window_enabled
 
     def mixed_window_clamp(self, num_waiting: int) -> int:
         """Adaptive per-window iteration clamp keyed to waiting-queue

@@ -62,6 +62,7 @@ from production_stack_tpu.engine.kv.prefetch import PrefetchedChain, PrefetchMan
 from production_stack_tpu.engine.models import get_model
 from production_stack_tpu.engine.models.weights import load_params
 from production_stack_tpu.obs.engine import EngineObs
+from production_stack_tpu.obs.histogram import Histogram
 from production_stack_tpu.engine.parallel import shardings as shardings_lib
 from production_stack_tpu.engine.parallel.mesh import AXES, build_mesh
 from production_stack_tpu.engine import sampling as sampling_lib
@@ -100,10 +101,12 @@ class _PendingStep:
     # into tpu:spec_tokens_* and tpu:spec_window_tokens_total.
     spec_stats: Optional[tuple] = None
     # Mixed K-step windows: the chunk schedule that rode the scan (one
-    # PrefillPlan per live iteration), the final chunk's still-in-flight
-    # tail logits [V] (None when the window left the prompt mid-prefill),
-    # and the step-counter ordinal of the final-chunk iteration — the
-    # PRNG key the K=1 path would sample the prompt's first token with.
+    # PrefillPlan per live iteration — packed windows interleave several
+    # prompts' chunks), the still-in-flight per-iteration tail logits
+    # [n_scan, V] (None when no chunk in the window was final), and the
+    # window's BASE step-counter ordinal — a final chunk at iteration f
+    # samples its prompt's first token with ordinal base + f, the PRNG
+    # key the K=1 path would burn for that step.
     chunk_sched: Optional[List] = None
     chunk_logits: Optional[object] = None
     chunk_ordinal: int = 0
@@ -779,9 +782,9 @@ class LLMEngine:
                 stop_ids, key_base, counts, seen,
                 presence, frequency, repetition,
                 pf_tokens, pf_cached, pf_valid, pf_new_blocks,
-                pf_prefix_ids, pf_final_iter,
+                pf_prefix_ids, pf_adapter,
                 n_steps, use_penalties, use_min_floor,
-                hist=None, lora=None, adapter_idx=None, pf_adapter=None,
+                hist=None, lora=None, adapter_idx=None,
             ):
                 stop_valid = stop_ids >= 0
                 stop_mask = None
@@ -793,26 +796,31 @@ class LLMEngine:
                     )(stop_ids, stop_valid)
                 S = tokens.shape[0]
                 T = pf_tokens.shape[1]
-                if lora is not None:
-                    # Mixed row layout: [S decode rows + T chunk rows
-                    # sharing ONE adapter] — the _run_mixed layout.
-                    packed_adapter = jnp.concatenate(
-                        [adapter_idx,
-                         jnp.full((T,), pf_adapter, jnp.int32)]
-                    )
 
                 def body(carry, xs):
                     (tokens, positions, ctx_lens, done, min_left,
-                     counts, seen, hist_c, chunk_logits, kv_caches) = carry
-                    t, pft, pfc, pfv, pfnb = xs
+                     counts, seen, hist_c, kv_caches) = carry
+                    # Packed windows: each iteration carries its OWN
+                    # prompt cursor — tokens, block table, and adapter
+                    # slot ride the scan xs, so chunks from several
+                    # prompts share one static [S + T] shape.
+                    t, pft, pfc, pfv, pfnb, pfpid, pfad = xs
                     active = jnp.logical_and(~done, t < max_steps)
                     blk = jnp.take_along_axis(
                         block_tables, (positions // bs)[:, None], axis=1
                     )[:, 0]
-                    extra = (
-                        {"lora": lora, "adapter_idx": packed_adapter}
-                        if lora is not None else {}
-                    )
+                    extra = {}
+                    if lora is not None:
+                        # Mixed row layout: [S decode rows + T chunk
+                        # rows sharing ONE adapter] — the _run_mixed
+                        # layout, per iteration.
+                        extra = {
+                            "lora": lora,
+                            "adapter_idx": jnp.concatenate(
+                                [adapter_idx,
+                                 jnp.full((T,), pfad, jnp.int32)]
+                            ),
+                        }
                     logits, kv_caches = model_mixed(
                         params,
                         dec_tokens=tokens,
@@ -825,17 +833,17 @@ class LLMEngine:
                         dec_slot_offsets=positions % bs,
                         pf_tokens=pft,
                         pf_cached_len=pfc,
-                        pf_prefix_block_ids=pf_prefix_ids,
+                        pf_prefix_block_ids=pfpid,
                         pf_new_block_ids=pfnb,
                         pf_valid_len=pfv,
                         kv_caches=kv_caches,
                         **extra,
                     )
-                    # The chunk tail row (only meaningful on the final
-                    # chunk's iteration; -1 = no final chunk this window).
-                    chunk_logits = jnp.where(
-                        t == pf_final_iter, logits[-1], chunk_logits
-                    )
+                    # logits[-1] is the chunk's tail row (last VALID
+                    # token); every iteration's tail rides out as a
+                    # scan output so EACH packed prompt's final chunk
+                    # can be finalized at collect.
+                    tail = logits[-1]
                     dlogits = logits[:S]
                     if use_penalties:
                         dlogits = sampling_lib.apply_penalties_state(
@@ -896,21 +904,20 @@ class LLMEngine:
                         ctx_lens + step,
                         jnp.logical_or(done, stop_hit),
                         jnp.maximum(min_left - step, 0),
-                        counts, seen, hist_c, chunk_logits, kv_caches,
-                    ), emitted
+                        counts, seen, hist_c, kv_caches,
+                    ), (emitted, tail)
 
                 init = (
                     tokens, positions, ctx_lens, done, min_left,
-                    counts, seen, hist,
-                    jnp.zeros((vocab,), jnp.float32), kv_caches,
+                    counts, seen, hist, kv_caches,
                 )
                 xs = (
                     jnp.arange(n_steps), pf_tokens, pf_cached, pf_valid,
-                    pf_new_blocks,
+                    pf_new_blocks, pf_prefix_ids, pf_adapter,
                 )
-                carry, emitted = jax.lax.scan(body, init, xs)
+                carry, (emitted, tails) = jax.lax.scan(body, init, xs)
                 (tokens, positions, ctx_lens, done, min_left,
-                 counts, seen, hist, chunk_logits, kv_caches) = carry
+                 counts, seen, hist, kv_caches) = carry
                 state = {
                     "tokens": tokens, "positions": positions,
                     "ctx_lens": ctx_lens, "done": done,
@@ -918,7 +925,7 @@ class LLMEngine:
                 }
                 if hist is not None:
                     state["hist"] = hist
-                return emitted, chunk_logits, state, kv_caches
+                return emitted, tails, state, kv_caches
 
             self._mixed_window_fn = jax.jit(
                 mixed_window,
@@ -982,6 +989,26 @@ class LLMEngine:
         # sustained arrivals are amortizing the host round-trip instead
         # of forcing K=1 steps.  Step-thread-only writer.
         self.mixed_window_chunk_tokens = 0
+        # Distinct prompts whose chunks rode each mixed K-step window
+        # (tpu:mixed_window_prompts_per_window): >1 means the packed
+        # multi-prompt path is filling windows under queue depth.
+        # Lives on the engine (not EngineObs) because the packed-window
+        # contract metrics render regardless of tracing.  Step-thread-
+        # only writer; Histogram.observe is thread-safe anyway.
+        self.mixed_window_prompts_hist = Histogram(
+            bounds=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0)
+        )
+        # Seconds of host<->device transfer work issued WHILE the device
+        # was busy with an in-flight window — H2D chunk staging for a
+        # chained window plus D2H offload gathers dispatched under the
+        # scan (tpu:window_transfer_overlap_seconds_total): stalls the
+        # overlap-everything dispatch avoided.  Step-thread-only writer.
+        self.window_transfer_overlap_s = 0.0
+        # Double-buffered host staging arrays for packed-window chunk
+        # payloads, keyed by (n_scan, T): two alternating sets per scan
+        # shape so building window N+1's H2D payload never waits on
+        # window N's still-draining copy.
+        self._mw_stage: Dict[tuple, list] = {}
         # Overload-protection counters (docs/robustness.md): requests the
         # API server shed with a structured 429 (bounded admission), and
         # requests shed or aborted because their client deadline expired.
@@ -1958,25 +1985,37 @@ class LLMEngine:
         """Enqueue one MIXED K-step window: each of the
         K = len(plan.chunk_schedule) scan iterations runs the packed
         [decode + chunk] mixed forward — decode rows advance from the
-        carried state exactly like ``_dispatch_window`` while the head
-        prompt's next chunk rides the same forward, its cursor
-        (cached_len / valid_len / new-block row) precomputed per
-        iteration and carried as scan xs.  ``chain_from`` chains the
-        decode carry from the previous window (pure or mixed) with no
-        host round-trip; the chunk arrays are fresh per window either
-        way.  The scan length is the next power of two >= K (a static
-        compile bucket — trailing iterations are no-ops frozen by
-        ``max_steps`` and a zero-valid chunk row)."""
+        carried state exactly like ``_dispatch_window`` while prompt
+        chunks ride the same forward, each iteration's cursor
+        (cached_len / valid_len / new-block row / prefix table /
+        adapter slot) precomputed per iteration and carried as scan xs.
+        Packed windows (multi_prompt_window) interleave cursors from
+        SEVERAL prompts: a final chunk's iteration f finalizes its
+        prompt at collect with PRNG ordinal base+f, and the next
+        iteration's xs switch to the next prompt's tokens and block
+        tables — the per-iteration prefix table is what makes the
+        ragged hand-off transparent to the model fn.  ``chain_from``
+        chains the decode carry from the previous window (pure or
+        mixed) with no host round-trip; the chunk arrays are fresh per
+        window either way, staged through double-buffered host arrays
+        (two alternating sets per scan shape) so building window N+1's
+        H2D payload never waits on window N's still-draining copy —
+        time spent staging while the device is busy is counted in
+        ``tpu:window_transfer_overlap_seconds_total``.  The scan length
+        is the next power of two >= K (a static compile bucket —
+        trailing iterations are no-ops frozen by ``max_steps`` and a
+        zero-valid chunk row)."""
         t0 = time.time()
         decode = plan.decode
         seqs = decode.seqs
         sched = plan.chunk_schedule
         k_eff = len(sched)
         n_scan = self._pow2_bucket(k_eff, 1)
-        head = sched[0].seq
-        if self.obs.enabled and head.first_scheduled_time is None:
-            head.first_scheduled_time = t0
-            self.obs.on_first_scheduled(head, t0)
+        if self.obs.enabled:
+            for cp in sched:
+                if cp.seq.first_scheduled_time is None:
+                    cp.seq.first_scheduled_time = t0
+                    self.obs.on_first_scheduled(cp.seq, t0)
         if chain_from is None:
             state = self._window_build(seqs, decode.steps)
             self._note_decode_launch()
@@ -1988,45 +2027,63 @@ class LLMEngine:
         # Per-iteration chunk schedule (host-precomputed, rides as scan
         # xs).  All chunks share ONE bucket T (static scan shape); dead
         # pow-2 padding iterations carry valid_len 0, new blocks parked
-        # on null block 0, and the END cursor as cached_len (their
-        # masked rows compute garbage that lands only on the null
+        # on null block 0, and the last chunk's END cursor as cached_len
+        # (their masked rows compute garbage that lands only on the null
         # block, exactly like frozen decode rows).
+        t_stage = time.time()
         bs = self.block_pool.block_size
         T = sched[0].bucket_len
-        pf_tokens = np.zeros((n_scan, T), np.int32)
-        pf_cached = np.zeros((n_scan,), np.int32)
-        pf_valid = np.zeros((n_scan,), np.int32)
-        pf_new_blocks = np.zeros((n_scan, T // bs), np.int32)
-        final_iter = -1
+        pmax = max(self._bmax, 1)
+        stage = self._mw_stage.get((n_scan, T))
+        if stage is None:
+            mk = lambda: {  # noqa: E731
+                "tokens": np.zeros((n_scan, T), np.int32),
+                "cached": np.zeros((n_scan,), np.int32),
+                "valid": np.zeros((n_scan,), np.int32),
+                "new_blocks": np.zeros((n_scan, T // bs), np.int32),
+                "prefix": np.zeros((n_scan, pmax), np.int32),
+                "adapter": np.zeros((n_scan,), np.int32),
+            }
+            stage = self._mw_stage[(n_scan, T)] = [mk(), mk(), 0]
+        buf = stage[stage[2]]
+        stage[2] ^= 1
+        for arr in buf.values():
+            arr.fill(0)
+        any_final = False
         for i, cp in enumerate(sched):
-            toks = head.prompt_token_ids[
+            toks = cp.seq.prompt_token_ids[
                 cp.cached_len : cp.cached_len + cp.num_new_tokens
             ]
-            pf_tokens[i, : len(toks)] = toks
-            pf_cached[i] = cp.cached_len
-            pf_valid[i] = cp.num_new_tokens
-            pf_new_blocks[i, : len(cp.new_block_ids)] = cp.new_block_ids
+            buf["tokens"][i, : len(toks)] = toks
+            buf["cached"][i] = cp.cached_len
+            buf["valid"][i] = cp.num_new_tokens
+            buf["new_blocks"][i, : len(cp.new_block_ids)] = cp.new_block_ids
+            full = list(cp.prefix_block_ids) + list(cp.new_block_ids)
+            buf["prefix"][i, : len(full)] = full
+            buf["adapter"][i] = cp.seq.adapter_idx
             if cp.is_final:
-                final_iter = i
+                any_final = True
+        # Dead pow-2 padding iterations replay the LAST live chunk's
+        # cursor/table at valid_len 0 (frozen, null-block writes only).
         end_cursor = sched[-1].cached_len + sched[-1].num_new_tokens
-        pf_cached[k_eff:] = end_cursor
-        # ONE accumulated-prefix table for the whole window: the fullest
-        # chunk's prefix + its new blocks; iteration i's cached_len
-        # masks validity, so a block written by iteration t is attended
-        # from iteration t+1 on — in-graph, no host trip.
-        pmax = max(self._bmax, 1)
-        prefix_ids = np.zeros((pmax,), np.int32)
-        full = list(sched[-1].prefix_block_ids) + list(sched[-1].new_block_ids)
-        prefix_ids[: len(full)] = full
+        buf["cached"][k_eff:] = end_cursor
+        buf["prefix"][k_eff:] = buf["prefix"][k_eff - 1]
 
         lora_kwargs = {}
         if self.lora_registry is not None:
             lora_kwargs = {
                 "lora": self.lora_registry.params,
                 "adapter_idx": state["adapter"],
-                "pf_adapter": np.int32(head.adapter_idx),
             }
-        emitted, chunk_logits, out_state, self.kv_caches = (
+        pf_device = {
+            k: self._put(v, P()) for k, v in buf.items()
+        }
+        if chain_from is not None:
+            # The previous window still occupies the device: every
+            # second of this H2D staging ran UNDER its compute instead
+            # of serializing after it.
+            self.window_transfer_overlap_s += time.time() - t_stage
+        emitted, tails, out_state, self.kv_caches = (
             self._mixed_window_fn(
                 self.params,
                 tokens=state["tokens"],
@@ -2052,12 +2109,12 @@ class LLMEngine:
                 presence=state["presence"],
                 frequency=state["frequency"],
                 repetition=state["repetition"],
-                pf_tokens=self._put(pf_tokens, P()),
-                pf_cached=self._put(pf_cached, P()),
-                pf_valid=self._put(pf_valid, P()),
-                pf_new_blocks=self._put(pf_new_blocks, P()),
-                pf_prefix_ids=self._put(prefix_ids, P()),
-                pf_final_iter=jnp.int32(final_iter),
+                pf_tokens=pf_device["tokens"],
+                pf_cached=pf_device["cached"],
+                pf_valid=pf_device["valid"],
+                pf_new_blocks=pf_device["new_blocks"],
+                pf_prefix_ids=pf_device["prefix"],
+                pf_adapter=pf_device["adapter"],
                 n_steps=n_scan,
                 use_penalties=state["use_penalties"],
                 use_min_floor=state["use_min_floor"],
@@ -2065,9 +2122,11 @@ class LLMEngine:
                 **lora_kwargs,
             )
         )
-        # The final chunk's iteration f is K=1 step (counter + f): the
-        # collect-side first-token sample burns exactly that ordinal.
-        chunk_ordinal = self._step_counter + max(final_iter, 0)
+        # chunk_ordinal is the window's BASE step counter: a final
+        # chunk at iteration f is K=1 step (base + f), and the
+        # collect-side first-token sample burns exactly that ordinal —
+        # per packed prompt.
+        chunk_ordinal = self._step_counter
         # K_eff live iterations = K_eff single-step equivalents (dead
         # pow-2 padding iterations burn no ordinal anywhere).
         self._step_counter += k_eff
@@ -2078,7 +2137,7 @@ class LLMEngine:
             host_s=time.time() - t0, steps=list(decode.steps),
             win_state=state,
             chunk_sched=list(sched),
-            chunk_logits=chunk_logits if final_iter >= 0 else None,
+            chunk_logits=tails if any_final else None,
             chunk_ordinal=chunk_ordinal,
         )
 
@@ -2143,25 +2202,40 @@ class LLMEngine:
             self.multistep_wasted_tokens += wasted
         if p.chunk_sched is not None:
             # Mixed window: account the chunk tokens that rode the scan
-            # and finalize the head prompt's admission when its final
-            # chunk landed — the identical _finalize_final_prefill path
-            # (and PRNG ordinal) the K=1 mixed step uses, so the first
-            # token is bit-identical by construction.
-            head = p.chunk_sched[0].seq
-            chunk_tokens = sum(cp.num_new_tokens for cp in p.chunk_sched)
-            if head.is_finished:
-                # Aborted / deadline-shed while the window flew: the
-                # written chunk KV is unreachable — counted, never
-                # silently vanished.
-                self.multistep_wasted_tokens += chunk_tokens
-            else:
+            # and finalize EACH packed prompt whose final chunk landed —
+            # the identical _finalize_final_prefill path (and PRNG
+            # ordinal: window base + the final chunk's iteration index)
+            # the K=1 mixed step uses, so first tokens are bit-identical
+            # by construction.  A prompt aborted / deadline-shed while
+            # the window flew skips its finalize — the written chunk KV
+            # is unreachable and counted as waste, never silently
+            # vanished — and the OTHER packed prompts are unaffected.
+            tails = (
+                np.asarray(p.chunk_logits)  # [n_scan, V] per-iter tails
+                if p.chunk_logits is not None else None
+            )
+            by_seq = []  # [(seq, [(iteration, chunk), ...])] in order
+            for i, cp in enumerate(p.chunk_sched):
+                if by_seq and by_seq[-1][0] is cp.seq:
+                    by_seq[-1][1].append((i, cp))
+                else:
+                    by_seq.append((cp.seq, [(i, cp)]))
+            for seq, chunks in by_seq:
+                chunk_tokens = sum(cp.num_new_tokens for _, cp in chunks)
+                if seq.is_finished:
+                    self.multistep_wasted_tokens += chunk_tokens
+                    continue
                 self.prefill_chunk_tokens += chunk_tokens
                 self.mixed_window_chunk_tokens += chunk_tokens
-                if p.chunk_logits is not None:
-                    outputs.extend(self._finalize_final_prefill(
-                        head, p.chunk_logits,
-                        step_ordinal=p.chunk_ordinal,
-                    ))
+                if tails is None:
+                    continue
+                for i, cp in chunks:
+                    if cp.is_final:
+                        outputs.extend(self._finalize_final_prefill(
+                            seq, tails[i],
+                            step_ordinal=p.chunk_ordinal + i,
+                        ))
+            self.mixed_window_prompts_hist.observe(len(by_seq))
         if spec:
             # Per-window speculation accounting: drafted/accepted feed
             # the existing acceptance-rate counters; the outcome split
@@ -3690,6 +3764,11 @@ class LLMEngine:
         self._offload_stager.commit(
             seq.seq_id, device_layers, seq.num_tokens
         )
+        if self._pending:
+            # A window (or step) is still in flight: this D2H gather
+            # dispatch rode the alternate stream UNDER its compute — an
+            # avoided stall the overlap metric makes visible.
+            self.window_transfer_overlap_s += time.time() - t0
         if self.obs.enabled:
             # Step-thread cost only (gather DISPATCH): the D2H wait lives
             # in tpu:offload_stage_seconds, observed by the writer.
@@ -3824,6 +3903,10 @@ class LLMEngine:
             # K-step window.
             "prefill_chunk_tokens": self.prefill_chunk_tokens,
             "mixed_window_chunk_tokens": self.mixed_window_chunk_tokens,
+            # Transfer seconds issued while the device was busy (H2D
+            # chunk staging for chained windows + D2H offload gathers
+            # under an in-flight scan) — stalls overlap dispatch avoided.
+            "window_transfer_overlap_seconds": self.window_transfer_overlap_s,
             "num_preemptions": self.scheduler.num_preemptions,
             # Overload protection: structured 429s issued by bounded
             # admission, and requests shed/aborted on an expired client

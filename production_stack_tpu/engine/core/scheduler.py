@@ -103,7 +103,13 @@ class StepPlan:
     decode_window: int = 1
     provisional: bool = False
     # Mixed K-step window: one PrefillPlan per scan iteration, all at
-    # ONE chunk bucket (static scan shape); only the last may be final.
+    # ONE chunk bucket (static scan shape).  Packed windows
+    # (multi_prompt_window) may carry chunks from SEVERAL prompts: a
+    # final chunk mid-schedule admits its prompt and the next iteration
+    # starts the next waiting prompt's cursor (later prompts ride
+    # padded at the window's established bucket — pf_valid masks
+    # identically).  Under --no-multi-prompt-window only the last chunk
+    # may be final (the PR-15 single-head shape).
     chunk_schedule: Optional[List[PrefillPlan]] = None
     window_fallback: Optional[str] = None
 
@@ -160,6 +166,11 @@ class Scheduler:
         # cross-thread instead of iterating a deque the step thread
         # mutates (a mid-iteration mutation raises RuntimeError).
         self.queued_prompt_tokens = 0
+        # Decode-side chunk-budget computations (_chunk_token_budget
+        # calls) — regression-tested O(1) per planning pass: packed
+        # window planning over N waiters must not recompute it per
+        # chunk.
+        self.budget_computations = 0
 
     # -- admission ---------------------------------------------------------
 
@@ -235,9 +246,31 @@ class Scheduler:
         ``_try_schedule_mixed_window``); only when that declines does
         the pass drop to K=1 steps so admission — mixed chunk or
         dedicated prefill — is re-evaluated every token, not every K
-        tokens (counted as ``window_fallback="waiting_head"``)."""
+        tokens (counted as ``window_fallback="waiting_head"``).
+
+        Packed-window exception (multi_prompt_window): when every batch
+        slot is occupied, NO admission is possible this pass no matter
+        how often it is re-evaluated — dropping to K=1 would burn K
+        host round-trips purely on ceremony.  Run a pure-decode window
+        clamped to the first step a slot could FREE (the smallest
+        remaining output budget across the batch): windows never
+        retire rows mid-scan — finish/abort land at collect — so
+        iterations past the first exhausted row's budget would decode
+        dead rows while admissible prompts wait, and the boundary is
+        exactly where packing becomes possible again."""
         window = self.config.window_steps
         if window > 1 and self.num_waiting:
+            if (
+                self.config.multi_prompt_window_enabled
+                and len(self.running) >= self.config.max_num_seqs
+            ):
+                # Floor 2: still a window (a K=1 pass here would be
+                # miscounted as a waiting_head forfeit — it isn't one,
+                # no admission fits a full batch either way).
+                return min(window, max(
+                    2,
+                    min(s.remaining_budget for s in self.running),
+                ))
             return 1
         return window
 
@@ -362,7 +395,7 @@ class Scheduler:
             return None
         chunk = None
         if self.num_waiting and len(self.running) < self.config.max_num_seqs:
-            budget = self.config.batched_tokens_budget - len(decode.seqs)
+            budget = self._chunk_token_budget(len(decode.seqs))
             chunk = self._try_schedule_prefill(chunk_budget=budget)
         if chunk is None:
             return StepPlan(decode=decode, decode_window=window)
@@ -392,40 +425,87 @@ class Scheduler:
             return None
         return head
 
-    def _chunk_buckets_in_budget(self) -> List[int]:
+    def _chunk_token_budget(self, num_decode_rows: int) -> int:
+        """Per-iteration chunk token budget beside ``num_decode_rows``
+        decode tokens — computed ONCE per planning pass and threaded
+        through window planning.  The per-chunk recomputation this
+        replaces also drifted on the packed path: a final chunk pops
+        its prompt into ``running`` mid-planning, which must not
+        shrink later chunks' budget (the window's decode rows are
+        fixed at plan time; packed prompts only join the decode batch
+        at the next boundary)."""
+        self.budget_computations += 1
+        return self.config.batched_tokens_budget - num_decode_rows
+
+    def _chunk_buckets_in_budget(self, budget: int) -> List[int]:
         """Chunk buckets admissible beside the current decode batch
         under the per-iteration token budget (each scan iteration is
         one mixed step: decode tokens + one chunk <= the budget, so the
         window's total is K x (decode + chunk))."""
-        budget = self.config.batched_tokens_budget - len(self.running)
         return [b for b in self.config.prefill_chunk_buckets if b <= budget]
+
+    def _next_packable_head(self) -> Optional[Sequence]:
+        """The next waiting prompt a PACKED window may start chunking
+        after the previous prompt's final chunk, or None to stop
+        packing this window: no open batch slot left (prompts already
+        popped by earlier final chunks count), empty queues, an
+        offloaded head (the restore state machine lives on the K=1
+        path), or a head needing the prompt-logprobs prefill
+        executable."""
+        if len(self.running) >= self.config.max_num_seqs:
+            return None
+        queue = self._admission_queue()
+        head = queue[0] if queue else None
+        if head is None or head.offloaded:
+            return None
+        sp = head.sampling_params
+        if sp.echo and sp.logprobs:
+            return None
+        return head
 
     def _extend_chunk_schedule(
         self, head: Sequence, first: PrefillPlan, buckets: List[int],
-        k_cap: int,
+        k_cap: int, budget: int,
     ) -> List[PrefillPlan]:
-        """Grow a window's chunk schedule past its (non-final) first
-        chunk, one ``_try_schedule_prefill`` chunk at a time — the SAME
-        bucket rule K=1 mixed stepping iterates, so the planned chunk
+        """Grow a window's chunk schedule past its first chunk, one
+        ``_try_schedule_prefill`` chunk at a time.
+
+        Single-head mode (--no-multi-prompt-window) iterates the SAME
+        bucket rule K=1 mixed stepping uses, so the planned chunk
         shapes (and therefore the compiled forwards, and the streams)
         are identical to the escape-hatch path.  Stops at ``k_cap``, at
-        pool pressure (the window ends non-final and the next window
-        continues), or when the K=1 rule would pick a DIFFERENT bucket
-        for the final chunk (one scan has ONE static chunk shape; the
-        mismatched final chunk runs as the next pass's K=1 mixed step
-        instead — bit-identical either way)."""
+        the head's final chunk, at pool pressure (the window ends
+        non-final and the next window continues), or when the K=1 rule
+        would pick a DIFFERENT bucket for the final chunk (one scan has
+        ONE static chunk shape; the mismatched final chunk runs as the
+        next pass's K=1 mixed step instead — bit-identical either way).
+
+        Packed mode keeps filling the window across prompts: a final
+        chunk admits its prompt, and the next iteration starts the next
+        packable head's cursor.  Every chunk after the first is FORCED
+        to the window's established bucket T — a chunk smaller than T
+        rides padded (pf_valid masks padding out of attention and the
+        tail-logit gather reads the last VALID row, so the compute is
+        bit-identical to the chunk's natural bucket) — which keeps the
+        scan shape static without ever rolling back committed plan
+        state when a prefix hit shrinks a chunk at planning time."""
         schedule = [first]
         T = first.bucket_len
-        budget_buckets = [b for b in buckets]
-        while len(schedule) < k_cap and not schedule[-1].is_final:
-            remaining = head.num_prompt_tokens - head.num_cached_tokens
-            fit = [b for b in budget_buckets if b >= remaining]
-            if fit and fit[0] != T:
-                break
-            nxt = self._try_schedule_prefill(
-                chunk_budget=self.config.batched_tokens_budget
-                - len(self.running)
-            )
+        packed = self.config.multi_prompt_window_enabled
+        while len(schedule) < k_cap:
+            if schedule[-1].is_final:
+                if not packed or self._next_packable_head() is None:
+                    break
+            if packed:
+                nxt = self._try_schedule_prefill(
+                    chunk_budget=budget, force_bucket=T
+                )
+            else:
+                remaining = head.num_prompt_tokens - head.num_cached_tokens
+                fit = [b for b in buckets if b >= remaining]
+                if fit and fit[0] != T:
+                    break
+                nxt = self._try_schedule_prefill(chunk_budget=budget)
             if nxt is None:
                 break
             schedule.append(nxt)
@@ -461,17 +541,29 @@ class Scheduler:
         world, chunk shapes included.  Returns None to fall back to the
         K=1 machinery (which owns preemption, restore, and the
         echo+logprobs special cases); a planned single-chunk outcome is
-        emitted in the K=1 shape directly (nothing to amortize)."""
+        emitted in the K=1 shape directly (nothing to amortize).
+
+        Packed mode (multi_prompt_window): K is no longer clamped by
+        queue depth — the adaptive clamp existed to re-evaluate
+        admission often, and a packed window IS the admission: a final
+        chunk mid-window admits its prompt and the next iteration
+        starts the next waiter's cursor, so deep queues fill the
+        window instead of shrinking it."""
         head = self._mixed_window_head()
         if head is None:
             return None
-        buckets = self._chunk_buckets_in_budget()
+        budget = self._chunk_token_budget(len(self.running))
+        buckets = self._chunk_buckets_in_budget(budget)
         if not buckets:
             return None
-        k_cap = min(
-            self.config.window_steps,
-            self.config.mixed_window_clamp(self.num_waiting),
-        )
+        packed = self.config.multi_prompt_window_enabled
+        if packed:
+            k_cap = self.config.window_steps
+        else:
+            k_cap = min(
+                self.config.window_steps,
+                self.config.mixed_window_clamp(self.num_waiting),
+            )
         if k_cap < 2:
             # Deep waiting queue: the adaptive clamp demands per-token
             # admission re-evaluation — today's K=1 behavior.
@@ -479,20 +571,20 @@ class Scheduler:
         # Multi-chunk precheck before committing any state: a head that
         # fits one chunk bucket admits completely in one K=1 mixed step
         # (a false positive from an unknown prefix hit just ends the
-        # window early at the final chunk).
+        # window early at the final chunk).  Packed windows keep going
+        # when OTHER waiters could fill the remaining iterations.
         remaining_max = head.num_prompt_tokens - (
             head.num_cached_tokens if head.partial_prefill else 0
         )
-        if remaining_max <= buckets[-1]:
+        if remaining_max <= buckets[-1] and (
+            not packed or self.num_waiting <= 1
+        ):
             return None
         decode = self._mixed_window_decode_plan(k_cap)
         if decode is None:
             return None
-        first = self._try_schedule_prefill(
-            chunk_budget=self.config.batched_tokens_budget
-            - len(decode.seqs)
-        )
-        if first is None or first.is_final:
+        first = self._try_schedule_prefill(chunk_budget=budget)
+        if first is None or (first.is_final and not packed):
             # Pool pressure / restore retry, or a prefix hit shrank the
             # prompt to one final chunk: emit the exact K=1 mixed shape
             # (decode blocks are over-allocated for the declined window
@@ -505,15 +597,18 @@ class Scheduler:
                     else "waiting_head"
                 ),
             )
-        schedule = self._extend_chunk_schedule(head, first, buckets, k_cap)
+        schedule = self._extend_chunk_schedule(
+            head, first, buckets, k_cap, budget
+        )
         k_eff = len(schedule)
         if k_eff == 1:
             # Couldn't extend (pool pressure / bucket-mismatched final
-            # chunk): the planned chunk runs as today's K=1 mixed step.
+            # chunk / nothing packable behind a final first chunk): the
+            # planned chunk runs as today's K=1 mixed step.
             self._recap_steps_k1(decode)
             return StepPlan(
                 decode=decode, prefill_chunk=first, decode_window=1,
-                window_fallback="waiting_head",
+                window_fallback=None if first.is_final else "waiting_head",
             )
         decode.steps = self._mixed_window_decode_steps(decode.seqs, k_eff)
         return StepPlan(
@@ -572,11 +667,17 @@ class Scheduler:
         return DecodePlan(seqs=list(self.running), steps=steps)
 
     def _try_schedule_prefill(
-        self, chunk_budget: Optional[int] = None
+        self, chunk_budget: Optional[int] = None,
+        force_bucket: Optional[int] = None,
     ) -> Optional[PrefillPlan]:
         """Plan one prefill step.  ``chunk_budget`` switches to mixed-step
         chunk mode: the padded length comes from ``prefill_chunk_buckets``
-        (not ``prefill_buckets``) and may not exceed the budget."""
+        (not ``prefill_buckets``) and may not exceed the budget.
+        ``force_bucket`` (packed windows) pins the padded chunk shape to
+        the window's established bucket — one scan has ONE static chunk
+        shape, and a chunk smaller than the bucket rides padded
+        (bit-identical: pf_valid masks padding and the tail-logit
+        gather reads the last valid row)."""
         if len(self.running) >= self.config.max_num_seqs:
             return None
         queue = self._admission_queue()
@@ -584,10 +685,13 @@ class Scheduler:
             return None
         seq = queue[0]
         if chunk_budget is not None:
-            chunk_buckets = [
-                b for b in self.config.prefill_chunk_buckets
-                if b <= chunk_budget
-            ]
+            if force_bucket is not None:
+                chunk_buckets = [force_bucket]
+            else:
+                chunk_buckets = [
+                    b for b in self.config.prefill_chunk_buckets
+                    if b <= chunk_budget
+                ]
             sp = seq.sampling_params
             if not chunk_buckets or (sp.echo and sp.logprobs):
                 # No chunk fits the budget, or the head needs the
@@ -762,22 +866,68 @@ class Scheduler:
         window = self.config.window_steps
         if window <= 1:
             return None
-        if len(self.running) != len(inflight_seqs) or any(
+        if len(self.running) < len(inflight_seqs) or any(
             a is not b for a, b in zip(self.running, inflight_seqs)
         ):
             return None
-        if not self.running:
+        parked = len(self.running) > len(inflight_seqs)
+        if parked:
+            # The in-flight window itself admitted prompts (packed
+            # final chunks pop into self.running at plan time).  Those
+            # rows have NO slot in the device carry yet — a chained
+            # MIXED window may keep streaming over the carried rows
+            # while the newcomers PARK for one window (their first
+            # token is already finalized at the in-flight window's
+            # collect; they join the batch at the next synchronous
+            # rebuild).  Only the packed planner creates this shape,
+            # and only when MORE packing work is waiting — otherwise
+            # break the pipeline so the parked rows join immediately
+            # (which also keeps the single-head seeded key-ordinal
+            # stream bit-identical to the K=1 path).
+            if not self.config.multi_prompt_window_enabled:
+                return None
+            if any(
+                seq.num_generated > 0
+                for seq in self.running[len(inflight_seqs):]
+            ):
+                return None  # not a parked admission: replan sync
+        if not inflight_seqs:
             return None
         if self.waiting or self.preempted:
-            return self._provisional_mixed_window(inflight_steps)
+            plan = self._provisional_mixed_window(inflight_steps)
+            if plan is not None:
+                return plan
+            if parked or not (
+                self.config.multi_prompt_window_enabled
+                and len(self.running) >= self.config.max_num_seqs
+            ):
+                return None
+            if any(
+                seq.remaining_budget <= prev_k
+                for seq, prev_k in zip(inflight_seqs, inflight_steps)
+            ):
+                # A row exhausts its output budget INSIDE the in-flight
+                # window: its slot frees at collect, so a chained pure
+                # window would decode a dead row for K steps while this
+                # waiting prompt could pack.  Break the pipeline; the
+                # synchronous replan sees the freed slot.
+                return None
+            # Packed mode with a slot-full batch: no admission is
+            # possible at this boundary no matter how it replans, so
+            # chain a full pure-decode window off the carry instead of
+            # breaking the pipeline into K=1 waiting_head steps
+            # (mirrors _window_for_pass's slot-full rule).
+        elif parked:
+            return None  # nothing left to pack: rebuild with the rows
         bs = self.block_pool.block_size
         # Per-window per-row token ceiling: K x (ngram + 1) under the
         # fused speculative window at max acceptance (all-greedy batch),
         # K otherwise.
         max_tok = self._window_token_cap(window)
+        rows = self.running[: len(inflight_seqs)]
         steps: List[int] = []
         needs: List[int] = []
-        for seq, prev_k in zip(self.running, inflight_steps):
+        for seq, prev_k in zip(rows, inflight_steps):
             # The in-flight window will (optimistically) land its whole
             # prev_k token budget before this one runs (full acceptance
             # under speculation; the device carry keeps the real count
@@ -795,11 +945,11 @@ class Scheduler:
         total = sum(needs)
         if total and not self.block_pool.can_allocate(total):
             return None
-        for seq, need in zip(self.running, needs):
+        for seq, need in zip(rows, needs):
             if need:
                 seq.block_table.extend(self.block_pool.allocate(need))
         return StepPlan(
-            decode=DecodePlan(seqs=list(self.running), steps=steps),
+            decode=DecodePlan(seqs=list(rows), steps=steps),
             decode_window=window,
             provisional=True,
         )
@@ -821,60 +971,71 @@ class Scheduler:
         head = self._mixed_window_head()
         if head is None:
             return None
-        buckets = self._chunk_buckets_in_budget()
+        # The chained scan's decode batch is the device CARRY's row set
+        # (parked admissions from the in-flight window have no slot
+        # yet), so the chunk budget and decode planning cover exactly
+        # those rows.
+        rows = self.running[: len(inflight_steps)]
+        budget = self._chunk_token_budget(len(rows))
+        buckets = self._chunk_buckets_in_budget(budget)
         if not buckets:
             return None
-        k_cap = min(
-            cfg.window_steps, cfg.mixed_window_clamp(self.num_waiting)
-        )
+        packed = cfg.multi_prompt_window_enabled
+        if packed:
+            k_cap = cfg.window_steps
+        else:
+            k_cap = min(
+                cfg.window_steps, cfg.mixed_window_clamp(self.num_waiting)
+            )
         # Single-chunk heads decline (pipeline break -> the sync K=1
         # mixed step admits them whole): a 1-iteration scan would mint
         # a whole executable variant for zero amortization.  A prefix
         # hit discovered at chunk planning can still shrink a
         # multi-chunk head to one final chunk — that rare case emits
         # the 1-iteration window below rather than rolling back
-        # committed plan state.
+        # committed plan state.  Packed windows keep chaining when
+        # OTHER waiters could fill the remaining iterations.
         remaining_max = head.num_prompt_tokens - (
             head.num_cached_tokens if head.partial_prefill else 0
         )
-        if remaining_max <= buckets[-1]:
+        if remaining_max <= buckets[-1] and (
+            not packed or self.num_waiting <= 1
+        ):
             return None
         bs = self.block_pool.block_size
         bases = [
             (seq.num_tokens + prev_k, seq.num_generated + prev_k)
-            for seq, prev_k in zip(self.running, inflight_steps)
+            for seq, prev_k in zip(rows, inflight_steps)
         ]
         steps = self._mixed_window_decode_steps(
-            self.running, k_cap, bases=bases
+            rows, k_cap, bases=bases
         )
         needs = []
-        for (base_tokens, _), k, seq in zip(bases, steps, self.running):
+        for (base_tokens, _), k, seq in zip(bases, steps, rows):
             slots = base_tokens + k - 1
             needs.append(max(0, -(-slots // bs) - len(seq.block_table)))
         total = sum(needs)
         if total and not self.block_pool.can_allocate(total):
             return None
-        for seq, need in zip(self.running, needs):
+        for seq, need in zip(rows, needs):
             if need:
                 seq.block_table.extend(self.block_pool.allocate(need))
         # Snapshot BEFORE chunk planning: a final chunk pops the head
         # into self.running at plan time, and the popped head has no
         # decode row in THIS window (it joins at the next boundary).
-        decode_seqs = list(self.running)
-        first = self._try_schedule_prefill(
-            chunk_budget=cfg.batched_tokens_budget - len(decode_seqs)
-        )
+        decode_seqs = list(rows)
+        first = self._try_schedule_prefill(chunk_budget=budget)
         if first is None:
             # Nothing chunkable (pool pressure / restore retry): break
             # the pipeline so the sync pass re-evaluates at K=1.  The
             # decode blocks above stay in the block tables and back the
             # replanned step.
             return None
-        if first.is_final:
+        if first.is_final and not packed:
             schedule = [first]
         else:
             schedule = self._extend_chunk_schedule(
-                head, first, buckets, k_cap
+                head, first, buckets, k_cap, budget
             )
         k_eff = len(schedule)
         return StepPlan(

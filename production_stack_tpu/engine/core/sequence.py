@@ -148,6 +148,17 @@ class Sequence:
         return self.outputs_absorbed + len(self.output_token_ids)
 
     @property
+    def remaining_budget(self) -> int:
+        """Output tokens this request may still generate (max_tokens
+        minus generated; model-length limits and stop conditions may
+        end it sooner).  The window planners use this as the earliest
+        step a batch slot could free: a slot-full pure window under
+        waiting pressure ends where the first row's budget runs out,
+        so admission re-evaluates the moment packing becomes possible
+        again."""
+        return max(0, self.sampling_params.max_tokens - self.num_generated)
+
+    @property
     def is_finished(self) -> bool:
         return self.status == SequenceStatus.FINISHED
 

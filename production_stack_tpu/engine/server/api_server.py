@@ -29,6 +29,7 @@ from production_stack_tpu.engine.server.async_engine import (
     AsyncEngine,
     DeadlineExceeded,
 )
+from production_stack_tpu.obs.histogram import render_histogram
 from production_stack_tpu.obs.trace import parse_traceparent
 from production_stack_tpu.router.stats import vocabulary as vocab
 from production_stack_tpu.utils.drain import DrainController
@@ -376,6 +377,11 @@ def build_engine_app(
             (vocab.TPU_PREFILL_CHUNK_TOKENS, s["prefill_chunk_tokens"]),
             (vocab.TPU_MIXED_WINDOW_CHUNK_TOKENS,
              s["mixed_window_chunk_tokens"]),
+            # Overlapped window dispatch: transfer seconds issued while
+            # the device was busy with an in-flight window (H2D chunk
+            # staging for chained windows + D2H offload gathers).
+            (vocab.TPU_WINDOW_TRANSFER_OVERLAP_SECONDS,
+             s["window_transfer_overlap_seconds"]),
             # Overload protection + step-loop watchdog (docs/robustness.md).
             (vocab.TPU_ADMISSION_REJECTED, s["admission_rejected_total"]),
             (vocab.TPU_DEADLINE_EXPIRED, s["deadline_expired_total"]),
@@ -448,6 +454,13 @@ def build_engine_app(
                     **dict.fromkeys(vocab.TPU_LOCKSTEP_FAILURE_REASONS, 0),
                     **({} if monitor is None else monitor.member_failures),
                 },
+            )
+            # Packed multi-prompt windows: how many distinct prompts'
+            # chunks rode each mixed K-step window (mass above bucket 1
+            # is queue depth converted into device utilization).
+            + render_histogram(
+                vocab.TPU_MIXED_WINDOW_PROMPTS,
+                engine.engine.mixed_window_prompts_hist,
             )
             + engine.engine.obs.render_metrics()
         )
@@ -2006,6 +2019,14 @@ def main(argv=None) -> None:
         '{reason="waiting_head"} — A/B baseline / debugging',
     )
     parser.add_argument(
+        "--no-multi-prompt-window",
+        action="store_true",
+        help="disable multi-prompt packing inside mixed K-step windows "
+        "and restore the single-head window planner exactly (one "
+        "waiting prompt's chunks per window, adaptive K-halving clamp "
+        "under deep queues) — A/B baseline / debugging",
+    )
+    parser.add_argument(
         "--max-num-batched-tokens",
         type=int,
         default=None,
@@ -2177,6 +2198,10 @@ def main(argv=None) -> None:
             **(
                 {"scheduler.mixed_window": False}
                 if args.no_mixed_window else {}
+            ),
+            **(
+                {"scheduler.multi_prompt_window": False}
+                if args.no_multi_prompt_window else {}
             ),
             **(
                 {"scheduler.max_num_batched_tokens": args.max_num_batched_tokens}

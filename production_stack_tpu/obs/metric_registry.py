@@ -218,6 +218,23 @@ REGISTRY = {
                 "the subset of tpu:prefill_chunk_tokens that paid no "
                 "per-chunk host round-trip",
     },
+    "tpu:mixed_window_prompts_per_window": {
+        "kind": "histogram", "layer": "engine",
+        "mirrors": ("fake_engine", "dashboard", "docs"),
+        "help": "Distinct prompts whose chunks rode each mixed K-step "
+                "window (packed multi-prompt windows) — mass above "
+                "bucket 1 is queue depth converted into device "
+                "utilization",
+    },
+    "tpu:window_transfer_overlap_seconds_total": {
+        "kind": "counter", "layer": "engine",
+        "mirrors": ("fake_engine", "dashboard", "docs"),
+        "help": "Seconds of host<->device transfer work issued while "
+                "the device was busy with an in-flight window (H2D "
+                "chunk staging for chained windows + D2H offload "
+                "gathers under the scan) — stalls the overlap dispatch "
+                "avoided",
+    },
     "tpu:spec_window_tokens_total": {
         "kind": "counter", "layer": "engine", "labels": ("outcome",),
         "mirrors": ("fake_engine", "dashboard", "docs"),

@@ -181,6 +181,21 @@ TPU_MULTISTEP_WASTED_TOKENS = "tpu:multistep_wasted_tokens_total"
 # round-trip.  Its ratio to tpu:prefill_chunk_tokens is the window
 # coverage of sustained-arrival prefill traffic.
 TPU_MIXED_WINDOW_CHUNK_TOKENS = "tpu:mixed_window_chunk_tokens_total"
+# Packed multi-prompt windows (scheduler multi_prompt_window): distinct
+# prompts whose chunks rode EACH mixed K-step window, as a histogram —
+# the packing depth.  A mass at bucket 1 under queue depth means the
+# packed path is not engaging (flag off, or per-window admission
+# declining); mass in the >1 buckets is queue depth being converted
+# into device utilization.
+TPU_MIXED_WINDOW_PROMPTS = "tpu:mixed_window_prompts_per_window"
+# Seconds of host<->device transfer work issued while the device was
+# BUSY with an in-flight window — H2D chunk staging for chained windows
+# and D2H offload gathers dispatched under the scan.  Each second here
+# is a stall the overlap-everything dispatch avoided; compare its rate
+# to wall time for the overlap duty-cycle.
+TPU_WINDOW_TRANSFER_OVERLAP_SECONDS = (
+    "tpu:window_transfer_overlap_seconds_total"
+)
 # Disaggregated prefill/decode serving (docs/engine.md "Disaggregated
 # data path"): prefill-phase prime completions served (the handoff
 # producer side), and decode-phase handoff prefetch outcomes — a hit
@@ -238,6 +253,7 @@ TPU_COUNTERS = frozenset({
     TPU_DEADLINE_EXPIRED,
     TPU_MULTISTEP_WASTED_TOKENS,
     TPU_MIXED_WINDOW_CHUNK_TOKENS,
+    TPU_WINDOW_TRANSFER_OVERLAP_SECONDS,
     TPU_DISAGG_PREFILL_PRIMES,
     TPU_DISAGG_HANDOFF_HITS,
     TPU_DISAGG_HANDOFF_MISSES,

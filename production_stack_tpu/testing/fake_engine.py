@@ -25,6 +25,7 @@ import uuid
 from aiohttp import web
 
 from production_stack_tpu.obs.engine import EngineObs
+from production_stack_tpu.obs.histogram import Histogram, render_histogram
 from production_stack_tpu.obs.trace import parse_traceparent
 from production_stack_tpu.router.stats import vocabulary as vocab
 
@@ -470,6 +471,10 @@ def build_fake_engine_app(state: FakeEngineState | None = None) -> web.Applicati
             # must exist so the scrape contract matches the real engine.
             (vocab.TPU_PREFILL_CHUNK_TOKENS, 0),
             (vocab.TPU_MIXED_WINDOW_CHUNK_TOKENS, 0),
+            # Overlapped window dispatch: no device, so no transfers ever
+            # overlap a window — zero, but the family must exist
+            # (tpu:mixed_window_prompts_per_window renders below).
+            (vocab.TPU_WINDOW_TRANSFER_OVERLAP_SECONDS, 0.0),
             # Async KV transfer plane: the fake engine has no remote
             # store, but the families must exist for the scrape contract
             # (obs.render_metrics below adds the matching
@@ -509,6 +514,12 @@ def build_fake_engine_app(state: FakeEngineState | None = None) -> web.Applicati
         ) + vocab.render_labeled_counter(
             vocab.TPU_KV_SNAPSHOT_FORMAT, "version",
             dict.fromkeys(vocab.TPU_KV_SNAPSHOT_VERSIONS, 0),
+        ) + render_histogram(
+            # Packed multi-prompt windows: the fake engine never packs
+            # (no device scan), so the histogram is empty — but the
+            # family must exist for the scrape contract (SC303).
+            vocab.TPU_MIXED_WINDOW_PROMPTS,
+            Histogram(bounds=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0)),
         ) + vocab.render_prometheus([
             # Slice-group lifecycle: live values in slice mode so the
             # whole group-liveness contract (epoch steps on restart,

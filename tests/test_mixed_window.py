@@ -187,10 +187,12 @@ def test_longer_prompt_chunks_across_chained_windows():
 
 
 def test_deep_queue_clamps_to_k1():
-    """The adaptive clamp: 3 extra waiters -> clamp 1 -> today's K=1
-    mixed step, counted as a waiting_head fallback (TTFT of the extra
-    waiters never regresses more than one window's worth)."""
-    sched, _ = _scheduler()
+    """The adaptive clamp (--no-multi-prompt-window single-head path):
+    3 extra waiters -> clamp 1 -> today's K=1 mixed step, counted as a
+    waiting_head fallback (TTFT of the extra waiters never regresses
+    more than one window's worth).  The packed default retires this
+    clamp — test_packed_window_* cover that path."""
+    sched, _ = _scheduler(multi_prompt_window=False)
     run = Sequence("run", list(RUN_PROMPT), SamplingParams(max_tokens=64))
     sched.add_seq(run)
     sched.schedule()
@@ -498,12 +500,15 @@ def test_k1_fallback_respects_spec_budget_block_invariant():
     legacy host-side speculative path is ngram+1 tokens, MORE than the
     clamp-bounded window allocation (the speculative dispatch indexes
     the table for its whole budget; a short table is a step-thread
-    crash)."""
+    crash).  Single-head path: the packed default would extend past
+    the bucket-mismatched final chunk (forced-bucket ride-along)
+    instead of falling back."""
     pool = BlockPool(num_blocks=256, block_size=4)
     cfg = SchedulerConfig(
         max_num_seqs=8, prefill_buckets=(16, 32, 64),
         prefill_chunk_buckets=(16, 32), max_model_len=512,
         decode_window=8, speculative_ngram=3, pipeline_decode=False,
+        multi_prompt_window=False,
     )
     sched = Scheduler(cfg, pool)
     run = Sequence("run", list(RUN_PROMPT), SamplingParams(max_tokens=64))
@@ -532,6 +537,356 @@ def test_k1_fallback_respects_spec_budget_block_invariant():
         # The speculative budget survived (blocks were topped up, not
         # the budget trimmed — the pool has room).
         assert k == 4
+
+
+# -- packed multi-prompt windows (SchedulerConfig.multi_prompt_window) ------
+
+
+def test_multi_prompt_window_default_on_and_gate():
+    cfg = SchedulerConfig()
+    assert cfg.multi_prompt_window_enabled
+    assert not SchedulerConfig(
+        multi_prompt_window=False).multi_prompt_window_enabled
+    # Packing rides the window machinery: no mixed windows, no packing.
+    assert not SchedulerConfig(
+        mixed_window=False).multi_prompt_window_enabled
+    assert not SchedulerConfig(
+        multi_step_window=False).multi_prompt_window_enabled
+    # A directly contradictory explicit combo refuses loudly.
+    with pytest.raises(ValueError, match="multi_prompt_window"):
+        SchedulerConfig(multi_prompt_window=True, mixed_window=False)
+
+
+def test_packed_window_plans_multiple_prompts():
+    """Three 2-chunk waiters pack back-to-back into ONE window: each
+    final chunk admits its prompt mid-schedule and the next iteration
+    starts the next waiter's cursor — no K-halving clamp, no
+    waiting_head fallback."""
+    sched, _ = _scheduler()
+    run = Sequence("run", list(RUN_PROMPT), SamplingParams(max_tokens=64))
+    sched.add_seq(run)
+    sched.schedule()
+    run.output_token_ids.append(1)
+    for i in range(3):
+        sched.add_seq(Sequence(
+            f"w{i}", [(3 * j + i) % 97 for j in range(32)],
+            SamplingParams(max_tokens=8),
+        ))
+    plan = sched.schedule()
+    assert plan.chunk_schedule is not None
+    assert plan.window_fallback is None
+    # 3 waiters x 2 chunks of 16 = 6 iterations, then slots are full
+    # (max_num_seqs=4: run + 3 admitted) so the window ends at 6 < 8.
+    assert len(plan.chunk_schedule) == 6
+    by_seq = [cp.seq.seq_id for cp in plan.chunk_schedule]
+    assert by_seq == ["w0", "w0", "w1", "w1", "w2", "w2"]
+    finals = [cp.is_final for cp in plan.chunk_schedule]
+    assert finals == [False, True] * 3
+    # All three prompts admitted at plan time; decode budget covers the
+    # whole window for the pre-existing row.
+    assert {s.seq_id for s in sched.running} == {"run", "w0", "w1", "w2"}
+    assert plan.decode.steps == [6]
+
+
+def test_packed_window_forces_first_chunk_bucket():
+    """After the first chunk establishes bucket T, every later chunk in
+    the window rides at T — a bucket-mismatched final chunk (the PR-15
+    K=1 fallback trigger) PACKS instead: is_final with num_new <= T and
+    padded rows masked by valid_len."""
+    sched, _ = _scheduler(prefill_chunk_buckets=(16, 32))
+    run = Sequence("run", list(RUN_PROMPT), SamplingParams(max_tokens=64))
+    sched.add_seq(run)
+    sched.schedule()
+    run.output_token_ids.append(1)
+    # 40 tokens: chunk 1 at bucket 32 (non-final), remaining 8 would
+    # naturally pick bucket 16 != 32 — forced to ride at 32.
+    sched.add_seq(Sequence("head", list(range(40)),
+                           SamplingParams(max_tokens=8)))
+    sched.add_seq(Sequence("next", list(range(40)),
+                           SamplingParams(max_tokens=8)))
+    plan = sched.schedule()
+    assert plan.chunk_schedule is not None
+    assert plan.window_fallback is None
+    assert {cp.bucket_len for cp in plan.chunk_schedule} == {32}
+    head_chunks = [cp for cp in plan.chunk_schedule
+                   if cp.seq.seq_id == "head"]
+    assert [cp.num_new_tokens for cp in head_chunks] == [32, 8]
+    assert head_chunks[-1].is_final
+    # The next waiter's chunks ride the same window at the same bucket.
+    assert any(cp.seq.seq_id == "next" for cp in plan.chunk_schedule)
+
+
+def test_no_multi_prompt_window_restores_single_head_plans():
+    """--no-multi-prompt-window is an exact single-head restore: with
+    ONE waiter the packed and unpacked planners emit identical plans
+    pass-by-pass (packing is a no-op at P=1); with a deep queue the
+    unpacked planner clamps and never packs a second prompt."""
+
+    def fingerprint(plan):
+        fp = {
+            "window": plan.decode_window,
+            "fallback": plan.window_fallback,
+        }
+        if plan.decode is not None:
+            fp["decode"] = (
+                [s.seq_id for s in plan.decode.seqs],
+                list(plan.decode.steps),
+            )
+        if plan.prefill_chunk is not None:
+            cp = plan.prefill_chunk
+            fp["chunk"] = (cp.seq.seq_id, cp.bucket_len, cp.cached_len,
+                           cp.num_new_tokens, cp.is_final)
+        if plan.chunk_schedule is not None:
+            fp["sched"] = [
+                (cp.seq.seq_id, cp.bucket_len, cp.cached_len,
+                 cp.num_new_tokens, cp.is_final)
+                for cp in plan.chunk_schedule
+            ]
+        return fp
+
+    def script(sched):
+        run = Sequence("run", list(RUN_PROMPT),
+                       SamplingParams(max_tokens=64))
+        sched.add_seq(run)
+        plans = [sched.schedule()]
+        run.output_token_ids.append(1)
+        sched.add_seq(Sequence("wait", list(LONG_PROMPT),
+                               SamplingParams(max_tokens=8)))
+        for _ in range(4):
+            plan = sched.schedule()
+            plans.append(plan)
+            if plan.decode is not None:
+                for seq, k in zip(plan.decode.seqs, plan.decode.steps):
+                    seq.output_token_ids.extend([1] * max(k, 1))
+            for seq in sched.running:  # simulate first-token finalize
+                if not seq.output_token_ids:
+                    seq.output_token_ids.append(1)
+        return [fingerprint(p) for p in plans]
+
+    packed = script(_scheduler()[0])
+    unpacked = script(_scheduler(multi_prompt_window=False)[0])
+    assert packed == unpacked
+    # Deep queue: the unpacked planner clamps (never >1 distinct prompt
+    # per window) while the packed planner packs several.
+    for kw, expect_packed in ((dict(), True),
+                              (dict(multi_prompt_window=False), False)):
+        sched, _ = _scheduler(**kw)
+        run = Sequence("run", list(RUN_PROMPT),
+                       SamplingParams(max_tokens=64))
+        sched.add_seq(run)
+        sched.schedule()
+        run.output_token_ids.append(1)
+        for i in range(3):
+            sched.add_seq(Sequence(
+                f"w{i}", [(3 * j + i) % 97 for j in range(32)],
+                SamplingParams(max_tokens=8),
+            ))
+        plan = sched.schedule()
+        if expect_packed:
+            assert plan.chunk_schedule is not None
+            distinct = {cp.seq.seq_id for cp in plan.chunk_schedule}
+            assert len(distinct) > 1
+        else:
+            distinct = {
+                cp.seq.seq_id for cp in (plan.chunk_schedule or [])
+            } | ({plan.prefill_chunk.seq.seq_id}
+                 if plan.prefill_chunk is not None else set())
+            assert len(distinct) <= 1
+
+
+def test_packed_planning_budget_is_o1_in_queue_depth():
+    """The chunk-token budget is computed ONCE per scheduler pass no
+    matter how many waiters the packed planner walks (the PR-15 code
+    recomputed it per chunk; over 16 waiters that was O(K) redundant
+    passes over the running set)."""
+    deltas = {}
+    for n_wait in (2, 16):
+        sched, _ = _scheduler(max_num_seqs=20)
+        run = Sequence("run", list(RUN_PROMPT),
+                       SamplingParams(max_tokens=64))
+        sched.add_seq(run)
+        sched.schedule()
+        run.output_token_ids.append(1)
+        for i in range(n_wait):
+            sched.add_seq(Sequence(
+                f"w{i}", list(LONG_PROMPT), SamplingParams(max_tokens=8)
+            ))
+        before = sched.budget_computations
+        plan = sched.schedule()
+        assert plan.chunk_schedule is not None
+        assert len({cp.seq.seq_id for cp in plan.chunk_schedule}) >= 2
+        deltas[n_wait] = sched.budget_computations - before
+    assert deltas[16] == deltas[2] == 1, deltas
+
+
+def test_packed_greedy_parity_grid():
+    """Packed greedy parity over {P=1, P=4} x {K=1, K=8}: byte-identical
+    streams whether prompts arrive one at a time or four at once, with
+    windows on or the K=1 escape hatch — greedy sampling is a pure
+    per-row function of context, packing only changes the schedule."""
+    prompts = {
+        f"p{i}": [(3 * j + 7 * i + 1) % 97 for j in range(32)]
+        for i in range(4)
+    }
+
+    def run_grid(mixed_window, burst):
+        eng = make_engine(mixed_window, max_num_seqs=6)
+        eng.add_request(
+            "a", prompt_token_ids=list(RUN_PROMPT),
+            sampling_params=SamplingParams(max_tokens=40, ignore_eos=True),
+        )
+        outs = {}
+        sent = 0
+        steps = 0
+        while eng.has_unfinished():
+            steps += 1
+            assert steps < 2000
+            for out in eng.step():
+                outs.setdefault(out.seq_id, []).append(out.new_token_id)
+            if sent < 4 and len(outs.get("a", [])) >= 5:
+                n = 4 if burst else 1
+                for _ in range(n):
+                    if sent < 4:
+                        rid = f"p{sent}"
+                        eng.add_request(
+                            rid, prompt_token_ids=list(prompts[rid]),
+                            sampling_params=SamplingParams(
+                                max_tokens=8, ignore_eos=True))
+                        sent += 1
+        return outs
+
+    ref = run_grid(mixed_window=False, burst=False)
+    for mixed_window in (True, False):
+        for burst in (True, False):
+            got = run_grid(mixed_window, burst)
+            assert got == ref, (
+                f"greedy divergence mixed_window={mixed_window} "
+                f"burst={burst}"
+            )
+
+
+def test_abort_one_packed_prompt_mid_window():
+    """Aborting ONE of the prompts packed into an in-flight window:
+    its chunk tokens are counted as waste and its finalize is skipped,
+    while the other packed prompt's stream is untouched (same tokens a
+    run without the aborted prompt produces)."""
+    def script(include_b):
+        eng = make_engine(True, max_num_seqs=6)
+        # Budget outlasts the warm windows below: "a" must still be
+        # decoding when b/c arrive, or no mixed window can form.
+        eng.add_request(
+            "a", prompt_token_ids=list(RUN_PROMPT),
+            sampling_params=SamplingParams(max_tokens=64, ignore_eos=True),
+        )
+        for _ in range(4):
+            eng.step()
+        while eng.has_pending():
+            eng.collect()
+        if include_b:
+            eng.add_request(
+                "b", prompt_token_ids=[(5 * j + 2) % 89 for j in range(32)],
+                sampling_params=SamplingParams(
+                    max_tokens=8, ignore_eos=True))
+        eng.add_request(
+            "c", prompt_token_ids=[(7 * j + 3) % 89 for j in range(32)],
+            sampling_params=SamplingParams(max_tokens=8, ignore_eos=True))
+        return eng
+
+    eng = script(include_b=True)
+    assert eng.dispatch()
+    packed = [p for p in eng._pending if p.chunk_sched is not None]
+    assert packed, "packed window did not dispatch"
+    in_window = {cp.seq.seq_id for p in packed for cp in p.chunk_sched}
+    assert {"b", "c"} <= in_window, in_window
+    b_tokens = sum(
+        cp.num_new_tokens
+        for p in packed for cp in p.chunk_sched
+        if cp.seq.seq_id == "b"
+    )
+    wasted0 = eng.multistep_wasted_tokens
+    eng.abort_request("b")
+    outs = {}
+    while eng.has_unfinished():
+        for out in eng.step():
+            outs.setdefault(out.seq_id, []).append(out.new_token_id)
+    assert "b" not in outs
+    assert eng.multistep_wasted_tokens - wasted0 >= b_tokens
+    assert len(outs["c"]) == 8
+
+    ref_eng = script(include_b=False)
+    ref = {}
+    while ref_eng.has_unfinished():
+        for out in ref_eng.step():
+            ref.setdefault(out.seq_id, []).append(out.new_token_id)
+    assert outs["c"] == ref["c"], "abort of b perturbed packed peer c"
+
+
+def test_overlap_staging_counts_and_preserves_parity():
+    """Chained-window H2D staging runs while the device is busy (the
+    overlap counter ticks) and the double-buffered staging never
+    corrupts an in-flight window's payload — greedy streams stay
+    byte-identical to the unpipelined K=1 path."""
+    eng = make_engine(True)
+    got = run_midstream(eng)
+    assert eng.window_transfer_overlap_s > 0, (
+        "no H2D staging overlapped an in-flight window"
+    )
+    ref = run_midstream(make_engine(False))
+    assert got == ref
+
+
+def test_offload_gather_under_inflight_window_counts_overlap():
+    """The D2H half of overlap dispatch: an async offload gather
+    dispatched while a window is in flight rides the alternate stream
+    (counted as avoided stall) and never observes a half-written window
+    carry — the in-flight window's collected stream is unchanged."""
+    def build():
+        eng = LLMEngine(EngineConfig(
+            model=ModelConfig(dtype="float32"),
+            cache=CacheConfig(
+                block_size=4, num_blocks=160, host_offload_gb=0.05),
+            scheduler=SchedulerConfig(
+                max_num_seqs=2,
+                prefill_buckets=(16, 32, 64, 128),
+                prefill_chunk_buckets=(16,),
+                max_model_len=256,
+            ),
+        ))
+        # Budget outlasts the warm windows: "a" must still be decoding
+        # when "b" arrives, or no mixed window can form.
+        eng.add_request(
+            "a", prompt_token_ids=list(RUN_PROMPT),
+            sampling_params=SamplingParams(max_tokens=64, ignore_eos=True),
+        )
+        for _ in range(4):
+            eng.step()
+        while eng.has_pending():
+            eng.collect()
+        eng.add_request(
+            "b", prompt_token_ids=list(LONG_PROMPT),
+            sampling_params=SamplingParams(max_tokens=8, ignore_eos=True))
+        assert eng.dispatch()
+        assert any(p.chunk_sched is not None for p in eng._pending)
+        return eng
+
+    eng = build()
+    seq_a = next(s for s in eng.scheduler.running if s.seq_id == "a")
+    before = eng.window_transfer_overlap_s
+    assert eng.offload_seq_blocks(seq_a, list(seq_a.block_table)[:2])
+    assert eng.window_transfer_overlap_s > before, (
+        "in-flight D2H gather not counted as overlap"
+    )
+    outs = {}
+    while eng.has_unfinished():
+        for out in eng.step():
+            outs.setdefault(out.seq_id, []).append(out.new_token_id)
+
+    ref_eng = build()
+    ref = {}
+    while ref_eng.has_unfinished():
+        for out in ref_eng.step():
+            ref.setdefault(out.seq_id, []).append(out.new_token_id)
+    assert outs == ref, "mid-flight offload gather perturbed the window"
 
 
 # -- compat-shim retirement -------------------------------------------------

@@ -342,6 +342,25 @@ async def test_histogram_families_on_both_metrics():
     assert "tpu:decode_host_gap_ms" in engine_text
 
 
+async def test_mixed_window_families_on_engine_metrics():
+    """The packed mixed-window families ride the engine scrape contract
+    together: the prompts-per-window histogram renders (stable family
+    header even at zero observations) next to the chunk-token and
+    transfer-overlap counters, so dashboards keying the packing panel
+    never see a partial family set."""
+    from production_stack_tpu.router.stats import vocabulary as vocab
+
+    engine_text = await scrape_engine_metrics()
+    for family in (
+        vocab.TPU_MIXED_WINDOW_CHUNK_TOKENS,
+        vocab.TPU_WINDOW_TRANSFER_OVERLAP_SECONDS,
+    ):
+        assert f"# TYPE {family} counter" in engine_text, family
+    hist_family = vocab.TPU_MIXED_WINDOW_PROMPTS
+    assert f"# TYPE {hist_family} histogram" in engine_text
+    assert f"{hist_family}_count" in engine_text
+
+
 async def test_engine_debug_requests_real_engine():
     """The REAL JAX engine records a per-request span timeline: queue,
     prefill, decode, detokenize — served at /debug/requests/{id}."""
