@@ -2597,7 +2597,9 @@ def run_trace_report(num_requests: int = 12, max_tokens: int = 16) -> dict:
             build_fake_engine_app,
         )
 
-        state = FakeEngineState(tokens_per_sec=400.0, ttft=0.02)
+        state = FakeEngineState(
+            tokens_per_sec=400.0, ttft=0.02, simulate_compiles=True,
+        )
         engine_server = TestServer(build_fake_engine_app(state))
         await engine_server.start_server()
         backend = str(engine_server.make_url("")).rstrip("/")
@@ -2611,18 +2613,31 @@ def run_trace_report(num_requests: int = 12, max_tokens: int = 16) -> dict:
         client = TestClient(router_server)
         try:
             ids = []
+            ttfts = []        # (seconds, compile_tainted) per request
             for i in range(num_requests):
                 rid = f"trace-bench-{i}"
+                t0 = time.perf_counter()
                 resp = await client.post(
                     "/v1/completions",
                     json={"model": state.model, "prompt": f"probe {i}",
                           "max_tokens": max_tokens, "stream": True},
                     headers={"x-request-id": rid},
                 )
-                await resp.read()
+                first_s = None
+                tainted = False
+                async for chunk in resp.content.iter_any():
+                    if first_s is None:
+                        first_s = time.perf_counter() - t0
+                        # The engine stamps compile taint into the first
+                        # SSE chunk (same sniff the router's stats
+                        # monitor uses for its compile-excluded window).
+                        tainted = (b'"compile": true' in chunk
+                                   or b'"compile":true' in chunk)
+                ttfts.append((first_s or 0.0, tainted))
                 ids.append(rid)
             phases: dict = {}
             totals = []
+            window_rows = []
             for rid in ids:
                 resp = await client.get(f"/debug/requests/{rid}")
                 if resp.status != 200:
@@ -2631,7 +2646,47 @@ def run_trace_report(num_requests: int = 12, max_tokens: int = 16) -> dict:
                 totals.append(joined["total_s"])
                 for name, dur in joined["phase_s"].items():
                     phases.setdefault(name, []).append(dur)
+            resp = await client.session.get(f"{backend}/debug/windows")
+            if resp.status == 200:
+                window_rows = (await resp.json()).get("windows", [])
             report = {"requests": len(totals)}
+            raw = sorted(s for s, _ in ttfts)
+            clean = sorted(s for s, tainted in ttfts if not tainted)
+
+            def pct(sorted_vals, q):
+                if not sorted_vals:
+                    return 0.0
+                idx = min(len(sorted_vals) - 1,
+                          int(q * (len(sorted_vals) - 1) + 0.5))
+                return sorted_vals[idx]
+
+            # Raw vs compile-excluded TTFT: the gap IS the XLA compile
+            # cost the first-chunk marker attributed — on the fake, the
+            # cold pow2 prompt bucket's first request carries it.
+            report["ttft"] = {
+                "p50_ms": round(pct(raw, 0.50) * 1e3, 2),
+                "p95_ms": round(pct(raw, 0.95) * 1e3, 2),
+                "clean_p50_ms": round(pct(clean, 0.50) * 1e3, 2),
+                "clean_p95_ms": round(pct(clean, 0.95) * 1e3, 2),
+                "compile_tainted": sum(1 for _, t in ttfts if t),
+            }
+            if window_rows:
+                ks = [w.get("k", 1) for w in window_rows]
+                delivered = sum(
+                    w.get("tokens_delivered", 0) for w in window_rows)
+                chunk_tok = sum(
+                    w.get("chunk_tokens_delivered", 0) for w in window_rows)
+                depth_hist: dict = {}
+                for w in window_rows:
+                    d = str(w.get("chain_depth", 0))
+                    depth_hist[d] = depth_hist.get(d, 0) + 1
+                report["windows"] = {
+                    "count": len(window_rows),
+                    "mean_k": round(sum(ks) / len(ks), 2),
+                    "chunk_token_share": round(
+                        chunk_tok / max(1, delivered + chunk_tok), 3),
+                    "chain_depth_hist": dict(sorted(depth_hist.items())),
+                }
             if totals:
                 mean_total = sum(totals) / len(totals)
                 report["mean_total_ms"] = round(mean_total * 1e3, 2)
@@ -2651,6 +2706,18 @@ def run_trace_report(num_requests: int = 12, max_tokens: int = 16) -> dict:
                 for name, row in table.items():
                     log(f"  {name:<24} {row['mean_ms']:>9.3f} "
                         f"{row['max_ms']:>9.3f} {row['share']:>6.1%}")
+            t = report["ttft"]
+            log("trace report: ttft "
+                f"p50={t['p50_ms']}ms p95={t['p95_ms']}ms | "
+                f"compile-excluded p50={t['clean_p50_ms']}ms "
+                f"p95={t['clean_p95_ms']}ms "
+                f"({t['compile_tainted']} tainted)")
+            if "windows" in report:
+                w = report["windows"]
+                log("trace report: window composition "
+                    f"n={w['count']} mean_k={w['mean_k']} "
+                    f"chunk_token_share={w['chunk_token_share']} "
+                    f"chain_depth_hist={w['chain_depth_hist']}")
             return report
         finally:
             await client.close()

@@ -764,10 +764,24 @@ class ObsConfig:
     tracing: bool = True
     # Completed request timelines kept per component (bounds /debug memory).
     trace_ring_size: int = 256
+    # Byte bound on the completed-trace ring: long-prompt records are
+    # hundreds of times larger than short ones, so the count bound alone
+    # does not bound resident memory.  Oldest records are evicted past
+    # this and counted in tpu:obs_trace_dropped_total.  0 disables the
+    # byte bound (count bound only).
+    trace_ring_bytes: int = 8 * 1024 * 1024
+    # Completed window flight-recorder records kept (obs/flight_recorder):
+    # one per engine dispatch, served at GET /debug/windows and joined
+    # into /debug/requests/{id}.
+    window_ring_size: int = 1024
 
     def __post_init__(self):
         if self.trace_ring_size < 1:
             raise ValueError("trace_ring_size must be >= 1")
+        if self.trace_ring_bytes < 0:
+            raise ValueError("trace_ring_bytes must be >= 0")
+        if self.window_ring_size < 1:
+            raise ValueError("window_ring_size must be >= 1")
 
 
 @dataclasses.dataclass

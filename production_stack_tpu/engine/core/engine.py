@@ -110,6 +110,11 @@ class _PendingStep:
     chunk_sched: Optional[List] = None
     chunk_logits: Optional[object] = None
     chunk_ordinal: int = 0
+    # Window flight record (obs/flight_recorder.WindowRecord) stamped at
+    # dispatch; collect() completes + publishes it.  None when tracing is
+    # off (the recorder is never consulted) or the step completed its
+    # record synchronously at dispatch.
+    rec: Optional[object] = None
 
 
 class LLMEngine:
@@ -966,11 +971,33 @@ class LLMEngine:
             )
 
         # Observability hub: request tracer + step-phase/latency histograms
-        # (all hooks no-op when config.obs.tracing is off).
+        # + window flight recorder + compile-event tracker (all hooks
+        # no-op when config.obs.tracing is off).
         self.obs = EngineObs(
             enabled=config.obs.tracing,
             ring_size=config.obs.trace_ring_size,
+            ring_bytes=config.obs.trace_ring_bytes,
+            window_ring_size=config.obs.window_ring_size,
         )
+        # Wrap every jit entry point in the compile tracker's cache-size
+        # probe so XLA compiles are counted/timed per executable shape key
+        # (tpu:compile_seconds_total{executable}, GET /debug/compiles).
+        # With tracing off wrap() is the identity, keeping bare jit
+        # callables — the untraced fast path is byte-identical.
+        for _jit_name in (
+            "_prefill_fn", "_decode_fn", "_mixed_fn", "_sample_fn",
+            "_window_fn", "_spec_window_fn", "_mixed_window_fn",
+            "_win_advance_fn", "_win_occurrence_fn", "_penalties_fn",
+            "_argmax_fn", "_logprobs_fn",
+        ):
+            _jit_fn = getattr(self, _jit_name, None)
+            if _jit_fn is not None:
+                setattr(
+                    self, _jit_name,
+                    self.obs.compile_tracker.wrap(
+                        _jit_name.lstrip("_"), _jit_fn
+                    ),
+                )
 
         self._step_counter = 0
         self._encode_fn = None  # lazily jitted /v1/embeddings path
@@ -1030,6 +1057,10 @@ class LLMEngine:
         # step-thread-only writers.
         self.multistep_fallback: Dict[str, int] = {}
         self.multistep_wasted_tokens = 0
+        # Last _can_window decline reason, stamped on the flight record
+        # of the K=1 dispatch that replaced the declined window (step-
+        # thread-only, overwritten every _can_window call).
+        self._last_window_decline: Optional[str] = None
         # Host-side mirror of the device-resident window block tables
         # (how many columns of each row are populated), for the chained
         # windows' delta scatter.
@@ -1117,8 +1148,12 @@ class LLMEngine:
                 "tables": tables,
             }
 
-        self._pipe_unpack_fn = jax.jit(_pipe_unpack)
-        self._pipe_advance_fn = jax.jit(_pipe_advance)
+        self._pipe_unpack_fn = self.obs.compile_tracker.wrap(
+            "pipe_unpack_fn", jax.jit(_pipe_unpack)
+        )
+        self._pipe_advance_fn = self.obs.compile_tracker.wrap(
+            "pipe_advance_fn", jax.jit(_pipe_advance)
+        )
 
     # -- sizing ------------------------------------------------------------
 
@@ -1354,6 +1389,18 @@ class LLMEngine:
             )
             if self.obs.enabled:
                 self.obs.step_phase("sample", time.time() - t_post)
+            if p.rec is not None:
+                # Sample-side jits (penalties/argmax/logprobs) ran inside
+                # _append_and_check: drain any compiles onto this record,
+                # then complete it.  Rows whose sequence finished while
+                # the step flew sampled a discarded overrun token.
+                self._note_compiles([s.seq_id for s in p.seqs], p.rec)
+                self.obs.recorder.on_collect(
+                    p.rec, host_s=p.host_s,
+                    tokens_emitted=len(p.seqs),
+                    tokens_delivered=len(live),
+                    tokens_wasted=len(p.seqs) - len(live),
+                )
         if p.outputs is None:
             # Drop in-flight successors whose every row has now finished:
             # pure overrun steps produce no outputs and must not wedge
@@ -1372,7 +1419,18 @@ class LLMEngine:
                 and self._pending[0].chunk_sched is None
                 and all(s.is_finished for s in self._pending[0].seqs)
             ):
-                self._pending.popleft()
+                d = self._pending.popleft()
+                if d.rec is not None:
+                    # Complete the dropped overrun's record so every
+                    # dispatched window appears exactly once: a plain
+                    # window's rows are all frozen (the device emitted
+                    # nothing), a single step sampled one discarded
+                    # token per row.
+                    n = 0 if d.steps is not None else len(d.seqs)
+                    self.obs.recorder.on_collect(
+                        d.rec, host_s=d.host_s,
+                        tokens_emitted=n, tokens_wasted=n,
+                    )
             if self.obs.enabled:
                 # Only pipelined steps have a pure-dispatch host_s: a
                 # synchronous step's host_s fuses array build, blocking
@@ -1425,10 +1483,25 @@ class LLMEngine:
         if plan.decode is None:
             outputs = self._run_prefill(plan.prefill_chunk)
             self._step_counter += 1
-            self._pending.append(
-                # stackcheck: allow=SC201 reason=host_s is a stats field (host-gap metric); no plan state reads it
-                _PendingStep(outputs=outputs, host_s=time.time() - t0)
-            )
+            # stackcheck: allow=SC201 reason=host_s is a stats field (host-gap metric); no plan state reads it
+            host_s = time.time() - t0
+            if self.obs.enabled:
+                cp = plan.prefill_chunk
+                rec = self.obs.recorder.on_dispatch(
+                    "prefill", k=1, rows=0, seq_ids=(cp.seq.seq_id,),
+                    chunk_prompts=1,
+                    chunk_tokens_planned=cp.num_new_tokens,
+                    fallback=plan.window_fallback, now=t0,
+                )
+                self._note_compiles((cp.seq.seq_id,), rec)
+                self.obs.recorder.on_collect(
+                    rec, host_s=host_s,
+                    tokens_emitted=len(outputs),
+                    tokens_delivered=len(outputs),
+                    chunk_tokens_delivered=cp.num_new_tokens,
+                )
+            # stackcheck: allow=SC201 reason=host_s is a stats field (host-gap metric); no plan state reads it
+            self._pending.append(_PendingStep(outputs=outputs, host_s=host_s))
             return True
         if plan.chunk_schedule is not None:
             # Mixed K-step window: the head prompt's chunks ride the
@@ -1444,24 +1517,69 @@ class LLMEngine:
             # admission/finalization needs collected state), so the
             # lookahead pipeline pauses for the step and resumes on the
             # next pure-decode plan.
+            gap = self._recorder_gap(t0) if self.obs.enabled else 0.0
             outputs = self._run_mixed(plan)
             self._step_counter += 1
             # stackcheck: allow=SC201 reason=host_s is a stats field (host-gap metric); no plan state reads it
+            host_s = time.time() - t0
+            if self.obs.enabled:
+                cp = plan.prefill_chunk
+                sids = tuple(s.seq_id for s in plan.decode.seqs) + (
+                    cp.seq.seq_id,
+                )
+                rec = self.obs.recorder.on_dispatch(
+                    "mixed", k=1, rows=len(plan.decode.seqs),
+                    seq_ids=sids, chunk_prompts=1,
+                    chunk_tokens_planned=cp.num_new_tokens,
+                    fallback=plan.window_fallback, host_gap_s=gap, now=t0,
+                )
+                self._note_compiles(sids, rec)
+                self.obs.recorder.on_collect(
+                    rec, host_s=host_s,
+                    tokens_emitted=len(outputs),
+                    tokens_delivered=len(outputs),
+                    chunk_tokens_delivered=cp.num_new_tokens,
+                )
+            # stackcheck: allow=SC201 reason=host_s is a stats field (host-gap metric); no plan state reads it
             self._pending.append(_PendingStep(
-                outputs=outputs, is_decode=True, host_s=time.time() - t0,
+                outputs=outputs, is_decode=True, host_s=host_s,
             ))
             return True
         seqs = plan.decode.seqs
         if plan.decode_window > 1 and self._can_window(seqs):
             self._pending.append(self._dispatch_window(plan, chain_from=None))
-        elif self._can_pipeline(seqs):
-            self._pending.append(self._dispatch_decode_async(seqs, False))
+            return True
+        # A K>1 plan that fell out of the window path carries the decline
+        # reason onto the replacing K=1 dispatch's flight record.
+        decline = plan.window_fallback or (
+            self._last_window_decline if plan.decode_window > 1 else None
+        )
+        if self._can_pipeline(seqs):
+            p = self._dispatch_decode_async(seqs, False)
+            if p.rec is not None and decline:
+                p.rec.fallback = decline
+            self._pending.append(p)
         else:
+            gap = self._recorder_gap(t0) if self.obs.enabled else 0.0
             outputs = self._run_decode(plan.decode)
             self._step_counter += 1
             # stackcheck: allow=SC201 reason=host_s is a stats field (host-gap metric); no plan state reads it
+            host_s = time.time() - t0
+            if self.obs.enabled:
+                sids = tuple(s.seq_id for s in seqs)
+                rec = self.obs.recorder.on_dispatch(
+                    "decode", k=1, rows=len(seqs), seq_ids=sids,
+                    fallback=decline, host_gap_s=gap, now=t0,
+                )
+                self._note_compiles(sids, rec)
+                self.obs.recorder.on_collect(
+                    rec, host_s=host_s,
+                    tokens_emitted=len(seqs),
+                    tokens_delivered=len(outputs),
+                )
+            # stackcheck: allow=SC201 reason=host_s is a stats field (host-gap metric); no plan state reads it
             self._pending.append(_PendingStep(
-                outputs=outputs, is_decode=True, host_s=time.time() - t0,
+                outputs=outputs, is_decode=True, host_s=host_s,
             ))
         return True
 
@@ -1534,6 +1652,7 @@ class LLMEngine:
     def _can_window(self, seqs: List[Sequence]) -> bool:
         """K-step windows serve everything except host-sampled features;
         a fallback is observable, never silent."""
+        self._last_window_decline = None
         if self._window_fn is None:
             return False
         if not self._batch_uses_host_state(seqs):
@@ -1555,6 +1674,10 @@ class LLMEngine:
             self.multistep_fallback[reason] = (
                 self.multistep_fallback.get(reason, 0) + 1
             )
+        # Remembered for the flight record of the K=1 dispatch that
+        # replaces the declined window (deterministic pick when several
+        # reasons coincide).
+        self._last_window_decline = min(reasons) if reasons else None
         return False
 
     def _can_pipeline(self, seqs: List[Sequence]) -> bool:
@@ -1566,6 +1689,25 @@ class LLMEngine:
         return self._pipeline_enabled and not any(
             self._host_state_flags(s)[1] or s._min_tok_pending
             for s in seqs
+        )
+
+    def _recorder_gap(self, t0: float) -> float:
+        """Host gap this dispatch inherited from the previous window
+        (device idle since the last decode retired), stamped onto the
+        flight record so a stalled window's timeline shows WHERE the
+        stall was.  Read before the launch bookkeeping clears it."""
+        last = self._last_decode_end
+        return max(0.0, t0 - last) if last is not None else 0.0
+
+    def _note_compiles(self, seq_ids, rec=None) -> None:
+        """Drain XLA compile events fired inside the jit calls this
+        dispatch just made and attribute them: the window flight record
+        goes compile-tainted and every co-scheduled request's trace is
+        tagged compile=true (the compile-excluded-TTFT separator)."""
+        if not self.obs.enabled:
+            return
+        self.obs.on_compile(
+            seq_ids, self.obs.compile_tracker.drain_events(), rec
         )
 
     def _note_decode_launch(self) -> None:
@@ -1588,6 +1730,7 @@ class LLMEngine:
         steady "same batch, +1 token" path (one packed [4, S] delta,
         tokens chained from the in-flight sample)."""
         t0 = time.time()
+        gap = self._recorder_gap(t0) if self.obs.enabled else 0.0
         # Rebuilds pad to the decode batch-size bucket; lookahead steps
         # reuse the device-resident state, whose row count is by
         # construction the same bucket (identical running set).
@@ -1672,10 +1815,18 @@ class LLMEngine:
             logits, temps, top_ps, top_ks, step_key, seeds, min_p=min_ps,
         )
         self._step_counter += 1
+        rec = None
+        if self.obs.enabled:
+            sids = tuple(s.seq_id for s in seqs)
+            rec = self.obs.recorder.on_dispatch(
+                "decode", k=1, rows=len(seqs), seq_ids=sids,
+                provisional=lookahead, host_gap_s=gap, now=t0,
+            )
+            self._note_compiles(sids, rec)
         # stackcheck: allow=SC201 reason=host_s is a stats field (host-gap metric); no plan state reads it
         return _PendingStep(
             seqs=list(seqs), sampled=sampled, is_decode=True,
-            host_s=time.time() - t0,
+            host_s=time.time() - t0, rec=rec,
         )
 
     # -- K-step device-resident decode windows -----------------------------
@@ -1879,6 +2030,7 @@ class LLMEngine:
         t0 = time.time()
         decode = plan.decode
         seqs = decode.seqs
+        gap = self._recorder_gap(t0) if self.obs.enabled else 0.0
         if chain_from is None:
             state = self._window_build(seqs, decode.steps)
             self._note_decode_launch()
@@ -1971,11 +2123,28 @@ class LLMEngine:
             # tokens.
             self._step_counter += self._window_steps
         state.update(out_state)
+        rec = None
+        if self.obs.enabled:
+            depth = 0
+            if chain_from is not None and chain_from.rec is not None:
+                depth = chain_from.rec.chain_depth + 1
+            sids = tuple(s.seq_id for s in seqs)
+            rec = self.obs.recorder.on_dispatch(
+                "spec" if spec_stats is not None else "decode",
+                k=self._window_steps, rows=len(seqs), seq_ids=sids,
+                chain_depth=depth, provisional=chain_from is not None,
+                spec_width=(
+                    self.config.scheduler.speculative_ngram
+                    if spec_stats is not None else 0
+                ),
+                fallback=plan.window_fallback, host_gap_s=gap, now=t0,
+            )
+            self._note_compiles(sids, rec)
         # stackcheck: allow=SC201 reason=host_s is a stats field (host-gap metric); no plan state reads it
         return _PendingStep(
             seqs=list(seqs), sampled=emitted, is_decode=True,
             host_s=time.time() - t0, steps=list(decode.steps),
-            win_state=state, spec_stats=spec_stats,
+            win_state=state, spec_stats=spec_stats, rec=rec,
         )
 
     # stackcheck: root=step-thread
@@ -2011,6 +2180,7 @@ class LLMEngine:
         sched = plan.chunk_schedule
         k_eff = len(sched)
         n_scan = self._pow2_bucket(k_eff, 1)
+        gap = self._recorder_gap(t0) if self.obs.enabled else 0.0
         if self.obs.enabled:
             for cp in sched:
                 if cp.seq.first_scheduled_time is None:
@@ -2078,11 +2248,13 @@ class LLMEngine:
         pf_device = {
             k: self._put(v, P()) for k, v in buf.items()
         }
+        overlap_s = 0.0
         if chain_from is not None:
             # The previous window still occupies the device: every
             # second of this H2D staging ran UNDER its compute instead
             # of serializing after it.
-            self.window_transfer_overlap_s += time.time() - t_stage
+            overlap_s = time.time() - t_stage
+            self.window_transfer_overlap_s += overlap_s
         emitted, tails, out_state, self.kv_caches = (
             self._mixed_window_fn(
                 self.params,
@@ -2131,6 +2303,25 @@ class LLMEngine:
         # pow-2 padding iterations burn no ordinal anywhere).
         self._step_counter += k_eff
         state.update(out_state)
+        rec = None
+        if self.obs.enabled:
+            depth = 0
+            if chain_from is not None and chain_from.rec is not None:
+                depth = chain_from.rec.chain_depth + 1
+            sids = tuple(s.seq_id for s in seqs) + tuple(
+                dict.fromkeys(cp.seq.seq_id for cp in sched)
+            )
+            rec = self.obs.recorder.on_dispatch(
+                "mixed", k=k_eff, rows=len(seqs), seq_ids=sids,
+                chain_depth=depth, provisional=chain_from is not None,
+                chunk_prompts=len({cp.seq.seq_id for cp in sched}),
+                chunk_tokens_planned=sum(
+                    cp.num_new_tokens for cp in sched
+                ),
+                fallback=plan.window_fallback, host_gap_s=gap,
+                transfer_overlap_s=overlap_s, now=t0,
+            )
+            self._note_compiles(sids, rec)
         # stackcheck: allow=SC201 reason=host_s is a stats field (host-gap metric); no plan state reads it
         return _PendingStep(
             seqs=list(seqs), sampled=emitted, is_decode=True,
@@ -2139,6 +2330,7 @@ class LLMEngine:
             chunk_sched=list(sched),
             chunk_logits=tails if any_final else None,
             chunk_ordinal=chunk_ordinal,
+            rec=rec,
         )
 
     def _collect_window(self, p: _PendingStep, t0: float) -> List[StepOutput]:
@@ -2195,11 +2387,13 @@ class LLMEngine:
         # (stop string, guided rejection) retires mid-replay skip their
         # tail.  Device-stopped rows emit -1 past the stop, so ordinary
         # stops contribute zero by construction.
-        wasted = 0
+        emitted = 0
         for i in range(len(p.seqs)):
-            wasted += int((arr[:, i] >= 0).sum()) - delivered[i]
+            emitted += int((arr[:, i] >= 0).sum())
+        wasted = emitted - sum(delivered)
         if wasted:
             self.multistep_wasted_tokens += wasted
+        chunk_delivered = 0
         if p.chunk_sched is not None:
             # Mixed window: account the chunk tokens that rode the scan
             # and finalize EACH packed prompt whose final chunk landed —
@@ -2227,6 +2421,7 @@ class LLMEngine:
                     continue
                 self.prefill_chunk_tokens += chunk_tokens
                 self.mixed_window_chunk_tokens += chunk_tokens
+                chunk_delivered += chunk_tokens
                 if tails is None:
                     continue
                 for i, cp in chunks:
@@ -2236,6 +2431,7 @@ class LLMEngine:
                             step_ordinal=p.chunk_ordinal + i,
                         ))
             self.mixed_window_prompts_hist.observe(len(by_seq))
+        drafted = accepted = 0
         if spec:
             # Per-window speculation accounting: drafted/accepted feed
             # the existing acceptance-rate counters; the outcome split
@@ -2250,6 +2446,18 @@ class LLMEngine:
             self.spec_window_tokens["wasted"] += wasted
         if self.obs.enabled:
             self.obs.step_phase("sample", time.time() - t_post)
+        if p.rec is not None:
+            # Sample-side jits ran inside the replay above: drain any
+            # compiles onto this record, then complete it.
+            self._note_compiles([s.seq_id for s in p.seqs], p.rec)
+            self.obs.recorder.on_collect(
+                p.rec, host_s=p.host_s,
+                tokens_emitted=emitted,
+                tokens_delivered=emitted - wasted,
+                tokens_wasted=wasted,
+                chunk_tokens_delivered=chunk_delivered,
+                drafted=drafted, accepted=accepted,
+            )
         return outputs
 
     def restore_seq_blocks(self, seq: Sequence) -> str:
@@ -3842,9 +4050,12 @@ class LLMEngine:
         )
         ids = (list(prompt_token_ids) + [0] * bucket)[:bucket]
         if self._encode_fn is None:
-            self._encode_fn = jax.jit(
-                partial(self.model.encode, cfg=self.config.model,
-                        mesh=self.mesh)
+            self._encode_fn = self.obs.compile_tracker.wrap(
+                "encode_fn",
+                jax.jit(
+                    partial(self.model.encode, cfg=self.config.model,
+                            mesh=self.mesh)
+                ),
             )
         out = self._encode_fn(
             self.params,
@@ -3878,6 +4089,64 @@ class LLMEngine:
 
     def loaded_adapters(self) -> List[str]:
         return [] if self.lora_registry is None else self.lora_registry.loaded()
+
+    def compile_inventory(self) -> Dict[str, int]:
+        """Config-derived expected executable counts per jit family — the
+        denominator of /debug/compiles' warmup coverage report.  These are
+        upper bounds on steady-state inventory (a deployment that never
+        sees a shape never compiles it); the report's point is naming the
+        families still cold after boot, not exact equality."""
+        sched = self.config.scheduler
+        dp = max(1, self.config.parallel.data_parallel)
+        decode_buckets = 1
+        b = dp
+        while b < sched.max_num_seqs:
+            b *= 2
+            decode_buckets += 1
+        inv: Dict[str, int] = {
+            "prefill_fn": len(sched.prefill_buckets),
+            "decode_fn": decode_buckets,
+            "sample_fn": decode_buckets,
+        }
+        if sched.mixed_enabled:
+            # One fused variant per (decode bucket, chunk bucket) pair.
+            inv["mixed_fn"] = decode_buckets * len(sched.prefill_chunk_buckets)
+        if sched.window_steps > 1:
+            inv["window_fn"] = decode_buckets
+            if sched.speculative_ngram:
+                inv["spec_window_fn"] = decode_buckets
+            if sched.mixed_window:
+                # Chunk schedules pad to pow2 scan lengths <= decode_window.
+                scan_variants, n = 1, 1
+                while n < sched.decode_window:
+                    n *= 2
+                    scan_variants += 1
+                inv["mixed_window_fn"] = decode_buckets * scan_variants
+        return inv
+
+    def compiles_payload(self) -> Dict:
+        """GET /debug/compiles: per-executable compile events (most
+        expensive first) + the warmup coverage join — compiled-shape
+        counts per jit family against the config-derived inventory."""
+        rows = self.obs.compile_tracker.snapshot()
+        by_family: Dict[str, int] = {}
+        for r in rows:
+            fam = r["executable"].split("[", 1)[0]
+            by_family[fam] = by_family.get(fam, 0) + 1
+        inventory = self.compile_inventory()
+        coverage = {
+            fam: {"compiled": by_family.get(fam, 0), "expected": exp}
+            for fam, exp in inventory.items()
+        }
+        return {
+            "enabled": self.obs.enabled,
+            "compiled_shapes": self.obs.compile_tracker.compiled_shapes(),
+            "compile_seconds": round(
+                self.obs.compile_tracker.compile_seconds(), 6
+            ),
+            "executables": rows,
+            "coverage": coverage,
+        }
 
     def stats(self) -> Dict[str, float]:
         return {
@@ -3957,4 +4226,11 @@ class LLMEngine:
             # tpu:kv_snapshot_format_total).
             "kv_wire_bytes": self.kv_wire_stats.wire_bytes(),
             "kv_snapshot_format": self.kv_wire_stats.snapshot_formats(),
+            # XLA compile events (obs/compile_tracker.py): seconds spent
+            # compiling, per executable shape key, plus the distinct-shape
+            # count (tpu:compile_seconds_total / tpu:compiled_shapes).
+            "compile_seconds": self.obs.compile_tracker.seconds_by_executable(),
+            "compiled_shapes": self.obs.compile_tracker.compiled_shapes(),
+            # Trace-ring byte-bound evictions (tpu:obs_trace_dropped_total).
+            "obs_trace_dropped": self.obs.tracer.dropped,
         }

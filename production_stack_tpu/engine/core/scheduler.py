@@ -94,8 +94,11 @@ class StepPlan:
     ``provisional`` marks plans made while the previous window is still
     in flight (optimistic no-finish assumption; the engine rolls back
     at collect).  ``window_fallback`` names the reason a pass that
-    WANTED a K>1 window was forced to K=1 (currently only
-    ``"waiting_head"``); the engine folds it into
+    WANTED a K>1 window was forced to K=1 (``"waiting_head"`` — the
+    head prompt forced per-token admission; ``"bucket_mismatch"`` —
+    the final chunk's natural bucket differed from the window's static
+    scan shape; ``"pool_pressure"`` — block pool / restore pressure
+    ended chunking early); the engine folds it into
     ``tpu:multistep_fallback_total``."""
 
     decode: Optional[DecodePlan] = None
@@ -171,6 +174,9 @@ class Scheduler:
         # window planning over N waiters must not recompute it per
         # chunk.
         self.budget_computations = 0
+        # Why the last _extend_chunk_schedule stopped early (None = it
+        # ran to a natural end) — window_fallback attribution.
+        self._chunk_stop_reason: Optional[str] = None
 
     # -- admission ---------------------------------------------------------
 
@@ -492,6 +498,10 @@ class Scheduler:
         schedule = [first]
         T = first.bucket_len
         packed = self.config.multi_prompt_window_enabled
+        # Why extension stopped EARLY (window_fallback attribution when
+        # the schedule collapses to K=1): a final chunk / k_cap exit is a
+        # natural end and leaves this None.
+        self._chunk_stop_reason = None
         while len(schedule) < k_cap:
             if schedule[-1].is_final:
                 if not packed or self._next_packable_head() is None:
@@ -504,9 +514,13 @@ class Scheduler:
                 remaining = head.num_prompt_tokens - head.num_cached_tokens
                 fit = [b for b in buckets if b >= remaining]
                 if fit and fit[0] != T:
+                    # One scan has ONE static chunk shape; the final
+                    # chunk's natural bucket differs.
+                    self._chunk_stop_reason = "bucket_mismatch"
                     break
                 nxt = self._try_schedule_prefill(chunk_budget=budget)
             if nxt is None:
+                self._chunk_stop_reason = "pool_pressure"
                 break
             schedule.append(nxt)
         return schedule
@@ -590,12 +604,12 @@ class Scheduler:
             # (decode blocks are over-allocated for the declined window
             # — they sit in the block tables and back later steps).
             self._recap_steps_k1(decode)
+            # first can only be None (pool pressure / restore retry) or
+            # final here; a final single chunk is a natural K=1 shape,
+            # not a decline.
             return StepPlan(
                 decode=decode, prefill_chunk=first, decode_window=1,
-                window_fallback=(
-                    None if first is not None and first.is_final
-                    else "waiting_head"
-                ),
+                window_fallback="pool_pressure" if first is None else None,
             )
         schedule = self._extend_chunk_schedule(
             head, first, buckets, k_cap, budget
@@ -606,9 +620,15 @@ class Scheduler:
             # chunk / nothing packable behind a final first chunk): the
             # planned chunk runs as today's K=1 mixed step.
             self._recap_steps_k1(decode)
+            # _extend_chunk_schedule says WHY it stopped when it stopped
+            # early (pool_pressure / bucket_mismatch); a final first
+            # chunk is a natural K=1 shape, not a decline.
             return StepPlan(
                 decode=decode, prefill_chunk=first, decode_window=1,
-                window_fallback=None if first.is_final else "waiting_head",
+                window_fallback=(
+                    None if first.is_final
+                    else (self._chunk_stop_reason or "waiting_head")
+                ),
             )
         decode.steps = self._mixed_window_decode_steps(decode.seqs, k_eff)
         return StepPlan(

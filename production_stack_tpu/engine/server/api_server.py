@@ -462,6 +462,17 @@ def build_engine_app(
                 vocab.TPU_MIXED_WINDOW_PROMPTS,
                 engine.engine.mixed_window_prompts_hist,
             )
+            # XLA compile events per executable shape key + the
+            # distinct-shape gauge, and trace-ring byte-bound evictions
+            # (obs/compile_tracker.py, obs/trace.py).
+            + vocab.render_labeled_counter(
+                vocab.TPU_COMPILE_SECONDS, "executable",
+                s["compile_seconds"],
+            )
+            + vocab.render_prometheus([
+                (vocab.TPU_COMPILED_SHAPES, s["compiled_shapes"]),
+                (vocab.TPU_OBS_TRACE_DROPPED, s["obs_trace_dropped"]),
+            ])
             + engine.engine.obs.render_metrics()
         )
         return web.Response(text=text)
@@ -473,7 +484,7 @@ def build_engine_app(
         return web.json_response(engine.engine.obs.debug_payload())
 
     async def debug_request(request: web.Request) -> web.Response:
-        snap = engine.engine.obs.tracer.snapshot(
+        snap = engine.engine.obs.request_payload(
             request.match_info["request_id"]
         )
         if snap is None:
@@ -483,6 +494,19 @@ def build_engine_app(
                 status=404,
             )
         return web.json_response(snap)
+
+    async def debug_windows(request: web.Request) -> web.Response:
+        """Window flight-recorder ring, newest first (?seq= filters to
+        windows one sequence rode)."""
+        return web.json_response(
+            engine.engine.obs.windows_payload(
+                seq=request.query.get("seq") or None
+            )
+        )
+
+    async def debug_compiles(_req: web.Request) -> web.Response:
+        """XLA compile events per executable + warmup coverage report."""
+        return web.json_response(engine.engine.compiles_payload())
 
     async def chat_completions(request: web.Request) -> web.StreamResponse:
         return await _serve_completion(request, chat=True)
@@ -1020,6 +1044,11 @@ def build_engine_app(
             retired = [False] * n_choices  # manually removed from `remaining`
             total_out = 0
             shed_on_deadline = False
+            # The compile taint rides the FIRST data chunk (headers are
+            # already on the wire at prepare(), before TTFT is known):
+            # the router proxy sniffs it to keep a compile-excluded TTFT
+            # window without parsing every chunk.
+            compile_stamped = False
             try:
                 remaining = n_choices
                 while remaining:
@@ -1067,6 +1096,12 @@ def build_engine_app(
                             ),
                             index=i,
                         )
+                        if not compile_stamped:
+                            compile_stamped = True
+                            if obs.enabled and obs.compile_tainted(
+                                request_id
+                            ):
+                                payload["compile"] = True
                         await response.write(
                             f"data: {json.dumps(payload)}\n\n".encode()
                         )
@@ -1298,21 +1333,26 @@ def build_engine_app(
         final_headers = {"X-Request-Id": request_id}
         if disagg_prefix_outcome is not None:
             final_headers["X-Disagg-Prefix"] = disagg_prefix_outcome
-        return web.json_response(
-            {
-                "id": request_id,
-                "object": obj,
-                "created": created,
-                "model": model_name,
-                "choices": choices,
-                "usage": {
-                    "prompt_tokens": len(prompt_token_ids),
-                    "completion_tokens": n_out,
-                    "total_tokens": len(prompt_token_ids) + n_out,
-                },
+        final_body = {
+            "id": request_id,
+            "object": obj,
+            "created": created,
+            "model": model_name,
+            "choices": choices,
+            "usage": {
+                "prompt_tokens": len(prompt_token_ids),
+                "completion_tokens": n_out,
+                "total_tokens": len(prompt_token_ids) + n_out,
             },
-            headers=final_headers,
-        )
+        }
+        if obs.enabled and obs.compile_tainted(request_id):
+            # An XLA compile fired inside this request's dispatches: its
+            # latency is cold-start, not steady state.  The router's
+            # stats monitor reads this to keep a compile-excluded TTFT
+            # window (same marker the streaming path puts in the first
+            # SSE chunk).
+            final_body["compile"] = True
+        return web.json_response(final_body, headers=final_headers)
 
     async def embeddings(request: web.Request) -> web.Response:
         """OpenAI /v1/embeddings: normalized mean-pooled final hidden
@@ -1577,6 +1617,8 @@ def build_engine_app(
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/debug/requests", debug_requests)
     app.router.add_get("/debug/requests/{request_id}", debug_request)
+    app.router.add_get("/debug/windows", debug_windows)
+    app.router.add_get("/debug/compiles", debug_compiles)
     app.router.add_post("/v1/chat/completions", chat_completions)
     app.router.add_post("/v1/completions", completions)
     app.router.add_post("/v1/embeddings", embeddings)
@@ -2157,6 +2199,16 @@ def main(argv=None) -> None:
         "--trace-ring-size", type=int, default=256,
         help="completed request timelines kept for GET /debug/requests",
     )
+    parser.add_argument(
+        "--trace-ring-bytes", type=int, default=8 * 1024 * 1024,
+        help="byte bound on the completed-trace ring (JSON-encoded size; "
+        "evictions past it count in tpu:obs_trace_dropped_total; 0 = "
+        "count bound only)",
+    )
+    parser.add_argument(
+        "--window-ring-size", type=int, default=1024,
+        help="window flight records kept for GET /debug/windows",
+    )
     parser.add_argument("--log-level", default="info")
     args = parser.parse_args(argv)
 
@@ -2253,6 +2305,8 @@ def main(argv=None) -> None:
             "scheduler.step_watchdog_s": args.step_watchdog_s,
             "obs.tracing": not args.no_tracing,
             "obs.trace_ring_size": args.trace_ring_size,
+            "obs.trace_ring_bytes": args.trace_ring_bytes,
+            "obs.window_ring_size": args.window_ring_size,
         },
     )
     # Multi-host slice bootstrap (chart StatefulSet mode / GKE TPU pod

@@ -20,6 +20,14 @@ from production_stack_tpu.obs.trace import (  # noqa: F401
     new_trace_id,
     parse_traceparent,
 )
+from production_stack_tpu.obs.compile_tracker import (  # noqa: F401
+    CompileTracker,
+)
+from production_stack_tpu.obs.flight_recorder import (  # noqa: F401
+    FlightRecorder,
+    WindowRecord,
+    WINDOW_KINDS,
+)
 from production_stack_tpu.obs.engine import (  # noqa: F401
     EngineObs,
     PHASE_SPAN_NAMES,

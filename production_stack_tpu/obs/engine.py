@@ -15,6 +15,8 @@ from __future__ import annotations
 import time
 from typing import Dict, Optional
 
+from production_stack_tpu.obs.compile_tracker import CompileTracker
+from production_stack_tpu.obs.flight_recorder import FlightRecorder
 from production_stack_tpu.obs.histogram import (
     Histogram,
     render_histogram,
@@ -76,9 +78,27 @@ PHASE_SPAN_NAMES = (
 
 
 class EngineObs:
-    def __init__(self, enabled: bool = True, ring_size: int = 256):
+    def __init__(
+        self,
+        enabled: bool = True,
+        ring_size: int = 256,
+        ring_bytes: int = 0,
+        window_ring_size: int = 1024,
+    ):
         self.enabled = bool(enabled)
-        self.tracer = Tracer("engine", enabled=self.enabled, ring_size=ring_size)
+        self.tracer = Tracer(
+            "engine", enabled=self.enabled, ring_size=ring_size,
+            ring_bytes=ring_bytes,
+        )
+        # Window flight recorder: one record per engine dispatch
+        # (GET /debug/windows, joined into /debug/requests/{id}).
+        self.recorder = FlightRecorder(
+            enabled=self.enabled, ring_size=window_ring_size,
+        )
+        # XLA compile-event tracker: the engine wraps its jit entry
+        # points through this when tracing is on (GET /debug/compiles,
+        # tpu:compile_seconds_total{executable}).
+        self.compile_tracker = CompileTracker(enabled=self.enabled)
         # Histograms are created eagerly (fixed, small set) so /metrics
         # always renders every family — dashboards and the router scraper
         # see stable names from the first scrape.
@@ -158,6 +178,28 @@ class EngineObs:
             return
         self.tracer.finish(request_id, aborted=True)
 
+    # -- compile taint (engine step thread writes, server reads) -----------
+
+    def on_compile(self, seq_ids, events, rec=None) -> None:
+        """Attribute drained compile events: mark the owning window
+        record compile-tainted and tag every co-scheduled request's trace
+        ``compile=true`` so compile-tainted TTFT samples are separable
+        from steady-state ones."""
+        if not self.enabled or not events:
+            return
+        total = sum(e.get("seconds", 0.0) for e in events)
+        self.recorder.note_compile(rec, total)
+        for sid in seq_ids:
+            self.tracer.set_attrs(sid, compile=True)
+
+    def compile_tainted(self, request_id: str) -> bool:
+        """Did an XLA compile fire inside this request's dispatches?  The
+        API server stamps the answer into the first response chunk so the
+        router can keep a compile-excluded TTFT window."""
+        if not self.enabled:
+            return False
+        return bool(self.tracer.get_attr(request_id, "compile", False))
+
     # -- server-side hooks -------------------------------------------------
 
     def start_request(
@@ -204,4 +246,25 @@ class EngineObs:
             "enabled": self.enabled,
             # Lock-held snapshots: the step thread mutates these traces.
             "requests": self.tracer.snapshots(),
+            "dropped": self.tracer.dropped,
+        }
+
+    def request_payload(self, request_id: str) -> Optional[Dict]:
+        """One request's timeline with its window flight records joined in
+        (/debug/requests/{id}): which windows it rode, what else shared
+        them, which one stalled.  None when the trace is unknown."""
+        snap = self.tracer.snapshot(request_id)
+        if snap is None:
+            return None
+        snap["windows"] = self.recorder.for_request(request_id)
+        return snap
+
+    def windows_payload(self, seq: Optional[str] = None) -> Dict:
+        """GET /debug/windows (+?seq= filter): the flight-recorder ring,
+        newest first."""
+        return {
+            "enabled": self.enabled,
+            "windows": self.recorder.snapshot(seq=seq),
+            "recorded": self.recorder.windows_recorded,
+            "dropped": self.recorder.dropped,
         }

@@ -207,8 +207,12 @@ REGISTRY = {
                 "because a co-scheduled request needed host-sampled "
                 "features (reason: logprobs | logit_bias | guided) or "
                 "because a waiting prompt forced K=1 admission cadence "
-                "and the mixed K-step window could not serve it "
-                "(reason: waiting_head)",
+                "and the mixed K-step window could not serve it — split "
+                "by WHY the mixed window declined (reason: bucket_mismatch "
+                "— the head chunk fit no static chunk bucket; "
+                "pool_pressure — the KV pool could not hold the chunk; "
+                "waiting_head — residual decline, e.g. mixed windows off "
+                "or an unpackable final chunk)",
     },
     "tpu:mixed_window_chunk_tokens_total": {
         "kind": "counter", "layer": "engine",
@@ -298,6 +302,30 @@ REGISTRY = {
                 "(preStop/SIGTERM on a follower drains the WHOLE slice "
                 "through the leader; followers keep stepping until the "
                 "group shutdown so in-flight streams finish)",
+    },
+    "tpu:compile_seconds_total": {
+        "kind": "counter", "layer": "engine", "labels": ("executable",),
+        "mirrors": ("fake_engine", "dashboard", "docs"),
+        "help": "Seconds spent in XLA trace+compile per executable shape "
+                "key (jit entry point + compact arg-shape signature) — "
+                "the compile tax behind first-request TTFT outliers; a "
+                "growing series under steady traffic means live shapes "
+                "are still missing from warmup coverage "
+                "(GET /debug/compiles)",
+    },
+    "tpu:compiled_shapes": {
+        "kind": "gauge", "layer": "engine",
+        "mirrors": ("fake_engine", "dashboard", "docs"),
+        "help": "Distinct executable shape keys compiled since boot; "
+                "read against the config-derived inventory in "
+                "GET /debug/compiles for warmup coverage",
+    },
+    "tpu:obs_trace_dropped_total": {
+        "kind": "counter", "layer": "engine",
+        "mirrors": ("fake_engine", "dashboard", "docs"),
+        "help": "Completed trace records evicted from the /debug/requests "
+                "ring by the count or byte bound (obs.trace_ring_size / "
+                "obs.trace_ring_bytes) — drops are visible, not silent",
     },
     # -- engine request-level histograms (obs layer) -----------------------
     "tpu:ttft_seconds": {
@@ -445,6 +473,15 @@ REGISTRY = {
         "mirrors": ("docs",),
         "help": "Scraped engine queue depth re-exported per backend",
     },
+    "tpu_router:ttft_clean_p95_seconds": {
+        "kind": "gauge", "layer": "router", "labels": ("server",),
+        "mirrors": ("dashboard", "docs"),
+        "help": "Compile-excluded TTFT p95 per backend (window): TTFT "
+                "samples whose first chunk carried the engine's "
+                "compile=true taint are excluded, separating steady-state "
+                "latency from XLA warmup outliers (compare against "
+                "tpu_router:ttft_seconds p95 for the compile tax)",
+    },
     "tpu_router:circuit_state": {
         "kind": "gauge", "layer": "router", "labels": ("server",),
         "mirrors": ("dashboard", "docs"),
@@ -540,6 +577,14 @@ REGISTRY = {
         "source_name": "tpu_router:pii_detections",
         "mirrors": ("dashboard", "docs"),
         "help": "PII entities detected in request bodies",
+    },
+    "tpu_router:obs_trace_dropped_total": {
+        "kind": "counter", "layer": "router",
+        "source_name": "tpu_router:obs_trace_dropped",
+        "mirrors": ("dashboard", "docs"),
+        "help": "Completed trace records evicted from the router's "
+                "/debug/requests ring by the count or byte bound "
+                "(--trace-ring-size / --trace-ring-bytes)",
     },
     "tpu_router:disagg_fallback_total": {
         "kind": "counter", "layer": "router", "labels": ("reason",),

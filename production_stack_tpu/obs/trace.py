@@ -16,6 +16,7 @@ cannot grow without limit under sustained traffic.
 from __future__ import annotations
 
 import dataclasses
+import json
 import secrets
 import threading
 import time
@@ -87,6 +88,9 @@ class RequestTrace:
     end: Optional[float] = None
     spans: List[Span] = dataclasses.field(default_factory=list)
     attrs: Dict = dataclasses.field(default_factory=dict)
+    # Serialized-record size, stamped when the trace is retired to the
+    # completed ring (the byte-bound accounting unit; not exported).
+    approx_bytes: int = 0
 
     def add_span(self, name: str, start: float, end: float, **attrs) -> Span:
         span = Span(name=name, start=start, end=end, attrs=attrs)
@@ -159,13 +163,58 @@ class Tracer:
     # peers) must not grow memory; oldest actives are dropped past this.
     MAX_ACTIVE_FACTOR = 4
 
-    def __init__(self, component: str, enabled: bool = True, ring_size: int = 256):
+    def __init__(
+        self,
+        component: str,
+        enabled: bool = True,
+        ring_size: int = 256,
+        ring_bytes: Optional[int] = None,
+    ):
         self.component = component
         self.enabled = enabled
         self.ring_size = max(1, int(ring_size))
+        # Byte bound on the completed ring: a long-prompt burst produces
+        # records hundreds of times larger than a short one, so a
+        # count-only cap does not bound resident memory.  None/0 = count
+        # bound only.  Evictions (either bound) increment ``dropped`` so
+        # drops are visible (tpu:obs_trace_dropped_total), not silent.
+        self.ring_bytes = int(ring_bytes) if ring_bytes else None
+        self._completed_bytes = 0
+        self.dropped = 0
         self._active: "OrderedDict[str, RequestTrace]" = OrderedDict()
-        self._completed: Deque[RequestTrace] = deque(maxlen=self.ring_size)
+        self._completed: Deque[RequestTrace] = deque()
         self._lock = threading.Lock()
+
+    @staticmethod
+    def _approx_bytes(trace: RequestTrace) -> int:
+        """Serialized size of one completed record — the unit the byte
+        bound accumulates.  Cost is paid once per request at finish, off
+        the per-token path."""
+        try:
+            return len(json.dumps(trace.to_dict(), default=str))
+        except (TypeError, ValueError):
+            return 1024
+
+    def _retire_locked(self, trace: RequestTrace) -> None:
+        """Move one finished trace into the completed ring, evicting the
+        oldest records past the count bound and the byte bound (always
+        keeping the newest).  Lock held by the caller."""
+        nbytes = self._approx_bytes(trace)
+        trace.approx_bytes = nbytes
+        self._completed.appendleft(trace)
+        self._completed_bytes += nbytes
+        while len(self._completed) > self.ring_size:
+            old = self._completed.pop()
+            self._completed_bytes -= old.approx_bytes
+            self.dropped += 1
+        while (
+            self.ring_bytes
+            and self._completed_bytes > self.ring_bytes
+            and len(self._completed) > 1
+        ):
+            old = self._completed.pop()
+            self._completed_bytes -= old.approx_bytes
+            self.dropped += 1
 
     def start(
         self,
@@ -194,7 +243,7 @@ class Tracer:
             if prev is not None:
                 prev.end = trace.start
                 prev.attrs["superseded"] = True
-                self._completed.appendleft(prev)
+                self._retire_locked(prev)
             self._active[request_id] = trace
             while len(self._active) > self.MAX_ACTIVE_FACTOR * self.ring_size:
                 self._active.popitem(last=False)
@@ -237,6 +286,15 @@ class Tracer:
             with self._lock:
                 trace.add_span(name, start, end, **attrs)
 
+    def get_attr(self, request_id: str, key: str, default=None):
+        """Lock-held read of one trace attribute (e.g. the compile taint
+        the API server checks at first-token time)."""
+        if not self.enabled:
+            return default
+        with self._lock:
+            trace = self._get_locked(request_id)
+            return default if trace is None else trace.attrs.get(key, default)
+
     def set_attrs(self, request_id: str, **attrs) -> None:
         if not self.enabled:
             return
@@ -256,7 +314,7 @@ class Tracer:
                 return None
             trace.end = end if end is not None else time.time()
             trace.attrs.update(attrs)
-            self._completed.appendleft(trace)
+            self._retire_locked(trace)
         return trace
 
     def discard(self, request_id: str) -> None:

@@ -92,6 +92,7 @@ def initialize_all(app: web.Application, args) -> ServiceRegistry:
             "router",
             enabled=not args.no_tracing,
             ring_size=args.trace_ring_size,
+            ring_bytes=args.trace_ring_bytes,
         ),
     )
 

@@ -223,6 +223,12 @@ def parse_args(argv=None) -> argparse.Namespace:
         help="completed request timelines kept for GET /debug/requests",
     )
     parser.add_argument(
+        "--trace-ring-bytes", type=int, default=8 * 1024 * 1024,
+        help="byte bound on the completed-trace ring (JSON-encoded size; "
+        "evictions past it count in tpu_router:obs_trace_dropped_total; "
+        "0 = count bound only)",
+    )
+    parser.add_argument(
         "--log-stats", action="store_true", help="Periodically log the stats planes"
     )
     parser.add_argument("--log-stats-interval", type=float, default=10.0)

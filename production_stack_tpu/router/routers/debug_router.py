@@ -73,7 +73,7 @@ def join_timelines(router_trace: Dict, engine_trace: Optional[Dict]) -> Dict:
         if s["name"] in PHASE_SPAN_NAMES
     }
     total_s = router_trace.get("duration_s", 0.0)
-    return {
+    joined = {
         "request_id": router_trace.get("request_id"),
         "trace_id": router_trace.get("trace_id"),
         "router": router_trace,
@@ -83,6 +83,12 @@ def join_timelines(router_trace: Dict, engine_trace: Optional[Dict]) -> Dict:
         "phase_sum_s": round(sum(phase_s.values()), 6),
         "total_s": round(total_s, 6),
     }
+    if engine_trace is not None and engine_trace.get("windows") is not None:
+        # The engine's window flight records ride the join inline: which
+        # dispatches this request's tokens rode, what else shared them,
+        # and which one stalled (obs/flight_recorder.py).
+        joined["windows"] = engine_trace["windows"]
+    return joined
 
 
 @routes.get("/debug/requests/{request_id}")

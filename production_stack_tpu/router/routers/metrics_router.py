@@ -26,6 +26,7 @@ from production_stack_tpu.router.services.request_service.request import (
     CIRCUIT_BREAKER,
     ENGINE_STATS_SCRAPER,
     REQUEST_STATS_MONITOR,
+    ROUTER_TRACER,
 )
 from production_stack_tpu.router.stats.vocabulary import ROUTER_HISTOGRAMS
 
@@ -83,6 +84,22 @@ async def metrics(request: web.Request) -> web.Response:
             ms.num_requests_uncompleted.labels(server=server).set(
                 stats.uncompleted_requests
             )
+            # Compile-excluded windowed TTFT p95 (the raw windowed p95
+            # feeds the capacity model; this one is the dashboard's
+            # steady-state line — the gap between the two IS the XLA
+            # compile cost the engine's first-chunk marker attributed).
+            ms.ttft_clean_p95.labels(server=server).set(stats.ttft_clean_p95)
+
+    # Router trace-ring evictions: the tracer counts cumulatively, the
+    # prometheus Counter wants increments — inc the delta at scrape time
+    # (same single-scraper assumption the engine-side counters make).
+    tracer = registry.get(ROUTER_TRACER)
+    if tracer is not None:
+        dropped = tracer.dropped
+        seen = request.app.get("_obs_dropped_seen", 0)
+        if dropped > seen:
+            ms.obs_trace_dropped_total.inc(dropped - seen)
+            request.app["_obs_dropped_seen"] = dropped
 
     breaker = registry.get(CIRCUIT_BREAKER)
     if breaker is not None:

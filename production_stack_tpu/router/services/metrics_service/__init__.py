@@ -53,6 +53,20 @@ circuit_state = Gauge(
     "Per-backend circuit breaker state (0=closed, 1=half_open, 2=open)",
     ["server"],
 )
+# Compile-excluded windowed TTFT p95: samples whose first chunk the engine
+# stamped '"compile": true' (an XLA compile fired inside the request) are
+# left out; the gap to raw TTFT p95 is the cold-start compile cost.
+ttft_clean_p95 = Gauge(
+    "tpu_router:ttft_clean_p95_seconds",
+    "Windowed TTFT p95 excluding compile-tainted samples (s)",
+    ["server"],
+)
+# Router-side trace-ring evictions (byte/count bound) — mirrors the
+# engine's tpu:obs_trace_dropped_total on the router's own tracer.
+obs_trace_dropped_total = Counter(
+    "tpu_router:obs_trace_dropped",
+    "Router request-trace ring evictions (byte/count bound)",
+)
 deadline_expired_total = Counter(
     "tpu_router:deadline_expired_total",
     "Requests shed by the router because their deadline expired before "

@@ -562,7 +562,20 @@ async def process_request(
                         if monitor:
                             # Seeds the token clock + counts this chunk; no
                             # ITL sample (first chunk defines no interval).
-                            monitor.on_request_response(url, request_id, now)
+                            # The engine stamps '"compile": true' into the
+                            # first chunk (SSE or JSON body alike) when an
+                            # XLA compile fired inside the request: a byte
+                            # sniff — not a parse — keeps that cold-start
+                            # sample out of the compile-excluded TTFT
+                            # window on the proxy hot path.
+                            tainted = (
+                                b'"compile": true' in chunk
+                                or b'"compile":true' in chunk
+                            )
+                            monitor.on_request_response(
+                                url, request_id, now,
+                                compile_tainted=tainted,
+                            )
                     elif monitor:
                         monitor.on_token_chunk(url, request_id, now)
                     if want_store:

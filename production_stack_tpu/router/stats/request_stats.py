@@ -45,6 +45,12 @@ class RequestStats:
     # not the whole process lifetime (router/capacity.py).
     itl_p95: float = 0.0
     ttft_p95: float = 0.0
+    # Compile-excluded windowed TTFT p95: samples whose first chunk the
+    # engine stamped ``"compile": true`` (an XLA compile fired inside
+    # the request's dispatches) are cold-start, not steady state, and
+    # are kept OUT of this quantile — the raw ttft_p95 above still sees
+    # every sample, so the gap between the two IS the compile cost.
+    ttft_clean_p95: float = 0.0
 
 
 class SlidingWindow:
@@ -99,6 +105,7 @@ class _EngineWindows:
     __slots__ = (
         "arrivals",
         "ttft",
+        "ttft_clean",
         "latency",
         "itl",
         "queueing",
@@ -112,6 +119,9 @@ class _EngineWindows:
     def __init__(self, window: float):
         self.arrivals = SlidingWindow(window)
         self.ttft = SlidingWindow(window)
+        # TTFT samples NOT compile-tainted by the engine (the first
+        # response chunk carried no "compile": true marker).
+        self.ttft_clean = SlidingWindow(window)
         self.latency = SlidingWindow(window)
         self.itl = SlidingWindow(window)
         self.queueing = SlidingWindow(window)
@@ -177,9 +187,15 @@ class RequestStatsMonitor:
                 w.hists["queueing"].observe(timestamp - arrived)
 
     def on_request_response(
-        self, engine_url: str, request_id: str, timestamp: float
+        self,
+        engine_url: str,
+        request_id: str,
+        timestamp: float,
+        compile_tainted: bool = False,
     ) -> None:
-        """First token chunk arrived: TTFT; request moves prefill -> decode."""
+        """First token chunk arrived: TTFT; request moves prefill -> decode.
+        ``compile_tainted`` (the engine's ``"compile": true`` first-chunk
+        marker) keeps the sample out of the compile-excluded window."""
         key = (engine_url, request_id)
         with self._lock:
             if key in self._first_token_at:
@@ -195,6 +211,8 @@ class RequestStatsMonitor:
             if arrived is not None:
                 w.ttft.update(timestamp, timestamp - arrived)
                 w.hists["ttft"].observe(timestamp - arrived)
+                if not compile_tainted:
+                    w.ttft_clean.update(timestamp, timestamp - arrived)
             w.in_prefill = max(0, w.in_prefill - 1)
             w.in_decoding += 1
 
@@ -289,6 +307,10 @@ class RequestStatsMonitor:
                     ),
                     ttft_p95=(
                         w.ttft.quantile(0.95, now) if with_quantiles else 0.0
+                    ),
+                    ttft_clean_p95=(
+                        w.ttft_clean.quantile(0.95, now)
+                        if with_quantiles else 0.0
                     ),
                 )
         return out

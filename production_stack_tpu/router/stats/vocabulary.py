@@ -170,9 +170,15 @@ TPU_SPEC_WINDOW_OUTCOMES = ("accepted", "rejected", "wasted")
 # amortization tax.
 TPU_MULTISTEP_FALLBACK = "tpu:multistep_fallback_total"
 # The closed reason set, pre-seeded as zero-valued series so scrapers,
-# dashboards, and rate() see stable label sets from boot.
+# dashboards, and rate() see stable label sets from boot.  The mixed-
+# window decline reasons are split so the flight recorder (and this
+# family) can say WHY a waiting prompt forced K=1: bucket_mismatch — the
+# head chunk fit no static chunk bucket; pool_pressure — the KV pool had
+# no room for the chunk's blocks; waiting_head — the residual decline
+# (mixed windows disabled, or an unpackable final chunk).
 TPU_MULTISTEP_FALLBACK_REASONS = (
     "guided", "logit_bias", "logprobs", "waiting_head",
+    "bucket_mismatch", "pool_pressure",
 )
 TPU_MULTISTEP_WASTED_TOKENS = "tpu:multistep_wasted_tokens_total"
 # Mixed K-step windows (scheduler mixed_window): prompt tokens whose
@@ -235,6 +241,20 @@ TPU_LOCKSTEP_MEMBER_FAILURES = "tpu:lockstep_member_failures_total"
 # dashboards, and rate() see stable label sets from boot.
 TPU_LOCKSTEP_FAILURE_REASONS = ("member_silent", "epoch_mismatch")
 TPU_SLICE_DRAIN_RELAYS = "tpu:slice_drain_relays_total"
+# XLA compile-event tracking (obs/compile_tracker.py): seconds spent in
+# trace+compile per executable shape key (labeled counter — the label is
+# the jit entry point plus a compact arg-shape signature), and the count
+# of distinct executable keys compiled since boot (gauge; read against
+# the config-derived inventory at GET /debug/compiles for warmup
+# coverage).  A compile_seconds series growing under steady traffic
+# means live shapes are still missing from warmup.
+TPU_COMPILE_SECONDS = "tpu:compile_seconds_total"
+TPU_COMPILED_SHAPES = "tpu:compiled_shapes"
+# Trace-ring eviction truth (obs/trace.py byte bound): completed
+# /debug/requests records dropped by the count or byte bound.  Nonzero
+# under a long-prompt burst is EXPECTED (the bound doing its job);
+# silent unbounded growth is what it replaces.
+TPU_OBS_TRACE_DROPPED = "tpu:obs_trace_dropped_total"
 TPU_COUNTERS = frozenset({
     TPU_PREFIX_CACHE_HIT_TOKENS,
     TPU_PREFIX_CACHE_QUERY_TOKENS,
@@ -258,6 +278,7 @@ TPU_COUNTERS = frozenset({
     TPU_DISAGG_HANDOFF_HITS,
     TPU_DISAGG_HANDOFF_MISSES,
     TPU_SLICE_DRAIN_RELAYS,
+    TPU_OBS_TRACE_DROPPED,
 })
 
 

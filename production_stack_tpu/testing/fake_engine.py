@@ -140,6 +140,8 @@ class FakeEngineState:
         remote_store_import: bool = False,
         store_import_chars_per_sec: float | None = None,
         slice_group: FakeSliceGroup | None = None,
+        simulate_compiles: bool = False,
+        tracing: bool = True,
     ):
         self.model = model
         self.tokens_per_sec = tokens_per_sec
@@ -179,8 +181,17 @@ class FakeEngineState:
         self._rng = random.Random(seed)
         self._seen_chunks: set = set()
         # Same obs contract as the real engine (EngineObs): tracing tests
-        # and the bench trace_report run against this in CI.
-        self.obs = EngineObs()
+        # and the bench trace_report run against this in CI.  tracing=False
+        # mirrors obs.tracing=off — the recorder/tracker zero-state gate.
+        self.obs = EngineObs(enabled=tracing)
+        # Simulated XLA compiles: a cold prompt-size bucket records one
+        # compile event (first request of each pow2 size pays it, repeats
+        # don't — the real cache-growth semantics), taints the request's
+        # trace/window, and stamps '"compile": true' into the first
+        # response chunk exactly like the real server, so the router's
+        # compile-excluded TTFT path and /debug/compiles are CI-testable
+        # without jax.
+        self.simulate_compiles = bool(simulate_compiles)
         # Headers of the most recent completion request (trace-propagation
         # assertions in tests).
         self.last_headers: dict = {}
@@ -544,18 +555,53 @@ def build_fake_engine_app(state: FakeEngineState | None = None) -> web.Applicati
                     if state.slice_group else {}
                 ),
             },
-        ) + state.obs.render_metrics()
+        ) + vocab.render_labeled_counter(
+            # XLA compile events per executable key: live values when
+            # simulate_compiles is on, empty header otherwise — family
+            # present either way for the scrape contract (SC303).
+            vocab.TPU_COMPILE_SECONDS, "executable",
+            state.obs.compile_tracker.seconds_by_executable(),
+        ) + vocab.render_prometheus([
+            (vocab.TPU_COMPILED_SHAPES,
+             state.obs.compile_tracker.compiled_shapes()),
+            (vocab.TPU_OBS_TRACE_DROPPED, state.obs.tracer.dropped),
+        ]) + state.obs.render_metrics()
 
     async def debug_requests(_request: web.Request) -> web.Response:
         return web.json_response(state.obs.debug_payload())
 
     async def debug_request(request: web.Request) -> web.Response:
-        snap = state.obs.tracer.snapshot(request.match_info["request_id"])
+        snap = state.obs.request_payload(request.match_info["request_id"])
         if snap is None:
             return web.json_response(
                 {"error": {"message": "unknown request id"}}, status=404
             )
         return web.json_response(snap)
+
+    async def debug_windows(request: web.Request) -> web.Response:
+        return web.json_response(
+            state.obs.windows_payload(seq=request.query.get("seq") or None)
+        )
+
+    async def debug_compiles(_request: web.Request) -> web.Response:
+        # Mirror of the real engine's compiles_payload(), jax-free: the
+        # fake has no config-derived shape inventory, so coverage reports
+        # the observed families as fully covered (contract tests assert
+        # the payload SHAPE; the coverage math is engine-side logic).
+        tracker = state.obs.compile_tracker
+        coverage = {}
+        for key in tracker.seconds_by_executable():
+            fam = key.split("[", 1)[0]
+            ent = coverage.setdefault(fam, {"compiled": 0, "expected": 0})
+            ent["compiled"] += 1
+            ent["expected"] += 1
+        return web.json_response({
+            "enabled": state.obs.enabled,
+            "compiled_shapes": tracker.compiled_shapes(),
+            "compile_seconds": round(tracker.compile_seconds(), 6),
+            "executables": tracker.snapshot(),
+            "coverage": coverage,
+        })
 
     async def chat_completions(request: web.Request) -> web.StreamResponse:
         return await _completion_common(request, chat=True)
@@ -778,6 +824,25 @@ def build_fake_engine_app(state: FakeEngineState | None = None) -> web.Applicati
         state.total_requests += 1
         state.num_running += 1
         state.total_prompt_tokens += max(1, len(prompt_text) // 4)
+        # One simulated flight record per request: the whole decode rides
+        # one "window" (k = token budget, one row), so /debug/windows and
+        # the /debug/requests/{id} join are contract-testable without a
+        # device.
+        rec = state.obs.recorder.on_dispatch(
+            "decode", k=max_tokens, rows=1, seq_ids=(request_id,),
+        )
+        if state.simulate_compiles and uncached_chars and state.obs.enabled:
+            sig = f"chars{1 << max(0, uncached_chars - 1).bit_length()}"
+            if (
+                f"prefill_fn[{sig}]"
+                not in state.obs.compile_tracker.seconds_by_executable()
+            ):
+                state.obs.compile_tracker.record("prefill_fn", sig, ttft_s)
+                state.obs.on_compile(
+                    (request_id,),
+                    state.obs.compile_tracker.drain_events(),
+                    rec,
+                )
         try:
             object_name = "chat.completion.chunk" if chat else "text_completion"
             if stream:
@@ -805,17 +870,17 @@ def build_fake_engine_app(state: FakeEngineState | None = None) -> web.Applicati
                         choice = {"index": 0, "delta": delta, "finish_reason": None}
                     else:
                         choice = {"index": 0, "text": token, "finish_reason": None}
-                    await response.write(
-                        _sse(
-                            {
-                                "id": request_id,
-                                "object": object_name,
-                                "created": created,
-                                "model": body.get("model", state.model),
-                                "choices": [choice],
-                            }
-                        )
-                    )
+                    chunk = {
+                        "id": request_id,
+                        "object": object_name,
+                        "created": created,
+                        "model": body.get("model", state.model),
+                        "choices": [choice],
+                    }
+                    if i == 0 and state.obs.compile_tainted(request_id):
+                        # Same first-chunk marker the real server stamps.
+                        chunk["compile"] = True
+                    await response.write(_sse(chunk))
                     state.total_generated_tokens += 1
                     if stall_after is not None and i + 1 >= stall_after:
                         # Injected stall: the stream hangs byte-less until
@@ -829,6 +894,10 @@ def build_fake_engine_app(state: FakeEngineState | None = None) -> web.Applicati
                         state.obs.request_hists["itl"].observe(now - t_last)
                     t_last = now
                 state.total_finished += 1
+                state.obs.recorder.on_collect(
+                    rec, tokens_emitted=max_tokens,
+                    tokens_delivered=max_tokens,
+                )
                 _finish_trace(request_id, t_recv, t_first, time.time())
                 final_choice = (
                     {"index": 0, "delta": {}, "finish_reason": "length"}
@@ -861,6 +930,9 @@ def build_fake_engine_app(state: FakeEngineState | None = None) -> web.Applicati
             text = " ".join(_word(state._rng) for _ in range(max_tokens))
             state.total_generated_tokens += max_tokens
             state.total_finished += 1
+            state.obs.recorder.on_collect(
+                rec, tokens_emitted=max_tokens, tokens_delivered=max_tokens,
+            )
             if state.obs.enabled:
                 # Same obs contract as the real engine: ITL is observed
                 # per token gap regardless of stream mode.
@@ -880,27 +952,32 @@ def build_fake_engine_app(state: FakeEngineState | None = None) -> web.Applicati
             resp_headers = {"X-Request-Id": request_id}
             if disagg_outcome is not None:
                 resp_headers["X-Disagg-Prefix"] = disagg_outcome
-            return web.json_response(
-                {
-                    "id": request_id,
-                    "object": object_name,
-                    "created": created,
-                    "model": body.get("model", state.model),
-                    "choices": [choice],
-                    "usage": {
-                        "prompt_tokens": len(prompt_text) // 4,
-                        "completion_tokens": max_tokens,
-                        "total_tokens": len(prompt_text) // 4 + max_tokens,
-                    },
+            final_body = {
+                "id": request_id,
+                "object": object_name,
+                "created": created,
+                "model": body.get("model", state.model),
+                "choices": [choice],
+                "usage": {
+                    "prompt_tokens": len(prompt_text) // 4,
+                    "completion_tokens": max_tokens,
+                    "total_tokens": len(prompt_text) // 4 + max_tokens,
                 },
-                headers=resp_headers,
-            )
+            }
+            if state.obs.compile_tainted(request_id):
+                # Same body marker the real server stamps non-streaming.
+                final_body["compile"] = True
+            return web.json_response(final_body, headers=resp_headers)
         except (asyncio.CancelledError, ConnectionResetError):
             # The peer tore the stream down (client disconnect, router
             # idle-read timeout, proxy teardown): record the abort so
             # propagation tests can assert the engine-side release
             # happened, then re-raise — cancellation must not be eaten.
             state.aborted_requests.append(request_id)
+            if rec is not None and rec.collected_at is None:
+                # Publish the flight record exactly once even on abort —
+                # an uncollected record would leak from /debug/windows.
+                state.obs.recorder.on_collect(rec)
             if state.obs.enabled:
                 state.obs.on_abort(request_id)
             raise
@@ -914,6 +991,8 @@ def build_fake_engine_app(state: FakeEngineState | None = None) -> web.Applicati
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/debug/requests", debug_requests)
     app.router.add_get("/debug/requests/{request_id}", debug_request)
+    app.router.add_get("/debug/windows", debug_windows)
+    app.router.add_get("/debug/compiles", debug_compiles)
     app.router.add_post("/v1/chat/completions", chat_completions)
     app.router.add_post("/v1/completions", completions)
     return app

@@ -106,6 +106,31 @@ def test_tracer_ring_and_active_bounds():
     assert tracer.active_count() <= tracer.MAX_ACTIVE_FACTOR * 4
 
 
+def test_tracer_byte_bound_evicts_and_counts_drops():
+    """The completed ring is byte-bounded too (PR 17): long-prompt bursts
+    produce records hundreds of times larger than short ones, so a
+    count-only cap does not bound resident memory.  Evictions increment
+    ``dropped`` (tpu:obs_trace_dropped_total) — never silent."""
+    tracer = Tracer("router", ring_size=1000, ring_bytes=4096)
+    for i in range(50):
+        tracer.start(f"r{i}", attrs={"prompt": "x" * 512})
+        tracer.add_span(f"r{i}", "router.queue", 0.0, 1.0)
+        tracer.finish(f"r{i}", end=2.0)
+    completed = tracer.completed()
+    # Far fewer than the count bound survived; the byte bound ruled.
+    assert 1 <= len(completed) < 50
+    assert completed[0].request_id == "r49"  # newest always kept
+    assert sum(t.approx_bytes for t in completed) <= 4096 + completed[0].approx_bytes
+    assert tracer.dropped == 50 - len(completed)
+    # No byte bound -> count bound only, nothing dropped at 50 records.
+    unbounded = Tracer("router", ring_size=1000)
+    for i in range(50):
+        unbounded.start(f"r{i}", attrs={"prompt": "x" * 512})
+        unbounded.finish(f"r{i}", end=2.0)
+    assert len(unbounded.completed()) == 50
+    assert unbounded.dropped == 0
+
+
 def test_duplicate_inflight_id_supersedes_not_merges():
     """Two concurrent requests reusing one X-Request-Id must not merge
     spans into one timeline: the older active trace retires to the ring

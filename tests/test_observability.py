@@ -441,12 +441,26 @@ def test_tracing_off_restores_fast_path():
     # Tracing on: state accrued.
     assert sum(h.count for h in eng_on.obs.step_hists.values()) > 0
     assert sum(h.count for h in eng_on.obs.request_hists.values()) > 0
+    # Tracing on: every dispatch left a flight record.
+    assert eng_on.obs.recorder.windows_recorded > 0
     # Tracing off: nothing accrued anywhere.
     assert not eng_off.obs.enabled
     assert sum(h.count for h in eng_off.obs.step_hists.values()) == 0
     assert sum(h.count for h in eng_off.obs.request_hists.values()) == 0
     assert eng_off.obs.tracer.completed() == []
     assert eng_off.obs.tracer.active_count() == 0
+    # ... including the flight recorder and compile tracker (PR 17): the
+    # recorder ring stays empty, on_dispatch returned None everywhere,
+    # and jit entry points stayed the BARE callables (wrap() identity —
+    # the byte-identical fast path, not a pass-through proxy).
+    assert eng_off.obs.recorder.windows_recorded == 0
+    assert eng_off.obs.recorder.snapshot() == []
+    assert eng_off.obs.compile_tracker.compiled_shapes() == 0
+    assert eng_off.obs.compile_tracker.snapshot() == []
+    from production_stack_tpu.obs.compile_tracker import _TrackedJit
+    assert not isinstance(eng_off._prefill_fn, _TrackedJit)
+    assert not isinstance(eng_off._decode_fn, _TrackedJit)
+    assert isinstance(eng_on._prefill_fn, _TrackedJit)
 
 
 async def test_idle_router_renders_histogram_family_headers():

@@ -52,6 +52,28 @@ def test_ttft_and_phase_transitions():
     assert s.uncompleted_requests == 0
 
 
+def test_ttft_clean_excludes_compile_tainted_samples():
+    """Compile-excluded TTFT window (PR 17): samples whose first chunk
+    carried the engine's compile marker stay out of ttft_clean_p95, so
+    the steady-state quantile is separable from XLA warmup outliers."""
+    m = RequestStatsMonitor(sliding_window_size=60.0)
+    # One compile-tainted cold request with a huge TTFT...
+    m.on_new_request(URL, "cold", timestamp=0.0)
+    m.on_request_response(URL, "cold", timestamp=8.0, compile_tainted=True)
+    # ...then steady-state requests with ~0.2s TTFTs.
+    for i in range(9):
+        m.on_new_request(URL, f"warm{i}", timestamp=10.0 + i)
+        m.on_request_response(URL, f"warm{i}", timestamp=10.2 + i)
+    s = m.get_request_stats(current_time=20.0, with_quantiles=True)[URL]
+    # The raw windowed p95 sees the 8s compile outlier; the clean one
+    # doesn't.
+    assert s.ttft_p95 > 1.0
+    assert s.ttft_clean_p95 < 0.5
+    # Without quantiles the field stays zero (cheap path).
+    s = m.get_request_stats(current_time=20.0)[URL]
+    assert s.ttft_clean_p95 == 0.0
+
+
 def test_itl_from_token_chunks():
     m = RequestStatsMonitor()
     m.on_new_request(URL, "r1", timestamp=0.0)
