@@ -1040,12 +1040,31 @@ def bench_engine_mixed_window_ab(args, preset: str) -> dict:
 
 
 def bench_engine_mixed_window_depth_grid(args, preset: str) -> dict:
-    """Queue-depth scaling of packed multi-prompt mixed windows through
-    the REAL engine: the tokens/s-monotone-in-depth claim, measured.
+    """The ROADMAP grid through the REAL engine: queue-depth {1, 4, 16}
+    x drafter {none, ngram, model} on a templated AND an adversarial
+    replay — depth scaling of packed multi-prompt mixed windows plus
+    the drafter roofline, measured.
     Each cell holds the waiting queue at a target depth d in {1, 4, 16}
     (continuous refill from a fixed 16-arrival pool the moment the queue
-    dips below d) while two resident streams decode, over drafter arms
-    {ngram 0, ngram 3}.  Arrival prompts are LONGER than the largest
+    dips below d) while two resident streams decode.  Drafting is
+    pure-decode-window-only (mixed windows keep the drafting state warm
+    but never draft), so each cell runs TWO timed phases: the admission
+    phase (continuous refill — the depth-monotonicity claim; identical
+    workload across replays and drafter arms) and a pure-decode TAIL
+    after the arrival pool drains — S_TAIL FRESH streams decoding
+    through chained spec windows, where the drafter arms separate.
+    The model arm loads the TARGET preset as its own drafter (identical
+    deterministic init; fresh tail streams keep the draft cache's
+    in-graph prime covering the full context, so acceptance is total).
+    The replays differ only in the tail text: templated tail streams
+    cycle fast (prompt-lookup heaven, n-gram acceptance near-total);
+    the adversarial tail adds repetition/frequency penalties so the
+    text NEVER cycles — the non-templated regime the ROADMAP claim is
+    about — which zeroes prompt-lookup acceptance while the model
+    drafter's penalty-aware proposals stay accepted, so its tail
+    tokens/s must strictly beat ngram's: acceptance quality measured
+    as throughput.
+    Arrival prompts are LONGER than the largest
     whole-prefill bucket, so every cell admits through mixed windows —
     the grid isolates PACKING: at depth 1 each window carries one
     prompt's 2 chunks (a short scan, one host dispatch+collect round
@@ -1100,17 +1119,56 @@ def bench_engine_mixed_window_depth_grid(args, preset: str) -> dict:
     ARRIVAL_GEN = 2
     N_WARM = 32          # TWO dress-rehearsal segments (see docstring)
     N_MEAS = 32
-    RES_BUDGET = 600
+    RES_BUDGET = 600     # resident generation cap (never reached)
+    S_TAIL = 8           # fresh decode streams for the tail phase
+    TAIL_RAMP = 400      # untimed: tail prefills + spec-scan compiles
+    TAIL_TOK = 600       # decode tokens timed in the tail phase
 
+    # Admission phase: IDENTICAL across replays and drafter arms (the
+    # depth-monotonicity claim is about packing, and mixed windows
+    # never draft) — pseudo-random streams, all distinct, no prefix
+    # sharing.  The drafter arms separate in the TAIL below.
     arrival_prompts = [
         [(11 * i + 17 * n + 3) % 101 for i in range(ARRIVAL_PROMPT)]
         for n in range(N_WARM + N_MEAS)
     ]
     res_prompts = [
-        [(5 * i + 3 * r) % 103 for i in range(RES_CTX)] for r in range(S_RES)
+        [(5 * i + 3 * r) % 103 for i in range(RES_CTX)]
+        for r in range(S_RES)
     ]
 
-    def run(depth: int, ngram: int, packed: bool = True) -> dict:
+    template = (5, 17, 9, 33, 21, 5, 17, 9)
+
+    def tail_for(replay: str):
+        """(prompts, extra SamplingParams kwargs) for the tail streams.
+
+        templated: rotated repetitive prompts, plain greedy — the
+        free-running tiny model settles into cycles fast, so
+        prompt-lookup acceptance is near-total (n-gram heaven).
+        adversarial: distinct pseudo-random prompts PLUS repetition/
+        frequency penalties.  The penalties keep the generated text
+        from ever cycling — which is exactly the non-templated traffic
+        the ROADMAP claim is about, and is what defeats prompt-lookup
+        (no bigram ever repeats).  The model drafter's penalty-aware
+        proposals (the drafter replays the carried penalty state along
+        its chain) keep ITS acceptance total, so the arm separation is
+        acceptance quality, not prompt trivia."""
+        if replay == "templated":
+            prompts = [
+                (list(template[r % len(template):])
+                 + list(template) * 16)[:RES_CTX]
+                for r in range(S_TAIL)
+            ]
+            return prompts, {}
+        prompts = [
+            [(7 * i + 5 * r + 11) % 97 for i in range(RES_CTX)]
+            for r in range(S_TAIL)
+        ]
+        return prompts, {"frequency_penalty": 0.6,
+                         "repetition_penalty": 1.3}
+
+    def run(depth: int, drafter: str, replay: str,
+            packed: bool = True) -> dict:
         sched = dict(
             # 8 arrival slots beside the 2 residents: a K=16 window can
             # pack exactly 8 two-chunk arrivals, so queue DEPTH is what
@@ -1126,8 +1184,16 @@ def bench_engine_mixed_window_depth_grid(args, preset: str) -> dict:
             prefill_chunk_buckets=(CHUNK,),
             max_model_len=768,
             decode_window=16,
-            speculative_ngram=ngram,
         )
+        if drafter == "ngram":
+            sched["speculative_ngram"] = 3
+        elif drafter == "model":
+            # The target preset as its own drafter: identical
+            # deterministic init (same seed) keeps acceptance near
+            # total, so the arm measures the fused draft-KV machinery,
+            # not a random drafter's (zero) agreement.
+            sched["speculative_model"] = preset
+            sched["speculative_draft_len"] = 3
         if not packed:
             sched["multi_prompt_window"] = False
         eng = LLMEngine(EngineConfig(
@@ -1196,6 +1262,47 @@ def bench_engine_mixed_window_depth_grid(args, preset: str) -> dict:
         while eng.has_unfinished():
             for out in eng.step():
                 outs.setdefault(out.seq_id, []).append(out.new_token_id)
+
+        # Pure-decode TAIL: S_TAIL FRESH streams decode through
+        # chained speculative windows with the queue empty — the phase
+        # where the drafter arms separate, since mixed windows never
+        # draft.  Fresh streams (not the admission residents) so the
+        # model drafter's lazy in-graph prime covers the FULL context
+        # (context at the first spec window <= the history window H),
+        # keeping identical-weights acceptance total; the untimed ramp
+        # absorbs the tail prefills, the spec executables' compiles
+        # (both prime variants dispatch within the first chained
+        # windows), and the prime itself.
+        tail_prompts, tail_kw = tail_for(replay)
+        for r in range(S_TAIL):
+            eng.add_request(
+                f"tail{r}", prompt_token_ids=list(tail_prompts[r]),
+                sampling_params=SamplingParams(
+                    max_tokens=400, ignore_eos=True, **tail_kw),
+            )
+
+        def pump(n_tokens: int) -> None:
+            produced = 0
+            steps = 0
+            while produced < n_tokens:
+                steps += 1
+                assert steps < 30000, "engine failed to drain"
+                for out in eng.step():
+                    outs.setdefault(out.seq_id, []).append(
+                        out.new_token_id)
+                    produced += 1
+
+        pump(TAIL_RAMP)
+        st0 = eng.stats()
+        t1 = time.perf_counter()
+        pump(TAIL_TOK)
+        tail_elapsed = time.perf_counter() - t1
+        st1 = eng.stats()
+        for r in range(S_TAIL):
+            eng.abort_request(f"tail{r}")
+        while eng.has_unfinished():
+            for out in eng.step():
+                outs.setdefault(out.seq_id, []).append(out.new_token_id)
         win_n = eng.mixed_window_prompts_hist.count - hist0[0]
         win_sum = eng.mixed_window_prompts_hist.sum - hist0[1]
         gen_delta = s1["total_generated_tokens"] - gen0
@@ -1215,8 +1322,16 @@ def bench_engine_mixed_window_depth_grid(args, preset: str) -> dict:
             digest.update(
                 f"arr{n}:{','.join(map(str, outs[f'arr{n}']))};".encode()
             )
+        drafted = st1["spec_tokens_drafted"] - st0["spec_tokens_drafted"]
+        accepted = (st1["spec_tokens_accepted"]
+                    - st0["spec_tokens_accepted"])
         result = {
             "tokens_per_s": round(tokens / max(elapsed, 1e-9), 1),
+            "decode_tokens_per_s": round(
+                TAIL_TOK / max(tail_elapsed, 1e-9), 1
+            ),
+            "acceptance_rate": round(accepted / drafted, 3) if drafted
+            else 0.0,
             "ttft_p50_ms": round(pct(meas_ttfts, 0.50) * 1e3, 1),
             "ttft_p95_ms": round(pct(meas_ttfts, 0.95) * 1e3, 1),
             "waiting_head": int(
@@ -1226,50 +1341,83 @@ def bench_engine_mixed_window_depth_grid(args, preset: str) -> dict:
             "transfer_overlap_s": round(
                 s1["window_transfer_overlap_seconds"], 4
             ),
+            "spec_draft_fraction_s": round(
+                st1["spec_draft_fraction_seconds"], 4
+            ),
             "greedy_digest": digest.hexdigest()[:16],
             "_res_streams": [list(outs.get(f"res{r}", []))
-                             for r in range(S_RES)],
+                             for r in range(S_RES)]
+            + [list(outs.get(f"tail{r}", []))
+               for r in range(S_TAIL)],
         }
         del eng
         gc.collect()
         return result
 
+    DEPTHS = (1, 4, 16)
+    DRAFTERS = ("none", "ngram", "model")
+    REPLAYS = (("temp", "templated"), ("adv", "adversarial"))
     results = {}
-    for depth in (1, 4, 16):
-        for ngram in (0, 3):
-            results[f"d{depth}_ng{ngram}"] = run(depth, ngram)
-    results["d16_ng0_nopack"] = run(16, 0, packed=False)
+    for rp, replay in REPLAYS:
+        for depth in DEPTHS:
+            for drafter in DRAFTERS:
+                results[f"{rp}_d{depth}_{drafter}"] = run(
+                    depth, drafter, replay)
+    results["temp_d16_none_nopack"] = run(
+        16, "none", "templated", packed=False)
 
-    digests = {c: r["greedy_digest"] for c, r in results.items()}
-    parity = len(set(digests.values())) == 1
+    # Parity is PER REPLAY (the two replays feed different prompts);
+    # within a replay every cell — any depth, any drafter, packed or
+    # not — must emit byte-identical greedy arrival streams and
+    # prefix-consistent resident streams.
+    parity = True
     res_parity = True
-    for r in range(S_RES):
-        streams = [c["_res_streams"][r] for c in results.values()]
-        shortest = min(streams, key=len)
-        res_parity &= all(s[: len(shortest)] == shortest for s in streams)
+    for rp, _ in REPLAYS:
+        cells = [r for c, r in results.items() if c.startswith(rp + "_")]
+        parity &= len({r["greedy_digest"] for r in cells}) == 1
+        for r_i in range(S_RES + S_TAIL):
+            streams = [c["_res_streams"][r_i] for c in cells]
+            shortest = min(streams, key=len)
+            res_parity &= all(
+                s[: len(shortest)] == shortest for s in streams)
     for cell in results.values():
         del cell["_res_streams"]
     monotone = all(
-        results[f"d1_ng{g}"]["tokens_per_s"]
-        <= results[f"d4_ng{g}"]["tokens_per_s"] * 1.02
-        and results[f"d4_ng{g}"]["tokens_per_s"]
-        <= results[f"d16_ng{g}"]["tokens_per_s"] * 1.02
-        for g in (0, 3)
+        results[f"{rp}_d1_{dr}"]["tokens_per_s"]
+        <= results[f"{rp}_d4_{dr}"]["tokens_per_s"] * 1.02
+        and results[f"{rp}_d4_{dr}"]["tokens_per_s"]
+        <= results[f"{rp}_d16_{dr}"]["tokens_per_s"] * 1.02
+        for rp, _ in REPLAYS for dr in DRAFTERS
     )
+    # The drafter roofline: on the ADVERSARIAL replay prompt-lookup
+    # collapses (ngram acceptance ~0 -> one token per scan iteration)
+    # while the model drafter keeps proposing the target's own argmax,
+    # so its pure-decode tail must be STRICTLY faster.  Depth doesn't
+    # matter in the tail (queue empty), so the three depths are three
+    # independent samples — compare their sums.
+    adv_model = sum(
+        results[f"adv_d{d}_model"]["decode_tokens_per_s"] for d in DEPTHS)
+    adv_ngram = sum(
+        results[f"adv_d{d}_ngram"]["decode_tokens_per_s"] for d in DEPTHS)
     return {
         **results,
         # The acceptance bars: tokens/s monotone non-decreasing in queue
-        # depth (2% CPU-noise band per step), ZERO waiting_head
-        # fallbacks on the packed path at depth 16, and greedy streams
-        # byte-identical across every cell including the unpacked
-        # reference.
+        # depth (2% CPU-noise band per step) in EVERY drafter x replay
+        # arm, ZERO waiting_head fallbacks on the packed path at depth
+        # 16, model drafter strictly beating ngram on the adversarial
+        # decode tail, and greedy streams byte-identical across every
+        # cell of a replay including the unpacked reference.
         "tokens_per_s_monotone": monotone,
-        "waiting_head_at_depth16": results["d16_ng0"]["waiting_head"],
+        "waiting_head_at_depth16": results["temp_d16_none"]["waiting_head"],
         "greedy_parity": parity,
         "resident_prefix_parity": res_parity,
+        "model_beats_ngram_adversarial": adv_model > adv_ngram,
+        "adv_decode_speedup_model_vs_ngram": round(
+            adv_model / max(adv_ngram, 1e-9), 2
+        ),
         "depth_speedup_d16_vs_d1": round(
-            results["d16_ng0"]["tokens_per_s"]
-            / max(results["d1_ng0"]["tokens_per_s"], 1e-9), 2
+            results["temp_d16_none"]["tokens_per_s"]
+            / max(results["temp_d1_none"]["tokens_per_s"], 1e-9), 2
         ),
     }
 
@@ -3350,9 +3498,11 @@ def main() -> None:
         except Exception as e:
             log(f"mixed-window A/B failed: {e}")
             detail["mixed_window_ab_error"] = str(e)[:200]
-        # Queue-depth scaling of packed multi-prompt windows: tokens/s
-        # must be monotone non-decreasing in depth {1, 4, 16}, packed
-        # waiting_head pinned at zero at depth 16, greedy digests
+        # Queue-depth x drafter grid on two replays: tokens/s must be
+        # monotone non-decreasing in depth {1, 4, 16} in every
+        # {none, ngram, model} arm, packed waiting_head pinned at zero
+        # at depth 16, the model drafter strictly beating ngram on the
+        # adversarial pure-decode tail, and greedy digests
         # byte-identical across every cell incl. the unpacked reference.
         try:
             import gc as _gc
@@ -3363,15 +3513,22 @@ def main() -> None:
             )
             dg = detail["mixed_window_depth"]
             log(f"mixed-window depth grid: tokens/s "
-                f"{dg['d1_ng0']['tokens_per_s']} @d1 / "
-                f"{dg['d4_ng0']['tokens_per_s']} @d4 / "
-                f"{dg['d16_ng0']['tokens_per_s']} @d16 "
+                f"{dg['temp_d1_none']['tokens_per_s']} @d1 / "
+                f"{dg['temp_d4_none']['tokens_per_s']} @d4 / "
+                f"{dg['temp_d16_none']['tokens_per_s']} @d16 "
                 f"(monotone {dg['tokens_per_s_monotone']}, "
                 f"{dg['depth_speedup_d16_vs_d1']}x d16/d1), "
-                f"{dg['d16_ng0']['prompts_per_window_mean']} prompts/"
+                f"{dg['temp_d16_none']['prompts_per_window_mean']} prompts/"
                 f"window @d16, waiting_head "
                 f"{dg['waiting_head_at_depth16']} packed vs "
-                f"{dg['d16_ng0_nopack']['waiting_head']} unpacked, "
+                f"{dg['temp_d16_none_nopack']['waiting_head']} unpacked, "
+                f"adversarial decode tail model vs ngram "
+                f"{dg['adv_d16_model']['decode_tokens_per_s']} vs "
+                f"{dg['adv_d16_ngram']['decode_tokens_per_s']} tok/s "
+                f"({dg['adv_decode_speedup_model_vs_ngram']}x, beats "
+                f"{dg['model_beats_ngram_adversarial']}; acceptance "
+                f"{dg['adv_d16_model']['acceptance_rate']} vs "
+                f"{dg['adv_d16_ngram']['acceptance_rate']}), "
                 f"parity {dg['greedy_parity']}")
         except Exception as e:
             log(f"mixed-window depth grid failed: {e}")

@@ -77,7 +77,7 @@ class ModelConfig:
 # weights are loaded from local checkpoints or randomly initialized).
 PRESETS = {
     "tiny-llama": ModelConfig(),
-    "debug-1l": ModelConfig(name="debug-1l", num_layers=1),
+    "debug-1l": ModelConfig(name="llama-debug-1l", num_layers=1),
     "llama-3.2-1b": ModelConfig(
         name="llama-3.2-1b",
         vocab_size=128256,
@@ -472,6 +472,34 @@ class SchedulerConfig:
     # step — the A/B baseline and the fallback the host-state rows use).
     # 0 = off.
     speculative_ngram: int = 0
+    # Draft-MODEL speculative decoding: a second, tiny model (a PRESETS
+    # name, e.g. "tiny-llama" — loaded through the same registry/weights
+    # path as the target and sharded on the same mesh) proposes up to
+    # speculative_draft_len tokens per scan iteration INSIDE the K-step
+    # window, autoregressively from its own small device-resident KV
+    # cache (carried through the scan like the n-gram history buffer;
+    # blocks come from a dedicated draft pool so target KV capacity is
+    # untouched).  The target verifies draft+1 rows in the SAME wide
+    # forward the n-gram drafter uses — the two drafters are proposal
+    # sources behind one in-scan drafting interface, so acceptance,
+    # penalties, min_tokens, stop masks and the PRNG ordinal schedule
+    # are shared and greedy streams stay byte-identical across
+    # {none, ngram, model}.  Mutually exclusive with speculative_ngram
+    # (one proposal source per engine); requires the window machinery
+    # (no legacy host path exists for the model drafter).  Unlike the
+    # n-gram drafter, proposals depend only on draft weights + carried
+    # state, so acceptance holds up on non-templated text.  None = off.
+    speculative_model: Optional[str] = None
+    # Draft tokens proposed per scan iteration by the model drafter
+    # (the D in the W = D+1 verify-row fan-out; the model-drafter
+    # analogue of speculative_ngram's count).
+    speculative_draft_len: int = 4
+    # Device blocks reserved for the draft model's KV pool.  None = auto
+    # (sized for max_num_seqs rows at the drafter's history window plus
+    # chained-window growth).  Exhaustion never stalls: a window that
+    # cannot allocate draft blocks declines to a plain (non-speculative)
+    # window, counted under tpu:multistep_fallback_total{reason=draft_pool}.
+    speculative_draft_pool_blocks: Optional[int] = None
     # Mixed K-step windows: a waiting prompt's prefill chunks ride the
     # device-resident decode scan instead of forcing K=1 steps — each
     # scan iteration runs the packed [decode + chunk] mixed forward
@@ -542,6 +570,27 @@ class SchedulerConfig:
     def __post_init__(self):
         if self.speculative_ngram < 0:
             raise ValueError("speculative_ngram must be >= 0")
+        if self.speculative_draft_len < 1:
+            raise ValueError("speculative_draft_len must be >= 1")
+        if (
+            self.speculative_draft_pool_blocks is not None
+            and self.speculative_draft_pool_blocks < 2
+        ):
+            # BlockPool reserves block 0 as the null block; a pool of
+            # fewer than 2 blocks can never allocate anything.
+            raise ValueError("speculative_draft_pool_blocks must be >= 2")
+        if self.speculative_model is not None and self.speculative_ngram:
+            raise ValueError(
+                "speculative_model and speculative_ngram are mutually "
+                "exclusive (one proposal source per engine); drop "
+                "--speculative-ngram or pass --no-speculative-model"
+            )
+        if self.speculative_model is not None and self.multi_step_window is False:
+            raise ValueError(
+                "speculative_model runs INSIDE the K-step window scan and "
+                "has no legacy host-side path; drop --no-multi-step-window "
+                "or --speculative-model"
+            )
         if self.decode_window < 1:
             raise ValueError("decode_window must be >= 1")
         if self.num_scheduler_steps > 1 and self.multi_step_window is False:
@@ -630,23 +679,45 @@ class SchedulerConfig:
         return max(1, self.decode_window)
 
     @property
+    def spec_drafter(self) -> Optional[str]:
+        """Configured in-scan proposal source: "ngram" (prompt-lookup
+        from the carried history buffer), "model" (tiny draft model with
+        its own device-resident KV), or None.  Selection only — gate on
+        spec_window_enabled for whether the fused path actually runs."""
+        if self.speculative_model is not None:
+            return "model"
+        if self.speculative_ngram:
+            return "ngram"
+        return None
+
+    @property
+    def spec_draft_len(self) -> int:
+        """Draft tokens proposed per scan iteration by whichever drafter
+        is configured (the D in the W = D+1 verify-row fan-out)."""
+        if self.speculative_model is not None:
+            return self.speculative_draft_len
+        return self.speculative_ngram
+
+    @property
     def spec_window_enabled(self) -> bool:
-        """The fused draft-and-verify path: n-gram speculation proposed,
-        verified, and folded INSIDE the K-step window scan.  False means
-        either no speculation, or the legacy host-side speculative path
-        (speculative_ngram with multi_step_window=False)."""
-        return bool(self.speculative_ngram) and self.window_steps > 1
+        """The fused draft-and-verify path: speculation (n-gram or draft
+        model) proposed, verified, and folded INSIDE the K-step window
+        scan.  False means either no speculation, or the legacy host-side
+        speculative path (speculative_ngram with multi_step_window=False;
+        the model drafter has no legacy path — it is simply inert at
+        K=1)."""
+        return self.spec_drafter is not None and self.window_steps > 1
 
     @property
     def window_max_tokens(self) -> int:
         """Per-pure-decode-window token ceiling a single row may emit:
         K iterations, each committing one token plus up to
-        speculative_ngram accepted drafts under the fused path.  THE
+        spec_draft_len accepted drafts under the fused path.  THE
         bound the scheduler budgets block allocation and max_model_len
         room against (max-acceptance growth), and the engine sizes the
         chained-window block-table delta from."""
         if self.spec_window_enabled:
-            return self.window_steps * (self.speculative_ngram + 1)
+            return self.window_steps * (self.spec_draft_len + 1)
         return self.window_steps
 
     @property
@@ -795,6 +866,10 @@ class EngineConfig:
     seed: int = 0
     tokenizer: Optional[str] = None  # HF tokenizer path; None -> byte fallback
     weights_path: Optional[str] = None  # safetensors dir; None -> random init
+    # Draft-model checkpoint (scheduler.speculative_model); None -> the
+    # same deterministic random init the target uses, seeded identically
+    # on every replica (lockstep-safe by construction).
+    draft_weights_path: Optional[str] = None
 
     def __post_init__(self):
         # The scheduler must not admit sequences the cache cannot hold.

@@ -38,7 +38,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from production_stack_tpu.engine.config import EngineConfig
+from production_stack_tpu.engine.config import PRESETS, EngineConfig
 from production_stack_tpu.engine.core.scheduler import (
     DecodePlan,
     PrefillPlan,
@@ -95,11 +95,14 @@ class _PendingStep:
     host_s: float = 0.0  # host time spent dispatching this step
     steps: Optional[List[int]] = None  # per-row window TOKEN budgets (windows)
     win_state: Optional[dict] = None  # device window carry (windows)
-    # Fused speculative windows: ``sampled`` is [K, W, S] (W = ngram + 1
-    # sub-steps per scan iteration) and ``spec_stats`` the still-in-flight
-    # (drafted [K, S], accepted [K, S]) device counters collect() folds
-    # into tpu:spec_tokens_* and tpu:spec_window_tokens_total.
+    # Fused speculative windows: ``sampled`` is [K, W, S] (W = draft_len
+    # + 1 sub-steps per scan iteration) and ``spec_stats`` the still-in-
+    # flight (drafted [K, S], accepted [K, S]) device counters collect()
+    # folds into tpu:spec_tokens_* and tpu:spec_window_tokens_total;
+    # ``spec_drafter`` names the proposal source that ran ("ngram" /
+    # "model") for the per-drafter accounting.
     spec_stats: Optional[tuple] = None
+    spec_drafter: Optional[str] = None
     # Mixed K-step windows: the chunk schedule that rode the scan (one
     # PrefillPlan per live iteration — packed windows interleave several
     # prompts' chunks), the still-in-flight per-iteration tail logits
@@ -194,6 +197,49 @@ class LLMEngine:
             self.params, shardings_lib.param_shardings(cfg, self.mesh)
         )
 
+        # Draft model for in-scan speculative decoding
+        # (scheduler.speculative_model): a second, tiny model loaded
+        # through the SAME registry/weights path as the target and
+        # sharded on the same mesh.  Compatibility is validated LOUDLY
+        # at boot whenever a draft model is configured — a vocab
+        # mismatch would silently collapse acceptance (draft argmax over
+        # a different token space) or propose out-of-range ids; params
+        # are loaded only when the fused window will actually run
+        # (spec_window_enabled), so an inert K=1 config stays cheap.
+        self.draft_model = None
+        self.draft_cfg = None
+        self.draft_params = None
+        if config.scheduler.speculative_model is not None:
+            name = config.scheduler.speculative_model
+            if name not in PRESETS:
+                raise ValueError(
+                    f"Unknown speculative_model preset {name!r}; "
+                    f"available: {sorted(PRESETS)}"
+                )
+            draft_cfg = dataclasses.replace(PRESETS[name])
+            if draft_cfg.vocab_size != cfg.vocab_size:
+                raise ValueError(
+                    f"speculative_model {name!r} vocab "
+                    f"({draft_cfg.vocab_size}) != target {cfg.name!r} vocab "
+                    f"({cfg.vocab_size}): the drafter must share the "
+                    "target's tokenizer/vocab — a mismatched drafter "
+                    "proposes tokens the target cannot accept (or ids "
+                    "outside its vocab), silently degrading acceptance; "
+                    "refusing to boot"
+                )
+            shardings_lib.validate_tp(draft_cfg, par.tensor_parallel)
+            self.draft_cfg = draft_cfg
+            if config.scheduler.spec_window_enabled:
+                self.draft_model = get_model(draft_cfg.name)
+                logger.info("Loading draft params for %s ...", draft_cfg.name)
+                self.draft_params = load_params(
+                    draft_cfg, config.draft_weights_path, seed=config.seed
+                )
+                self.draft_params = jax.device_put(
+                    self.draft_params,
+                    shardings_lib.param_shardings(draft_cfg, self.mesh),
+                )
+
         num_blocks = self._decide_num_blocks()
         self.block_pool = BlockPool(
             num_blocks,
@@ -238,6 +284,53 @@ class LLMEngine:
             config.cache.block_size,
             self._kv_bytes(num_blocks) / 2**30,
         )
+
+        # Dedicated draft-KV pool (model drafter only): the draft
+        # model's device-resident cache lives in its OWN small block
+        # pool, so target KV capacity is untouched and a draft-side
+        # allocation failure can never preempt serving — it declines the
+        # window to plain (tpu:multistep_fallback_total{reason=
+        # draft_pool}).  Per-row capacity covers a full causal prime of
+        # the carried history window plus _DRAFT_PRIME_CHAIN windows of
+        # max-acceptance growth between primes (the skip-prime chain).
+        # Dense dtype regardless of cache.kv_cache_dtype: the pool is
+        # tiny (a 2-layer drafter at H+chain tokens per row) and the
+        # int8 (data, scale) plumbing would buy nothing.
+        self.draft_block_pool = None
+        self.draft_kv_caches = None
+        self._draft_blocks_per_row = 0
+        # Host-side draft-cache coherence state (step-thread-only):
+        # whether the device draft KV currently extends the batch's
+        # committed context (any non-model-spec dispatch breaks it), and
+        # how many windows chained since the last in-graph prime (the
+        # conservative capacity watermark).
+        self._draft_primed = False
+        self._draft_windows_since_prime = 0
+        self._draft_block_alloc: List[int] = []
+        if self.draft_params is not None:
+            bs = config.cache.block_size
+            cap = (
+                self._SPEC_HIST_WINDOW
+                + self._DRAFT_PRIME_CHAIN * config.scheduler.window_max_tokens
+            )
+            self._draft_blocks_per_row = -(-cap // bs)
+            pool_blocks = config.scheduler.speculative_draft_pool_blocks
+            if pool_blocks is None:
+                # Auto: every decode row fits simultaneously (+1 for the
+                # reserved null block 0) — exhaustion only under an
+                # explicit undersized override.
+                pool_blocks = (
+                    config.scheduler.max_num_seqs * self._draft_blocks_per_row
+                    + 1
+                )
+            self.draft_block_pool = BlockPool(
+                pool_blocks, bs, enable_prefix_caching=False
+            )
+            self.draft_kv_caches = self._allocate_draft_kv(pool_blocks)
+            logger.info(
+                "Draft KV pool: %d blocks x %d tokens (%d blocks/row)",
+                pool_blocks, bs, self._draft_blocks_per_row,
+            )
 
         offload_bytes = int(config.cache.host_offload_gb * 2**30)
         # Wire representation for offload/remote snapshots
@@ -483,31 +576,59 @@ class LLMEngine:
                 donate_argnames=("kv_caches",),
             )
 
-        # Fused n-gram speculation INSIDE the K-step window scan (the
-        # ROADMAP item-1 plan fusion): each scan iteration proposes up
-        # to `speculative_ngram` draft tokens on-device from a carried
-        # recent-history buffer (prompt lookup: most recent earlier
-        # occurrence of the trailing bigram), verifies them in the SAME
-        # forward by scoring the draft positions alongside the committed
-        # token (W = ngram+1 rows per sequence — the host speculative
+        # Fused speculation INSIDE the K-step window scan (the ROADMAP
+        # item-1 plan fusion): each scan iteration proposes up to
+        # `spec_draft_len` draft tokens on-device from ONE of two
+        # proposal sources behind a shared drafting interface — the
+        # n-gram drafter (prompt lookup: most recent earlier occurrence
+        # of the trailing bigram within a carried recent-history buffer)
+        # or the draft MODEL (scheduler.speculative_model: a tiny second
+        # model run autoregressively from its own compact device-
+        # resident KV cache, carried through the scan like the history
+        # buffer) — then verifies them in the SAME wide forward by
+        # scoring the draft positions alongside the committed token
+        # (W = draft_len+1 rows per sequence — the host speculative
         # path's expanded-batch layout, now inside the scan), and folds
         # acceptance into the carried state.  A rejected draft costs a
         # scan iteration, never a host round-trip; accepted tokens
         # advance the row's position/KV cursor inside the window.
         # Greedy-only (acceptance compares the model's own argmax, so
-        # greedy streams are byte-identical by construction); penalties,
-        # the min_tokens floor and stop masking apply to EVERY accepted
-        # token sequentially through the same apply_penalties_state /
-        # stop-mask code the single-step path uses.
+        # greedy streams are byte-identical by construction AND a pure
+        # function of weights + carried state — lockstep replicas cannot
+        # desync); penalties, the min_tokens floor and stop masking
+        # apply to EVERY accepted token sequentially through the same
+        # apply_penalties_state / stop-mask code the single-step path
+        # uses.
+        #
+        # Model-drafter cache layout: the draft KV uses COMPACT slots
+        # (0-based within the row's dedicated draft blocks) but TRUE
+        # sequence positions for RoPE — attention distances stay exact,
+        # so draft logits match full-context draft logits whenever the
+        # H-token history window covers the whole sequence, and degrade
+        # gracefully (history truncation, not corruption) past it.  The
+        # cache is (re)built by an in-graph causal PRIME (do_prime
+        # static arg): ONE wide draft forward over the S x (H-1) history
+        # tokens, write-then-attend + per-row ctx masking making row c
+        # attend exactly slots 0..c — the same trick the verify rows
+        # use.  Chained windows skip the prime (draft_pos rides the
+        # carry); the host re-primes on batch rebuilds, after any
+        # non-model-spec dispatch, and every _DRAFT_PRIME_CHAIN windows
+        # (the conservative capacity watermark).
         self._spec_window_fn = None
         if self._window_steps > 1 and config.scheduler.spec_window_enabled:
             model_decode = partial(self.model.decode, cfg=cfg, mesh=self.mesh)
             bs = config.cache.block_size
             n_steps = self._window_steps
             vocab = cfg.vocab_size
-            D = config.scheduler.speculative_ngram  # drafts per iteration
+            drafter = config.scheduler.spec_drafter
+            D = config.scheduler.spec_draft_len  # drafts per iteration
             W = D + 1  # verify rows per sequence (committed + drafts)
             H = self._SPEC_HIST_WINDOW
+            if drafter == "model":
+                draft_decode = partial(
+                    self.draft_model.decode, cfg=self.draft_cfg,
+                    mesh=self.mesh,
+                )
 
             def spec_window(
                 params, tokens, positions, ctx_lens, done, min_left,
@@ -515,6 +636,8 @@ class LLMEngine:
                 stop_ids, counts, seen, hist,
                 presence, frequency, repetition,
                 use_penalties, use_min_floor,
+                draft_params=None, draft_tables=None, draft_pos=None,
+                draft_kv=None, do_prime=False,
                 lora=None, adapter_idx=None,
             ):
                 stop_valid = stop_ids >= 0
@@ -528,10 +651,58 @@ class LLMEngine:
                 bmax = block_tables.shape[1]
                 if lora is not None:
                     wide_adapter = jnp.repeat(adapter_idx, W)
+                if drafter == "model":
+                    dbmax = draft_tables.shape[1]
+                if drafter == "model" and do_prime:
+                    # -- in-graph causal prime of the draft cache -------
+                    # One wide draft forward over every row's history-
+                    # window tokens EXCLUDING the committed last token
+                    # (the scan's first draft forward consumes that):
+                    # hist col c of a row with `live` valid entries maps
+                    # to compact slot c - (H - live) at TRUE position
+                    # positions + 1 - H + c; invalid (left-pad) rows
+                    # park on draft null block 0 at ctx 0.  Write-then-
+                    # attend + ctx = slot+1 masking gives exact causal
+                    # attention in the single call.
+                    Hm1 = H - 1
+                    live = jnp.minimum(positions + 1, H)
+                    colsp = jnp.arange(Hm1)[None, :]
+                    slots = colsp - (H - live)[:, None]
+                    pvalid = slots >= 0
+                    safe_slot = jnp.where(pvalid, slots, 0)
+                    rope = positions[:, None] + 1 - H + colsp
+                    pblk = jnp.take_along_axis(
+                        draft_tables,
+                        jnp.clip(safe_slot // bs, 0, dbmax - 1),
+                        axis=1,
+                    )
+                    _, draft_kv = draft_decode(
+                        draft_params,
+                        tokens=jnp.maximum(hist[:, :Hm1], 0).reshape(-1),
+                        positions=jnp.where(pvalid, rope, 0).reshape(-1),
+                        block_tables=jnp.repeat(draft_tables, Hm1, axis=0),
+                        ctx_lens=jnp.where(
+                            pvalid, slots + 1, 0
+                        ).reshape(-1),
+                        slot_block_ids=jnp.where(
+                            pvalid, pblk, 0
+                        ).reshape(-1),
+                        slot_offsets=(safe_slot % bs).reshape(-1),
+                        kv_caches=draft_kv,
+                    )
+                    # Invariant entering the scan: the draft cache holds
+                    # all context up to but EXCLUDING the committed
+                    # token, and draft_pos counts those compact slots.
+                    draft_pos = live - 1
 
                 def body(carry, t):
-                    (tokens, positions, ctx_lens, done, min_left,
-                     emitted_cnt, counts, seen, hist, kv_caches) = carry
+                    if drafter == "model":
+                        (tokens, positions, ctx_lens, done, min_left,
+                         emitted_cnt, counts, seen, hist, draft_pos,
+                         kv_caches, draft_kv) = carry
+                    else:
+                        (tokens, positions, ctx_lens, done, min_left,
+                         emitted_cnt, counts, seen, hist, kv_caches) = carry
                     # Budget gate is the TOKEN count, not the iteration
                     # index: acceptance advances a row several tokens
                     # per iteration and max_steps budgets the
@@ -539,51 +710,153 @@ class LLMEngine:
                     # blocks for.
                     active = jnp.logical_and(~done, emitted_cnt < max_steps)
 
-                    # -- on-device prompt-lookup draft ------------------
-                    # Most recent earlier occurrence of the trailing
-                    # bigram within the carried [S, H] history (left
-                    # -1-padded, hist[:, -1] == the committed token);
-                    # the tokens that followed it are the draft.  No
-                    # bigram hit falls back to the most recent UNIGRAM
-                    # occurrence of the committed token: the verify rows
-                    # are computed either way (static shapes), so a
-                    # speculative proposal is free and a rejected one
-                    # costs nothing the empty iteration didn't.
-                    key0 = hist[:, H - 2][:, None]
-                    key1 = hist[:, H - 1][:, None]
-                    starts = jnp.arange(H - 2)
-                    match2 = jnp.logical_and(
-                        jnp.logical_and(
-                            hist[:, : H - 2] == key0,
+                    if drafter == "model":
+                        # -- in-scan draft-model proposal ---------------
+                        # D+1 sequential single-row draft forwards: d=0
+                        # consumes the committed token (writing its KV
+                        # at compact slot draft_pos, TRUE RoPE position
+                        # `positions`), each d < D argmaxes the next
+                        # proposal and feeds it forward; the final d=D
+                        # forward only writes the last draft's KV so the
+                        # cache invariant holds even at full acceptance.
+                        # The verify's rewind is free: draft_pos
+                        # advances by the ACCEPTED count + 1, landing
+                        # the next iteration's first write exactly on
+                        # the first stale (rejected-draft) slot — stale
+                        # slots are overwritten before any row's ctx
+                        # mask can attend them.  Inactive rows park
+                        # writes on draft null block 0.
+                        cur = tokens
+                        drafts = []
+                        # Penalty-aware proposals: the verifier scores
+                        # sub-step j with the carried penalty state plus
+                        # the tokens accepted at sub-steps < j, so the
+                        # drafter replays the SAME transform on a local
+                        # copy along its chain — otherwise every token
+                        # where penalties flip the target argmax is a
+                        # guaranteed rejection.  Acceptance stays a pure
+                        # function of weights + carried state.
+                        if use_penalties:
+                            dcounts, dseen = counts, seen
+                        if use_min_floor:
+                            dmin = min_left
+                        drows = jnp.arange(tokens.shape[0])
+                        for d in range(D + 1):
+                            dslot = draft_pos + d
+                            dblk = jnp.take_along_axis(
+                                draft_tables,
+                                jnp.clip(dslot // bs, 0, dbmax - 1)[:, None],
+                                axis=1,
+                            )[:, 0]
+                            dlogits, draft_kv = draft_decode(
+                                draft_params,
+                                tokens=cur,
+                                positions=positions + d,
+                                block_tables=draft_tables,
+                                ctx_lens=jnp.where(active, dslot + 1, 0),
+                                slot_block_ids=jnp.where(active, dblk, 0),
+                                slot_offsets=dslot % bs,
+                                kv_caches=draft_kv,
+                            )
+                            if d < D:
+                                if use_penalties:
+                                    dlogits = (
+                                        sampling_lib.apply_penalties_state(
+                                            dlogits, dcounts, dseen,
+                                            presence, frequency, repetition,
+                                        )
+                                    )
+                                if use_min_floor:
+                                    dlogits = dlogits + (
+                                        jnp.logical_and(
+                                            stop_mask, (dmin > 0)[:, None]
+                                        ).astype(jnp.float32) * -1e9
+                                    )
+                                cur = jnp.argmax(
+                                    dlogits, axis=-1
+                                ).astype(jnp.int32)
+                                drafts.append(cur)
+                                if use_penalties:
+                                    # Mirror the verifier's append gate:
+                                    # a proposed stop token is emitted
+                                    # but not counted, and the chain
+                                    # past it is dead anyway.
+                                    dstop = jnp.any(
+                                        jnp.logical_and(
+                                            cur[:, None] == stop_ids,
+                                            stop_valid,
+                                        ),
+                                        axis=1,
+                                    )
+                                    dapp = jnp.logical_and(active, ~dstop)
+                                    dcounts = dcounts.at[drows, cur].add(
+                                        dapp.astype(jnp.int16)
+                                    )
+                                    dseen = dseen.at[drows, cur].max(dapp)
+                                if use_min_floor:
+                                    dmin = jnp.maximum(
+                                        dmin - active.astype(jnp.int32), 0
+                                    )
+                        draft = jnp.stack(drafts, axis=1)  # [S, D]
+                        # Room for drafts: the bonus/correction token
+                        # always takes one budget slot, drafts fill the
+                        # rest (same budget gate as the n-gram source).
+                        room = jnp.maximum(max_steps - emitted_cnt - 1, 0)
+                        dvalid = jnp.logical_and(
+                            jnp.arange(D)[None, :] < room[:, None],
+                            active[:, None],
+                        )
+                    else:
+                        # -- on-device prompt-lookup draft --------------
+                        # Most recent earlier occurrence of the trailing
+                        # bigram within the carried [S, H] history (left
+                        # -1-padded, hist[:, -1] == the committed
+                        # token); the tokens that followed it are the
+                        # draft.  No bigram hit falls back to the most
+                        # recent UNIGRAM occurrence of the committed
+                        # token: the verify rows are computed either way
+                        # (static shapes), so a speculative proposal is
+                        # free and a rejected one costs nothing the
+                        # empty iteration didn't.
+                        key0 = hist[:, H - 2][:, None]
+                        key1 = hist[:, H - 1][:, None]
+                        starts = jnp.arange(H - 2)
+                        match2 = jnp.logical_and(
+                            jnp.logical_and(
+                                hist[:, : H - 2] == key0,
+                                hist[:, 1 : H - 1] == key1,
+                            ),
+                            hist[:, : H - 2] >= 0,
+                        )
+                        best2 = jnp.max(
+                            jnp.where(match2, starts[None, :], -1), axis=1
+                        )
+                        match1 = jnp.logical_and(
                             hist[:, 1 : H - 1] == key1,
-                        ),
-                        hist[:, : H - 2] >= 0,
-                    )
-                    best2 = jnp.max(
-                        jnp.where(match2, starts[None, :], -1), axis=1
-                    )
-                    match1 = jnp.logical_and(
-                        hist[:, 1 : H - 1] == key1, hist[:, 1 : H - 1] >= 0
-                    )
-                    best1 = jnp.max(
-                        jnp.where(match1, starts[None, :], -1), axis=1
-                    )
-                    best = jnp.where(best2 >= 0, best2, best1)
-                    dpos = best[:, None] + 2 + jnp.arange(D)[None, :]
-                    draft = jnp.take_along_axis(
-                        hist, jnp.clip(dpos, 0, H - 1), axis=1
-                    )
-                    # Room for drafts: the bonus/correction token always
-                    # takes one budget slot, drafts fill the rest.
-                    room = jnp.maximum(max_steps - emitted_cnt - 1, 0)
-                    dvalid = (
-                        (best >= 0)[:, None]
-                        & (dpos < H)
-                        & (draft >= 0)
-                        & (jnp.arange(D)[None, :] < room[:, None])
-                        & active[:, None]
-                    )
-                    # Only a contiguous prefix is verifiable.
+                            hist[:, 1 : H - 1] >= 0,
+                        )
+                        best1 = jnp.max(
+                            jnp.where(match1, starts[None, :], -1), axis=1
+                        )
+                        best = jnp.where(best2 >= 0, best2, best1)
+                        dpos = best[:, None] + 2 + jnp.arange(D)[None, :]
+                        draft = jnp.take_along_axis(
+                            hist, jnp.clip(dpos, 0, H - 1), axis=1
+                        )
+                        # Room for drafts: the bonus/correction token
+                        # always takes one budget slot, drafts fill the
+                        # rest.
+                        room = jnp.maximum(max_steps - emitted_cnt - 1, 0)
+                        dvalid = (
+                            (best >= 0)[:, None]
+                            & (dpos < H)
+                            & (draft >= 0)
+                            & (jnp.arange(D)[None, :] < room[:, None])
+                            & active[:, None]
+                        )
+                    # Only a contiguous prefix is verifiable (already
+                    # contiguous for model proposals; shared so both
+                    # sources feed the identical verify machinery).
                     dvalid = jnp.cumsum(
                         jnp.where(dvalid, 0, 1), axis=1
                     ) == 0
@@ -696,25 +969,38 @@ class LLMEngine:
                     )
                     hidx = jnp.arange(H)[None, :] + adv[:, None]
                     hist = jnp.take_along_axis(cat, hidx, axis=1)
-                    return (
+                    core = (
                         jnp.where(active, last_tok, tokens),
                         positions + adv,
                         ctx_lens + adv,
                         new_done,
                         min_left,
                         emitted_cnt + adv,
-                        counts, seen, hist, kv_caches,
-                    ), (emitted, nd, acc_cnt)
+                        counts, seen, hist,
+                    )
+                    if drafter == "model":
+                        # Commit the draft-cache cursor: adv = accepted
+                        # + 1 slots now hold exactly the tokens up to
+                        # (excluding) the new committed token.
+                        return core + (
+                            draft_pos + adv, kv_caches, draft_kv,
+                        ), (emitted, nd, acc_cnt)
+                    return core + (kv_caches,), (emitted, nd, acc_cnt)
 
-                carry, ys = jax.lax.scan(
-                    body,
-                    (tokens, positions, ctx_lens, done, min_left,
-                     jnp.zeros_like(positions), counts, seen, hist,
-                     kv_caches),
-                    jnp.arange(n_steps),
-                )
-                (tokens, positions, ctx_lens, done, min_left, _cnt,
-                 counts, seen, hist, kv_caches) = carry
+                init = (tokens, positions, ctx_lens, done, min_left,
+                        jnp.zeros_like(positions), counts, seen, hist)
+                if drafter == "model":
+                    init = init + (draft_pos, kv_caches, draft_kv)
+                else:
+                    init = init + (kv_caches,)
+                carry, ys = jax.lax.scan(body, init, jnp.arange(n_steps))
+                if drafter == "model":
+                    (tokens, positions, ctx_lens, done, min_left, _cnt,
+                     counts, seen, hist, draft_pos, kv_caches,
+                     draft_kv) = carry
+                else:
+                    (tokens, positions, ctx_lens, done, min_left, _cnt,
+                     counts, seen, hist, kv_caches) = carry
                 emitted, drafted, accepted = ys  # [K, W, S], [K, S], [K, S]
                 state = {
                     "tokens": tokens, "positions": positions,
@@ -722,12 +1008,23 @@ class LLMEngine:
                     "min_left": min_left, "counts": counts, "seen": seen,
                     "hist": hist,
                 }
+                if drafter == "model":
+                    state["draft_pos"] = draft_pos
+                    return (
+                        emitted, drafted, accepted, state, kv_caches,
+                        draft_kv,
+                    )
                 return emitted, drafted, accepted, state, kv_caches
 
             self._spec_window_fn = jax.jit(
                 spec_window,
-                static_argnames=("use_penalties", "use_min_floor"),
-                donate_argnames=("kv_caches",),
+                static_argnames=(
+                    "use_penalties", "use_min_floor", "do_prime",
+                ),
+                donate_argnames=(
+                    ("kv_caches", "draft_kv") if drafter == "model"
+                    else ("kv_caches",)
+                ),
             )
 
         if self._window_steps > 1:
@@ -943,12 +1240,13 @@ class LLMEngine:
         self._argmax_fn = jax.jit(
             lambda logits: jnp.argmax(logits, axis=-1).astype(jnp.int32)
         )
-        # N-gram speculative decoding effectiveness counters (fed by
-        # BOTH the legacy host-side path and the fused window path).
+        # Speculative decoding effectiveness counters (fed by the
+        # legacy host-side n-gram path and the fused window path, both
+        # drafters).
         self.spec_tokens_drafted = 0
         self.spec_tokens_accepted = 0
         # Fused speculative-window outcomes per collected window
-        # (tpu:spec_window_tokens_total{outcome}): draft tokens the
+        # (tpu:spec_window_tokens_total{outcome,drafter}): draft tokens the
         # verifier accepted / rejected inside windows, and window tokens
         # emitted by the fused path but undeliverable at collect
         # (abort / out-of-band finish mid-window).  Step-thread-only
@@ -956,6 +1254,31 @@ class LLMEngine:
         self.spec_window_tokens: Dict[str, int] = {
             "accepted": 0, "rejected": 0, "wasted": 0,
         }
+        # Scan seconds spent in the model drafter's forwards
+        # (tpu:spec_draft_fraction_seconds): measured window sync time x
+        # a static cost-model split — per scan iteration the drafter
+        # runs (D+1) single rows (plus the amortized prime: (H-1) rows
+        # every _DRAFT_PRIME_CHAIN x K iterations) through the DRAFT
+        # parameter set while the verifier runs W = D+1 rows through the
+        # TARGET set; decode is weight-streaming-bound, so row-count x
+        # param-count is the honest first-order split.  Step-thread-only
+        # writer.
+        self.spec_draft_fraction_s = 0.0
+        self._draft_cost_fraction = 0.0
+        if self.draft_params is not None:
+            tgt_n = sum(
+                x.size for x in jax.tree_util.tree_leaves(self.params)
+            )
+            dft_n = sum(
+                x.size for x in jax.tree_util.tree_leaves(self.draft_params)
+            )
+            d_len = config.scheduler.spec_draft_len
+            draft_rows = (d_len + 1) + (self._SPEC_HIST_WINDOW - 1) / (
+                self._DRAFT_PRIME_CHAIN * self._window_steps
+            )
+            self._draft_cost_fraction = (draft_rows * dft_n) / (
+                draft_rows * dft_n + (d_len + 1) * tgt_n
+            )
         self._logprobs_fn = jax.jit(
             sampling_lib.top_logprobs_of, static_argnames=("k",)
         )
@@ -1215,6 +1538,21 @@ class LLMEngine:
             return [(zeros(), zeros()) for _ in range(cfg.num_layers)]
         zeros = jax.jit(
             lambda: jnp.zeros(shape, dtype),
+            out_shardings=layer_shardings[0][0],
+        )
+        return [(zeros(), zeros()) for _ in range(cfg.num_layers)]
+
+    def _allocate_draft_kv(self, num_blocks: int):
+        """Draft model's paged KV (model drafter): same block size as
+        the target pool (one slot-targeting code path), the DRAFT
+        architecture's head shapes, always dense dtype (the pool is tiny
+        — see the boot-time sizing comment)."""
+        cfg = self.draft_cfg
+        bs = self.config.cache.block_size
+        shape = (num_blocks, bs, cfg.num_kv_heads, cfg.head_dim)
+        layer_shardings = shardings_lib.kv_cache_shardings(cfg, self.mesh)
+        zeros = jax.jit(
+            lambda: jnp.zeros(shape, jnp.dtype(cfg.dtype)),
             out_shardings=layer_shardings[0][0],
         )
         return [(zeros(), zeros()) for _ in range(cfg.num_layers)]
@@ -1978,6 +2316,36 @@ class LLMEngine:
                 ids = s.all_token_ids[-H:]
                 hist[i, H - len(ids):] = ids
             state["hist"] = self._put(hist, row_spec)
+        if self.draft_block_pool is not None:
+            # Model drafter: per-row draft-KV block tables from the
+            # DEDICATED pool (static [S, Bd] width — the draft cache is
+            # compact, so the table never grows mid-chain).  A rebuild
+            # frees the previous batch's allocation wholesale and
+            # re-allocates: any preempted / aborted / restored
+            # sequence's draft KV is structurally reset (the draft
+            # cache is rebuilt from `hist` by the next in-graph prime —
+            # nothing stale can survive a batch change, and draft
+            # writes never touch self.kv_caches at all).  Allocation
+            # failure (an undersized explicit pool) declines this
+            # batch's windows to plain — counted per declined dispatch
+            # under tpu:multistep_fallback_total{reason=draft_pool},
+            # never a stall.
+            self._draft_primed = False
+            if self._draft_block_alloc:
+                self.draft_block_pool.free(self._draft_block_alloc)
+                self._draft_block_alloc = []
+            bd = self._draft_blocks_per_row
+            need = len(seqs) * bd
+            if self.draft_block_pool.can_allocate(need):
+                blocks = self.draft_block_pool.allocate(need)
+                self._draft_block_alloc = blocks
+                dt = np.zeros((S, bd), np.int32)
+                for i in range(len(seqs)):
+                    dt[i] = blocks[i * bd:(i + 1) * bd]
+                state["draft_tables"] = self._put(dt, row_spec)
+                state["draft_pos"] = self._put(
+                    np.zeros((S,), np.int32), batch_spec
+                )
         if self.lora_registry is not None:
             adapter = np.zeros((S,), np.int32)
             for i, seq in enumerate(seqs):
@@ -2050,32 +2418,78 @@ class LLMEngine:
         # per-iteration key schedule, so seeded streams stay
         # bit-identical across window sizes with speculation configured.
         spec_stats = None
-        if self._spec_window_fn is not None and all(
+        spec_drafter = None
+        use_spec = self._spec_window_fn is not None and all(
             self._host_state_flags(s)[2] for s in seqs
+        )
+        if use_spec and self.draft_params is not None and (
+            "draft_tables" not in state
         ):
-            emitted, drafted, accepted, out_state, self.kv_caches = (
-                self._spec_window_fn(
-                    self.params,
-                    tokens=state["tokens"],
-                    positions=state["positions"],
-                    ctx_lens=state["ctx_lens"],
-                    done=state["done"],
-                    min_left=state["min_left"],
-                    block_tables=state["tables"],
-                    max_steps=state["max_steps"],
-                    kv_caches=self.kv_caches,
-                    stop_ids=state["stop_ids"],
-                    counts=state["counts"],
-                    seen=state["seen"],
-                    hist=state["hist"],
-                    presence=state["presence"],
-                    frequency=state["frequency"],
-                    repetition=state["repetition"],
-                    use_penalties=state["use_penalties"],
-                    use_min_floor=state["use_min_floor"],
-                    **lora_kwargs,
-                )
+            # Model drafter configured but this batch's build could not
+            # allocate draft blocks (undersized explicit pool): decline
+            # to the plain window — observable, never a stall.  One
+            # increment per declined dispatch, matching the _can_window
+            # counting unit.
+            use_spec = False
+            self.multistep_fallback["draft_pool"] = (
+                self.multistep_fallback.get("draft_pool", 0) + 1
             )
+        if use_spec:
+            spec_kwargs = {}
+            if self.draft_params is not None:
+                spec_drafter = "model"
+                # Skip-prime chaining: re-prime the draft cache in-graph
+                # on the first model-spec window after any break in the
+                # chain (batch rebuild, plain/mixed dispatch) and every
+                # _DRAFT_PRIME_CHAIN windows (capacity watermark: a
+                # primed cache holds <= H-1 slots and each window adds
+                # <= window_max_tokens; the pool sizes exactly that
+                # chain).
+                do_prime = (
+                    not self._draft_primed
+                    or self._draft_windows_since_prime
+                    >= self._DRAFT_PRIME_CHAIN
+                )
+                spec_kwargs = {
+                    "draft_params": self.draft_params,
+                    "draft_tables": state["draft_tables"],
+                    "draft_pos": state["draft_pos"],
+                    "draft_kv": self.draft_kv_caches,
+                    "do_prime": do_prime,
+                }
+            else:
+                spec_drafter = "ngram"
+            out = self._spec_window_fn(
+                self.params,
+                tokens=state["tokens"],
+                positions=state["positions"],
+                ctx_lens=state["ctx_lens"],
+                done=state["done"],
+                min_left=state["min_left"],
+                block_tables=state["tables"],
+                max_steps=state["max_steps"],
+                kv_caches=self.kv_caches,
+                stop_ids=state["stop_ids"],
+                counts=state["counts"],
+                seen=state["seen"],
+                hist=state["hist"],
+                presence=state["presence"],
+                frequency=state["frequency"],
+                repetition=state["repetition"],
+                use_penalties=state["use_penalties"],
+                use_min_floor=state["use_min_floor"],
+                **spec_kwargs,
+                **lora_kwargs,
+            )
+            if spec_drafter == "model":
+                (emitted, drafted, accepted, out_state, self.kv_caches,
+                 self.draft_kv_caches) = out
+                self._draft_windows_since_prime = (
+                    0 if do_prime else self._draft_windows_since_prime + 1
+                )
+                self._draft_primed = True
+            else:
+                emitted, drafted, accepted, out_state, self.kv_caches = out
             spec_stats = (drafted, accepted)
             # Greedy argmax consumes no PRNG ordinals; the counter still
             # advances one per iteration (deterministic on every
@@ -2083,6 +2497,10 @@ class LLMEngine:
             # shared weights and carried state, never of wall clock).
             self._step_counter += self._window_steps
         else:
+            # Any non-model-spec dispatch advances positions without
+            # extending the draft KV: the chain is broken and the next
+            # model-spec window must re-prime from `hist`.
+            self._draft_primed = False
             emitted, out_state, self.kv_caches = self._window_fn(
                 self.params,
                 tokens=state["tokens"],
@@ -2134,9 +2552,10 @@ class LLMEngine:
                 k=self._window_steps, rows=len(seqs), seq_ids=sids,
                 chain_depth=depth, provisional=chain_from is not None,
                 spec_width=(
-                    self.config.scheduler.speculative_ngram
+                    self.config.scheduler.spec_draft_len
                     if spec_stats is not None else 0
                 ),
+                drafter=spec_drafter or "",
                 fallback=plan.window_fallback, host_gap_s=gap, now=t0,
             )
             self._note_compiles(sids, rec)
@@ -2144,7 +2563,8 @@ class LLMEngine:
         return _PendingStep(
             seqs=list(seqs), sampled=emitted, is_decode=True,
             host_s=time.time() - t0, steps=list(decode.steps),
-            win_state=state, spec_stats=spec_stats, rec=rec,
+            win_state=state, spec_stats=spec_stats,
+            spec_drafter=spec_drafter, rec=rec,
         )
 
     # stackcheck: root=step-thread
@@ -2193,6 +2613,11 @@ class LLMEngine:
             state = self._window_chain(chain_from, seqs, decode.steps)
             self._gap_steps += 1  # device busy: zero gap by construction
             self._last_decode_end = None
+        # Mixed windows keep `hist` warm but advance positions without
+        # extending the draft KV (drafting is a pure-decode-window
+        # feature): the model drafter's skip-prime chain is broken and
+        # the next model-spec window re-primes from the warm hist.
+        self._draft_primed = False
 
         # Per-iteration chunk schedule (host-precomputed, rides as scan
         # xs).  All chunks share ONE bucket T (static scan shape); dead
@@ -2345,11 +2770,12 @@ class LLMEngine:
         are counted as multistep waste.  Fused windows additionally
         account drafted / accepted / wasted speculation per window."""
         arr = np.asarray(p.sampled)  # the ONE device sync point
+        sync_s = time.time() - t0
         spec = p.spec_stats is not None
         if arr.ndim == 3:
             arr = arr.reshape(-1, arr.shape[-1])  # [K*W, S], in order
         if self.obs.enabled:
-            self.obs.step_phase("collect", time.time() - t0)
+            self.obs.step_phase("collect", sync_s)
         t_post = time.time()
         outputs: List[StepOutput] = []
         delivered = [0] * len(p.seqs)
@@ -2444,6 +2870,18 @@ class LLMEngine:
             self.spec_window_tokens["accepted"] += accepted
             self.spec_window_tokens["rejected"] += drafted - accepted
             self.spec_window_tokens["wasted"] += wasted
+            if p.spec_drafter == "model":
+                # Scan seconds attributed to draft forwards
+                # (tpu:spec_draft_fraction_seconds): the measured
+                # collect sync wait times the static cost-model split
+                # computed at boot from real parameter counts (the
+                # n-gram drafter's lookup costs no forward, so it
+                # accrues nothing).  Pipelined windows under-attribute —
+                # the host overlaps part of the scan — which keeps the
+                # counter a floor, never an overclaim.
+                self.spec_draft_fraction_s += (
+                    self._draft_cost_fraction * sync_s
+                )
         if self.obs.enabled:
             self.obs.step_phase("sample", time.time() - t_post)
         if p.rec is not None:
@@ -3427,6 +3865,15 @@ class LLMEngine:
     # iteration and recent repetition dominates prompt-lookup hits.
     _SPEC_HIST_WINDOW = 128
 
+    # Model-drafter skip-prime chain length: windows that may chain off
+    # one in-graph causal prime of the draft cache before the next prime
+    # (the prime costs S x (H-1) draft rows; chained windows extend the
+    # compact draft cache in place, so amortizing it over a chain keeps
+    # the drafter's per-token overhead near the (D+1)-row floor).  Also
+    # sizes the per-row draft-pool capacity: H + chain x
+    # window_max_tokens compact slots, rounded up to whole blocks.
+    _DRAFT_PRIME_CHAIN = 8
+
     @classmethod
     def _draft_ngram(cls, seq: Sequence, k: int, n: int = 2) -> List[int]:
         """Prompt-lookup drafting: find the most recent earlier occurrence
@@ -4113,8 +4560,13 @@ class LLMEngine:
             inv["mixed_fn"] = decode_buckets * len(sched.prefill_chunk_buckets)
         if sched.window_steps > 1:
             inv["window_fn"] = decode_buckets
-            if sched.speculative_ngram:
-                inv["spec_window_fn"] = decode_buckets
+            if sched.spec_window_enabled:
+                # The model drafter's do_prime static arg doubles the
+                # spec-window inventory (prime / skip-prime variants
+                # per decode bucket).
+                inv["spec_window_fn"] = decode_buckets * (
+                    2 if sched.spec_drafter == "model" else 1
+                )
             if sched.mixed_window:
                 # Chunk schedules pad to pow2 scan lengths <= decode_window.
                 scan_variants, n = 1, 1
@@ -4214,8 +4666,13 @@ class LLMEngine:
             "spec_tokens_drafted": self.spec_tokens_drafted,
             "spec_tokens_accepted": self.spec_tokens_accepted,
             # Fused speculative windows: per-window outcome split
-            # (accepted / rejected draft tokens, wasted emissions).
+            # (accepted / rejected draft tokens, wasted emissions), the
+            # configured proposal source ("" when none — keys the
+            # drafter label on tpu:spec_window_tokens_total), and scan
+            # seconds attributed to the model drafter's forwards.
             "spec_window_tokens": dict(self.spec_window_tokens),
+            "spec_drafter": self.config.scheduler.spec_drafter or "",
+            "spec_draft_fraction_seconds": self.spec_draft_fraction_s,
             # K-step decode windows: single-step fallbacks by reason and
             # emitted-but-undeliverable window tokens.
             "multistep_fallback": dict(self.multistep_fallback),

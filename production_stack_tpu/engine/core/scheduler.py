@@ -799,13 +799,17 @@ class Scheduler:
 
     def _window_token_cap(self, window: int) -> int:
         """Per-row token ceiling for a pure-decode window plan: the
-        max-acceptance growth K x (ngram + 1) only when the fused
-        drafter can actually engage — it drafts exclusively for
-        all-greedy batches (the same temperature <= 0 predicate the
-        engine dispatches on, read from broadcast SamplingParams so
-        lockstep replicas agree) — and plain K otherwise, so sampled
-        workloads never pre-allocate blocks for drafts that cannot
-        happen."""
+        max-acceptance growth K x (draft_len + 1) — draft_len from
+        whichever drafter is configured (n-gram count or the model
+        drafter's speculative_draft_len) — only when the fused drafter
+        can actually engage: it drafts exclusively for all-greedy
+        batches (the same temperature <= 0 predicate the engine
+        dispatches on, read from broadcast SamplingParams so lockstep
+        replicas agree) — and plain K otherwise, so sampled workloads
+        never pre-allocate blocks for drafts that cannot happen.  A
+        model-drafter window that declines to plain at dispatch time
+        (draft-pool pressure) emits at most K tokens — strictly under
+        this ceiling, so the pre-allocation stays sufficient."""
         if (
             window > 1
             and self.config.spec_window_enabled
@@ -813,7 +817,7 @@ class Scheduler:
                 s.sampling_params.temperature <= 0 for s in self.running
             )
         ):
-            return window * (self.config.speculative_ngram + 1)
+            return window * (self.config.spec_draft_len + 1)
         return window
 
     def _step_budget(self, seq: Sequence, window: int = 1) -> int:

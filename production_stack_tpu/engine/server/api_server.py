@@ -409,13 +409,30 @@ def build_engine_app(
                     **s["multistep_fallback"],
                 },
             )
-            + vocab.render_labeled_counter(
-                vocab.TPU_SPEC_WINDOW_TOKENS, "outcome",
+            # Fused speculative windows: outcome x drafter (one engine
+            # runs at most one proposal source, so the live counts land
+            # on the configured drafter's series; all six cells pre-seed
+            # at zero so dashboards see a stable label set from boot),
+            # plus the draft-forward time the model drafter spent.
+            + vocab.render_labeled_counter2(
+                vocab.TPU_SPEC_WINDOW_TOKENS, ("outcome", "drafter"),
                 {
-                    **dict.fromkeys(vocab.TPU_SPEC_WINDOW_OUTCOMES, 0),
-                    **s["spec_window_tokens"],
+                    **{
+                        (o, d): 0
+                        for o in vocab.TPU_SPEC_WINDOW_OUTCOMES
+                        for d in vocab.TPU_SPEC_WINDOW_DRAFTERS
+                    },
+                    **{
+                        (o, s["spec_drafter"]): v
+                        for o, v in s["spec_window_tokens"].items()
+                        if s["spec_drafter"]
+                    },
                 },
             )
+            + vocab.render_prometheus([
+                (vocab.TPU_SPEC_DRAFT_FRACTION_SECONDS,
+                 s["spec_draft_fraction_seconds"]),
+            ])
             # Quantized KV tiering plane: bytes per tier boundary by
             # wire format, and snapshot serde versions on the kvserver
             # wire (pre-seeded with the closed label sets so scrapers
@@ -2004,6 +2021,47 @@ def main(argv=None) -> None:
         "legacy host-side speculative path runs instead",
     )
     parser.add_argument(
+        "--speculative-model",
+        default=None,
+        help="draft-MODEL speculative decoding: a model preset name "
+        "(e.g. a 2-layer llama sharing the target's tokenizer/vocab — "
+        "a vocab mismatch refuses to boot) loaded as a second tiny "
+        "model on the same mesh.  It proposes --speculative-draft-len "
+        "tokens per scan iteration INSIDE the K-step window, "
+        "autoregressively from its own small device-resident KV cache "
+        "(dedicated draft pool; target KV capacity untouched), and the "
+        "target verifies draft+1 rows in the same wide forward the "
+        "n-gram drafter uses.  Mutually exclusive with "
+        "--speculative-ngram; requires the window machinery (no legacy "
+        "host path).  Unlike n-gram lookup, acceptance holds up on "
+        "non-templated text",
+    )
+    parser.add_argument(
+        "--speculative-draft-len",
+        type=int,
+        default=4,
+        help="draft tokens the model drafter proposes per scan "
+        "iteration (the D in the W = D+1 verify-row fan-out; only "
+        "meaningful with --speculative-model)",
+    )
+    parser.add_argument(
+        "--speculative-draft-pool-blocks",
+        type=int,
+        default=None,
+        help="device blocks reserved for the draft model's dedicated KV "
+        "pool (default: auto-sized for max_num_seqs rows).  Exhaustion "
+        "never stalls — a window that cannot allocate draft blocks "
+        "declines to a plain window, counted under "
+        "tpu:multistep_fallback_total{reason=draft_pool}",
+    )
+    parser.add_argument(
+        "--no-speculative-model",
+        action="store_true",
+        help="force the model drafter OFF even if --speculative-model "
+        "is set (deploy-template escape hatch; restores ngram-only / "
+        "non-speculative behavior exactly)",
+    )
+    parser.add_argument(
         "--num-scheduler-steps",
         type=int,
         default=1,
@@ -2234,6 +2292,24 @@ def main(argv=None) -> None:
             ),
             "scheduler.num_scheduler_steps": args.num_scheduler_steps,
             "scheduler.speculative_ngram": args.speculative_ngram,
+            **(
+                {
+                    "scheduler.speculative_model": args.speculative_model,
+                    "scheduler.speculative_draft_len":
+                        args.speculative_draft_len,
+                    **(
+                        {
+                            "scheduler.speculative_draft_pool_blocks":
+                                args.speculative_draft_pool_blocks,
+                        }
+                        if args.speculative_draft_pool_blocks is not None
+                        else {}
+                    ),
+                }
+                if args.speculative_model is not None
+                and not args.no_speculative_model
+                else {}
+            ),
             **(
                 {"scheduler.multi_step_window": False}
                 if args.no_multi_step_window else {}

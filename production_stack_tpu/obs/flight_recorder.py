@@ -60,6 +60,7 @@ class WindowRecord:
     chain_depth: int = 0           # 0 = cold dispatch; n = nth chained window
     provisional: bool = False      # planned off in-flight carry (lookahead)
     spec_width: int = 0            # draft tokens per iteration (spec windows)
+    drafter: str = ""              # proposal source ("ngram"/"model"), spec only
     chunk_prompts: int = 0         # distinct prompts whose chunks packed in
     chunk_tokens_planned: int = 0  # prompt tokens scheduled into the window
     chunk_tokens_delivered: int = 0
@@ -99,6 +100,7 @@ class WindowRecord:
         }
         if self.spec_width:
             d["spec_width"] = self.spec_width
+            d["drafter"] = self.drafter
             d["drafted"] = self.drafted
             d["accepted"] = self.accepted
         if self.chunk_prompts:
@@ -145,6 +147,7 @@ class FlightRecorder:
         chain_depth: int = 0,
         provisional: bool = False,
         spec_width: int = 0,
+        drafter: str = "",
         chunk_prompts: int = 0,
         chunk_tokens_planned: int = 0,
         fallback: Optional[str] = None,
@@ -168,6 +171,7 @@ class FlightRecorder:
             chain_depth=int(chain_depth),
             provisional=bool(provisional),
             spec_width=int(spec_width),
+            drafter=str(drafter),
             chunk_prompts=int(chunk_prompts),
             chunk_tokens_planned=int(chunk_tokens_planned),
             fallback=fallback,

@@ -212,7 +212,9 @@ REGISTRY = {
                 "— the head chunk fit no static chunk bucket; "
                 "pool_pressure — the KV pool could not hold the chunk; "
                 "waiting_head — residual decline, e.g. mixed windows off "
-                "or an unpackable final chunk)",
+                "or an unpackable final chunk; draft_pool — the draft "
+                "model's dedicated KV pool could not cover the batch, so "
+                "the window ran plain instead of speculative)",
     },
     "tpu:mixed_window_chunk_tokens_total": {
         "kind": "counter", "layer": "engine",
@@ -240,13 +242,26 @@ REGISTRY = {
                 "avoided",
     },
     "tpu:spec_window_tokens_total": {
-        "kind": "counter", "layer": "engine", "labels": ("outcome",),
+        "kind": "counter", "layer": "engine", "labels": ("outcome", "drafter"),
         "mirrors": ("fake_engine", "dashboard", "docs"),
         "help": "Fused speculative-window outcomes (outcome: accepted | "
                 "rejected | wasted) — draft tokens the in-scan verifier "
                 "accepted/rejected, and fused-window tokens emitted but "
-                "undeliverable at collect; acceptance rate stays "
-                "derivable from tpu:spec_tokens_{drafted,accepted}",
+                "undeliverable at collect — split by the proposal source "
+                "(drafter: ngram — prompt-lookup from the carried history "
+                "buffer; model — the tiny draft model riding the scan); "
+                "acceptance rate per drafter is accepted / (accepted + "
+                "rejected) over this family",
+    },
+    "tpu:spec_draft_fraction_seconds": {
+        "kind": "counter", "layer": "engine",
+        "mirrors": ("fake_engine", "dashboard", "docs"),
+        "help": "Scan wall-time attributed to the draft model's forwards "
+                "inside fused speculative windows (static cost-model "
+                "split of collect wait: draft rows x draft params vs "
+                "verify rows x target params, prime amortized) — the "
+                "speculation overhead the acceptance rate must pay for; "
+                "the ngram drafter accrues zero here",
     },
     "tpu:multistep_wasted_tokens_total": {
         "kind": "counter", "layer": "engine",

@@ -145,17 +145,29 @@ TPU_KV_PREFETCH_WASTE = "tpu:kv_prefetch_waste"
 # their client deadline expired before first token.
 TPU_ADMISSION_REJECTED = "tpu:admission_rejected_total"
 TPU_DEADLINE_EXPIRED = "tpu:deadline_expired_total"
-# Fused speculative windows (scheduler speculative_ngram with the
-# K-step window active): per-window outcome split of the on-device
-# draft-and-verify — draft tokens the verifier accepted / rejected
-# inside windows, plus window tokens emitted by the fused path but
-# undeliverable at collect (abort / out-of-band finish mid-window).
-# Acceptance RATE stays derivable from tpu:spec_tokens_{drafted,
-# accepted}, which the fused path feeds alongside the legacy host path.
+# Fused speculative windows (scheduler speculative_ngram or
+# speculative_model with the K-step window active): per-window outcome
+# split of the on-device draft-and-verify — draft tokens the verifier
+# accepted / rejected inside windows, plus window tokens emitted by the
+# fused path but undeliverable at collect (abort / out-of-band finish
+# mid-window) — split by the proposal source (drafter: ngram — prompt
+# lookup from the carried history buffer; model — the tiny draft model
+# riding the scan).  Acceptance RATE per drafter is accepted /
+# (accepted + rejected) over this family; the unlabeled totals stay
+# derivable from tpu:spec_tokens_{drafted,accepted}, which the fused
+# path feeds alongside the legacy host path.
 TPU_SPEC_WINDOW_TOKENS = "tpu:spec_window_tokens_total"
-# The closed outcome set, pre-seeded as zero-valued series so scrapers,
-# dashboards, and rate() see stable label sets from boot.
+# The closed outcome and drafter sets, pre-seeded as zero-valued series
+# so scrapers, dashboards, and rate() see stable label sets from boot.
 TPU_SPEC_WINDOW_OUTCOMES = ("accepted", "rejected", "wasted")
+TPU_SPEC_WINDOW_DRAFTERS = ("ngram", "model")
+# Scan wall-time attributed to the draft model's forwards inside fused
+# speculative windows (static cost-model split of the collect wait) —
+# the overhead the model drafter's acceptance rate must out-earn.  The
+# ngram drafter accrues ZERO here (its lookup is a gather, not a
+# forward); compare rate() against tpu:spec_window_tokens_total
+# {outcome="accepted",drafter="model"} for the speculation ROI.
+TPU_SPEC_DRAFT_FRACTION_SECONDS = "tpu:spec_draft_fraction_seconds"
 # K-step decode windows (scheduler multi_step_window): dispatches that
 # fell back to single-step because a co-scheduled request needed
 # host-sampled features (labeled by reason — logprobs / logit_bias /
@@ -175,10 +187,12 @@ TPU_MULTISTEP_FALLBACK = "tpu:multistep_fallback_total"
 # family) can say WHY a waiting prompt forced K=1: bucket_mismatch — the
 # head chunk fit no static chunk bucket; pool_pressure — the KV pool had
 # no room for the chunk's blocks; waiting_head — the residual decline
-# (mixed windows disabled, or an unpackable final chunk).
+# (mixed windows disabled, or an unpackable final chunk); draft_pool —
+# the draft model's dedicated KV pool could not cover the batch, so the
+# window ran plain (non-speculative) instead.
 TPU_MULTISTEP_FALLBACK_REASONS = (
     "guided", "logit_bias", "logprobs", "waiting_head",
-    "bucket_mismatch", "pool_pressure",
+    "bucket_mismatch", "pool_pressure", "draft_pool",
 )
 TPU_MULTISTEP_WASTED_TOKENS = "tpu:multistep_wasted_tokens_total"
 # Mixed K-step windows (scheduler mixed_window): prompt tokens whose
@@ -266,6 +280,7 @@ TPU_COUNTERS = frozenset({
     TPU_REMOTE_PREFIX_BLOCKS_EXPORTED,
     TPU_SPEC_TOKENS_DRAFTED,
     TPU_SPEC_TOKENS_ACCEPTED,
+    TPU_SPEC_DRAFT_FRACTION_SECONDS,
     TPU_PREFILL_CHUNK_TOKENS,
     TPU_KV_PREFETCH_HIT,
     TPU_KV_PREFETCH_WASTE,

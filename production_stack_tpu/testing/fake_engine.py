@@ -477,6 +477,9 @@ def build_fake_engine_app(state: FakeEngineState | None = None) -> web.Applicati
             (vocab.TPU_DISAGG_HANDOFF_MISSES, state.disagg_handoff_misses),
             (vocab.TPU_SPEC_TOKENS_DRAFTED, 0),
             (vocab.TPU_SPEC_TOKENS_ACCEPTED, 0),
+            # Draft-model speculation: no device, so no draft forwards
+            # ever run — zero, but the family must exist (SC303).
+            (vocab.TPU_SPEC_DRAFT_FRACTION_SECONDS, 0.0),
             # The fake engine serves every prompt instantly, so no mixed
             # chunking ever happens (windowed or not) — but the counters
             # must exist so the scrape contract matches the real engine.
@@ -508,11 +511,16 @@ def build_fake_engine_app(state: FakeEngineState | None = None) -> web.Applicati
         ]) + vocab.render_labeled_counter(
             vocab.TPU_MULTISTEP_FALLBACK, "reason",
             dict.fromkeys(vocab.TPU_MULTISTEP_FALLBACK_REASONS, 0),
-        ) + vocab.render_labeled_counter(
+        ) + vocab.render_labeled_counter2(
             # Fused speculative windows: no device, so no drafts — but
-            # the family must exist for the scrape contract (SC303).
-            vocab.TPU_SPEC_WINDOW_TOKENS, "outcome",
-            dict.fromkeys(vocab.TPU_SPEC_WINDOW_OUTCOMES, 0),
+            # the family (all outcome x drafter cells) must exist for
+            # the scrape contract (SC303).
+            vocab.TPU_SPEC_WINDOW_TOKENS, ("outcome", "drafter"),
+            {
+                (o, d): 0
+                for o in vocab.TPU_SPEC_WINDOW_OUTCOMES
+                for d in vocab.TPU_SPEC_WINDOW_DRAFTERS
+            },
         ) + vocab.render_labeled_counter2(
             # Quantized KV tiering plane: no KV tiers in the fake, but
             # both families must exist for the scrape contract (SC303).

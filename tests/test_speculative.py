@@ -156,14 +156,17 @@ async def test_spec_counters_exported_at_metrics():
                 text = await resp.text()
         assert "tpu:spec_tokens_drafted" in text
         assert "tpu:spec_tokens_accepted" in text
-        # The fused-window outcome family renders with its closed label
-        # set from boot (this server runs the fused path: spec + the
-        # default K-step window).
+        # The fused-window outcome family renders with its closed
+        # outcome x drafter label set from boot (this server runs the
+        # fused path: spec + the default K-step window).
         for outcome in ("accepted", "rejected", "wasted"):
-            assert (
-                'tpu:spec_window_tokens_total{outcome="%s"}' % outcome
-                in text
-            )
+            for drafter in ("ngram", "model"):
+                assert (
+                    'tpu:spec_window_tokens_total{outcome="%s",'
+                    'drafter="%s"}' % (outcome, drafter)
+                    in text
+                )
+        assert "tpu:spec_draft_fraction_seconds" in text
         # Drafting is opportunistic (depends on n-gram hits in the random
         # model's output); the contract here is exported, parseable,
         # consistent counters.
