@@ -1678,6 +1678,251 @@ def bench_engine_overload_ab(args, preset: str) -> dict:
     }
 
 
+def bench_engine_encode_ab(args, preset: str) -> dict:
+    """Encode-lane A/B through the REAL engine (ISSUE 19; docs/engine.md
+    "The encode lane", docs/router.md "Encode lanes & semantic cache"):
+
+      throughput:  N embed texts through the batched [B, T] encode path
+                   vs the serial per-text legacy loop (same forwards,
+                   different batching) — claim: batched >= 3x texts/s;
+      isolation:   streaming generation p95 ITL with a concurrent embed
+                   pump vs embed-free — claim: within 1.10x (the step
+                   loop runs at most ONE encode batch per window
+                   boundary while generation is live);
+      cache:       a repeat-heavy embeddings trace through the router's
+                   semantic cache — claim: hit rate >= 0.5 with every
+                   hit byte-identical to the first answer;
+      parity:      /v1/embeddings and a greedy completion byte-identical
+                   between the lane and --no-encode-lane.
+    """
+    import asyncio
+    import dataclasses as _dc
+    import gc
+
+    from production_stack_tpu.engine.config import (
+        CacheConfig,
+        EngineConfig,
+        PRESETS,
+        SchedulerConfig,
+    )
+    from production_stack_tpu.engine.core.engine import LLMEngine
+
+    n_texts = 64
+    text_words = 24
+
+    def sched(**kw):
+        return SchedulerConfig(
+            max_num_seqs=4, prefill_buckets=(128, 256), max_model_len=512,
+            **kw,
+        )
+
+    def make_texts(tag: str):
+        return [
+            " ".join(f"{tag}{(17 * i + j) % 997}" for j in range(text_words))
+            for i in range(n_texts)
+        ]
+
+    # -- leg 1: batched vs serial embed throughput (direct engine) -------
+    eng = LLMEngine(EngineConfig(
+        model=_dc.replace(PRESETS[preset]),
+        cache=CacheConfig(num_blocks=256),
+        scheduler=sched(),
+    ))
+    texts = make_texts("doc")
+    token_lists = [eng.tokenizer.encode(t) for t in texts]
+    bucket = eng.config.scheduler.encode_batch_buckets[-1]
+    # Warm both paths' compiles off the clock.
+    eng.embed(token_lists[0])
+    eng.encode_batch(token_lists[:bucket])
+
+    t0 = time.perf_counter()
+    serial_out = [eng.embed(ids) for ids in token_lists]
+    serial_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    batched_out = []
+    for i in range(0, n_texts, bucket):
+        batched_out.extend(eng.encode_batch(token_lists[i:i + bucket]))
+    batched_s = time.perf_counter() - t0
+
+    vectors_equal = all(
+        np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(serial_out, batched_out)
+    )
+    throughput = {
+        "texts": n_texts,
+        "serial_texts_per_s": round(n_texts / serial_s, 1),
+        "batched_texts_per_s": round(n_texts / batched_s, 1),
+        "speedup": round(serial_s / max(batched_s, 1e-9), 2),
+        "vectors_bitexact": vectors_equal,
+    }
+    del eng, serial_out, batched_out
+    gc.collect()
+
+    # -- legs 2-4: over HTTP (isolation, cache, parity) ------------------
+    async def run_http() -> dict:
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from production_stack_tpu.engine.server.api_server import (
+            build_engine_app,
+        )
+        from production_stack_tpu.engine.server.async_engine import AsyncEngine
+        from production_stack_tpu.router.app import build_app
+        from production_stack_tpu.router.parser import (
+            parse_args as parse_router_args,
+        )
+
+        def make_async(encode_lane: bool) -> AsyncEngine:
+            return AsyncEngine(EngineConfig(
+                model=_dc.replace(PRESETS[preset]),
+                cache=CacheConfig(num_blocks=512),
+                scheduler=sched(encode_lane=encode_lane),
+            ))
+
+        lane_eng = make_async(True)
+        lane_srv = TestServer(build_engine_app(lane_eng, preset))
+        await lane_srv.start_server()
+        lane = TestClient(lane_srv)
+
+        async def gen_itl(embed_load: bool) -> float:
+            """p95 token gap across 3 concurrent greedy streams, with an
+            optional concurrent embed pump riding the same engine."""
+            gaps: list = []
+            stop = asyncio.Event()
+
+            async def pump():
+                docs = make_texts("load")
+                i = 0
+                while not stop.is_set():
+                    resp = await lane.post("/v1/embeddings", json={
+                        "model": preset,
+                        "input": docs[i % n_texts:][:4] or docs[:4],
+                    })
+                    await resp.read()
+                    i += 4
+
+            async def stream(i: int):
+                resp = await lane.post("/v1/completions", json={
+                    "model": preset,
+                    "prompt": " ".join(f"g{i}w{j}" for j in range(32)),
+                    "max_tokens": 24, "ignore_eos": True, "stream": True,
+                })
+                assert resp.status == 200, await resp.text()
+                last = None
+                async for chunk in resp.content.iter_any():
+                    now = time.perf_counter()
+                    if b"data: " not in chunk:
+                        continue
+                    if last is not None:
+                        gaps.append(now - last)
+                    last = now
+
+            pump_task = (
+                asyncio.ensure_future(pump()) if embed_load else None
+            )
+            try:
+                await asyncio.gather(*(stream(i) for i in range(3)))
+            finally:
+                stop.set()
+                if pump_task is not None:
+                    await pump_task
+            s = sorted(gaps)
+            return s[int(0.95 * (len(s) - 1))] * 1e3 if s else 0.0
+
+        # Warm compiles (prefill bucket + encode batch) off the clock.
+        await gen_itl(embed_load=True)
+        itl_free_ms = await gen_itl(embed_load=False)
+        itl_load_ms = await gen_itl(embed_load=True)
+        isolation = {
+            "gen_itl_p95_embed_free_ms": round(itl_free_ms, 3),
+            "gen_itl_p95_under_embed_ms": round(itl_load_ms, 3),
+            "itl_ratio": round(itl_load_ms / max(itl_free_ms, 1e-9), 3),
+        }
+
+        # -- cache leg: repeat-heavy trace through the router ------------
+        router_srv = TestServer(build_app(parse_router_args([
+            "--static-backends", str(lane_srv.make_url("")).rstrip("/"),
+            "--static-models", preset,
+            "--engine-stats-interval", "1",
+            "--encode-cache-max-bytes", "8000000",
+        ])))
+        await router_srv.start_server()
+        router = TestClient(router_srv)
+        distinct, total = 8, 32
+        rng = np.random.RandomState(3)
+        first_bytes: dict = {}
+        hits = 0
+        identical = True
+        try:
+            for n in range(total):
+                # First pass touches every distinct doc once, then the
+                # repeat-heavy tail (RAG re-chunking traffic shape).
+                d = n if n < distinct else int(rng.randint(distinct))
+                resp = await router.post("/v1/embeddings", json={
+                    "model": preset, "input": f"corpus document {d}",
+                })
+                body = await resp.read()
+                assert resp.status == 200, body
+                if resp.headers.get("x-encode-cache") == "hit":
+                    hits += 1
+                    identical = identical and (body == first_bytes[d])
+                else:
+                    first_bytes.setdefault(d, body)
+                # The store is a background task; let it land.
+                await asyncio.sleep(0)
+            await asyncio.sleep(0.05)
+        finally:
+            await router.close()
+            await router_srv.close()
+        cache = {
+            "requests": total,
+            "distinct": distinct,
+            "hits": hits,
+            "hit_rate": round(hits / total, 3),
+            "hits_byte_identical": identical,
+        }
+
+        # -- parity leg: lane vs --no-encode-lane ------------------------
+        serial_eng = make_async(False)
+        serial_srv = TestServer(build_engine_app(serial_eng, preset))
+        await serial_srv.start_server()
+        serial = TestClient(serial_srv)
+        try:
+            embed_body = {"model": preset,
+                          "input": ["parity one", "parity two"]}
+            comp_body = {"model": preset,
+                         "prompt": "the quick brown fox", "max_tokens": 16}
+            pair = []
+            for client in (lane, serial):
+                e = await (await client.post(
+                    "/v1/embeddings", json=embed_body)).json()
+                c = await (await client.post(
+                    "/v1/completions", json=comp_body)).json()
+                pair.append((e["data"], c["choices"][0]["text"]))
+            parity = {
+                "embeddings_identical": pair[0][0] == pair[1][0],
+                "greedy_completion_identical": pair[0][1] == pair[1][1],
+            }
+        finally:
+            await serial.close()
+            await serial_srv.close()
+            await lane.close()
+            await lane_srv.close()
+        return {"isolation": isolation, "cache": cache, "parity": parity}
+
+    http_legs = asyncio.run(run_http())
+    gc.collect()
+    result = {"throughput": throughput, **http_legs}
+    result["criteria"] = {
+        "batched_3x_serial": throughput["speedup"] >= 3.0,
+        "gen_itl_within_1_10x": result["isolation"]["itl_ratio"] <= 1.10,
+        "cache_hit_rate_ge_0_5": result["cache"]["hit_rate"] >= 0.5,
+        "cache_hits_byte_identical": result["cache"]["hits_byte_identical"],
+        "no_encode_lane_parity": all(result["parity"].values()),
+    }
+    return result
+
+
 def bench_remote_prefix_ab(args, preset: str) -> dict:
     """Remote shared-prefix import A/B through the REAL engine against a
     LATENCY-INJECTED kvserver: a cold replica imports a long warm-store
@@ -2949,7 +3194,7 @@ AB_STAGES = (
     "multi_round",
     "int8_ab", "kv_int8_ab", "kv_capacity_ab", "gather_ab", "pipeline_ab",
     "mixed_ab", "multistep_ab", "mixed_window_ab", "spec_window_ab",
-    "overload_ab",
+    "overload_ab", "encode_ab",
     "remote_prefix_ab", "disagg_ab", "fleet_surge_ab",
 )
 
@@ -3586,6 +3831,32 @@ def main() -> None:
         except Exception as e:
             log(f"overload A/B failed: {e}")
             detail["overload_ab_error"] = str(e)[:200]
+
+    if run_stage("encode_ab"):
+        # Encode-lane A/B: batched [B, T] embed throughput vs the serial
+        # per-text loop, generation ITL isolation under an embed pump,
+        # the router semantic cache on a repeat-heavy trace, and
+        # --no-encode-lane parity (docs/engine.md "The encode lane").
+        try:
+            try:
+                del params, kv
+            except NameError:
+                pass
+            import gc as _gc
+
+            _gc.collect()
+            detail["encode_ab"] = bench_engine_encode_ab(args, preset)
+            ab = detail["encode_ab"]
+            log(f"encode A/B: batched {ab['throughput']['speedup']}x "
+                f"serial embed throughput "
+                f"({ab['throughput']['batched_texts_per_s']} vs "
+                f"{ab['throughput']['serial_texts_per_s']} texts/s), "
+                f"gen ITL ratio {ab['isolation']['itl_ratio']}x under "
+                f"embed load, cache hit rate {ab['cache']['hit_rate']}, "
+                f"criteria {ab['criteria']}")
+        except Exception as e:
+            log(f"encode A/B failed: {e}")
+            detail["encode_ab_error"] = str(e)[:200]
 
     if run_stage("remote_prefix_ab"):
         # Remote shared-prefix import A/B: synchronous per-block GETs

@@ -320,12 +320,20 @@ class CacheConfig:
     kv_wire_format: str = "auto"
 
     def __post_init__(self):
-        if self.disagg_role not in (None, "prefill", "decode", "both"):
+        if self.disagg_role not in (None, "prefill", "decode", "both",
+                                    "encode"):
             raise ValueError(
                 f"Unknown disagg_role {self.disagg_role!r} "
-                "(None | prefill | decode | both)"
+                "(None | prefill | decode | both | encode)"
             )
-        if self.disagg_role is not None and not self.remote_kv_url:
+        if (
+            self.disagg_role is not None
+            and self.disagg_role != "encode"
+            and not self.remote_kv_url
+        ):
+            # "encode" is a pool label, not a KV-sharing role: a
+            # dedicated embed/rerank/score pool member does no prefix
+            # handoff and needs no store.
             raise ValueError("disagg_role requires remote_kv_url")
         if self.kv_cache_dtype not in ("auto", "int8"):
             raise ValueError(
@@ -547,6 +555,25 @@ class SchedulerConfig:
     # False (--no-admission-control) restores the unbounded legacy
     # admission exactly (greedy parity asserted in tests/test_overload.py).
     admission_control: Optional[bool] = None
+    # Batched encode lane: embed/rerank/score inputs queue on the event
+    # loop and the STEP THREAD drains them as [B, T]-bucketed encode
+    # batches at window boundaries — one prefill-chunk-shaped pass with
+    # no KV bookkeeping, so decode windows are never preempted mid-scan
+    # and the device is never touched off the step thread.  None = auto
+    # (ON; the server auto-disables it under multi-host lockstep, where
+    # a leader-only encode forward would desync the SPMD followers);
+    # False (--no-encode-lane) restores the serial per-text embed path.
+    encode_lane: Optional[bool] = None
+    # B-axis bucket grid for encode batches: a batch of n texts pads to
+    # the smallest bucket >= n (T pads to a prefill chunk bucket), so
+    # the jitted executable count stays |encode_batch_buckets| x
+    # |prefill_chunk_buckets| — the same grid discipline as mixed steps.
+    encode_batch_buckets: Tuple[int, ...] = (1, 2, 4, 8)
+    # Encode-queue admission bound (texts): once this many texts are
+    # queued for the encode lane, new embed/rerank/score requests get a
+    # structured 429 + Retry-After (PR-5 admission, encode flavor).
+    # None = auto: 32 x encode_batch_buckets[-1].
+    max_queued_encode_texts: Optional[int] = None
     # Step-loop watchdog: /health fails liveness when the engine step
     # thread has not completed an iteration within this many seconds (a
     # hung device dispatch otherwise serves a green probe forever).
@@ -649,6 +676,19 @@ class SchedulerConfig:
             raise ValueError("max_queued_requests must be >= 1")
         if self.max_queued_tokens is not None and self.max_queued_tokens < 1:
             raise ValueError("max_queued_tokens must be >= 1")
+        if not self.encode_batch_buckets:
+            raise ValueError("encode_batch_buckets must be non-empty")
+        if tuple(sorted(self.encode_batch_buckets)) != tuple(
+            self.encode_batch_buckets
+        ) or self.encode_batch_buckets[0] < 1:
+            raise ValueError(
+                "encode_batch_buckets must be positive and sorted ascending"
+            )
+        if (
+            self.max_queued_encode_texts is not None
+            and self.max_queued_encode_texts < 1
+        ):
+            raise ValueError("max_queued_encode_texts must be >= 1")
         if self.step_watchdog_s < 0:
             raise ValueError("step_watchdog_s must be >= 0 (0 disables)")
         if (
@@ -795,6 +835,22 @@ class SchedulerConfig:
         if self.max_queued_tokens is not None:
             return self.max_queued_tokens
         return 2 * self.max_num_seqs * self.max_model_len
+
+    @property
+    def encode_lane_enabled(self) -> bool:
+        """Resolved encode-lane gate: auto (None) means ON.  The server
+        additionally clears it under multi-host lockstep (leader-only
+        encode forwards would desync SPMD followers)."""
+        if self.encode_lane is None:
+            return True
+        return bool(self.encode_lane)
+
+    @property
+    def queued_encode_texts_cap(self) -> int:
+        """Resolved encode-queue text bound (admission)."""
+        if self.max_queued_encode_texts is not None:
+            return self.max_queued_encode_texts
+        return 32 * self.encode_batch_buckets[-1]
 
     @property
     def batched_tokens_budget(self) -> int:

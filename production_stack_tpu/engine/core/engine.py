@@ -1324,6 +1324,22 @@ class LLMEngine:
 
         self._step_counter = 0
         self._encode_fn = None  # lazily jitted /v1/embeddings path
+        # Lazily jitted [B, T]-bucketed encode-lane executable (one per
+        # static shape, compile-tracked like every other jit family).
+        self._encode_batch_fn = None
+        # Encode-lane counters (tpu:encode_* families).  The batch
+        # counters/histograms are STEP-THREAD-only writers (the batcher
+        # runs encode batches from the step loop); encode_queue_depth is
+        # a gauge the AsyncEngine's batcher overwrites from either side
+        # (plain int store — racy-but-benign snapshot, never summed).
+        self.encode_texts_total = 0
+        self.encode_batch_size_hist = Histogram(
+            bounds=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0)
+        )
+        self.encode_seconds_hist = Histogram(
+            bounds=(0.005, 0.02, 0.05, 0.1, 0.25, 1.0, 4.0)
+        )
+        self.encode_queue_depth = 0
         self._token_texts = None  # guided decoding token-text cache
         self._seqs: Dict[str, Sequence] = {}
         # Cumulative counters for /metrics.
@@ -4511,6 +4527,82 @@ class LLMEngine:
         )
         return np.asarray(out)
 
+    def encode_max_len(self) -> int:
+        """Longest input (tokens) the embedding path accepts — the bound
+        both ``embed`` and ``encode_batch`` validate against, exposed so
+        the API layer can reject over-long inputs before queueing."""
+        return min(
+            self.config.scheduler.prefill_buckets[-1],
+            self.config.scheduler.max_model_len,
+        )
+
+    def encode_batch(self, batch_token_ids: List[List[int]]) -> np.ndarray:
+        """Batched embeddings: ONE [B, T]-bucketed llama.encode_batch
+        dispatch for up to encode_batch_buckets[-1] texts (B pads to an
+        encode-batch bucket, T to a prefill bucket), replacing B serial
+        ``embed`` round-trips.  Vectors are identical to per-text
+        ``embed`` output up to float addition order.  STEP-THREAD-only
+        caller in production (the EncodeBatcher) — this touches the
+        device."""
+        if not hasattr(self.model, "encode_batch"):
+            raise ValueError(
+                f"model {self.config.model.name!r} has no batched encode path"
+            )
+        if not batch_token_ids:
+            raise ValueError("encode_batch needs at least one input")
+        sched = self.config.scheduler
+        if len(batch_token_ids) > sched.encode_batch_buckets[-1]:
+            raise ValueError(
+                f"encode_batch of {len(batch_token_ids)} texts exceeds the "
+                f"largest encode batch bucket "
+                f"({sched.encode_batch_buckets[-1]})"
+            )
+        max_len = self.encode_max_len()
+        lens = []
+        for ids in batch_token_ids:
+            if not ids:
+                raise ValueError("input produced no tokens")
+            if len(ids) > max_len:
+                raise ValueError(
+                    f"input is {len(ids)} tokens; the embedding path "
+                    f"supports up to {max_len}"
+                )
+            lens.append(len(ids))
+        b_bucket = next(
+            b for b in sched.encode_batch_buckets
+            if b >= len(batch_token_ids)
+        )
+        t_bucket = next(b for b in sched.prefill_buckets if b >= max(lens))
+        rows = [
+            (list(ids) + [0] * t_bucket)[:t_bucket]
+            for ids in batch_token_ids
+        ]
+        # Padding rows carry valid_len 0: the masked mean-pool yields a
+        # zero vector we slice away below.
+        rows += [[0] * t_bucket] * (b_bucket - len(rows))
+        valid = lens + [0] * (b_bucket - len(lens))
+        if self._encode_batch_fn is None:
+            self._encode_batch_fn = self.obs.compile_tracker.wrap(
+                "encode_batch_fn",
+                jax.jit(
+                    partial(self.model.encode_batch, cfg=self.config.model,
+                            mesh=self.mesh)
+                ),
+            )
+        t0 = time.time()
+        out = self._encode_batch_fn(
+            self.params,
+            tokens=jnp.asarray(rows, jnp.int32),
+            valid_lens=jnp.asarray(valid, jnp.int32),
+        )
+        vectors = np.asarray(out)[: len(batch_token_ids)]
+        # Step-thread-only writers (see counter init): one batch per
+        # observation, wall seconds include the device sync above.
+        self.encode_texts_total += len(batch_token_ids)
+        self.encode_batch_size_hist.observe(float(len(batch_token_ids)))
+        self.encode_seconds_hist.observe(time.time() - t0)
+        return vectors
+
     # -- multi-LoRA admin (engine/lora.py) ---------------------------------
 
     def _require_lora(self):
@@ -4574,6 +4666,11 @@ class LLMEngine:
                     n *= 2
                     scan_variants += 1
                 inv["mixed_window_fn"] = decode_buckets * scan_variants
+        if sched.encode_lane_enabled and hasattr(self.model, "encode_batch"):
+            # One executable per (B bucket, T bucket) encode-batch shape.
+            inv["encode_batch_fn"] = (
+                len(sched.encode_batch_buckets) * len(sched.prefill_buckets)
+            )
         return inv
 
     def compiles_payload(self) -> Dict:
@@ -4637,6 +4734,11 @@ class LLMEngine:
                 self.deadline_expired + self.deadline_expired_admission
             ),
             "queued_prompt_tokens": self.scheduler.queued_prompt_tokens,
+            # Encode lane (batched embed/rerank/score): texts encoded via
+            # the [B, T]-bucketed batch path and the current queue depth
+            # the batcher is carrying (docs/engine.md).
+            "encode_texts_total": self.encode_texts_total,
+            "encode_queue_depth": self.encode_queue_depth,
             # Mean host-side serialization per decode step (ms): time the
             # device sat idle between decode steps.  ≈0 when the lookahead
             # pipeline is feeding the device ahead of collection.

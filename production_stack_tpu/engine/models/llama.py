@@ -454,6 +454,31 @@ def encode(
     return pooled / jnp.maximum(jnp.linalg.norm(pooled), 1e-9)
 
 
+def encode_batch(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jax.Array,  # [B, T] int32 (B and T both padded to buckets)
+    valid_lens: jax.Array,  # [B] int32 (0 for padding rows)
+    mesh: Optional[Mesh] = None,
+) -> jax.Array:
+    """Batched embedding forward: B independent ``encode`` passes fused
+    into one dispatch — the encode lane's [B, T]-bucketed executable.
+    Unsharded we vmap the single-text encode (one wide kernel); under a
+    tp/sp mesh the shard_map'd attention inside ``encode`` is not
+    vmappable, so rows run under ``jax.lax.map`` instead (still one
+    dispatch, B sequential shard_map bodies).  Returns [B, hidden]
+    L2-normalized float32 vectors; padding rows (valid_len 0) produce
+    garbage vectors the caller drops."""
+    if mesh is None:
+        return jax.vmap(
+            lambda t, v: encode(params, cfg, t, v, mesh=None)
+        )(tokens, valid_lens)
+    return jax.lax.map(
+        lambda tv: encode(params, cfg, tv[0], tv[1], mesh=mesh),
+        (tokens, valid_lens),
+    )
+
+
 def mixed_step(
     params: Params,
     cfg: ModelConfig,

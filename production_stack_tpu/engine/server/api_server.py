@@ -479,6 +479,21 @@ def build_engine_app(
                 vocab.TPU_MIXED_WINDOW_PROMPTS,
                 engine.engine.mixed_window_prompts_hist,
             )
+            # Encode lane: batched embed/rerank/score texts, the queue
+            # the batcher is carrying, and per-batch size/latency
+            # (docs/engine.md "The encode lane").
+            + vocab.render_prometheus([
+                (vocab.TPU_ENCODE_TEXTS, s["encode_texts_total"]),
+                (vocab.TPU_ENCODE_QUEUE_DEPTH, s["encode_queue_depth"]),
+            ])
+            + render_histogram(
+                vocab.TPU_ENCODE_BATCH_SIZE,
+                engine.engine.encode_batch_size_hist,
+            )
+            + render_histogram(
+                vocab.TPU_ENCODE_SECONDS,
+                engine.engine.encode_seconds_hist,
+            )
             # XLA compile events per executable shape key + the
             # distinct-shape gauge, and trace-ring byte-bound evictions
             # (obs/compile_tracker.py, obs/trace.py).
@@ -1405,9 +1420,20 @@ def build_engine_app(
                            "type": "invalid_request_error"}},
                 status=400,
             )
+        err, token_lists, deadline = _encode_admission(request, body, inputs)
+        if err is not None:
+            return err
         try:
-            vectors, token_counts = await _embed_texts(inputs)
+            vectors, token_counts = await _embed_texts(
+                inputs, token_lists=token_lists, deadline=deadline
+            )
             total_tokens = sum(token_counts)
+        except DeadlineExceeded as e:
+            return web.json_response(
+                {"error": {"message": str(e), "type": "deadline_expired",
+                           "code": 504}},
+                status=504,
+            )
         except ValueError as e:
             # Over-long input, or a model without an encode path.
             return web.json_response(
@@ -1431,19 +1457,86 @@ def build_engine_app(
                       "total_tokens": total_tokens},
         })
 
-    async def _embed_texts(texts):
-        """Embed a list of strings via the encode path; returns unit vectors.
+    def _encode_admission(request, body, texts):
+        """Shared PR-5 overload protection for the encode surface
+        (embeddings / rerank / score), applied BEFORE any device work is
+        queued: deadline parse (400 on malformed), bounded admission
+        (structured 429 + Retry-After against the encode-queue caps),
+        expired-deadline shed (504).  Returns (error_response,
+        token_lists, deadline); the token lists are reused by the embed
+        call so each text tokenizes once."""
+        tokenizer = engine.engine.tokenizer
+        token_lists = [tokenizer.encode(text) for text in texts]
+        now = time.time()
+        try:
+            deadline = parse_deadline(request.headers, body, now)
+        except ValueError as e:
+            return (
+                web.json_response(
+                    {"error": {"message": str(e),
+                               "type": "invalid_request_error"}},
+                    status=400,
+                ),
+                None, None,
+            )
+        rejection = engine.check_encode_admission(
+            len(token_lists), sum(len(ids) for ids in token_lists)
+        )
+        if rejection is not None:
+            engine.engine.admission_rejected += 1
+            return (
+                web.json_response(
+                    {
+                        "error": {
+                            "message": (
+                                "engine overloaded: "
+                                f"{rejection.queued_requests} texts "
+                                f"({rejection.queued_tokens} prompt tokens) "
+                                "already queued on the encode lane; retry "
+                                f"after {rejection.retry_after_s}s"
+                            ),
+                            "type": "overloaded",
+                            "code": 429,
+                            "detail": dataclasses.asdict(rejection),
+                        }
+                    },
+                    status=429,
+                    headers={"Retry-After": str(rejection.retry_after_s)},
+                ),
+                None, None,
+            )
+        if deadline is not None and now >= deadline:
+            # Event-loop-side counter (the step thread owns
+            # deadline_expired), same split as the completions path.
+            engine.engine.deadline_expired_admission += 1
+            return (
+                web.json_response(
+                    {"error": {"message": (
+                        "request deadline already expired at admission"
+                    ), "type": "deadline_expired", "code": 504}},
+                    status=504,
+                ),
+                None, None,
+            )
+        return None, token_lists, deadline
 
-        Raises ValueError for over-long inputs or models without an encode
-        path — callers map that to a 400.
+    async def _embed_texts(texts, token_lists=None, deadline=None):
+        """Embed a list of strings via the batched encode lane: texts
+        queue on the EncodeBatcher and the STEP THREAD runs them as
+        [B, T]-bucketed encode batches at window boundaries
+        (engine/server/encode_batcher.py) — this coroutine never touches
+        the device.  --no-encode-lane restores the legacy serial
+        per-text path.  Returns (unit vectors, per-text token counts).
+
+        Raises ValueError for over-long inputs or models without an
+        encode path — callers map that to a 400 — and DeadlineExceeded
+        when a queued text's deadline expired before dispatch (504).
         """
         tokenizer = engine.engine.tokenizer
-        vectors, token_counts = [], []
-        for text in texts:
-            ids = tokenizer.encode(text)
-            token_counts.append(len(ids))
-            vectors.append(await asyncio.to_thread(engine.engine.embed, ids))
-        return vectors, token_counts
+        if token_lists is None:
+            token_lists = [tokenizer.encode(text) for text in texts]
+        vectors = await engine.embed_batch(token_lists, deadline=deadline)
+        return vectors, [len(ids) for ids in token_lists]
 
     def _dot(a, b) -> float:
         return float(np.dot(a, b))
@@ -1489,9 +1582,21 @@ def build_engine_app(
                            "type": "invalid_request_error"}},
                 status=400,
             )
+        texts = [query] + documents
+        err, token_lists, deadline = _encode_admission(request, body, texts)
+        if err is not None:
+            return err
         try:
-            vectors, token_counts = await _embed_texts([query] + documents)
+            vectors, token_counts = await _embed_texts(
+                texts, token_lists=token_lists, deadline=deadline
+            )
             total_tokens = sum(token_counts)
+        except DeadlineExceeded as e:
+            return web.json_response(
+                {"error": {"message": str(e), "type": "deadline_expired",
+                           "code": 504}},
+                status=504,
+            )
         except ValueError as e:
             return web.json_response(
                 {"error": {"message": str(e), "type": "invalid_request_error"}},
@@ -1560,11 +1665,22 @@ def build_engine_app(
                            "type": "invalid_request_error"}},
                 status=400,
             )
+        # Embed each distinct text once: a broadcast text_1 would
+        # otherwise re-run the device forward per pair.
+        distinct = list(dict.fromkeys(t1 + t2))
+        err, token_lists, deadline = _encode_admission(request, body, distinct)
+        if err is not None:
+            return err
         try:
-            # Embed each distinct text once: a broadcast text_1 would
-            # otherwise re-run the device forward per pair.
-            distinct = list(dict.fromkeys(t1 + t2))
-            vectors, token_counts = await _embed_texts(distinct)
+            vectors, token_counts = await _embed_texts(
+                distinct, token_lists=token_lists, deadline=deadline
+            )
+        except DeadlineExceeded as e:
+            return web.json_response(
+                {"error": {"message": str(e), "type": "deadline_expired",
+                           "code": 504}},
+                status=504,
+            )
         except ValueError as e:
             return web.json_response(
                 {"error": {"message": str(e), "type": "invalid_request_error"}},
@@ -2141,11 +2257,13 @@ def main(argv=None) -> None:
     parser.add_argument(
         "--disagg-role",
         default=None,
-        choices=["prefill", "decode", "both"],
+        choices=["prefill", "decode", "both", "encode"],
         help="cross-engine prefix sharing through the remote KV store: "
         "'prefill' exports prompt KV blocks after prefill, 'decode' "
         "imports matching blocks instead of recomputing, 'both' shares "
-        "symmetrically (requires --remote-kv-url)",
+        "symmetrically (requires --remote-kv-url); 'encode' marks a "
+        "dedicated embed/rerank/score pool member (no KV handoff, no "
+        "--remote-kv-url needed) — the router's encode lane prefers it",
     )
     parser.add_argument(
         "--no-remote-prefetch",
@@ -2224,6 +2342,24 @@ def main(argv=None) -> None:
         "--max-queued-tokens", type=int, default=None,
         help="waiting-queue prompt-token bound for bounded admission "
         "(default: 2 x --max-num-seqs x --max-model-len)",
+    )
+    parser.add_argument(
+        "--no-encode-lane",
+        action="store_true",
+        help="disable the batched encode lane (embed/rerank/score then "
+        "run the legacy serial per-text encode off the step thread, and "
+        "encode admission falls back to the generation caps) — A/B "
+        "baseline / debugging",
+    )
+    parser.add_argument(
+        "--encode-batch-buckets", default=None,
+        help="comma-separated B-axis bucket grid for encode batches "
+        "(default 1,2,4,8); the T axis pads to the prefill buckets",
+    )
+    parser.add_argument(
+        "--max-queued-encode-texts", type=int, default=None,
+        help="encode-queue text bound for bounded admission "
+        "(default: 32 x the largest encode batch bucket)",
     )
     parser.add_argument(
         "--step-watchdog-s", type=float, default=300.0,
@@ -2378,6 +2514,21 @@ def main(argv=None) -> None:
                 {"scheduler.max_queued_tokens": args.max_queued_tokens}
                 if args.max_queued_tokens is not None else {}
             ),
+            **(
+                {"scheduler.encode_lane": False}
+                if args.no_encode_lane else {}
+            ),
+            **(
+                {"scheduler.encode_batch_buckets": tuple(
+                    int(b) for b in args.encode_batch_buckets.split(",")
+                )}
+                if args.encode_batch_buckets else {}
+            ),
+            **(
+                {"scheduler.max_queued_encode_texts":
+                    args.max_queued_encode_texts}
+                if args.max_queued_encode_texts is not None else {}
+            ),
             "scheduler.step_watchdog_s": args.step_watchdog_s,
             "obs.tracing": not args.no_tracing,
             "obs.trace_ring_size": args.trace_ring_size,
@@ -2404,6 +2555,17 @@ def main(argv=None) -> None:
             "plane (cache.remote_prefetch auto -> False)"
         )
         config.cache.remote_prefetch = False
+    if denv is not None and config.scheduler.encode_lane is None:
+        # A leader-only encode forward would desync the SPMD followers'
+        # jitted launch sequence (encode batches are not part of the
+        # lockstep event broadcast).  Auto resolves to off here; an
+        # EXPLICIT encode_lane=True is still cleared by the AsyncEngine
+        # guard, which is the one that owns device dispatch.
+        logger.info(
+            "multi-host lockstep group: disabling the batched encode "
+            "lane (scheduler.encode_lane auto -> False)"
+        )
+        config.scheduler.encode_lane = False
     if denv is not None and args.data_parallel > 1:
         # dp shards the decode batch; across PROCESSES the leader could
         # not read the non-addressable logit/token shards (and dp over

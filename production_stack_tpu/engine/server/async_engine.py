@@ -115,6 +115,24 @@ class AsyncEngine:
         # the stamp advancing, and /health turns that into a liveness
         # failure instead of serving a green probe (tpu:last_step_age_seconds).
         self._last_step_ts: Optional[float] = None
+        # Batched encode lane (encode_batcher.py): the event loop queues
+        # embed/rerank/score token lists and THIS object's step thread
+        # drains them as [B, T]-bucketed encode batches at window
+        # boundaries.  Disabled under multi-host lockstep (a leader-only
+        # encode forward would desync the SPMD followers' jitted launch
+        # sequence) and for models without a batched encode path — both
+        # fall back to the legacy serial embed.
+        self.encode_batcher = None
+        if (
+            config.scheduler.encode_lane_enabled
+            and (denv is None or denv.num_processes <= 1)
+            and hasattr(self.engine.model, "encode_batch")
+        ):
+            from production_stack_tpu.engine.server.encode_batcher import (
+                EncodeBatcher,
+            )
+
+            self.encode_batcher = EncodeBatcher(self.engine)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -136,6 +154,11 @@ class AsyncEngine:
             await asyncio.to_thread(self._slice_monitor.stop)
         if self._thread is not None:
             await asyncio.to_thread(self._thread.join, 30)
+        if self.encode_batcher is not None:
+            # The step thread is gone; queued embeds can never run.
+            self.encode_batcher.fail_all(
+                RuntimeError("engine shutting down")
+            )
         # Release the engine's own workers AFTER the step thread is gone
         # (it is their producer): prefetch fetchers, offload stager
         # writer, prefix exporter, the remote-KV deleter (whose queued
@@ -194,6 +217,39 @@ class AsyncEngine:
             self._aborts.append(request_id)
         self._wakeup.set()
 
+    async def embed_batch(
+        self,
+        batch_token_ids: List[List[int]],
+        deadline: Optional[float] = None,
+    ) -> List:
+        """Embed a list of tokenized inputs.  With the encode lane on
+        (the default) every text is queued on the EncodeBatcher and the
+        STEP THREAD runs the [B, T]-bucketed batch at a window boundary
+        — the device is never touched from this coroutine's thread.
+        With the lane off (--no-encode-lane / multi-host lockstep) each
+        text runs the legacy serial encode off-thread, preserving the
+        pre-lane behavior exactly.  Raises ValueError on empty or
+        over-long inputs either way."""
+        max_len = self.engine.encode_max_len()
+        for ids in batch_token_ids:
+            if not ids:
+                raise ValueError("input produced no tokens")
+            if len(ids) > max_len:
+                raise ValueError(
+                    f"input is {len(ids)} tokens; the embedding path "
+                    f"supports up to {max_len}"
+                )
+        if self.encode_batcher is None:
+            return [
+                await asyncio.to_thread(self.engine.embed, ids)
+                for ids in batch_token_ids
+            ]
+        futures = self.encode_batcher.submit(
+            batch_token_ids, asyncio.get_running_loop(), deadline
+        )
+        self._wakeup.set()
+        return list(await asyncio.gather(*futures))
+
     def stats(self) -> Dict[str, float]:
         return self.engine.stats()
 
@@ -235,6 +291,41 @@ class AsyncEngine:
             queued_requests=queued_requests,
             queued_tokens=queued_tokens,
             max_queued_requests=cfg.queued_requests_cap,
+            max_queued_tokens=cfg.queued_tokens_cap,
+            kv_usage_perc=float(self.engine.block_pool.usage),
+            retry_after_s=min(retry_after, 60),
+        )
+
+    def check_encode_admission(
+        self, n_texts: int, n_tokens: int
+    ) -> Optional[AdmissionRejection]:
+        """Bounded admission for the encode lane: the queue the batcher
+        carries is bounded in texts (queued_encode_texts_cap) and tokens
+        (the shared queued_tokens_cap), so an embed burst sheds with a
+        structured 429 at the edge instead of queueing unboundedly.
+        With the lane off, encode requests count against the generation
+        caps (one text = one request) — they compete for the same
+        device either way."""
+        cfg = self.engine.config.scheduler
+        if not cfg.admission_enabled:
+            return None
+        if self.encode_batcher is None:
+            return self.check_admission(n_texts, n_tokens)
+        depth, queued_tokens = self.encode_batcher.snapshot()
+        if (
+            depth + n_texts <= cfg.queued_encode_texts_cap
+            and queued_tokens + n_tokens <= cfg.queued_tokens_cap
+        ):
+            return None
+        # Service-rate estimate, encode flavor: each window boundary
+        # drains up to one full encode batch bucket.
+        retry_after = max(
+            1, -(-depth // max(1, cfg.encode_batch_buckets[-1]))
+        )
+        return AdmissionRejection(
+            queued_requests=depth,
+            queued_tokens=queued_tokens,
+            max_queued_requests=cfg.queued_encode_texts_cap,
             max_queued_tokens=cfg.queued_tokens_cap,
             kv_usage_perc=float(self.engine.block_pool.usage),
             retry_after_s=min(retry_after, 60),
@@ -354,6 +445,13 @@ class AsyncEngine:
                 except Exception as e:
                     self._emit(request_id, e)
             if not self.engine.has_unfinished():
+                # Device idle: encode batches are the only work there is
+                # — drain the queue completely before sleeping.
+                if (
+                    self.encode_batcher is not None
+                    and self.encode_batcher.run_pending(max_batches=0)
+                ):
+                    continue
                 self._wakeup.wait(timeout=0.01)
                 self._wakeup.clear()
                 continue
@@ -411,6 +509,14 @@ class AsyncEngine:
                             prompt_logprobs=out.prompt_logprobs,
                         ),
                     )
+            # Window boundary: at most ONE encode batch per iteration
+            # while generation is live — an embed burst adds one
+            # prefill-chunk-shaped pass between decode windows, never
+            # preempts a window mid-scan, and generation ITL stays
+            # bounded.  (The batcher is None under lockstep, so
+            # followers never see a forward they didn't replay.)
+            if self.encode_batcher is not None:
+                self.encode_batcher.run_pending(max_batches=1)
         if self._lockstep is not None:
             from production_stack_tpu.engine.parallel.distributed import (
                 StepEvents,

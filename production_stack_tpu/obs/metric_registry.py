@@ -232,6 +232,33 @@ REGISTRY = {
                 "bucket 1 is queue depth converted into device "
                 "utilization",
     },
+    "tpu:encode_texts_total": {
+        "kind": "counter", "layer": "engine",
+        "mirrors": ("fake_engine", "dashboard", "docs"),
+        "help": "Texts embedded via the step thread's [B, T]-bucketed "
+                "encode batches (the batched embed/rerank/score lane)",
+    },
+    "tpu:encode_queue_depth": {
+        "kind": "gauge", "layer": "engine",
+        "mirrors": ("fake_engine", "dashboard", "docs"),
+        "help": "Texts queued for the encode lane (the depth encode "
+                "admission bounds; the step thread drains one batch per "
+                "window boundary while generation is live)",
+    },
+    "tpu:encode_batch_size": {
+        "kind": "histogram", "layer": "engine",
+        "mirrors": ("fake_engine", "dashboard", "docs"),
+        "help": "Actual texts per encode batch — mass near the top "
+                "bucket means embed traffic is coalescing; mass stuck "
+                "at 1 under load means it arrives too sparse to batch",
+    },
+    "tpu:encode_seconds": {
+        "kind": "histogram", "layer": "engine",
+        "mirrors": ("fake_engine", "dashboard", "docs"),
+        "help": "Wall seconds per [B, T]-bucketed encode batch "
+                "(dispatch through device sync, observed on the step "
+                "thread)",
+    },
     "tpu:window_transfer_overlap_seconds_total": {
         "kind": "counter", "layer": "engine",
         "mirrors": ("fake_engine", "dashboard", "docs"),
@@ -507,8 +534,11 @@ REGISTRY = {
         "kind": "gauge", "layer": "router", "labels": ("pool",),
         "mirrors": ("dashboard", "docs"),
         "help": "Capacity-model fleet headroom in spare request slots per "
-                "admission pool (fleet, or prefill/decode under disagg "
-                "role pools); the prom-adapter exposes it for HPA",
+                "admission pool (fleet, or prefill/decode/encode under "
+                "role pools — the encode lane's embed/rerank/score "
+                "traffic is admitted against its own pool's headroom, so "
+                "an embed burst cannot starve generation); the "
+                "prom-adapter exposes it for HPA",
     },
     "tpu_router:backend_capacity_slots": {
         "kind": "gauge", "layer": "router", "labels": ("server",),
@@ -567,13 +597,17 @@ REGISTRY = {
         "kind": "counter", "layer": "router",
         "source_name": "tpu_router:semantic_cache_hits",
         "mirrors": ("dashboard", "docs"),
-        "help": "Semantic cache hits served",
+        "help": "Semantic cache hits served (chat experimental cache + "
+                "the encode-lane cache fronting /v1/embeddings, rerank "
+                "and score — an exact hit answers with the stored "
+                "response bytes and zero engine work)",
     },
     "tpu_router:semantic_cache_misses_total": {
         "kind": "counter", "layer": "router",
         "source_name": "tpu_router:semantic_cache_misses",
         "mirrors": ("dashboard", "docs"),
-        "help": "Semantic cache lookups that missed",
+        "help": "Semantic cache lookups that missed (chat experimental "
+                "cache + the encode-lane cache)",
     },
     "tpu_router:pii_requests_scanned_total": {
         "kind": "counter", "layer": "router",

@@ -157,6 +157,45 @@ def initialize_all(app: web.Application, args) -> ServiceRegistry:
             _unavailable("--feature-gates", e)
         initialize_experimental(app, registry, args)
 
+    # Encode-lane semantic cache (router/encode_cache.py): fronts the
+    # embed/rerank/score proxy paths with chunk-hash-keyed exact replay
+    # (+ the optional rerank similarity tier).  Composes with whatever
+    # proxy_hooks the experimental tier installed above — the app has
+    # ONE hooks slot, and the cache must see the request only if PII
+    # screening didn't already block it.
+    if getattr(args, "encode_cache_max_bytes", 0) > 0:
+        from production_stack_tpu.router.encode_cache import (
+            ENCODE_CACHE_SERVICE,
+            ChainedProxyHooks,
+            EncodeCache,
+            EncodeCacheHooks,
+            make_fleet_vectorizer,
+        )
+
+        encode_cache = EncodeCache(
+            max_bytes=args.encode_cache_max_bytes,
+            ttl_s=args.encode_cache_ttl_s,
+            similarity_threshold=args.encode_cache_similarity_threshold,
+            chunk_chars=args.kv_chunk_chars,
+        )
+        registry.set(ENCODE_CACHE_SERVICE, encode_cache)
+        vectorize = (
+            make_fleet_vectorizer(registry, chunk_chars=args.kv_chunk_chars)
+            if args.encode_cache_similarity_threshold > 0 else None
+        )
+        cache_hooks = EncodeCacheHooks(encode_cache, vectorize=vectorize)
+        prior = app.get("proxy_hooks")
+        app["proxy_hooks"] = (
+            ChainedProxyHooks(prior, cache_hooks) if prior is not None
+            else cache_hooks
+        )
+        logger.info(
+            "Encode-lane semantic cache enabled (max_bytes=%d, ttl=%.0fs, "
+            "similarity=%.2f)",
+            args.encode_cache_max_bytes, args.encode_cache_ttl_s,
+            args.encode_cache_similarity_threshold,
+        )
+
     if args.dynamic_config_json:
         try:
             from production_stack_tpu.router.dynamic_config import DynamicConfigWatcher

@@ -358,7 +358,7 @@ class ShedDecision:
 
     reason: str          # one of FLEET_SHED_REASONS
     retry_after_s: float
-    pool: str            # "fleet" | "decode" | "prefill"
+    pool: str            # "fleet" | "decode" | "prefill" | "encode"
     headroom: float
     capacity: float
 
@@ -393,13 +393,20 @@ class FleetAdmission:
         request_stats: Mapping,
         priority: int = 0,
         monitor=None,
+        lane: str = "generate",
     ) -> Optional[ShedDecision]:
         """None = admit.  ``endpoints`` is the already-filtered candidate
-        list for this request (model + health + breaker filtering done)."""
+        list for this request (model + health + breaker filtering done).
+        ``lane`` selects which role pool's headroom gates the request:
+        ``"generate"`` (completions traffic, the default) keys on the
+        decode-capable pool; ``"encode"`` (embeddings / rerank / score)
+        keys on the encode pool — dedicated ``encode``-role members plus
+        fused role-less backends — so an embed burst sheds against ITS
+        pool's knee and never eats the generation pool's headroom."""
         if not endpoints:
             return None  # nothing to protect; the routing layer will 503
         self.model.refresh_maybe(endpoints, engine_stats, request_stats, monitor)
-        pool_name, pool = self._admission_pool(endpoints)
+        pool_name, pool = self._admission_pool(endpoints, lane)
         capacity = self.model.pool_capacity(pool)
         headroom = self.model.pool_headroom(pool, request_stats)
         if capacity <= 0:
@@ -424,14 +431,27 @@ class FleetAdmission:
         return None
 
     @staticmethod
-    def _admission_pool(endpoints) -> Tuple[str, List]:
+    def _admission_pool(endpoints, lane: str = "generate") -> Tuple[str, List]:
         """The pool whose headroom gates this request: the decode-capable
         endpoints when disagg roles are configured (prefill-pool
         saturation must not shed work the decode/fused pool could
-        absorb), the whole fleet otherwise."""
+        absorb), the whole fleet otherwise.  On the encode lane the gate
+        is the encode pool — ``encode``-role members plus fused
+        role-less backends (which serve both surfaces); if no such
+        endpoints exist the lane degrades to fleet-wide headroom rather
+        than shedding everything."""
         if any(getattr(ep, "role", None) for ep in endpoints):
+            if lane == "encode":
+                encode_capable = [
+                    ep for ep in endpoints
+                    if getattr(ep, "role", None) in (None, "", "encode")
+                ]
+                if encode_capable:
+                    return "encode", encode_capable
+                return "fleet", list(endpoints)
             decode_capable = [
-                ep for ep in endpoints if getattr(ep, "role", None) != "prefill"
+                ep for ep in endpoints
+                if getattr(ep, "role", None) not in ("prefill", "encode")
             ]
             if decode_capable:
                 return "decode", decode_capable

@@ -1,0 +1,461 @@
+"""Encode-lane semantic cache: answer repeat embed/rerank/score requests
+at the router with zero engine work.
+
+ROADMAP "millions of users" economics, applied to the encode surface:
+embedding traffic is dominated by REPEATS — the same documents re-chunked
+by RAG pipelines, the same queries re-scored against the same corpora —
+and every repeat costs a full `[B, T]` encode batch on an engine
+(docs/engine.md "The encode lane").  This cache fronts the encode lane
+(docs/router.md "Encode lanes & semantic cache") with two tiers:
+
+* **Exact tier** — keyed on the PR-13 chunk-hash chain
+  (routing/kv_aware.py): each text is digested as a chained blake2b walk
+  over ``chunk_chars`` slices INCLUDING the partial tail (the routing
+  chain stops at full chunks because it keys *prefix affinity*; a cache
+  key must cover every byte or "abc" and "abcd" would collide).  A hit
+  replays the stored response bytes verbatim — byte-identical to the
+  answer the engine gave, so clients cannot distinguish cache from
+  compute.
+* **Similarity tier** (optional, ``similarity_threshold`` > 0) — for
+  rerank requests whose DOCUMENT set is an exact chain match but whose
+  query text drifted (rephrasings of the same question against the same
+  corpus).  The query is vectorized through the embed lane itself (ONE
+  text) and compared against the stored queries' vectors; a cosine match
+  at/above the threshold serves the cached ranking — one encode forward
+  instead of N+1.  Embeddings requests never use this tier: vectorizing
+  the query costs exactly the forward a hit would save.
+
+Bounded by ``max_bytes`` with LRU eviction and a TTL staleness bound.
+Both bounds are enforced at store/lookup time on the event loop — the
+router is one asyncio loop, no locking (router/capacity.py precedent).
+
+Metrics: the cache reuses the ``tpu_router:semantic_cache_{hits,misses,
+size}`` families declared by router/experimental (re-declaring a
+prometheus timeseries raises; the registry help names both caches).  The
+``x-encode-cache: hit|similar`` response header marks served hits.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import time
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from aiohttp import web
+
+logger = logging.getLogger(__name__)
+
+ENCODE_CACHE_SERVICE = "encode_cache"
+
+_REQ_KEY = "encode_cache_store_key"
+
+# Paths the cache fronts (the router's encode lane surface —
+# services/request_service/request.py ENCODE_PATHS).
+_EMBED_PATH = "/v1/embeddings"
+_RERANK_PATHS = ("/v1/rerank", "/rerank")
+_SCORE_PATHS = ("/v1/score", "/score")
+
+
+def chunk_chain_key(text: str, chunk_chars: int) -> str:
+    """Chained blake2b digest over ``chunk_chars`` slices of ``text``,
+    INCLUDING the partial tail — the exact-tier key primitive.  Matches
+    the PR-13 routing chain (kv_aware._prefix_hashes) on full chunks and
+    extends it over the remainder so the key covers every byte."""
+    h = hashlib.blake2b(digest_size=8)
+    for start in range(0, max(len(text), 1), max(chunk_chars, 1)):
+        h.update(text[start : start + chunk_chars].encode("utf-8"))
+    return h.hexdigest()
+
+
+class EncodeCache:
+    """Byte-bounded, TTL'd, LRU exact-response cache for the encode lane,
+    plus the rerank similarity tier's (docs_key -> query vectors) index."""
+
+    def __init__(
+        self,
+        *,
+        max_bytes: int,
+        ttl_s: float = 300.0,
+        similarity_threshold: float = 0.0,
+        chunk_chars: int = 1024,
+        clock=time.time,
+    ):
+        if max_bytes <= 0:
+            raise ValueError("max_bytes must be > 0 (0 disables the cache)")
+        if ttl_s <= 0:
+            raise ValueError("ttl_s must be > 0")
+        if not 0.0 <= similarity_threshold <= 1.0:
+            raise ValueError("similarity_threshold must be in [0, 1]")
+        self.max_bytes = int(max_bytes)
+        self.ttl_s = float(ttl_s)
+        self.similarity_threshold = float(similarity_threshold)
+        self.chunk_chars = int(chunk_chars)
+        self._clock = clock
+        # exact key -> (response_bytes, stored_at, docs_key|None, qvec|None)
+        self._entries: "OrderedDict[str, tuple]" = OrderedDict()
+        self._bytes = 0
+        self.hits = 0
+        self.similar_hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    # -- keys ----------------------------------------------------------------
+
+    def request_key(self, path: str, body: Dict[str, Any]) -> Optional[Tuple]:
+        """(exact_key, docs_key, query_text) for a cacheable request, or
+        None.  ``docs_key``/``query_text`` are non-None only for rerank
+        (the similarity tier's join).  Streaming bodies and non-text
+        inputs are uncacheable."""
+        model = body.get("model")
+        cc = self.chunk_chars
+        if path == _EMBED_PATH:
+            raw = body.get("input")
+            texts = [raw] if isinstance(raw, str) else raw
+            if not isinstance(texts, list) or not texts or not all(
+                isinstance(t, str) for t in texts
+            ):
+                return None
+            # encoding_format et al. change the response shape — fold
+            # every non-input field into the key rather than enumerate.
+            aux = json.dumps(
+                {k: v for k, v in body.items() if k != "input"},
+                sort_keys=True,
+            )
+            exact = self._digest(
+                path, str(model), aux, *[chunk_chain_key(t, cc) for t in texts]
+            )
+            return exact, None, None
+        if path in _RERANK_PATHS:
+            query, documents = body.get("query"), body.get("documents")
+            if not isinstance(query, str) or not isinstance(documents, list) \
+                    or not all(isinstance(d, str) for d in documents):
+                return None
+            aux = json.dumps(
+                {k: v for k, v in body.items()
+                 if k not in ("query", "documents")},
+                sort_keys=True,
+            )
+            docs_key = self._digest(
+                "rerank-docs", str(model), aux,
+                *[chunk_chain_key(d, cc) for d in documents],
+            )
+            exact = self._digest(docs_key, chunk_chain_key(query, cc))
+            return exact, docs_key, query
+        if path in _SCORE_PATHS:
+            t1, t2 = body.get("text_1"), body.get("text_2")
+            sides = []
+            for side in (t1, t2):
+                texts = [side] if isinstance(side, str) else side
+                if not isinstance(texts, list) or not texts or not all(
+                    isinstance(t, str) for t in texts
+                ):
+                    return None
+                sides.append(texts)
+            aux = json.dumps(
+                {k: v for k, v in body.items()
+                 if k not in ("text_1", "text_2")},
+                sort_keys=True,
+            )
+            exact = self._digest(
+                "score", str(model), aux,
+                *[chunk_chain_key(t, cc) for ts in sides for t in ts],
+                str(len(sides[0])),
+            )
+            return exact, None, None
+        return None
+
+    @staticmethod
+    def _digest(*parts: str) -> str:
+        h = hashlib.blake2b(digest_size=16)
+        for p in parts:
+            h.update(p.encode("utf-8"))
+            h.update(b"\x00")
+        return h.hexdigest()
+
+    # -- exact tier ----------------------------------------------------------
+
+    def lookup(self, exact_key: str) -> Optional[bytes]:
+        """Stored response bytes for an exact-key hit, or None.  Expired
+        entries are evicted on touch (TTL is a staleness bound, not a
+        sweeper contract)."""
+        entry = self._entries.get(exact_key)
+        if entry is None:
+            self.misses += 1
+            return None
+        body, stored_at, _docs_key, _qvec = entry
+        if self._clock() - stored_at > self.ttl_s:
+            self._evict(exact_key)
+            self.misses += 1
+            return None
+        self._entries.move_to_end(exact_key)
+        self.hits += 1
+        return body
+
+    def store(
+        self,
+        exact_key: str,
+        response_bytes: bytes,
+        docs_key: Optional[str] = None,
+        query_vector: Optional[List[float]] = None,
+    ) -> None:
+        """Insert/refresh an entry, then evict LRU-first until the byte
+        budget holds.  An answer larger than the whole budget is not
+        cached (it would evict everything and still not fit)."""
+        cost = len(response_bytes) + len(exact_key)
+        if cost > self.max_bytes:
+            return
+        if exact_key in self._entries:
+            self._evict(exact_key, count=False)
+        self._entries[exact_key] = (
+            response_bytes, self._clock(), docs_key, query_vector,
+        )
+        self._bytes += cost
+        while self._bytes > self.max_bytes and self._entries:
+            oldest = next(iter(self._entries))
+            self._evict(oldest)
+
+    def _evict(self, exact_key: str, count: bool = True) -> None:
+        body, _ts, _dk, _qv = self._entries.pop(exact_key)
+        self._bytes -= len(body) + len(exact_key)
+        if count:
+            self.evictions += 1
+
+    # -- similarity tier (rerank) -------------------------------------------
+
+    def similar_lookup(
+        self, docs_key: str, query_vector: List[float]
+    ) -> Optional[bytes]:
+        """Best resident entry sharing ``docs_key`` whose stored query
+        vector clears the cosine threshold.  Vectors are unit-norm
+        (llama.encode L2-normalizes), so cosine is a dot product."""
+        if self.similarity_threshold <= 0:
+            return None
+        best, best_sim = None, self.similarity_threshold
+        now = self._clock()
+        for key, (body, stored_at, dk, qvec) in self._entries.items():
+            if dk != docs_key or qvec is None:
+                continue
+            if now - stored_at > self.ttl_s:
+                continue
+            sim = sum(a * b for a, b in zip(query_vector, qvec))
+            if sim >= best_sim:
+                best, best_sim = (key, body), sim
+        if best is None:
+            return None
+        key, body = best
+        self._entries.move_to_end(key)
+        self.similar_hits += 1
+        return body
+
+    def has_docs_key(self, docs_key: str) -> bool:
+        """Cheap pre-gate for the similarity tier: vectorizing the query
+        costs one engine forward — only worth paying when some resident
+        ranking could actually answer."""
+        return any(dk == docs_key for _b, _t, dk, _qv in self._entries.values())
+
+    # -- reads ---------------------------------------------------------------
+
+    @property
+    def size(self) -> int:
+        return len(self._entries)
+
+    @property
+    def resident_bytes(self) -> int:
+        return self._bytes
+
+
+class EncodeCacheHooks:
+    """proxy_hooks implementation fronting the encode lane.
+
+    ``vectorize`` is an optional async callable ``text -> unit vector or
+    None`` backed by the embed lane itself (app.py wires it to POST
+    /v1/embeddings at an encode-capable backend); None keeps the
+    similarity tier inert (exact tier only)."""
+
+    def __init__(
+        self,
+        cache: EncodeCache,
+        vectorize: Optional[Callable] = None,
+    ):
+        self.cache = cache
+        self.vectorize = vectorize
+
+    async def _read_json(self, request: web.Request) -> Optional[Dict[str, Any]]:
+        # aiohttp caches the raw body; the data path's later read() is free.
+        raw = await request.read()
+        if not raw:
+            return None
+        try:
+            body = json.loads(raw)
+        except json.JSONDecodeError:
+            return None
+        return body if isinstance(body, dict) else None
+
+    async def pre_route(
+        self, request: web.Request, path: str
+    ) -> Optional[web.StreamResponse]:
+        from production_stack_tpu.router.experimental import (
+            semantic_cache_hits,
+            semantic_cache_misses,
+            semantic_cache_size,
+        )
+
+        if path != _EMBED_PATH and path not in _RERANK_PATHS \
+                and path not in _SCORE_PATHS:
+            return None
+        body = await self._read_json(request)
+        if body is None:
+            return None
+        keys = self.cache.request_key(path, body)
+        if keys is None:
+            return None
+        exact_key, docs_key, query_text = keys
+        cached = self.cache.lookup(exact_key)
+        semantic_cache_size.set(self.cache.size)
+        if cached is not None:
+            semantic_cache_hits.inc()
+            return web.Response(
+                body=cached,
+                content_type="application/json",
+                headers={"x-encode-cache": "hit"},
+            )
+        if (
+            docs_key is not None
+            and self.vectorize is not None
+            and self.cache.similarity_threshold > 0
+            and self.cache.has_docs_key(docs_key)
+        ):
+            qvec = await self.vectorize(query_text)
+            if qvec is not None:
+                near = self.cache.similar_lookup(docs_key, qvec)
+                if near is not None:
+                    semantic_cache_hits.inc()
+                    return web.Response(
+                        body=near,
+                        content_type="application/json",
+                        headers={"x-encode-cache": "similar"},
+                    )
+        semantic_cache_misses.inc()
+        request[_REQ_KEY] = (exact_key, docs_key, query_text)
+        return None
+
+    def post_response_hook(self, request: web.Request, path: str):
+        """Background store callable for a missed request, or None."""
+        stash = request.get(_REQ_KEY)
+        if stash is None:
+            return None
+        exact_key, docs_key, query_text = stash
+        cache, vectorize = self.cache, self.vectorize
+
+        async def store(body_json: Dict[str, Any], response_bytes: bytes) -> None:
+            from production_stack_tpu.router.experimental import (
+                semantic_cache_size,
+            )
+
+            try:
+                payload = json.loads(response_bytes)
+            except (ValueError, UnicodeDecodeError):
+                return
+            # Error envelopes are uncacheable (belt-and-braces on top of
+            # the status==200 gate in process_request).
+            if not isinstance(payload, dict) or "error" in payload:
+                return
+            qvec = None
+            if (
+                docs_key is not None
+                and vectorize is not None
+                and cache.similarity_threshold > 0
+            ):
+                # The stored query vector is what future near-duplicate
+                # queries compare against; vectorized in the background
+                # store, off the client's critical path.
+                try:
+                    qvec = await vectorize(query_text)
+                except Exception:
+                    logger.exception("encode-cache query vectorize failed")
+            cache.store(
+                exact_key, response_bytes,
+                docs_key=docs_key, query_vector=qvec,
+            )
+            semantic_cache_size.set(cache.size)
+
+        return store
+
+
+class ChainedProxyHooks:
+    """Compose proxy_hooks implementations: the first pre_route
+    short-circuit wins; every post_response store callable runs (the
+    app has ONE ``proxy_hooks`` slot — experimental PII/chat-cache hooks
+    and the encode cache must coexist)."""
+
+    def __init__(self, *hooks):
+        self.hooks = [h for h in hooks if h is not None]
+
+    async def pre_route(self, request, path):
+        for h in self.hooks:
+            resp = await h.pre_route(request, path)
+            if resp is not None:
+                return resp
+        return None
+
+    def post_response_hook(self, request, path):
+        stores = [
+            s for h in self.hooks
+            for s in [h.post_response_hook(request, path)]
+            if s is not None
+        ]
+        if not stores:
+            return None
+        if len(stores) == 1:
+            return stores[0]
+
+        async def fanout(body_json, response_bytes):
+            for s in stores:
+                await s(body_json, response_bytes)
+
+        return fanout
+
+
+def make_fleet_vectorizer(registry, chunk_chars: int = 1024):
+    """An embed-lane-backed ``vectorize`` callable: POST /v1/embeddings
+    for ONE text at an encode-capable backend through the router's own
+    client session.  Any failure returns None — the similarity tier
+    degrades to exact-only, never blocks the proxy path."""
+
+    async def vectorize(text: str):
+        from production_stack_tpu.router.routing.base import prefer_encode_pool
+        from production_stack_tpu.router.service_discovery import (
+            DISCOVERY_SERVICE,
+        )
+        from production_stack_tpu.router.services.request_service.request import (
+            CLIENT_SESSION,
+        )
+
+        discovery = registry.get(DISCOVERY_SERVICE)
+        session = registry.get(CLIENT_SESSION)
+        if discovery is None or session is None:
+            return None
+        endpoints = prefer_encode_pool(
+            [ep for ep in discovery.get_endpoint_info() if not ep.sleep]
+        )
+        if not endpoints:
+            return None
+        ep = endpoints[0]
+        model = ep.model_names[0] if ep.model_names else None
+        try:
+            async with session.post(
+                f"{ep.url}/v1/embeddings",
+                json={"input": text, "model": model},
+            ) as resp:
+                if resp.status != 200:
+                    return None
+                payload = await resp.json()
+            return payload["data"][0]["embedding"]
+        except Exception:
+            logger.debug("fleet vectorize failed", exc_info=True)
+            return None
+
+    return vectorize

@@ -54,8 +54,9 @@ def parse_args(argv=None) -> argparse.Namespace:
     parser.add_argument(
         "--static-backend-roles",
         default=None,
-        help="Comma-separated disagg roles, one entry per backend: "
-        "'prefill', 'decode', or empty (fused).  Required by "
+        help="Comma-separated role-pool assignments, one entry per "
+        "backend: 'prefill', 'decode', 'encode' (dedicated "
+        "embed/rerank/score pool), or empty (fused).  Required by "
         "--routing-logic disagg under static discovery",
     )
     parser.add_argument("--k8s-namespace", default="default")
@@ -66,9 +67,9 @@ def parse_args(argv=None) -> argparse.Namespace:
     parser.add_argument(
         "--k8s-role-label",
         default="app.production-stack-tpu/role",
-        help="Pod label carrying the disagg role ('prefill'/'decode'); "
-        "the helm role pools stamp it on engine pods (stackcheck SC707 "
-        "pins the chart<->flag agreement)",
+        help="Pod label carrying the role-pool assignment "
+        "('prefill'/'decode'/'encode'); the helm role pools stamp it on "
+        "engine pods (stackcheck SC707 pins the chart<->flag agreement)",
     )
 
     # Routing (reference parser.py:98-116).
@@ -266,6 +267,29 @@ def parse_args(argv=None) -> argparse.Namespace:
         "local checkpoint — the reference's presidio-analyzer analogue)",
     )
 
+    # Encode-lane semantic cache (router/encode_cache.py): answers repeat
+    # /v1/embeddings (and exact-hit rerank/score) from the router with
+    # zero engine work.  Off by default (max-bytes 0) — caching is a
+    # correctness-visible behavior the operator must opt into.
+    parser.add_argument(
+        "--encode-cache-max-bytes", type=int, default=0,
+        help="byte budget for the encode-lane semantic cache (exact tier "
+        "keyed on the chunk-hash chain; LRU + TTL bounded); 0 disables "
+        "the cache entirely",
+    )
+    parser.add_argument(
+        "--encode-cache-ttl-s", type=float, default=300.0,
+        help="max age of a cached encode answer before it is re-computed "
+        "(staleness bound; entries also evict under the byte budget)",
+    )
+    parser.add_argument(
+        "--encode-cache-similarity-threshold", type=float, default=0.0,
+        help="cosine similarity past which a near-duplicate single-text "
+        "embedding request may be answered from the similarity tier "
+        "(vectorized via the embed lane itself); 0 keeps the cache "
+        "exact-only",
+    )
+
     parser.add_argument("--request-rewriter", default="noop")
     parser.add_argument("--log-level", default="info")
     parser.add_argument(
@@ -318,10 +342,10 @@ def validate_args(args: argparse.Namespace) -> None:
                     f"--static-backends has {len(urls)}"
                 )
             for role in roles:
-                if role and role not in ("prefill", "decode"):
+                if role and role not in ("prefill", "decode", "encode"):
                     raise ValueError(
                         f"--static-backend-roles entries must be 'prefill', "
-                        f"'decode', or empty; got {role!r}"
+                        f"'decode', 'encode', or empty; got {role!r}"
                     )
     if args.routing_logic == "session" and not args.session_key:
         raise ValueError("--routing-logic session requires --session-key")
@@ -363,6 +387,14 @@ def validate_args(args: argparse.Namespace) -> None:
         raise ValueError("--retry-budget must be >= 0")
     if args.drain_grace_s < 0:
         raise ValueError("--drain-grace-s must be >= 0")
+    if args.encode_cache_max_bytes < 0:
+        raise ValueError("--encode-cache-max-bytes must be >= 0")
+    if args.encode_cache_ttl_s <= 0:
+        raise ValueError("--encode-cache-ttl-s must be > 0")
+    if not 0.0 <= args.encode_cache_similarity_threshold <= 1.0:
+        raise ValueError(
+            "--encode-cache-similarity-threshold must be in [0, 1]"
+        )
     if args.fleet_default_slots < 1:
         raise ValueError("--fleet-default-slots must be >= 1")
     if args.fleet_slo_p95_itl_s <= 0 or args.fleet_slo_p95_ttft_s <= 0:

@@ -18,6 +18,7 @@ from production_stack_tpu.router.routing import ROUTING_SERVICE
 from production_stack_tpu.router.service_discovery import (
     DISCOVERY_SERVICE,
     decode_capable,
+    encode_capable,
     role_pool,
     roles_configured,
 )
@@ -166,12 +167,18 @@ async def metrics(request: web.Request) -> web.Response:
             ms.fleet_headroom_slots.labels(pool="decode").set(
                 capacity.pool_headroom(decode_capable(endpoints), request_stats)
             )
+            # Encode lane isolation is observable: the pool an embed
+            # burst sheds against (dedicated encode members + fused
+            # backends), separate from the generation pools above.
+            ms.fleet_headroom_slots.labels(pool="encode").set(
+                capacity.pool_headroom(encode_capable(endpoints), request_stats)
+            )
         else:
             # Roles gone (fleet hot-swapped back to fused): retire the
             # per-role labels instead of freezing their last values — a
             # frozen headroom=0 series would pin the adapter's
             # min()-over-pools HPA signal at zero forever.
-            for stale_pool in ("prefill", "decode"):
+            for stale_pool in ("prefill", "decode", "encode"):
                 try:
                     ms.fleet_headroom_slots.remove(stale_pool)
                 except KeyError:

@@ -46,11 +46,34 @@ def exclude_prefill_role(endpoints: List[EndpointInfo]) -> List[EndpointInfo]:
     the disagg prime phase — a session/KV-affinity/least-loaded pick must
     not park a generation stream on one (it would decode at prefill-pool
     batch shapes AND re-introduce the interference disaggregation exists
-    to remove).  Degrades rather than 500s: when ONLY prefill-role
-    backends exist they stay eligible (a prefill-role engine can still
-    decode; disagg_role only steers KV export/import)."""
-    capable = [ep for ep in endpoints if getattr(ep, "role", None) != "prefill"]
+    to remove).  Dedicated ``encode``-pool backends are likewise reserved
+    for embed/rerank/score traffic (docs/router.md "Encode lanes"): a
+    generation stream parked there would contend with the batched encode
+    windows the pool exists to isolate.  Degrades rather than 500s: when
+    ONLY reserved-role backends exist they stay eligible (any engine can
+    still decode; the role only steers pool placement)."""
+    capable = [
+        ep for ep in endpoints
+        if getattr(ep, "role", None) not in ("prefill", "encode")
+    ]
     return capable if capable else endpoints
+
+
+def prefer_encode_pool(endpoints: List[EndpointInfo]) -> List[EndpointInfo]:
+    """Encode-lane candidate selection (embeddings / rerank / score):
+    dedicated ``encode``-role backends win outright when any exist; else
+    fused role-less backends (they serve both surfaces); else the full
+    list (a prefill/decode-only fleet still answers embeddings — degrade,
+    never 503 a request some backend could serve)."""
+    dedicated = [
+        ep for ep in endpoints if getattr(ep, "role", None) == "encode"
+    ]
+    if dedicated:
+        return dedicated
+    fused = [
+        ep for ep in endpoints if getattr(ep, "role", None) in (None, "")
+    ]
+    return fused if fused else endpoints
 
 
 def filter_circuit_available(endpoints: List[EndpointInfo], breaker) -> List[EndpointInfo]:

@@ -32,9 +32,11 @@ DISCOVERY_SERVICE = "service_discovery"
 
 # Disaggregated serving roles (docs/engine.md "Disaggregated data path").
 # A "prefill"-role engine runs the prime phase and exports prefix chains;
-# a "decode"-role engine admits with remote-prefetch imports.  Role-less
-# endpoints are fused (serve both phases, today's behavior).
-ENGINE_ROLES = ("prefill", "decode")
+# a "decode"-role engine admits with remote-prefetch imports.  An
+# "encode"-role engine serves only the embed/rerank/score lane
+# (docs/router.md "Encode lanes & semantic cache").  Role-less endpoints
+# are fused (serve everything, today's behavior).
+ENGINE_ROLES = ("prefill", "decode", "encode")
 # Pod label the helm chart stamps on role-pool engine pods and the
 # router's k8s discovery reads back (--k8s-role-label; stackcheck SC707
 # pins the chart<->flag agreement).
@@ -53,7 +55,7 @@ class EndpointInfo:
     # "chat" | "completion" | "embeddings" | "rerank" | "score"
     model_types: Optional[List[str]] = None
     sleep: bool = False  # engine put to sleep by autoscaler; excluded from routing
-    # Disaggregated serving role: "prefill" | "decode" | None (fused).
+    # Role-pool assignment: "prefill" | "decode" | "encode" | None (fused).
     role: Optional[str] = None
 
 
@@ -64,9 +66,18 @@ def role_pool(endpoints: List["EndpointInfo"], role: str) -> List["EndpointInfo"
 
 def decode_capable(endpoints: List["EndpointInfo"]) -> List["EndpointInfo"]:
     """Endpoints eligible to serve the decode/generation phase: everything
-    except dedicated prefill-pool backends (role-less fused endpoints
-    count — they decode today and keep decoding under disagg)."""
-    return [ep for ep in endpoints if ep.role != "prefill"]
+    except dedicated prefill-pool and encode-pool backends (role-less
+    fused endpoints count — they decode today and keep decoding under
+    disagg)."""
+    return [ep for ep in endpoints if ep.role not in ("prefill", "encode")]
+
+
+def encode_capable(endpoints: List["EndpointInfo"]) -> List["EndpointInfo"]:
+    """Endpoints eligible for the embed/rerank/score lane: dedicated
+    ``encode``-pool members plus role-less fused backends (which serve
+    both surfaces) — the pool whose headroom gates encode admission
+    (router/capacity.py)."""
+    return [ep for ep in endpoints if ep.role in (None, "", "encode")]
 
 
 def roles_configured(endpoints: List["EndpointInfo"]) -> bool:

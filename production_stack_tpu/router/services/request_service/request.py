@@ -65,6 +65,12 @@ CIRCUIT_BREAKER = "circuit_breaker"
 # unit tests get).
 RETRY_BUDGET = "retry_budget"
 
+# Encode-lane surface: requests to these paths run the engines' batched
+# encode lane (embed/rerank/score), not the decode scan — they gate on
+# the ENCODE pool's fleet headroom and route to encode-capable backends
+# (docs/router.md "Encode lanes & semantic cache").
+ENCODE_PATHS = ("/v1/embeddings", "/v1/rerank", "/rerank", "/v1/score", "/score")
+
 # Headers that must not be forwarded either direction: hop-by-hop headers,
 # plus encoding headers — aiohttp's client auto-decompresses the backend body
 # and negotiates its own Accept-Encoding, so forwarding either would claim an
@@ -248,6 +254,17 @@ async def route_general_request(
     monitor = registry.get(REQUEST_STATS_MONITOR)
     request_stats = monitor.get_request_stats(time.time()) if monitor else {}
 
+    # Encode lane: embed/rerank/score requests prefer the dedicated
+    # encode pool (role-less fused backends serve both; prefill/decode
+    # members are reserved for generation) and gate on the ENCODE
+    # pool's headroom below — an embed burst sheds against its own
+    # knee instead of stretching generation ITL.
+    lane = "encode" if endpoint_path in ENCODE_PATHS else "generate"
+    if lane == "encode":
+        from production_stack_tpu.router.routing.base import prefer_encode_pool
+
+        endpoints = prefer_encode_pool(endpoints)
+
     # Fleet-level admission (router/capacity.py): when the online
     # capacity model estimates the admission pool's headroom exhausted,
     # shed HERE with a structured 429 + Retry-After — before a routing
@@ -260,6 +277,7 @@ async def route_general_request(
             endpoints, engine_stats, request_stats,
             priority=request_priority(request.headers, body_json),
             monitor=monitor,
+            lane=lane,
         )
         if shed is not None:
             from production_stack_tpu.router.services import (

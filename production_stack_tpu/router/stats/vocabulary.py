@@ -208,6 +208,18 @@ TPU_MIXED_WINDOW_CHUNK_TOKENS = "tpu:mixed_window_chunk_tokens_total"
 # declining); mass in the >1 buckets is queue depth being converted
 # into device utilization.
 TPU_MIXED_WINDOW_PROMPTS = "tpu:mixed_window_prompts_per_window"
+# Batched encode lane (scheduler encode_lane; docs/engine.md "The encode
+# lane"): texts embedded via the step thread's [B, T]-bucketed encode
+# batches (counter), the queue of texts the batcher is carrying (gauge —
+# the depth encode admission bounds), per-batch ACTUAL size as a
+# histogram (mass near the top bucket means embed/rerank/score traffic
+# is coalescing; mass stuck at 1 under load means it arrives too sparse
+# to batch and is paying per-text dispatches), and per-batch wall
+# seconds including the device sync.
+TPU_ENCODE_TEXTS = "tpu:encode_texts_total"
+TPU_ENCODE_QUEUE_DEPTH = "tpu:encode_queue_depth"
+TPU_ENCODE_BATCH_SIZE = "tpu:encode_batch_size"
+TPU_ENCODE_SECONDS = "tpu:encode_seconds"
 # Seconds of host<->device transfer work issued while the device was
 # BUSY with an in-flight window — H2D chunk staging for chained windows
 # and D2H offload gathers dispatched under the scan.  Each second here
@@ -288,6 +300,7 @@ TPU_COUNTERS = frozenset({
     TPU_DEADLINE_EXPIRED,
     TPU_MULTISTEP_WASTED_TOKENS,
     TPU_MIXED_WINDOW_CHUNK_TOKENS,
+    TPU_ENCODE_TEXTS,
     TPU_WINDOW_TRANSFER_OVERLAP_SECONDS,
     TPU_DISAGG_PREFILL_PRIMES,
     TPU_DISAGG_HANDOFF_HITS,

@@ -142,6 +142,7 @@ class FakeEngineState:
         slice_group: FakeSliceGroup | None = None,
         simulate_compiles: bool = False,
         tracing: bool = True,
+        max_queued_encode_texts: int = 256,
     ):
         self.model = model
         self.tokens_per_sec = tokens_per_sec
@@ -229,7 +230,7 @@ class FakeEngineState:
         # tokens) and stamps X-Disagg-Prefix.  ``shared_store`` is the
         # simulated shared KV store: pass ONE set to every fake in a
         # fleet so prefill-pool exports are visible to decode-pool fakes.
-        if disagg_role not in (None, "prefill", "decode", "both"):
+        if disagg_role not in (None, "prefill", "decode", "both", "encode"):
             raise ValueError(f"unknown disagg_role {disagg_role!r}")
         self.disagg_role = disagg_role
         self.shared_store = shared_store if shared_store is not None else set()
@@ -240,6 +241,23 @@ class FakeEngineState:
         self.disagg_prefill_primes = 0
         self.disagg_handoff_hits = 0
         self.disagg_handoff_misses = 0
+        # -- encode lane emulation (embeddings / rerank / score) -----------
+        # Same contract as the real engine's batched encode lane
+        # (engine/server/encode_batcher.py): each request lands as ONE
+        # batch, deterministic unit vectors keyed by text alone (so any
+        # two fakes — or two scrapes of one fake — agree bit-for-bit,
+        # the semantic-cache parity property), admission 429s once
+        # queued texts would exceed ``max_queued_encode_texts``, and the
+        # tpu:encode_* metric families render live values.
+        self.max_queued_encode_texts = int(max_queued_encode_texts)
+        self.encode_texts_total = 0
+        self.encode_in_flight = 0  # tpu:encode_queue_depth mirror
+        self.encode_batch_size_hist = Histogram(
+            bounds=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0)
+        )
+        self.encode_seconds_hist = Histogram(
+            bounds=(0.005, 0.02, 0.05, 0.1, 0.25, 1.0, 4.0)
+        )
         # -- multi-host slice-group emulation (FakeSliceGroup) -------------
         # This state becomes the LEADER (ordinal 0) of a simulated slice:
         # /health conjoins member liveness, a failed group refuses data-
@@ -370,6 +388,18 @@ def fake_prefix_chain(prompt_text: str, chunk_chars: int = 64) -> list:
         h.update(prompt_text[start : start + chunk_chars].encode("utf-8"))
         chain.append(h.hexdigest())
     return chain
+
+
+def fake_embedding(text: str, dim: int = 32) -> list:
+    """Deterministic unit vector for ``text`` — a function of the text
+    ALONE (no per-engine seed), so every fake in a fleet returns the
+    identical embedding for the same input.  That's the property the
+    router's semantic cache tests lean on: a cached answer must be
+    byte-identical to a fresh one regardless of which backend served it."""
+    digest = hashlib.blake2b(text.encode("utf-8"), digest_size=32).digest()
+    raw = [((b / 255.0) * 2.0 - 1.0) for b in digest[:dim]]
+    norm = sum(v * v for v in raw) ** 0.5 or 1.0
+    return [round(v / norm, 8) for v in raw]
 
 
 def _word(rng: random.Random) -> str:
@@ -508,7 +538,18 @@ def build_fake_engine_app(state: FakeEngineState | None = None) -> web.Applicati
             # families must exist for the scrape contract
             # (TPU_MULTISTEP_FALLBACK renders its labeled header below).
             (vocab.TPU_MULTISTEP_WASTED_TOKENS, 0),
-        ]) + vocab.render_labeled_counter(
+            # Batched encode lane (embed/rerank/score): live values from
+            # the fake lane below — texts encoded and the queue-depth
+            # gauge — so router encode-lane CI asserts batching through
+            # /metrics alone (SC303; the batch-size/latency histograms
+            # render below).
+            (vocab.TPU_ENCODE_TEXTS, state.encode_texts_total),
+            (vocab.TPU_ENCODE_QUEUE_DEPTH, state.encode_in_flight),
+        ]) + render_histogram(
+            vocab.TPU_ENCODE_BATCH_SIZE, state.encode_batch_size_hist,
+        ) + render_histogram(
+            vocab.TPU_ENCODE_SECONDS, state.encode_seconds_hist,
+        ) + vocab.render_labeled_counter(
             vocab.TPU_MULTISTEP_FALLBACK, "reason",
             dict.fromkeys(vocab.TPU_MULTISTEP_FALLBACK_REASONS, 0),
         ) + vocab.render_labeled_counter2(
@@ -992,6 +1033,190 @@ def build_fake_engine_app(state: FakeEngineState | None = None) -> web.Applicati
         finally:
             state.num_running -= 1
 
+    def _encode_gate(request: web.Request, texts: list):
+        """PR-5-shaped overload protection for the fake encode lane:
+        expired propagated deadline -> 504, queued texts past the cap ->
+        structured 429 + Retry-After (same body shape as the real
+        engine's encode admission).  Returns an error response or None."""
+        deadline_hdr = request.headers.get("x-request-deadline")
+        if deadline_hdr is not None:
+            try:
+                deadline = float(deadline_hdr)
+            except (TypeError, ValueError):
+                deadline = None
+            if deadline is not None and time.time() >= deadline:
+                state.deadline_expired += 1
+                return web.json_response(
+                    {"error": {"message": "request deadline already "
+                               "expired at admission",
+                               "type": "deadline_expired", "code": 504}},
+                    status=504,
+                )
+        if (
+            state.admission_control
+            and state.encode_in_flight + len(texts)
+            > state.max_queued_encode_texts
+        ):
+            state.admission_rejected += 1
+            retry_after = max(1, state.encode_in_flight // 32)
+            return web.json_response(
+                {
+                    "error": {
+                        "message": (
+                            "engine overloaded: "
+                            f"{state.encode_in_flight} texts already "
+                            "queued on the encode lane; retry after "
+                            f"{retry_after}s"
+                        ),
+                        "type": "overloaded",
+                        "code": 429,
+                        "detail": {
+                            "queued_requests": state.encode_in_flight,
+                            "max_queued_requests":
+                                state.max_queued_encode_texts,
+                            "retry_after_s": retry_after,
+                        },
+                    }
+                },
+                status=429,
+                headers={"Retry-After": str(retry_after)},
+            )
+        return None
+
+    async def _encode_batch(texts: list) -> list:
+        """One request = ONE simulated encode batch, like the real step
+        thread's window-boundary drain: the whole list lands as a single
+        forward, observed once in the batch-size histogram."""
+        state.encode_in_flight += len(texts)
+        t0 = time.time()
+        try:
+            await asyncio.sleep(state.ttft)
+            return [fake_embedding(t) for t in texts]
+        finally:
+            state.encode_in_flight -= len(texts)
+            state.encode_texts_total += len(texts)
+            state.encode_batch_size_hist.observe(float(len(texts)))
+            state.encode_seconds_hist.observe(time.time() - t0)
+
+    async def embeddings(request: web.Request) -> web.Response:
+        state.data_plane_hits += 1
+        body = await request.json()
+        state.last_headers = dict(request.headers)
+        raw_input = body.get("input")
+        inputs = [raw_input] if isinstance(raw_input, str) else raw_input
+        if not isinstance(inputs, list) or not all(
+            isinstance(x, str) for x in inputs
+        ) or not inputs:
+            return web.json_response(
+                {"error": {"message": "'input' must be a string or list of "
+                           "strings", "type": "invalid_request_error"}},
+                status=400,
+            )
+        err = _encode_gate(request, inputs)
+        if err is not None:
+            return err
+        state.total_requests += 1
+        vectors = await _encode_batch(inputs)
+        total_tokens = sum(max(1, len(t) // 4) for t in inputs)
+        state.total_prompt_tokens += total_tokens
+        return web.json_response({
+            "object": "list",
+            "data": [
+                {"object": "embedding", "index": i, "embedding": vec}
+                for i, vec in enumerate(vectors)
+            ],
+            "model": body.get("model", state.model),
+            "usage": {"prompt_tokens": total_tokens,
+                      "total_tokens": total_tokens},
+        })
+
+    async def rerank(request: web.Request) -> web.Response:
+        state.data_plane_hits += 1
+        body = await request.json()
+        state.last_headers = dict(request.headers)
+        query, documents = body.get("query"), body.get("documents")
+        if not isinstance(query, str) or not isinstance(documents, list):
+            return web.json_response(
+                {"error": {"message": "'query' must be a string and "
+                           "'documents' a list of strings",
+                           "type": "invalid_request_error"}},
+                status=400,
+            )
+        err = _encode_gate(request, [query] + documents)
+        if err is not None:
+            return err
+        state.total_requests += 1
+        vectors = await _encode_batch([query] + documents)
+        qvec, dvecs = vectors[0], vectors[1:]
+        results = [
+            {"index": i, "document": {"text": documents[i]},
+             "relevance_score": sum(a * b for a, b in zip(qvec, dvec))}
+            for i, dvec in enumerate(dvecs)
+        ]
+        results.sort(key=lambda r: r["relevance_score"], reverse=True)
+        top_n = body.get("top_n")
+        if top_n is not None:
+            results = results[:top_n]
+        total_tokens = sum(
+            max(1, len(t) // 4) for t in [query] + documents
+        )
+        return web.json_response({
+            # Deterministic id (hash of the inputs, not a uuid) so a
+            # cached rerank answer is byte-identical to a fresh one.
+            "id": "rerank-" + hashlib.blake2b(
+                json.dumps([query, documents], sort_keys=True).encode(),
+                digest_size=8,
+            ).hexdigest(),
+            "model": body.get("model", state.model),
+            "usage": {"prompt_tokens": total_tokens,
+                      "total_tokens": total_tokens},
+            "results": results,
+        })
+
+    async def score(request: web.Request) -> web.Response:
+        state.data_plane_hits += 1
+        body = await request.json()
+        state.last_headers = dict(request.headers)
+
+        def as_list(v):
+            if isinstance(v, str):
+                return [v]
+            return v if isinstance(v, list) else None
+
+        t1, t2 = as_list(body.get("text_1")), as_list(body.get("text_2"))
+        if t1 is None or t2 is None or not t1 or not t2:
+            return web.json_response(
+                {"error": {"message": "'text_1' and 'text_2' must be "
+                           "non-empty strings or lists of strings",
+                           "type": "invalid_request_error"}},
+                status=400,
+            )
+        if len(t1) == 1:
+            t1 = t1 * len(t2)
+        distinct = list(dict.fromkeys(t1 + t2))
+        err = _encode_gate(request, distinct)
+        if err is not None:
+            return err
+        state.total_requests += 1
+        vectors = await _encode_batch(distinct)
+        by_text = dict(zip(distinct, vectors))
+        data = [
+            {"object": "score", "index": i,
+             "score": sum(x * y for x, y in zip(by_text[a], by_text[b]))}
+            for i, (a, b) in enumerate(zip(t1, t2))
+        ]
+        total_tokens = sum(
+            max(1, len(a) // 4) + max(1, len(b) // 4)
+            for a, b in zip(t1, t2)
+        )
+        return web.json_response({
+            "object": "list",
+            "data": data,
+            "model": body.get("model", state.model),
+            "usage": {"prompt_tokens": total_tokens,
+                      "total_tokens": total_tokens},
+        })
+
     app.router.add_get("/v1/models", models)
     app.router.add_get("/health", health)
     app.router.add_get("/ready", ready)
@@ -1003,6 +1228,11 @@ def build_fake_engine_app(state: FakeEngineState | None = None) -> web.Applicati
     app.router.add_get("/debug/compiles", debug_compiles)
     app.router.add_post("/v1/chat/completions", chat_completions)
     app.router.add_post("/v1/completions", completions)
+    app.router.add_post("/v1/embeddings", embeddings)
+    app.router.add_post("/v1/rerank", rerank)
+    app.router.add_post("/rerank", rerank)
+    app.router.add_post("/v1/score", score)
+    app.router.add_post("/score", score)
     return app
 
 
@@ -1071,10 +1301,11 @@ def main(argv=None) -> None:
     parser.add_argument(
         "--disagg-role",
         default=None,
-        choices=["prefill", "decode", "both"],
+        choices=["prefill", "decode", "both", "encode"],
         help="emulate a disagg role pool member: prefill serves prime "
         "calls and records exports; decode honors handoff tokens with a "
-        "simulated prefetch hit (TTFT skipped) or miss",
+        "simulated prefetch hit (TTFT skipped) or miss; encode marks a "
+        "dedicated embed/rerank/score pool member",
     )
     args = parser.parse_args(argv)
     state = FakeEngineState(

@@ -428,6 +428,45 @@ class FleetHarness:
                     chunks=chunks, itl_p95=p95, phase=phase)
         )
 
+    async def one_embed_request(
+        self, *, phase: str = "replay", texts: Optional[List[str]] = None,
+        repeat_pool: int = 0,
+    ) -> Outcome:
+        """One /v1/embeddings request through the router's encode lane,
+        classified with the same Outcome vocabulary as generation.
+        ``repeat_pool`` > 0 draws inputs from a small fixed pool (the
+        repeat-heavy trace the semantic cache exists for) instead of
+        unique probe strings."""
+        arrived = self.now()
+        if texts is None:
+            if repeat_pool > 0:
+                texts = [f"embed corpus doc {self.rng.randrange(repeat_pool)}"]
+            else:
+                texts = [f"embed probe {self.rng.random():.8f}"]
+        status = 0
+        try:
+            resp = await self.client.post(
+                "/v1/embeddings", json={"model": MODEL, "input": texts}
+            )
+            status = resp.status
+            payload = await resp.read()
+        except Exception:
+            return self._record(
+                Outcome(arrived, self.now(), "error", status=status,
+                        phase=phase)
+            )
+        if status != 200:
+            kind = self._classify_reject(status, payload)
+            return self._record(
+                Outcome(arrived, self.now(), kind, status=status, phase=phase)
+            )
+        data = json.loads(payload).get("data", [])
+        kind = "completed" if len(data) == len(texts) else "error"
+        return self._record(
+            Outcome(arrived, self.now(), kind, status=status,
+                    chunks=len(data), phase=phase)
+        )
+
     @staticmethod
     def _classify_reject(status: int, payload: bytes) -> str:
         if status != 429:
@@ -460,10 +499,17 @@ class FleetHarness:
         events: Optional[List[Tuple[float, Callable]]] = None,
         phase: str = "replay",
         low_priority_frac: float = 0.0,
+        embed_frac: float = 0.0,
+        embed_repeat_pool: int = 0,
     ) -> None:
         """Seeded diurnal replay.  ``events`` is a list of
         ``(replay_t, async_callable)`` fired in order as the replay
-        clock passes each time (scale events, fault injections)."""
+        clock passes each time (scale events, fault injections).
+        ``embed_frac`` sends that fraction of arrivals down the encode
+        lane (/v1/embeddings) instead of chat — the mixed
+        generation+embed workload the per-lane admission contract is
+        about; ``embed_repeat_pool`` makes the embed side repeat-heavy
+        (semantic-cache fodder)."""
         events = sorted(events or [], key=lambda e: e[0])
         tasks: List[asyncio.Task] = []
         t_start = self.now()
@@ -484,17 +530,19 @@ class FleetHarness:
                 await events[next_event][1]()
                 next_event += 1
             if t >= t_next_arrival:
-                priority = (
-                    1
-                    if low_priority_frac
-                    and self.rng.random() < low_priority_frac
-                    else None
-                )
-                tasks.append(
-                    asyncio.ensure_future(
-                        self.one_request(phase=phase, priority=priority)
+                if embed_frac and self.rng.random() < embed_frac:
+                    coro = self.one_embed_request(
+                        phase=phase, repeat_pool=embed_repeat_pool
                     )
-                )
+                else:
+                    priority = (
+                        1
+                        if low_priority_frac
+                        and self.rng.random() < low_priority_frac
+                        else None
+                    )
+                    coro = self.one_request(phase=phase, priority=priority)
+                tasks.append(asyncio.ensure_future(coro))
                 rate = self.qps_at(t, duration_s, base_qps, peak_qps)
                 t_next_arrival = t + (
                     self.rng.expovariate(rate) if rate > 0 else duration_s

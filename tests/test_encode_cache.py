@@ -1,0 +1,220 @@
+"""Encode-lane semantic cache units (router/encode_cache.py; docs/router.md
+"Encode lanes & semantic cache") — pure, no jax, no sockets:
+
+* chunk_chain_key covers every byte (partial-tail sensitivity the PR-13
+  routing chain deliberately lacks);
+* request_key per path: input normalization, aux-field folding, rerank's
+  (exact, docs_key, query) triple, score side-boundary sensitivity;
+* exact tier: verbatim bytes, TTL evict-on-touch, byte-budget LRU,
+  oversized-entry skip;
+* similarity tier: cosine threshold, docs_key join, has_docs_key pre-gate;
+* ChainedProxyHooks composition (first pre_route wins, stores fan out).
+"""
+
+import asyncio
+
+import pytest
+
+from production_stack_tpu.router.encode_cache import (
+    ChainedProxyHooks,
+    EncodeCache,
+    chunk_chain_key,
+)
+
+
+def make_cache(**kw):
+    defaults = dict(max_bytes=4096, ttl_s=100.0, chunk_chars=8,
+                    clock=lambda: 0.0)
+    defaults.update(kw)
+    return EncodeCache(**defaults)
+
+
+# -- key primitive -----------------------------------------------------------
+
+
+def test_chunk_chain_key_covers_partial_tail():
+    # Differ only in the tail PAST the last full chunk: the routing
+    # chain (full chunks only) would collide these; the cache key must
+    # not — "abc" and "abcd" are different requests.
+    assert chunk_chain_key("abcdefgh" + "xy", 8) != \
+        chunk_chain_key("abcdefgh" + "xz", 8)
+    assert chunk_chain_key("abc", 8) != chunk_chain_key("abcd", 8)
+    # Deterministic, and chunking is an implementation detail of equal
+    # texts (same text, same key).
+    assert chunk_chain_key("same text", 8) == chunk_chain_key("same text", 8)
+    assert chunk_chain_key("", 8) == chunk_chain_key("", 8)
+
+
+def test_request_key_embeddings_normalizes_and_folds_aux():
+    c = make_cache()
+    # A bare-string input and its single-element list form are the SAME
+    # request (the engine treats them identically).
+    one = c.request_key("/v1/embeddings", {"model": "m", "input": "hello"})
+    lst = c.request_key("/v1/embeddings", {"model": "m", "input": ["hello"]})
+    assert one == lst and one[0] and one[1] is None and one[2] is None
+    # Any non-input field changes the answer shape -> changes the key.
+    fmt = c.request_key(
+        "/v1/embeddings",
+        {"model": "m", "input": "hello", "encoding_format": "base64"},
+    )
+    assert fmt[0] != one[0]
+    assert c.request_key(
+        "/v1/embeddings", {"model": "other", "input": "hello"}
+    )[0] != one[0]
+    # Order matters (indices are positional in the response).
+    ab = c.request_key("/v1/embeddings", {"model": "m", "input": ["a", "b"]})
+    ba = c.request_key("/v1/embeddings", {"model": "m", "input": ["b", "a"]})
+    assert ab[0] != ba[0]
+    # Non-text inputs (token-id arrays) are uncacheable, not mis-keyed.
+    assert c.request_key("/v1/embeddings", {"model": "m", "input": 42}) is None
+    assert c.request_key(
+        "/v1/embeddings", {"model": "m", "input": [[1, 2, 3]]}
+    ) is None
+
+
+def test_request_key_rerank_docs_key_survives_query_drift():
+    c = make_cache()
+    k1 = c.request_key(
+        "/v1/rerank", {"model": "m", "query": "q one", "documents": ["a", "b"]}
+    )
+    k2 = c.request_key(
+        "/v1/rerank", {"model": "m", "query": "q two", "documents": ["a", "b"]}
+    )
+    # Same corpus, drifted query: exact keys differ, docs_key joins them
+    # (the similarity tier's index), and the query text rides along.
+    assert k1[0] != k2[0]
+    assert k1[1] == k2[1] is not None
+    assert (k1[2], k2[2]) == ("q one", "q two")
+    # A different corpus breaks the join.
+    k3 = c.request_key(
+        "/v1/rerank", {"model": "m", "query": "q one", "documents": ["a", "c"]}
+    )
+    assert k3[1] != k1[1]
+    # top_n changes the response -> aux-folded into BOTH keys.
+    k4 = c.request_key(
+        "/v1/rerank",
+        {"model": "m", "query": "q one", "documents": ["a", "b"], "top_n": 1},
+    )
+    assert k4[0] != k1[0] and k4[1] != k1[1]
+    # /rerank is the same surface as /v1/rerank.
+    assert c.request_key(
+        "/rerank", {"model": "m", "query": "q one", "documents": ["a", "b"]}
+    ) == k1
+
+
+def test_request_key_score_is_side_boundary_sensitive():
+    c = make_cache()
+    a = c.request_key(
+        "/v1/score", {"model": "m", "text_1": "x", "text_2": ["y", "z"]}
+    )
+    # Same flat text multiset, different side split: different requests.
+    b = c.request_key(
+        "/v1/score", {"model": "m", "text_1": ["x", "y"], "text_2": "z"}
+    )
+    assert a is not None and b is not None and a[0] != b[0]
+    assert a[1] is None and a[2] is None  # no similarity join for score
+    # Unknown paths are not cacheable.
+    assert c.request_key("/v1/chat/completions", {"model": "m"}) is None
+
+
+# -- exact tier --------------------------------------------------------------
+
+
+def test_exact_tier_verbatim_ttl_and_lru_budget():
+    clock = [0.0]
+    c = make_cache(max_bytes=130, ttl_s=10.0, clock=lambda: clock[0])
+    body = b'{"object":"list","data":[1,2,3]}'
+    c.store("k1", body)
+    assert c.lookup("k1") == body  # verbatim bytes, not a re-serialization
+    assert (c.hits, c.misses) == (1, 0)
+    # TTL is evict-on-touch: expired entries miss AND leave the cache.
+    clock[0] = 10.1
+    assert c.lookup("k1") is None
+    assert c.size == 0 and c.resident_bytes == 0
+    assert c.misses == 1
+    # Byte-budget LRU: filling past max_bytes evicts oldest-first;
+    # a lookup refreshes recency.
+    clock[0] = 20.0
+    c.store("a", b"x" * 60)
+    c.store("b", b"y" * 60)
+    c.lookup("a")  # a is now most-recent
+    c.store("c", b"z" * 60)  # budget 130: must evict b (LRU), not a
+    assert c.lookup("a") is not None
+    assert c.lookup("b") is None
+    assert c.resident_bytes <= 130
+    # An entry larger than the whole budget is skipped, not thrashed in.
+    before = c.size
+    c.store("huge", b"w" * 500)
+    assert c.size == before and c.lookup("huge") is None
+
+
+def test_similarity_tier_threshold_and_docs_key_join():
+    c = make_cache(similarity_threshold=0.9)
+    c.store("r1", b"ranking-one", docs_key="D", query_vector=[1.0, 0.0])
+    c.store("r2", b"ranking-two", docs_key="D", query_vector=[0.0, 1.0])
+    c.store("r3", b"other-corpus", docs_key="E", query_vector=[1.0, 0.0])
+    assert c.has_docs_key("D") and not c.has_docs_key("Z")
+    # Near-duplicate of r1's query: best match above threshold wins.
+    assert c.similar_lookup("D", [0.99, 0.14]) == b"ranking-one"
+    assert c.similar_hits == 1
+    # Below threshold: no hit (cos 45deg ~= 0.707 < 0.9).
+    assert c.similar_lookup("D", [0.707, 0.707]) is None
+    # The join is per-corpus: r3's identical query vector under docs_key
+    # "E" never answers a "D" request.
+    assert c.similar_lookup("D", [1.0, 0.0]) == b"ranking-one"
+    # Threshold 0 keeps the tier inert even with stored vectors.
+    c0 = make_cache(similarity_threshold=0.0)
+    c0.store("r", b"body", docs_key="D", query_vector=[1.0, 0.0])
+    assert c0.similar_lookup("D", [1.0, 0.0]) is None
+
+
+def test_cache_rejects_invalid_construction():
+    with pytest.raises(ValueError):
+        EncodeCache(max_bytes=0)
+    with pytest.raises(ValueError):
+        EncodeCache(max_bytes=10, ttl_s=0)
+    with pytest.raises(ValueError):
+        EncodeCache(max_bytes=10, similarity_threshold=1.5)
+
+
+# -- hook composition --------------------------------------------------------
+
+
+class _StubHooks:
+    def __init__(self, name, pre=None, log=None):
+        self.name, self.pre, self.log = name, pre, log if log is not None else []
+
+    async def pre_route(self, request, path):
+        self.log.append(("pre", self.name))
+        return self.pre
+
+    def post_response_hook(self, request, path):
+        async def store(body_json, response_bytes):
+            self.log.append(("store", self.name, response_bytes))
+
+        return store
+
+
+def test_chained_hooks_first_preroute_wins_and_stores_fan_out():
+    log = []
+    short = object()  # any non-None short-circuits
+    a = _StubHooks("a", pre=None, log=log)
+    b = _StubHooks("b", pre=short, log=log)
+    c = _StubHooks("c", pre=None, log=log)
+    chain = ChainedProxyHooks(a, None, b, c)
+
+    async def run():
+        got = await chain.pre_route({}, "/v1/embeddings")
+        assert got is short
+        # b short-circuited: c's pre_route never ran.
+        assert log == [("pre", "a"), ("pre", "b")]
+        log.clear()
+        store = chain.post_response_hook({}, "/v1/embeddings")
+        await store({}, b"bytes")
+        assert log == [
+            ("store", "a", b"bytes"),
+            ("store", "b", b"bytes"),
+            ("store", "c", b"bytes"),
+        ]
+
+    asyncio.run(run())
